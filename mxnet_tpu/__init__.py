@@ -1,0 +1,67 @@
+"""mxnet_tpu — a TPU-native deep learning framework with the capabilities of
+Apache MXNet 1.x, built from scratch on JAX/XLA/pjit/Pallas.
+
+This is NOT a port of the reference C++/CUDA codebase: the compute path is
+jax.jit-compiled XLA programs, device placement is jax.sharding over a Mesh,
+and distributed communication is XLA collectives (psum/all_gather/ppermute)
+over ICI/DCN instead of NCCL/ps-lite.
+
+Public surface mirrors the reference (`python/mxnet/__init__.py`):
+  mx.nd / mx.ndarray     imperative tensor ops (async via XLA dispatch)
+  mx.sym / mx.symbol     lazy symbolic graphs, jit-compiled on bind
+  mx.autograd            imperative tape -> jax.vjp backward
+  mx.gluon               Block/HybridBlock/Parameter/Trainer + layers
+  mx.mod / mx.module     Module training API (fit/bind/forward/backward)
+  mx.kvstore / mx.kv     collective-backed parameter store
+  mx.optimizer, mx.metric, mx.initializer, mx.lr_scheduler, mx.io, mx.image
+  mx.context: cpu()/gpu()/tpu() device handles (gpu aliases tpu)
+"""
+
+__version__ = "0.1.0"
+
+from . import base
+from .base import MXNetError
+from . import context
+from .context import Context, cpu, gpu, tpu, current_context, num_gpus
+from . import engine
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+from . import autograd
+from . import random
+from .random import seed
+from . import executor
+from . import initializer
+from .initializer import init
+from . import optimizer
+from . import lr_scheduler
+from . import metric
+from . import kvstore
+from . import kvstore as kv
+from . import io
+from . import recordio
+from . import image
+from . import gluon
+from . import model
+from .model import FeedForward
+from . import module
+from . import module as mod
+from . import callback
+from . import monitor
+from . import profiler
+from . import visualization
+from .visualization import print_summary
+from . import parallel
+from . import models
+from . import utils
+from . import test_utils
+from . import attribute
+from .attribute import AttrScope
+from . import name
+from .name import NameManager
+from . import operator
+from .operator import CustomOp, CustomOpProp
+from . import rtc

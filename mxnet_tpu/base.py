@@ -1,0 +1,72 @@
+"""Base utilities: errors, dtype handling, string/registry helpers.
+
+Capability parity with the reference's `python/mxnet/base.py` (error type,
+registry glue) and dmlc-core's logging/param machinery, redesigned for a
+pure-Python + JAX stack (no C ABI marshalling needed).
+"""
+from __future__ import annotations
+
+import os
+import numpy as np
+
+import jax.numpy as jnp
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (parity: reference MXNetError)."""
+
+
+# ---------------------------------------------------------------------------
+# dtype registry (parity: mshadow type codes used across the reference C ABI)
+# ---------------------------------------------------------------------------
+_DTYPE_NP_TO_CODE = {
+    np.dtype(np.float32): 0,
+    np.dtype(np.float64): 1,
+    np.dtype(np.float16): 2,
+    np.dtype(np.uint8): 3,
+    np.dtype(np.int32): 4,
+    np.dtype(np.int8): 5,
+    np.dtype(np.int64): 6,
+    jnp.bfloat16.dtype: 7,
+    np.dtype(np.bool_): 8,
+}
+_DTYPE_CODE_TO_NP = {v: k for k, v in _DTYPE_NP_TO_CODE.items()}
+
+
+def dtype_np(dtype):
+    """Normalize a user dtype spec (str/np.dtype/jnp dtype) to a numpy dtype."""
+    if dtype is None:
+        return np.dtype(np.float32)
+    if dtype == "bfloat16" or dtype is jnp.bfloat16:
+        return jnp.bfloat16.dtype
+    return np.dtype(dtype)
+
+
+def default_dtype():
+    return np.dtype(np.float32)
+
+
+def getenv_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def getenv_bool(name, default=False):
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v not in ("0", "false", "False", "")
+
+
+def check_call(ret):  # parity shim: no C ABI, nothing to check
+    return ret
+
+
+class classproperty:
+    def __init__(self, f):
+        self.f = f
+
+    def __get__(self, obj, owner):
+        return self.f(owner)

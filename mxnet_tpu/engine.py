@@ -1,0 +1,60 @@
+"""Execution engine surface.
+
+Parity: reference `src/engine/` — the threaded dependency engine
+(`include/mxnet/engine.h:96-295`) that topologically dispatches op closures
+when their read/write vars clear, giving async execution and compute/comm
+overlap.
+
+TPU-native redesign: XLA's async dispatch IS the engine. Every jnp/lax call
+returns immediately with a future-backed buffer; data dependencies are
+tracked by the runtime; `wait_to_read`/`waitall` are the synchronization
+points; donation replaces in-place write scheduling; streams/priorities are
+XLA's concern. This module keeps the reference's *API surface* (bulk scopes,
+engine-type query, WaitAll) as thin shims so user code ports cleanly, and
+documents the ordering guarantees:
+  - ops on the same buffers execute in program order (functional dataflow);
+  - host reads (asnumpy/asscalar/wait_to_read) block until ready;
+  - exceptions surface at the blocking read, like the reference's
+    propagation to WaitForVar (threaded_engine.cc:361-369).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+import jax
+
+
+def current_engine_type():
+    """Parity: MXNET_ENGINE_TYPE (src/engine/engine.cc:32-58). 'XLAAsync' is
+    the only engine; 'Naive' semantics (fully synchronous, for debugging) can
+    be requested via MXNET_ENGINE_TYPE=NaiveEngine which makes every op block."""
+    return os.environ.get("MXNET_ENGINE_TYPE", "XLAAsync")
+
+
+_naive = current_engine_type() == "NaiveEngine"
+
+
+def maybe_sync(data):
+    """Called by the invoke path when Naive (sync) mode is requested."""
+    if _naive and hasattr(data, "block_until_ready"):
+        data.block_until_ready()
+    return data
+
+
+def wait_all():
+    """Parity: Engine::WaitForAll / mx.nd.waitall."""
+    for d in jax.live_arrays():
+        d.block_until_ready()
+
+
+@contextlib.contextmanager
+def bulk(size):
+    """Parity: engine bulk scope (threaded_engine.h:398-472). XLA fuses
+    adjacent ops automatically under jit; eager ops are already batched by
+    async dispatch, so this is a no-op scope kept for API compatibility."""
+    yield
+
+
+def set_bulk_size(size):
+    return size

@@ -1,0 +1,13 @@
+"""Gluon: the imperative high-level API (parity: python/mxnet/gluon/)."""
+from .parameter import Parameter, ParameterDict, Constant, \
+    DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import rnn
+from . import loss
+from . import data
+from . import utils
+from . import model_zoo
+from . import contrib
+from .utils import split_and_load, split_data

@@ -1,0 +1,446 @@
+"""Gluon Block / HybridBlock / SymbolBlock.
+
+Parity: reference `python/mxnet/gluon/block.py:124,429,653` — Block (eager),
+HybridBlock (hybridize -> _build_cache -> CachedOp, block.py:480-513),
+SymbolBlock (wrap a Symbol as a Block).
+
+TPU-native redesign: `hybridize()` IS `jax.jit`. The first hybridized call
+traces the block's eager forward with tracer-backed NDArrays and compiles one
+XLA program per (input shapes/dtypes, train-mode) key — the shape-keyed
+re-specialization of CachedOp (`src/imperative/cached_op.cc:209,263`) is
+jax.jit's native cache. Parameter mutations during forward (BatchNorm
+running stats) are detected at trace time and threaded functionally as extra
+outputs. The compiled call is recorded on the autograd tape as a single
+node, so `loss.backward()` differentiates *through the compiled program*
+(jax.vjp of the jitted fn) — the analog of CachedOp::Backward
+(`cached_op.cc:480`).
+"""
+from __future__ import annotations
+
+import re
+import threading
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from .. import ndarray as nd_mod
+from .. import autograd
+from .. import random as _random
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+
+class _BlockScope:
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+        self._name_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                from .. import name as name_mod
+                prefix = name_mod.current().get(None, hint) + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            prefix = "%s%d_" % (hint, count)
+            current._counter[hint] = count + 1
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        from .. import name as name_mod
+        self._name_scope = name_mod.Prefix(self._block.prefix)
+        self._name_scope.__enter__()
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        if self._block._empty_prefix:
+            return
+        self._name_scope.__exit__(ptype, value, trace)
+        self._name_scope = None
+        _BlockScope._current.value = self._old_scope
+
+
+class Block:
+    """Base class for all layers and models (parity: gluon/block.py:124)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = {}
+        self._reg_params = {}
+        self._forward_hooks = {}
+        self._forward_pre_hooks = {}
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join("  ({key}): {block}".format(
+            key=key, block=_indent(repr(block), 2))
+            for key, block in self._children.items())
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    def __setattr__(self, name, value):
+        if hasattr(self, name):
+            existing = getattr(self, name)
+            if isinstance(existing, (Parameter, Block)) and \
+                    not isinstance(value, type(existing)):
+                raise TypeError("Changing attribute type for {name} from "
+                                "{type1} to {type2} is not allowed.".format(
+                                    name=name, type1=type(existing),
+                                    type2=type(value)))
+        if isinstance(value, Block):
+            self.register_child(value, name)
+        elif isinstance(value, Parameter):
+            assert name not in self._reg_params or \
+                self._reg_params[name] is value, \
+                "Overriding Parameter attribute %s is not allowed." % name
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self, select=None):
+        ret = ParameterDict(self._params.prefix)
+        if not select:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({name: value for name, value in self.params.items()
+                        if pattern.match(name)})
+        for child in self._children.values():
+            ret.update(child.collect_params(select=select))
+        return ret
+
+    def register_child(self, block, name=None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    def register_forward_hook(self, hook):
+        handle = len(self._forward_hooks)
+        self._forward_hooks[handle] = hook
+        return handle
+
+    def register_forward_pre_hook(self, hook):
+        handle = len(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle] = hook
+        return handle
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    # -- persistence (parity: save_params/load_params block.py:308,318) ----
+    def save_params(self, filename):
+        self.collect_params().save(filename, strip_prefix=self.prefix)
+
+    save_parameters = save_params
+
+    def load_params(self, filename, ctx=None, allow_missing=False,
+                    ignore_extra=False):
+        self.collect_params().load(filename, ctx, allow_missing, ignore_extra,
+                                   self.prefix)
+
+    load_parameters = load_params
+
+    def __call__(self, *args):
+        for hook in self._forward_pre_hooks.values():
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks.values():
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        from ..visualization import block_summary
+        return block_summary(self, *inputs)
+
+
+def _indent(s_, num_spaces):
+    lines = s_.split("\n")
+    first = lines.pop(0)
+    return first + ("\n" + " " * num_spaces).join([""] + lines) \
+        if lines else first
+
+
+class HybridBlock(Block):
+    """Block that can be traced+compiled (parity: gluon/block.py:429)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_fn = None
+        self._flags = {}
+
+    def hybridize(self, active=True, **kwargs):
+        self._active = active
+        self._flags = kwargs
+        self._cached_fn = None  # invalidate compile cache
+        super().hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        self._cached_fn = None
+        super().cast(dtype)
+
+    def infer_shape(self, *args):
+        """Finish deferred parameter init by probing with the given inputs."""
+        self._deferred_infer(args)
+
+    def _deferred_infer(self, args):
+        # run one abstract forward with eval_shape to trigger deferred inits
+        try:
+            self.forward(*args)
+        except DeferredInitializationError:
+            raise
+
+    def forward(self, x, *args):
+        """Dispatch to hybrid_forward with the nd namespace + param arrays."""
+        try:
+            params = {name: p.data() for name, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self._finish_deferred_init(x, *args)
+            params = {name: p.data() for name, p in self._reg_params.items()}
+        return self.hybrid_forward(nd_mod, x, *args, **params)
+
+    def _finish_deferred_init(self, *args):
+        """Infer missing param shapes from input shapes via the layer's
+        shape rule (each layer overrides _infer_param_shapes) or eval_shape."""
+        self._shape_probe(*args)
+        for p in self._reg_params.values():
+            if p._deferred_init:
+                raise DeferredInitializationError(
+                    "Could not infer shape for %s" % p.name)
+
+    def _shape_probe(self, x, *args):
+        # default: layers override; composite blocks never hit this because
+        # their children handle their own params
+        raise DeferredInitializationError(
+            "%s has uninitialized parameters and no shape rule" % self.name)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    # -- the jit seam -------------------------------------------------------
+    def __call__(self, *args):
+        if self._active:
+            try:
+                return self._call_cached(*args)
+            except DeferredInitializationError:
+                # first call with deferred params: run eagerly once to infer
+                return super().__call__(*args)
+        return super().__call__(*args)
+
+    def _collect_all_params(self):
+        params = self.collect_params()
+        names = list(params.keys())
+        return names, [params[n] for n in names]
+
+    def _build_cache(self):
+        """Compile the forward (parity: _build_cache block.py:480)."""
+        names, plist = self._collect_all_params()
+        for p in plist:
+            if p._data is None:
+                raise DeferredInitializationError(
+                    "hybridize: parameter %s not initialized" % p.name)
+        block = self
+
+        def pure_fn(param_vals, input_vals, key, train):
+            # rebind parameter buffers to tracers, run the eager forward,
+            # harvest outputs + mutated params (functional aux threading)
+            saved = [(p._data._data, p._data._entry) for p in plist]
+            injected = []
+            try:
+                for p, v in zip(plist, param_vals):
+                    p._data._data = v
+                    p._data._entry = None
+                    injected.append(v)
+                ins = [NDArray(v) for v in input_vals]
+                with autograd._RecordingStateScope(False, train), \
+                        _random.trace_key_scope(key):
+                    out = block.forward(*ins)
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                out_vals = tuple(o._data for o in outs)
+                updates = {}
+                for i, p in enumerate(plist):
+                    if p._data._data is not injected[i]:
+                        updates[i] = p._data._data
+                return out_vals, updates
+            finally:
+                for p, (d, e) in zip(plist, saved):
+                    p._data._data = d
+                    p._data._entry = e
+
+        grad_idx = [i for i, p in enumerate(plist) if p.grad_req != "null"]
+
+        def bwd_impl(tensors, nograd_snapshot, key, out_cts, train):
+            """vjp of the (unjitted) trace, itself jit-compiled — the
+            CachedOp::Backward program. (vjp over an already-jitted fn can't
+            linearize reduce_window et al., so we vjp the raw trace.)"""
+            n_in = len(tensors) - len(grad_idx)
+
+            def g(*ts):
+                ins = ts[:n_in]
+                gvals = ts[n_in:]
+                full = list(nograd_snapshot)
+                for j, i in enumerate(grad_idx):
+                    full[i] = gvals[j]
+                out_vals, _ = pure_fn(tuple(full), tuple(ins), key, train)
+                return out_vals
+
+            _, vjp_fn = jax.vjp(g, *tensors)
+            return vjp_fn(tuple(out_cts))
+
+        self._cached_fn = (names, plist,
+                           jax.jit(pure_fn, static_argnames=("train",)),
+                           jax.jit(bwd_impl, static_argnames=("train",)),
+                           grad_idx)
+
+    def _call_cached(self, *args):
+        if self._cached_fn is None:
+            self._build_cache()
+        names, plist, fn, bwd, grad_idx = self._cached_fn
+        in_vals = tuple(a._data if isinstance(a, NDArray) else jnp.asarray(a)
+                        for a in args)
+        param_vals = tuple(p._data._data for p in plist)
+        key = _random.next_key()
+        train = autograd.is_training()
+        out_vals, updates = fn(param_vals, in_vals, key, train=train)
+        for i, v in updates.items():
+            plist[i]._data._data = v
+            plist[i]._data._version += 1
+        outs = [NDArray(v) for v in out_vals]
+        needs_grad = bool(grad_idx) or any(
+            getattr(a, "_entry", None) is not None for a in args)
+        if autograd.is_recording() and needs_grad:
+            snapshot = param_vals
+
+            def custom_backward(out_grads, input_vals, kwargs):
+                gins = bwd(tuple(input_vals), snapshot, key,
+                           tuple(out_grads), train=train)
+                return list(gins)
+
+            class _OpDef:
+                fn = None
+                differentiable = True
+                name = "CachedOp"
+
+            # keep positions aligned with `vals`: non-NDArray args contribute
+            # a None parent entry but still occupy a cotangent slot
+            nd_inputs = list(args) + [plist[i]._data for i in grad_idx]
+            vals = list(in_vals) + [param_vals[i] for i in grad_idx]
+            autograd.record_op(_OpDef, nd_inputs, vals, outs, {},
+                               custom_backward=custom_backward)
+        if len(outs) == 1:
+            return outs[0]
+        return tuple(outs)
+
+    def export(self, path, epoch=0):
+        """Save params for deployment (parity: HybridBlock.export). The graph
+        itself is recompiled from code at load; params use the standard
+        container."""
+        self.collect_params().save("%s-%04d.params" % (path, epoch))
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap a Symbol + params into a Block (parity: gluon/block.py:653)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix=None, params=params)
+        from ..symbol import Symbol, Group
+        if isinstance(outputs, (list, tuple)):
+            outputs = Group(outputs)
+        if isinstance(inputs, Symbol):
+            inputs = [inputs]
+        self._symbol = outputs
+        self._input_names = [i.name for i in inputs]
+        arg_names = outputs.list_arguments()
+        aux_names = outputs.list_auxiliary_states()
+        for name in arg_names + aux_names:
+            if name not in self._input_names:
+                self.params.get(name, allow_deferred_init=True,
+                                grad_req="null" if name in aux_names
+                                else "write")
+
+    def forward(self, *args):
+        values = {}
+        for name, a in zip(self._input_names, args):
+            values[name] = a._data
+        for name, p in self.params.items():
+            if p._data is not None:
+                values[name] = p.data()._data
+        outs, _ = self._symbol._eval(values, train=autograd.is_training())
+        outs = [NDArray(o) for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        from .. import symbol as sym_mod
+        s = sym_mod.load(symbol_file)
+        inputs = [sym_mod.Variable(n) for n in
+                  ([input_names] if isinstance(input_names, str)
+                   else input_names)]
+        block = SymbolBlock(s, inputs)
+        if param_file:
+            block.collect_params().load(param_file, ctx=ctx,
+                                        ignore_extra=True, allow_missing=True)
+        return block

@@ -1,0 +1,4 @@
+"""Gluon contrib (parity: python/mxnet/gluon/contrib/ — concurrent
+containers and experimental rnn cells)."""
+from .nn import Concurrent, HybridConcurrent, Identity
+from . import rnn

@@ -1,0 +1,48 @@
+"""Contrib RNN cells (parity: gluon/contrib/rnn/ — VariationalDropoutCell,
+Conv*Cell are niche; VariationalDropoutCell provided)."""
+from ..rnn.rnn_cell import ModifierCell, BidirectionalCell
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Same dropout mask reused across time steps (Gal & Ghahramani)."""
+
+    def __init__(self, base_cell, drop_inputs=0., drop_states=0.,
+                 drop_outputs=0.):
+        assert not drop_states or not isinstance(base_cell, BidirectionalCell)
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    def _alias(self):
+        return "vardrop"
+
+    def reset(self):
+        super().reset()
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    def _mask(self, F, p, like):
+        return F.Dropout(F.ones_like(like), p=p, mode="always")
+
+    def hybrid_forward(self, F, inputs, states):
+        cell = self.base_cell
+        if self.drop_states:
+            if self._state_mask is None:
+                self._state_mask = self._mask(F, self.drop_states, states[0])
+            states = [states[0] * self._state_mask] + list(states[1:])
+        if self.drop_inputs:
+            if self._input_mask is None:
+                self._input_mask = self._mask(F, self.drop_inputs, inputs)
+            inputs = inputs * self._input_mask
+        next_output, next_states = cell(inputs, states)
+        if self.drop_outputs:
+            if self._output_mask is None:
+                self._output_mask = self._mask(F, self.drop_outputs,
+                                               next_output)
+            next_output = next_output * self._output_mask
+        return next_output, next_states

@@ -1,0 +1,95 @@
+"""DataLoader.
+
+Parity: reference `python/mxnet/gluon/data/dataloader.py:72-94` — batching +
+shuffling + multiprocess workers over POSIX shared memory.
+
+TPU-native redesign: workers use a thread pool by default — batch assembly is
+numpy (releases the GIL) and the expensive device transfer is XLA's async
+host→HBM DMA, so processes+shm buy little; `num_workers>0` therefore maps to
+a prefetching thread pool that keeps the host pipeline ahead of the device
+(the PrefetcherIter capability, iter_prefetcher.h).
+"""
+from __future__ import annotations
+
+import threading
+import queue as _queue
+
+import numpy as np
+
+from ...ndarray import NDArray
+from .sampler import SequentialSampler, RandomSampler, BatchSampler
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (parity: dataloader default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        import jax.numpy as jnp
+        return NDArray(jnp.stack([d._data for d in data]))
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = np.asarray(data)
+    if data.dtype == np.float64:
+        data = data.astype(np.float32)
+    return NDArray(data)
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size must be specified unless "
+                                 "batch_sampler is specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = RandomSampler(len(dataset))
+                else:
+                    sampler = SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must not be specified if sampler "
+                                 "is specified")
+            batch_sampler = BatchSampler(
+                sampler, batch_size, last_batch if last_batch else "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError("batch_size, shuffle, sampler and last_batch "
+                             "must not be specified if batch_sampler is "
+                             "specified.")
+        self._batch_sampler = batch_sampler
+        self._num_workers = num_workers
+        self._prefetch = max(0, int(prefetch) if prefetch is not None
+                             else 2 * num_workers)
+        self._batchify_fn = batchify_fn or default_batchify_fn
+
+    def _make_batch(self, indices):
+        return self._batchify_fn([self._dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for batch in self._batch_sampler:
+                yield self._make_batch(batch)
+            return
+        # prefetching thread pool (double-buffered host pipeline)
+        q = _queue.Queue(maxsize=max(2, self._prefetch))
+        sentinel = object()
+
+        def producer():
+            try:
+                for batch in self._batch_sampler:
+                    q.put(self._make_batch(batch))
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            yield item
+
+    def __len__(self):
+        return len(self._batch_sampler)

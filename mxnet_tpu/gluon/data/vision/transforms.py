@@ -1,0 +1,187 @@
+"""Vision transforms (parity: python/mxnet/gluon/data/vision/transforms.py —
+ToTensor, Normalize, Resize, CenterCrop, RandomResizedCrop, RandomFlip*,
+RandomColorJitter family, Compose, Cast)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...block import Block, HybridBlock
+from ....ndarray import NDArray
+from .... import image as _image
+
+
+class Compose(Block):
+    def __init__(self, transforms):
+        super().__init__()
+        self._transforms = transforms
+
+    def forward(self, x):
+        for t in self._transforms:
+            x = t(x)
+        return x
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.Cast(x, dtype=self._dtype)
+
+
+class ToTensor(Block):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (parity: image_random to_tensor)."""
+
+    def forward(self, x):
+        arr = x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+        arr = arr.astype(np.float32) / 255.0
+        if arr.ndim == 3:
+            arr = arr.transpose(2, 0, 1)
+        elif arr.ndim == 4:
+            arr = arr.transpose(0, 3, 1, 2)
+        return NDArray(arr)
+
+
+class Normalize(Block):
+    def __init__(self, mean, std):
+        super().__init__()
+        self._mean = np.asarray(mean, dtype=np.float32)
+        self._std = np.asarray(std, dtype=np.float32)
+
+    def forward(self, x):
+        arr = x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+        shape = (-1, 1, 1) if arr.ndim == 3 else (1, -1, 1, 1)
+        return NDArray((arr - self._mean.reshape(shape)) /
+                       self._std.reshape(shape))
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+
+    def forward(self, x):
+        return _image.imresize(x, self._size[0], self._size[1])
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+
+    def forward(self, x):
+        return _image.center_crop(x, self._size)[0]
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                 interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        return _image.random_size_crop(x, self._size, self._scale[0],
+                                       self._ratio)[0]
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if np.random.rand() < 0.5:
+            arr = x.asnumpy() if isinstance(x, NDArray) else x
+            return NDArray(arr[:, ::-1].copy())
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if np.random.rand() < 0.5:
+            arr = x.asnumpy() if isinstance(x, NDArray) else x
+            return NDArray(arr[::-1].copy())
+        return x
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._args = (max(0, 1 - brightness), 1 + brightness)
+
+    def forward(self, x):
+        alpha = np.random.uniform(*self._args)
+        arr = x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+        return NDArray(np.clip(arr * alpha, 0, 255).astype(arr.dtype))
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._args = (max(0, 1 - contrast), 1 + contrast)
+
+    def forward(self, x):
+        alpha = np.random.uniform(*self._args)
+        arr = x.asnumpy().astype(np.float32)
+        gray = arr.mean()
+        return NDArray(np.clip(gray + alpha * (arr - gray), 0, 255))
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation):
+        super().__init__()
+        self._args = (max(0, 1 - saturation), 1 + saturation)
+
+    def forward(self, x):
+        alpha = np.random.uniform(*self._args)
+        arr = x.asnumpy().astype(np.float32)
+        gray = arr.mean(axis=-1, keepdims=True)
+        return NDArray(np.clip(gray + alpha * (arr - gray), 0, 255))
+
+
+class RandomHue(Block):
+    def __init__(self, hue):
+        super().__init__()
+        self._hue = hue
+
+    def forward(self, x):
+        # cheap HSV-free approximation: channel-rotation jitter
+        alpha = np.random.uniform(-self._hue, self._hue)
+        arr = x.asnumpy().astype(np.float32)
+        rotated = np.roll(arr, 1, axis=-1)
+        return NDArray(np.clip((1 - abs(alpha)) * arr + abs(alpha) * rotated,
+                               0, 255))
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._transforms = []
+        if brightness:
+            self._transforms.append(RandomBrightness(brightness))
+        if contrast:
+            self._transforms.append(RandomContrast(contrast))
+        if saturation:
+            self._transforms.append(RandomSaturation(saturation))
+        if hue:
+            self._transforms.append(RandomHue(hue))
+
+    def forward(self, x):
+        for t in self._transforms:
+            x = t(x)
+        return x
+
+
+class RandomLighting(Block):
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        alpha = np.random.normal(0, self._alpha, 3)
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        rgb = eigvec @ (alpha * eigval)
+        arr = x.asnumpy().astype(np.float32)
+        return NDArray(np.clip(arr + rgb, 0, 255))

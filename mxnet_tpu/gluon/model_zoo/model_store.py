@@ -1,0 +1,30 @@
+"""Pretrained-model file resolution.
+
+Parity: reference `python/mxnet/gluon/model_zoo/model_store.py` (sha1-keyed
+download cache). No network egress in this environment: files must already
+exist under root (~/.mxnet/models); otherwise an informative error is raised.
+"""
+from __future__ import annotations
+
+import os
+
+_DEFAULT_ROOT = os.path.join("~", ".mxnet", "models")
+
+
+def get_model_file(name, root=_DEFAULT_ROOT):
+    root = os.path.expanduser(root or _DEFAULT_ROOT)
+    file_path = os.path.join(root, name + ".params")
+    if os.path.exists(file_path):
+        return file_path
+    raise IOError(
+        "Pretrained weights %s.params not found under %s and cannot be "
+        "downloaded (no network egress). Train from scratch or place the "
+        "file there." % (name, root))
+
+
+def purge(root=_DEFAULT_ROOT):
+    root = os.path.expanduser(root)
+    if os.path.exists(root):
+        for f in os.listdir(root):
+            if f.endswith(".params"):
+                os.remove(os.path.join(root, f))

@@ -1,0 +1,350 @@
+"""Gluon Parameter / ParameterDict.
+
+Parity: reference `python/mxnet/gluon/parameter.py:43,267,518` (Parameter with
+deferred init + grad_req, ParameterDict with prefix scoping, save/load).
+
+TPU-native redesign: one buffer per parameter (no per-context copies — the
+reference kept one copy per GPU and reduced with KVStore; here multi-device
+means *sharding* the single logical array over the mesh, handled by
+mxnet_tpu.parallel). grad_req wires into the autograd tape via
+mark_variables.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..base import MXNetError, dtype_np
+from ..context import current_context, cpu
+from ..ndarray import NDArray
+from ..ndarray.sparse import RowSparseNDArray
+from .. import autograd
+from .. import initializer as init_mod
+from ..symbol import Variable
+
+
+class DeferredInitializationError(MXNetError):
+    pass
+
+
+class Parameter:
+    def __init__(self, name, grad_req="write", shape=None, dtype=np.float32,
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self.name = name
+        self._grad_req = None
+        if isinstance(shape, int):
+            shape = (shape,)
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._differentiable = differentiable
+        self._stype = stype
+        self._data = None
+        self._grad = None
+        self._deferred_init = ()
+        self._var = None
+        self.grad_req = grad_req if differentiable else "null"
+
+    def __repr__(self):
+        return "Parameter {name} (shape={shape}, dtype={dtype})".format(
+            name=self.name, shape=self.shape, dtype=self.dtype)
+
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        assert req in ("write", "add", "null")
+        if not self._differentiable:
+            req = "null"
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if req == "null":
+            self._grad = None
+            if self._data is not None:
+                self._data._entry = None
+        elif self._data is not None:
+            self._init_grad()
+
+    def _needs_shape(self):
+        return self.shape is None or any(s == 0 for s in self.shape)
+
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        default_init = default_init or init_mod.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if self._needs_shape():
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init)
+                return
+            raise MXNetError(
+                "Cannot initialize Parameter '%s' because it has invalid "
+                "shape: %s." % (self.name, str(self.shape)))
+        self._finish_init(init, ctx, default_init)
+
+    def _finish_init(self, init, ctx, default_init):
+        ctx = ctx if ctx is not None else current_context()
+        if isinstance(ctx, (list, tuple)):
+            ctx = ctx[0]  # one logical buffer; devices = sharding
+        data = NDArray(jnp.zeros(self.shape, dtype=dtype_np(self.dtype)),
+                       ctx=ctx)
+        initializer = init if init is not None else (self.init or default_init)
+        desc = init_mod.InitDesc(self.name)
+        initializer(desc, data)
+        self._data = data
+        self._deferred_init = ()
+        if self._grad_req != "null":
+            self._init_grad()
+
+    def _finish_deferred_init(self, shape):
+        if not self._deferred_init:
+            return
+        if self._needs_shape():
+            inferred = list(self.shape) if self.shape else list(shape)
+            for i, s in enumerate(inferred):
+                if s == 0:
+                    inferred[i] = shape[i]
+            self.shape = tuple(inferred) if self.shape else tuple(shape)
+        init, ctx, default_init = self._deferred_init
+        self._finish_init(init, ctx, default_init)
+
+    def _init_grad(self):
+        if self._stype == "row_sparse":
+            pass  # grads materialize as row_sparse at update time
+        self._grad = NDArray(jnp.zeros(self._data.shape,
+                                       dtype=self._data._data.dtype),
+                             ctx=self._data._ctx)
+        autograd.mark_variables([self._data], [self._grad], self._grad_req)
+
+    # -- accessors ----------------------------------------------------------
+    def _check_initialized(self):
+        if self._data is None:
+            if self._deferred_init:
+                raise DeferredInitializationError(
+                    "Parameter '%s' has not been initialized yet because "
+                    "initialization was deferred. Actual initialization "
+                    "happens during the first forward pass." % self.name)
+            raise MXNetError(
+                "Parameter '%s' has not been initialized. You should first "
+                "call block.collect_params().initialize() before using it."
+                % self.name)
+
+    def data(self, ctx=None):
+        self._check_initialized()
+        return self._data
+
+    def list_data(self):
+        self._check_initialized()
+        return [self._data]
+
+    def grad(self, ctx=None):
+        self._check_initialized()
+        if self._grad is None:
+            raise MXNetError(
+                "Cannot get gradient array for Parameter '%s' because "
+                "grad_req='null'" % self.name)
+        return self._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        self._check_initialized()
+        return [self._data._ctx]
+
+    def set_data(self, data):
+        if self._data is None:
+            # allow set before init (load path) when shape known
+            self.shape = tuple(data.shape)
+            self._data = data if isinstance(data, NDArray) else NDArray(data)
+            if self._grad_req != "null":
+                self._init_grad()
+            return
+        self._data._data = (data._data if isinstance(data, NDArray)
+                            else jnp.asarray(data)).astype(self._data._data.dtype).reshape(self._data.shape)
+        self._data._version += 1
+
+    def zero_grad(self):
+        if self._grad is not None:
+            self._grad._data = jnp.zeros_like(self._grad._data)
+            self._grad._version += 1
+
+    def row_sparse_data(self, row_id):
+        self._check_initialized()
+        rsp = RowSparseNDArray.from_dense(self._data)
+        return rsp.retain(row_id)
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is not None:
+            self._data._data = self._data._data.astype(dtype_np(dtype))
+            if self._grad is not None:
+                self._grad._data = self._grad._data.astype(dtype_np(dtype))
+                autograd.mark_variables([self._data], [self._grad],
+                                        self._grad_req)
+
+    def reset_ctx(self, ctx):
+        pass  # placement is XLA/sharding-managed
+
+    def var(self):
+        if self._var is None:
+            self._var = Variable(self.name, shape=self.shape,
+                                 dtype=self.dtype, init=self.init,
+                                 lr_mult=self.lr_mult, wd_mult=self.wd_mult)
+        return self._var
+
+
+class Constant(Parameter):
+    """Parity: gluon.Constant — non-differentiable fixed value."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            value = NDArray(np.asarray(value))
+        self.value = value
+
+        class _CInit(init_mod.Initializer):
+            def _init_weight(self, _, arr):
+                arr._data = value._data
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype, init=_CInit(),
+                         differentiable=False)
+
+
+class ParameterDict:
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = {}  # ordered by insertion (py3.7 dict)
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def __repr__(self):
+        s = "{name}(\n{content}\n)"
+        name = self._prefix + " " if self._prefix else ""
+        return s.format(name=name, content="\n".join(
+            "  " + repr(v) for v in self.values()))
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __contains__(self, key):
+        return key in self._params
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if hasattr(param, k) and getattr(param, k) is not None:
+                    existing = getattr(param, k)
+                    if k == "shape" and v is not None and existing is not None:
+                        v = tuple(v) if not isinstance(v, int) else (v,)
+                        # merge partial shapes
+                        if len(v) == len(existing):
+                            merged = tuple(a if a else b
+                                           for a, b in zip(existing, v))
+                            param.shape = merged
+                            continue
+                else:
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise KeyError("No constant named '{}'.".format(name))
+            param = Constant(name, value)
+            self._params[name] = param
+        return param
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params:
+                assert self._params[k] is v, \
+                    "Cannot update self with other because they have different " \
+                    "Parameters with the same name '%s'" % k
+            else:
+                self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        default = init if init is not None else init_mod.Uniform()
+        for _, v in self.items():
+            v.initialize(None, ctx, default, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for v in self.values():
+            v.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for v in self.values():
+            v.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for v in self.values():
+            setattr(v, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        from ..utils import serialization
+        arg_dict = {}
+        for param in self.values():
+            block = param.list_data()
+            weight = block[0]
+            if not param.name.startswith(strip_prefix):
+                raise ValueError(
+                    "Prefix '%s' is to be striped before saving, but "
+                    "Parameter's name '%s' does not start with it"
+                    % (strip_prefix, param.name))
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        serialization.save_ndarrays(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        from ..utils import serialization
+        arg_dict = serialization.load_ndarrays(filename)
+        arg_dict = {restore_prefix + k: v for k, v in arg_dict.items()}
+        if not allow_missing:
+            for name in self.keys():
+                assert name in arg_dict, \
+                    "Parameter '%s' is missing in file '%s'" % (name, filename)
+        for name in arg_dict:
+            if name not in self._params:
+                assert ignore_extra, \
+                    "Parameter '%s' loaded from file '%s' is not present in " \
+                    "ParameterDict" % (name, filename)
+                continue
+            self[name].set_data(arg_dict[name])

@@ -1,0 +1,186 @@
+"""Fused recurrent layers (RNN/LSTM/GRU).
+
+Parity: reference `python/mxnet/gluon/rnn/rnn_layer.py` — multi-layer
+(bi)directional layers backed by the fused RNN op (`src/operator/rnn-inl.h`,
+cuDNN path `cudnn_rnn-inl.h`).
+
+TPU-native redesign: the fused op is a lax.scan (ops/nn.py RNN); under
+hybridize the whole stack compiles to one XLA while-loop program. Parameters
+are kept per-layer/direction/gate (i2h/h2h weight+bias) with reference-
+compatible names, packed into the flat vector at call time.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..block import HybridBlock
+from ...ndarray import NDArray
+from ... import ndarray as F_nd
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, mode, **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC"), \
+            "Invalid layout %s; must be one of ['TNC' or 'NTC']" % layout
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+        ng, ni, nh = self._gates, input_size, hidden_size
+        for i in range(num_layers):
+            for j in ["l", "r"][:self._dir]:
+                self._register_param("%s%d_i2h_weight" % (j, i),
+                                     shape=(ng * nh, ni),
+                                     init=i2h_weight_initializer)
+                self._register_param("%s%d_h2h_weight" % (j, i),
+                                     shape=(ng * nh, nh),
+                                     init=h2h_weight_initializer)
+                self._register_param("%s%d_i2h_bias" % (j, i),
+                                     shape=(ng * nh,),
+                                     init=i2h_bias_initializer)
+                self._register_param("%s%d_h2h_bias" % (j, i),
+                                     shape=(ng * nh,),
+                                     init=h2h_bias_initializer)
+            ni = nh * self._dir
+
+    def _register_param(self, name, shape, init):
+        from ..nn.basic_layers import _init
+        p = self.params.get(name, shape=shape, init=_init(init),
+                            allow_deferred_init=True)
+        setattr(self, name, p)
+
+    def _alias(self):
+        return self._mode
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def _shape_probe(self, x, *args):
+        ni = x.shape[-1]
+        ng, nh = self._gates, self._hidden_size
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                getattr(self, "%s%d_i2h_weight" % (j, i)).shape = (ng * nh, ni)
+            ni = nh * self._dir
+        for p in self._reg_params.values():
+            if p._deferred_init:
+                p._finish_deferred_init(p.shape)
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        if func is None:
+            func = F_nd.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            states.append(func(**{k: v for k, v in info.items()
+                                  if k != "__layout__"}))
+        return states
+
+    def _pack_params(self, params):
+        """Flatten per-gate params into the fused-op vector (layout documented
+        in ops/nn.py _unpack_rnn_params)."""
+        chunks = []
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                for part in ("i2h_weight", "h2h_weight", "i2h_bias",
+                             "h2h_bias"):
+                    chunks.append(params["%s%d_%s" % (j, i, part)].reshape(-1))
+        from ... import ndarray as F
+        return F.Concat(*chunks, dim=0)
+
+    def forward(self, inputs, states=None):
+        try:
+            params = {name: p.data() for name, p in self._reg_params.items()}
+        except Exception:
+            self._finish_deferred_init(
+                inputs if self._layout == "TNC" else inputs)
+            params = {name: p.data() for name, p in self._reg_params.items()}
+        batch_size = inputs.shape[self._layout.find("N")]
+        skip_states = states is None
+        if skip_states:
+            states = self.begin_state(batch_size)
+        if isinstance(states, NDArray):
+            states = [states]
+        out = self.hybrid_forward(F_nd, inputs, states, **params)
+        return out[0] if skip_states else out
+
+    def hybrid_forward(self, F, inputs, states, **params):
+        if self._layout == "NTC":
+            inputs = F.swapaxes(inputs, dim1=0, dim2=1)
+        flat = self._pack_params(params)
+        rnn_args = [inputs, flat, states[0]]
+        if self._mode == "lstm":
+            rnn_args.append(states[1])
+        ret = F.RNN(*rnn_args, state_size=self._hidden_size,
+                    num_layers=self._num_layers, mode=self._mode,
+                    bidirectional=self._dir == 2, p=self._dropout,
+                    state_outputs=True)
+        if self._mode == "lstm":
+            outputs, state_h, state_c = ret
+            out_states = [state_h, state_c]
+        else:
+            outputs, state_h = ret
+            out_states = [state_h]
+        if self._layout == "NTC":
+            outputs = F.swapaxes(outputs, dim1=0, dim2=1)
+        return outputs, out_states
+
+
+class RNN(_RNNLayer):
+    """Parity: rnn_layer.py RNN (modes rnn_relu/rnn_tanh)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "lstm", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"},
+                {"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

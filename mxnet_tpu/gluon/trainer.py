@@ -1,0 +1,149 @@
+"""Gluon Trainer.
+
+Parity: reference `python/mxnet/gluon/trainer.py:27` — creates a kvstore
+(:112), step = allreduce + update (:160,206,247), allreduce_grads, lr
+scheduling, save/load optimizer states, gradient compression knob.
+
+TPU-native redesign: parameters have ONE logical buffer (not per-device
+copies), so _allreduce_grads is a no-op on a single chip and a mesh psum
+under data parallelism (kvstore type 'tpu'/'dist_*'). The update path calls
+the pure optimizer rules; for the fully-fused XLA train step (forward + loss
++ backward + update in one compiled program with donation), see
+mxnet_tpu.parallel.TrainStep which reuses the same optimizer rules.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from .. import optimizer as opt
+from .. import kvstore as kvs
+from .parameter import ParameterDict, Parameter
+from ..ndarray.sparse import RowSparseNDArray
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                "got %s." % (type(params)))
+        self._params = []
+        for param in params:
+            if not isinstance(param, Parameter):
+                raise ValueError(
+                    "First argument must be a list or dict of Parameters, "
+                    "got list of %s." % (type(param)))
+            self._params.append(param)
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params if optimizer_params else {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kv_type = kvstore
+        self._kvstore = None
+        self._update_on_kvstore = update_on_kvstore
+        self._kv_initialized = False
+        self._params_to_init = []
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                "optimizer_params must be None if optimizer is an Optimizer " \
+                "instance"
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)]
+
+    def _init_kvstore(self):
+        if isinstance(self._kv_type, kvs.KVStore):
+            self._kvstore = self._kv_type
+        elif self._kv_type is None:
+            self._kvstore = None
+        else:
+            self._kvstore = kvs.create(self._kv_type)
+        if self._kvstore is not None and self._compression_params:
+            self._kvstore.set_gradient_compression(self._compression_params)
+        self._distributed = self._kvstore is not None and \
+            self._kvstore.num_workers > 1
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.lr if self._optimizer.lr_scheduler is None \
+            else self._optimizer.lr_scheduler(self._optimizer.num_update)
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def _row_sparse_pull(self, parameter, out, row_id):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        idx = self._params.index(parameter)
+        if self._kvstore is not None:
+            key = "param_%d" % idx
+            if key not in self._kvstore._store:
+                self._kvstore.init(key, parameter.data())
+            self._kvstore.row_sparse_pull(key, out=out, row_ids=row_id)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce + optimizer update (parity: trainer.py:160)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        # single logical buffer per param: nothing to reduce locally.
+        # multi-host data parallelism: psum grads over the process mesh.
+        if self._kvstore is not None and self._kvstore.num_workers > 1:
+            for param in self._params:
+                if param.grad_req != "null":
+                    g = param.grad()
+                    g._data = kvs._multihost_psum(g._data) / \
+                        self._kvstore.num_workers
+
+    def _update(self, ignore_stale_grad=False):
+        updater = self._updaters[0]
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            if param._data is None:
+                continue
+            grad = param._grad
+            if grad is None:
+                if ignore_stale_grad:
+                    continue
+                raise MXNetError(
+                    "Gradient of Parameter `%s` not found. Call backward "
+                    "first." % param.name)
+            updater(i, grad, param.data())
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def save_states(self, fname):
+        assert self._optimizer is not None
+        with open(fname, "wb") as fout:
+            fout.write(self._updaters[0].get_states(dump_optimizer=False))
+
+    def load_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        with open(fname, "rb") as f:
+            states = f.read()
+        self._updaters[0].set_states(states)
+        self._updaters[0].optimizer = self._optimizer

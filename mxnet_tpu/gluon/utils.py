@@ -1,0 +1,97 @@
+"""Gluon utilities (parity: python/mxnet/gluon/utils.py — split_data,
+split_and_load, clip_global_norm, check_sha1, download)."""
+from __future__ import annotations
+
+import os
+import hashlib
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..ndarray import NDArray
+from .. import ndarray as nd
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    size = data.shape[batch_axis]
+    if size < num_slice:
+        raise ValueError(
+            "Too many slices for data with shape %s. Arguments are "
+            "num_slice=%d and batch_axis=%d." % (str(data.shape), num_slice,
+                                                 batch_axis))
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            "data with shape %s cannot be evenly split into %d slices "
+            "along axis %d. Use a batch size that's multiple of %d or set "
+            "even_split=False to allow uneven partitioning of data."
+            % (str(data.shape), num_slice, batch_axis, num_slice))
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        idx = [slice(None)] * data.ndim
+        idx[batch_axis] = slice(begin, end)
+        slices.append(data[tuple(idx)])
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Parity: utils.split_and_load. On TPU multi-device execution shards a
+    single array over the mesh instead of making per-device copies, so with
+    one logical context the batch is NOT split; with an explicit ctx list the
+    reference-compatible per-slice list is returned."""
+    if not isinstance(data, NDArray):
+        data = NDArray(np.asarray(data))
+    if not isinstance(ctx_list, (list, tuple)):
+        ctx_list = [ctx_list]
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm):
+    """Parity: utils.clip_global_norm (rescales in place)."""
+    assert len(arrays) > 0
+    total_norm = float(jnp.sqrt(sum(
+        float(jnp.sum(jnp.square(a._data))) for a in arrays)))
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a._data = a._data * scale
+            a._version += 1
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None):
+    """Parity surface for model_zoo pretrained downloads. This environment
+    has no network egress; raises with guidance unless the file is present."""
+    fname = path if path and not os.path.isdir(path) else os.path.join(
+        path or ".", url.split("/")[-1])
+    if os.path.exists(fname) and not overwrite and (
+            not sha1_hash or check_sha1(fname, sha1_hash)):
+        return fname
+    raise IOError(
+        "download(%s) unavailable: no network egress in this environment. "
+        "Place the file at %s manually." % (url, fname))
+
+
+def _indent(s_, numSpaces):
+    s = s_.split("\n")
+    if len(s) == 1:
+        return s_
+    first = s.pop(0)
+    s = [first] + [(numSpaces * " ") + line for line in s]
+    return "\n".join(s)

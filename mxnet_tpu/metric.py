@@ -1,0 +1,451 @@
+"""Evaluation metrics.
+
+Parity: reference `python/mxnet/metric.py:68-1190` — EvalMetric base,
+CompositeEvalMetric, Accuracy, TopKAccuracy, F1, Perplexity, MAE, MSE, RMSE,
+CrossEntropy, NegativeLogLikelihood, PearsonCorrelation, Loss, Torch, Caffe,
+CustomMetric + np() helper and the registry/create path.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy
+
+from .base import MXNetError
+from .registry import get_register_func, get_create_func, get_alias_func
+
+
+def check_label_shapes(labels, preds, shape=False):
+    if shape:
+        label_shape, pred_shape = len(labels), len(preds)
+    else:
+        label_shape, pred_shape = labels.shape[0], preds.shape[0]
+    if label_shape != pred_shape:
+        raise ValueError("Shape of labels {} does not match shape of "
+                         "predictions {}".format(label_shape, pred_shape))
+
+
+class EvalMetric:
+    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+        self.name = str(name)
+        self.output_names = output_names
+        self.label_names = label_names
+        self._kwargs = kwargs
+        self.reset()
+
+    def __str__(self):
+        return "EvalMetric: {}".format(dict(self.get_name_value()))
+
+    def get_config(self):
+        config = self._kwargs.copy()
+        config.update({"metric": self.__class__.__name__, "name": self.name,
+                       "output_names": self.output_names,
+                       "label_names": self.label_names})
+        return config
+
+    def update_dict(self, label, pred):
+        if self.output_names is not None:
+            pred = [pred[name] for name in self.output_names]
+        else:
+            pred = list(pred.values())
+        if self.label_names is not None:
+            label = [label[name] for name in self.label_names]
+        else:
+            label = list(label.values())
+        self.update(label, pred)
+
+    def update(self, labels, preds):
+        raise NotImplementedError()
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.sum_metric / self.num_inst)
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+
+register = get_register_func(EvalMetric, "metric")
+alias = get_alias_func(EvalMetric, "metric")
+_create = get_create_func(EvalMetric, "metric")
+
+
+def create(metric, *args, **kwargs):
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    if isinstance(metric, list):
+        composite = CompositeEvalMetric()
+        for child in metric:
+            composite.add(create(child, *args, **kwargs))
+        return composite
+    return _create(metric, *args, **kwargs)
+
+
+@register
+@alias("composite")
+class CompositeEvalMetric(EvalMetric):
+    def __init__(self, metrics=None, name="composite", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names)
+        self.metrics = metrics if metrics is not None else []
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def get_metric(self, index):
+        try:
+            return self.metrics[index]
+        except IndexError:
+            return ValueError("Metric index {} is out of range 0 and {}".format(
+                index, len(self.metrics)))
+
+    def update_dict(self, labels, preds):
+        for metric in self.metrics:
+            metric.update_dict(labels, preds)
+
+    def update(self, labels, preds):
+        for metric in self.metrics:
+            metric.update(labels, preds)
+
+    def reset(self):
+        try:
+            for metric in self.metrics:
+                metric.reset()
+        except AttributeError:
+            pass
+
+    def get(self):
+        names, values = [], []
+        for metric in self.metrics:
+            name, value = metric.get()
+            if isinstance(name, str):
+                name = [name]
+            if isinstance(value, (float, int, numpy.generic)):
+                value = [value]
+            names.extend(name)
+            values.extend(value)
+        return (names, values)
+
+
+def _np(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else numpy.asarray(x)
+
+
+@register
+@alias("acc")
+class Accuracy(EvalMetric):
+    def __init__(self, axis=1, name="accuracy", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names, axis=axis)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds, True)
+        for label, pred_label in zip(labels, preds):
+            pl = _np(pred_label)
+            if pl.ndim > 1 and pl.shape[-1] > 1 and pl.ndim != _np(label).ndim:
+                pl = numpy.argmax(pl, axis=self.axis)
+            elif pl.ndim > 1 and pl.shape[self.axis] > 1:
+                pl = numpy.argmax(pl, axis=self.axis)
+            pl = pl.astype("int32").ravel()
+            lab = _np(label).astype("int32").ravel()
+            check_label_shapes(lab, pl)
+            self.sum_metric += (pl == lab).sum()
+            self.num_inst += len(pl)
+
+
+@register
+@alias("top_k_accuracy", "top_k_acc")
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1, name="top_k_accuracy", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names, top_k=top_k)
+        self.top_k = top_k
+        assert self.top_k > 1, "Please use Accuracy if top_k is no more than 1"
+        self.name += "_%d" % self.top_k
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds, True)
+        for label, pred_label in zip(labels, preds):
+            pred = numpy.argsort(_np(pred_label).astype("float32"), axis=1)
+            lab = _np(label).astype("int32")
+            num_samples = pred.shape[0]
+            num_classes = pred.shape[1]
+            top_k = min(num_classes, self.top_k)
+            for j in range(top_k):
+                self.sum_metric += (
+                    pred[:, num_classes - 1 - j].ravel() == lab.ravel()).sum()
+            self.num_inst += num_samples
+
+
+@register
+class F1(EvalMetric):
+    def __init__(self, name="f1", output_names=None, label_names=None,
+                 average="macro"):
+        self.average = average
+        self.metrics = _BinaryClassificationMetrics()
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            self.metrics.update_binary_stats(_np(label), _np(pred))
+        if self.average == "macro":
+            self.sum_metric += self.metrics.fscore
+            self.num_inst += 1
+            self.metrics.reset_stats()
+        else:
+            self.sum_metric = self.metrics.fscore * self.metrics.total_examples
+            self.num_inst = self.metrics.total_examples
+
+    def reset(self):
+        self.sum_metric = 0.0
+        self.num_inst = 0
+        if hasattr(self, "metrics"):
+            self.metrics.reset_stats()
+
+
+class _BinaryClassificationMetrics:
+    def __init__(self):
+        self.reset_stats()
+
+    def update_binary_stats(self, label, pred):
+        pred_label = numpy.argmax(pred, axis=1)
+        label = label.astype("int32").ravel()
+        if len(numpy.unique(label)) > 2:
+            raise ValueError("%s currently only supports binary "
+                             "classification." % self.__class__.__name__)
+        self.true_positives += ((pred_label == 1) & (label == 1)).sum()
+        self.false_positives += ((pred_label == 1) & (label == 0)).sum()
+        self.false_negatives += ((pred_label == 0) & (label == 1)).sum()
+        self.true_negatives += ((pred_label == 0) & (label == 0)).sum()
+
+    @property
+    def precision(self):
+        denom = self.true_positives + self.false_positives
+        return self.true_positives / denom if denom > 0 else 0.0
+
+    @property
+    def recall(self):
+        denom = self.true_positives + self.false_negatives
+        return self.true_positives / denom if denom > 0 else 0.0
+
+    @property
+    def fscore(self):
+        if self.precision + self.recall > 0:
+            return 2 * self.precision * self.recall / (
+                self.precision + self.recall)
+        return 0.0
+
+    @property
+    def total_examples(self):
+        return (self.false_negatives + self.false_positives +
+                self.true_negatives + self.true_positives)
+
+    def reset_stats(self):
+        self.false_positives = 0
+        self.false_negatives = 0
+        self.true_positives = 0
+        self.true_negatives = 0
+
+
+@register
+class Perplexity(EvalMetric):
+    def __init__(self, ignore_label, axis=-1, name="perplexity",
+                 output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names,
+                         ignore_label=ignore_label, axis=axis)
+        self.ignore_label = ignore_label
+        self.axis = axis
+
+    def update(self, labels, preds):
+        assert len(labels) == len(preds)
+        loss = 0.0
+        num = 0
+        for label, pred in zip(labels, preds):
+            lab = _np(label).astype("int32").ravel()
+            p = _np(pred).reshape(-1, _np(pred).shape[-1])
+            probs = p[numpy.arange(lab.shape[0]), lab]
+            if self.ignore_label is not None:
+                ignore = (lab == self.ignore_label)
+                probs = numpy.where(ignore, 1.0, probs)
+                num -= ignore.sum()
+            loss -= numpy.sum(numpy.log(numpy.maximum(1e-10, probs)))
+            num += lab.shape[0]
+        self.sum_metric += loss
+        self.num_inst += num
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, math.exp(self.sum_metric / self.num_inst))
+
+
+@register
+class MAE(EvalMetric):
+    def __init__(self, name="mae", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            lab, p = _np(label), _np(pred)
+            if lab.ndim == 1:
+                lab = lab.reshape(lab.shape[0], 1)
+            if p.ndim == 1:
+                p = p.reshape(p.shape[0], 1)
+            self.sum_metric += numpy.abs(lab - p).mean()
+            self.num_inst += 1
+
+
+@register
+class MSE(EvalMetric):
+    def __init__(self, name="mse", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            lab, p = _np(label), _np(pred)
+            if lab.ndim == 1:
+                lab = lab.reshape(lab.shape[0], 1)
+            if p.ndim == 1:
+                p = p.reshape(p.shape[0], 1)
+            self.sum_metric += ((lab - p) ** 2.0).mean()
+            self.num_inst += 1
+
+
+@register
+class RMSE(EvalMetric):
+    def __init__(self, name="rmse", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            lab, p = _np(label), _np(pred)
+            if lab.ndim == 1:
+                lab = lab.reshape(lab.shape[0], 1)
+            if p.ndim == 1:
+                p = p.reshape(p.shape[0], 1)
+            self.sum_metric += numpy.sqrt(((lab - p) ** 2.0).mean())
+            self.num_inst += 1
+
+
+@register
+@alias("ce")
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-12, name="cross-entropy", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names, eps=eps)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            lab = _np(label).ravel()
+            p = _np(pred)
+            assert lab.shape[0] == p.shape[0]
+            prob = p[numpy.arange(lab.shape[0]), numpy.int64(lab)]
+            self.sum_metric += (-numpy.log(prob + self.eps)).sum()
+            self.num_inst += lab.shape[0]
+
+
+@register
+@alias("nll_loss")
+class NegativeLogLikelihood(EvalMetric):
+    def __init__(self, eps=1e-12, name="nll-loss", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names, eps=eps)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            lab = _np(label).ravel()
+            p = _np(pred)
+            num_examples = p.shape[0]
+            prob = p[numpy.arange(num_examples, dtype=numpy.int64), numpy.int64(lab)]
+            self.sum_metric += (-numpy.log(prob + self.eps)).sum()
+            self.num_inst += num_examples
+
+
+@register
+@alias("pearsonr")
+class PearsonCorrelation(EvalMetric):
+    def __init__(self, name="pearsonr", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            lab, p = _np(label).ravel(), _np(pred).ravel()
+            self.sum_metric += numpy.corrcoef(p, lab)[0, 1]
+            self.num_inst += 1
+
+
+@register
+class Loss(EvalMetric):
+    def __init__(self, name="loss", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, _, preds):
+        for pred in preds:
+            loss = _np(pred).sum()
+            self.sum_metric += loss
+            self.num_inst += _np(pred).size
+
+
+@register
+class Torch(Loss):
+    def __init__(self, name="torch", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+
+@register
+class Caffe(Loss):
+    def __init__(self, name="caffe", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+
+@register
+class CustomMetric(EvalMetric):
+    def __init__(self, feval, name=None, allow_extra_outputs=False,
+                 output_names=None, label_names=None):
+        if name is None:
+            name = feval.__name__
+            if name.find("<") != -1:
+                name = "custom(%s)" % name
+        super().__init__(name, output_names, label_names,
+                         feval=feval, allow_extra_outputs=allow_extra_outputs)
+        self._feval = feval
+        self._allow_extra_outputs = allow_extra_outputs
+
+    def update(self, labels, preds):
+        if not self._allow_extra_outputs:
+            check_label_shapes(labels, preds, True)
+        for pred, label in zip(preds, labels):
+            reval = self._feval(_np(label), _np(pred))
+            if isinstance(reval, tuple):
+                (sum_metric, num_inst) = reval
+                self.sum_metric += sum_metric
+                self.num_inst += num_inst
+            else:
+                self.sum_metric += reval
+                self.num_inst += 1
+
+
+def np(numpy_feval, name=None, allow_extra_outputs=False):
+    def feval(label, pred):
+        return numpy_feval(label, pred)
+    feval.__name__ = numpy_feval.__name__
+    return CustomMetric(feval, name, allow_extra_outputs)

@@ -1,0 +1,11 @@
+"""Model factories for the BASELINE exercise configs (SURVEY §6):
+  1. LeNet/MLP on MNIST (Module API)        -> lenet.get_lenet / get_mlp
+  2. ResNet-50 ImageNet (Gluon hybridize)   -> gluon.model_zoo resnet50_v1
+  3. LSTM word language model               -> word_lm.RNNModel
+  4. SSD object detection (multibox ops)    -> ssd.SSDLite
+  5. Sparse linear classification           -> sparse_linear.SparseLinear
+"""
+from .lenet import get_lenet, get_mlp, LeNet
+from .word_lm import RNNModel
+from .ssd import SSDLite
+from .sparse_linear import SparseLinear

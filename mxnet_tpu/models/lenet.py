@@ -1,0 +1,58 @@
+"""LeNet + MLP (parity: reference example/image-classification/symbols/
+lenet.py and mlp.py — exercised by train_mnist.py / BASELINE config 1)."""
+from __future__ import annotations
+
+from .. import symbol as sym
+from ..gluon import nn, HybridBlock
+
+
+def get_mlp(num_classes=10):
+    """Symbol-API MLP (parity: example/image-classification/symbols/mlp.py)."""
+    data = sym.Variable("data")
+    data = sym.Flatten(data, name="flatten")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=128)
+    act1 = sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = sym.FullyConnected(act1, name="fc2", num_hidden=64)
+    act2 = sym.Activation(fc2, name="relu2", act_type="relu")
+    fc3 = sym.FullyConnected(act2, name="fc3", num_hidden=num_classes)
+    return sym.SoftmaxOutput(fc3, name="softmax")
+
+
+def get_lenet(num_classes=10):
+    """Symbol-API LeNet (parity: symbols/lenet.py)."""
+    data = sym.Variable("data")
+    conv1 = sym.Convolution(data, name="conv1", kernel=(5, 5), num_filter=20)
+    tanh1 = sym.Activation(conv1, name="tanh1", act_type="tanh")
+    pool1 = sym.Pooling(tanh1, name="pool1", pool_type="max", kernel=(2, 2),
+                        stride=(2, 2))
+    conv2 = sym.Convolution(pool1, name="conv2", kernel=(5, 5), num_filter=50)
+    tanh2 = sym.Activation(conv2, name="tanh2", act_type="tanh")
+    pool2 = sym.Pooling(tanh2, name="pool2", pool_type="max", kernel=(2, 2),
+                        stride=(2, 2))
+    flatten = sym.Flatten(pool2, name="flatten")
+    fc1 = sym.FullyConnected(flatten, name="fc1", num_hidden=500)
+    tanh3 = sym.Activation(fc1, name="tanh3", act_type="tanh")
+    fc2 = sym.FullyConnected(tanh3, name="fc2", num_hidden=num_classes)
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+class LeNet(HybridBlock):
+    """Gluon LeNet for the imperative path."""
+
+    def __init__(self, num_classes=10, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.conv1 = nn.Conv2D(20, kernel_size=5, activation="tanh")
+            self.pool1 = nn.MaxPool2D(pool_size=2, strides=2)
+            self.conv2 = nn.Conv2D(50, kernel_size=5, activation="tanh")
+            self.pool2 = nn.MaxPool2D(pool_size=2, strides=2)
+            self.flatten = nn.Flatten()
+            self.fc1 = nn.Dense(500, activation="tanh")
+            self.fc2 = nn.Dense(num_classes)
+
+    def hybrid_forward(self, F, x):
+        x = self.pool1(self.conv1(x))
+        x = self.pool2(self.conv2(x))
+        x = self.flatten(x)
+        x = self.fc1(x)
+        return self.fc2(x)

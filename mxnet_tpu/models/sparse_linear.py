@@ -1,0 +1,78 @@
+"""Sparse linear classifier (parity: reference example/sparse/
+linear_classification/train.py — BASELINE config 5: CSR data dot
+row-sparse-updated weights, dist kvstore row_sparse push/pull)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..ndarray import NDArray
+from ..ndarray.sparse import CSRNDArray, RowSparseNDArray, dot as sparse_dot
+from .. import ndarray as nd
+from .. import kvstore as kvs
+from .. import optimizer as opt
+
+
+class SparseLinear:
+    """Logistic-regression-style linear model over sparse features, trained
+    with row-sparse gradient push/pull through a KVStore."""
+
+    def __init__(self, num_features, num_classes=2, kvstore=None,
+                 optimizer="sgd", learning_rate=0.1):
+        self.num_features = num_features
+        self.num_classes = num_classes
+        self.weight = NDArray(np.zeros((num_features, num_classes),
+                                       dtype=np.float32))
+        self.bias = NDArray(np.zeros((num_classes,), dtype=np.float32))
+        self._kv = kvs.create(kvstore) if isinstance(kvstore, str) else kvstore
+        self._opt = opt.create(optimizer, learning_rate=learning_rate)
+        self._updater = opt.get_updater(self._opt)
+        if self._kv is not None:
+            self._kv.init("weight", self.weight)
+            self._kv.set_optimizer(self._opt)
+
+    def forward(self, x):
+        if isinstance(x, CSRNDArray):
+            scores = sparse_dot(x, self.weight)
+        else:
+            scores = nd.dot(x, self.weight)
+        return scores + self.bias
+
+    def loss_grad(self, x, y):
+        """Softmax CE loss + row-sparse weight gradient."""
+        import jax.numpy as jnp
+        import jax
+        scores = self.forward(x)
+        n = scores.shape[0]
+        logp = jax.nn.log_softmax(scores._data, axis=-1)
+        yi = y._data.astype(jnp.int32) if isinstance(y, NDArray) else \
+            jnp.asarray(y, dtype=jnp.int32)
+        loss = -jnp.mean(jnp.take_along_axis(logp, yi[:, None], axis=1))
+        prob = jax.nn.softmax(scores._data, axis=-1)
+        dscore = (prob - jax.nn.one_hot(yi, self.num_classes)) / n
+        xd = x.todense()._data if isinstance(x, CSRNDArray) else x._data
+        wgrad_dense = xd.T @ dscore
+        bgrad = jnp.sum(dscore, axis=0)
+        # only rows with any non-zero feature received gradient -> row_sparse
+        touched = np.nonzero(np.asarray(jnp.any(xd != 0, axis=0)))[0]
+        wgrad = RowSparseNDArray(jnp.asarray(touched, dtype=jnp.int64),
+                                 wgrad_dense[touched],
+                                 wgrad_dense.shape)
+        return float(loss), wgrad, NDArray(bgrad)
+
+    def step(self, x, y):
+        loss, wgrad, bgrad = self.loss_grad(x, y)
+        if self._kv is not None:
+            self._kv.push("weight", wgrad)
+            self._kv.pull("weight", out=self.weight)
+        else:
+            self._updater("weight", wgrad, self.weight)
+        self._updater("bias", bgrad, self.bias)
+        return loss
+
+    def row_sparse_pull(self, row_ids):
+        """Pull only the rows needed for a batch (parity: row_sparse_pull)."""
+        if self._kv is None:
+            return RowSparseNDArray.from_dense(self.weight).retain(row_ids)
+        out = RowSparseNDArray.from_dense(self.weight)
+        self._kv.row_sparse_pull("weight", out=out, row_ids=row_ids)
+        return out

@@ -1,0 +1,51 @@
+"""LSTM word language model (parity: reference example/rnn/word_lm/model.py —
+BASELINE config 3: embedding -> multilayer LSTM -> tied/untied decoder)."""
+from __future__ import annotations
+
+from ..gluon import nn, rnn, HybridBlock
+
+
+class RNNModel(HybridBlock):
+    def __init__(self, mode="lstm", vocab_size=10000, num_embed=200,
+                 num_hidden=200, num_layers=2, dropout=0.5, tie_weights=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._mode = mode
+        self._num_hidden = num_hidden
+        with self.name_scope():
+            self.drop = nn.Dropout(dropout)
+            self.encoder = nn.Embedding(vocab_size, num_embed)
+            if mode == "lstm":
+                self.rnn = rnn.LSTM(num_hidden, num_layers, dropout=dropout,
+                                    input_size=num_embed)
+            elif mode == "gru":
+                self.rnn = rnn.GRU(num_hidden, num_layers, dropout=dropout,
+                                   input_size=num_embed)
+            else:
+                self.rnn = rnn.RNN(num_hidden, num_layers, dropout=dropout,
+                                   input_size=num_embed,
+                                   activation="relu" if mode == "rnn_relu"
+                                   else "tanh")
+            if tie_weights:
+                assert num_embed == num_hidden
+                self.decoder = nn.Dense(vocab_size, flatten=False,
+                                        params=self.encoder.params)
+            else:
+                self.decoder = nn.Dense(vocab_size, flatten=False,
+                                        in_units=num_hidden)
+
+    def begin_state(self, batch_size):
+        return self.rnn.begin_state(batch_size)
+
+    def forward(self, inputs, hidden=None):
+        # inputs: [T, N] int tokens
+        emb = self.drop(self.encoder(inputs))
+        if hidden is None:
+            output = self.rnn(emb)
+            output = self.drop(output)
+            decoded = self.decoder(output.reshape((-1, self._num_hidden)))
+            return decoded
+        output, hidden = self.rnn(emb, hidden)
+        output = self.drop(output)
+        decoded = self.decoder(output.reshape((-1, self._num_hidden)))
+        return decoded, hidden

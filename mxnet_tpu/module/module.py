@@ -1,0 +1,305 @@
+"""Module: symbol + one jit-compiled executor.
+
+Parity: reference `python/mxnet/module/module.py:40` (bind:364 →
+DataParallelExecutorGroup, init_optimizer:473 wiring KVStore).
+
+TPU-native redesign: the reference sliced each batch across N GPU executors
+(`executor_group.py:129`); here ONE executor runs the whole batch and
+multi-chip data parallelism is mesh sharding (mxnet_tpu.parallel) — the XLA
+partitioner plays the role of DataParallelExecutorGroup, so there is no
+per-device replica bookkeeping to manage.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .base_module import BaseModule
+from ..base import MXNetError
+from ..context import cpu
+from ..executor import Executor
+from ..ndarray import NDArray
+from .. import ndarray as nd
+from .. import optimizer as opt
+from .. import kvstore as kvs
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger=logger)
+        if context is None:
+            context = cpu()
+        if isinstance(context, (list, tuple)):
+            context = context[0]  # devices = sharding, one logical executor
+        self._context = context
+        self._symbol = symbol
+        data_names = list(data_names) if data_names is not None else []
+        label_names = list(label_names) if label_names is not None else []
+        arg_names = symbol.list_arguments()
+        input_names = data_names + label_names + (list(state_names) if
+                                                  state_names else [])
+        self._param_names = [x for x in arg_names if x not in input_names]
+        self._fixed_param_names = list(fixed_param_names or [])
+        self._aux_names = symbol.list_auxiliary_states()
+        self._data_names = data_names
+        self._label_names = label_names
+        self._state_names = list(state_names or [])
+        self._output_names = symbol.list_outputs()
+        self._arg_params = None
+        self._aux_params = None
+        self._params_dirty = False
+        self._compression_params = compression_params
+        self._optimizer = None
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._updater = None
+        self._exec = None
+        self._data_shapes = None
+        self._label_shapes = None
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        from ..model import load_checkpoint
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = True
+        if load_optimizer_states:
+            mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
+        return mod
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        self._symbol.save("%s-symbol.json" % prefix)
+        param_name = "%s-%04d.params" % (prefix, epoch)
+        arg_params, aux_params = self.get_params()
+        save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+        save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+        from ..utils import serialization
+        serialization.save_ndarrays(param_name, save_dict)
+        if save_optimizer_states:
+            self.save_optimizer_states("%s-%04d.states" % (prefix, epoch))
+
+    # -- properties ---------------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return [(n, o.shape) for n, o in
+                zip(self._output_names, self._exec.outputs)] \
+            if self._exec.outputs else None
+
+    # -- params -------------------------------------------------------------
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        arg = {n: self._exec.arg_dict[n].copy() for n in self._param_names}
+        aux = {n: self._exec.aux_dict[n].copy() for n in self._aux_names}
+        return arg, aux
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "call bind before initializing the parameters"
+        from ..initializer import Uniform, InitDesc
+        initializer = initializer if initializer is not None else Uniform(0.01)
+
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            if arg_params is not None and name in arg_params:
+                arr._data = arg_params[name]._data.reshape(arr.shape).astype(
+                    arr._data.dtype)
+            elif self._arg_params is not None and name in self._arg_params:
+                arr._data = self._arg_params[name]._data.reshape(
+                    arr.shape).astype(arr._data.dtype)
+            elif initializer is not None:
+                initializer(InitDesc(name), arr)
+            elif not allow_missing:
+                raise MXNetError("no initializer for %s" % name)
+        for name in self._aux_names:
+            arr = self._exec.aux_dict[name]
+            if aux_params is not None and name in aux_params:
+                arr._data = aux_params[name]._data.reshape(arr.shape)
+            elif self._aux_params is not None and name in self._aux_params:
+                arr._data = self._aux_params[name]._data.reshape(arr.shape)
+        self.params_initialized = True
+        self._params_dirty = False
+
+    # -- bind ---------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        shapes = {}
+        norm_data = []
+        for d in data_shapes:
+            name, shape = (d.name, d.shape) if hasattr(d, "name") else d
+            shapes[name] = tuple(shape)
+            norm_data.append((name, tuple(shape)))
+        self._data_shapes = norm_data
+        norm_label = []
+        if label_shapes:
+            for d in label_shapes:
+                name, shape = (d.name, d.shape) if hasattr(d, "name") else d
+                shapes[name] = tuple(shape)
+                norm_label.append((name, tuple(shape)))
+        self._label_shapes = norm_label
+
+        req = {}
+        for n in self._symbol.list_arguments():
+            if n in self._param_names and n not in self._fixed_param_names:
+                req[n] = grad_req if for_training else "null"
+            elif n in self._data_names and inputs_need_grad:
+                req[n] = grad_req
+            else:
+                req[n] = "null"
+        self._exec = Executor.simple_bind(self._symbol, self._context,
+                                          grad_req=req, **shapes)
+        self.binded = True
+        if shared_module is not None and shared_module.params_initialized:
+            arg, aux = shared_module.get_params()
+            self._exec.copy_params_from(arg, aux)
+            self.params_initialized = True
+
+    # -- compute ------------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feeds = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feeds[name] = arr
+        if data_batch.label is not None:
+            for name, arr in zip(self._label_names, data_batch.label):
+                if name in self._exec.arg_dict:
+                    feeds[name] = arr
+        self._exec.forward(is_train=is_train, **feeds)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized and \
+            self.inputs_need_grad
+        return [self._exec.grad_dict.get(n) for n in self._data_names]
+
+    # -- optimizer ----------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            self.logger.warning("optimizer already initialized, ignoring...")
+            return
+        if isinstance(optimizer, str):
+            # default rescale_grad = 1/batch (parity: module.py:497 — loss
+            # heads emit unnormalized grads; the optimizer rescales)
+            batch_size = self._data_shapes[0][1][0] if self._data_shapes \
+                else 1
+            idx2name = {i: n for i, n in enumerate(self._param_names)}
+            optimizer_params = dict(optimizer_params)
+            optimizer_params.setdefault("rescale_grad", 1.0 / batch_size)
+            optimizer = opt.create(optimizer, param_idx2name=idx2name,
+                                   sym=self._symbol, **optimizer_params)
+        self._optimizer = optimizer
+        if isinstance(kvstore, str):
+            kvstore = kvs.create(kvstore) if kvstore else None
+        self._kvstore = kvstore
+        self._update_on_kvstore = kvstore is not None
+        if kvstore is not None:
+            if self._compression_params:
+                kvstore.set_gradient_compression(self._compression_params)
+            kvstore.set_optimizer(self._optimizer)
+            for i, name in enumerate(self._param_names):
+                kvstore.init(i, self._exec.arg_dict[name])
+        else:
+            self._updater = opt.get_updater(self._optimizer)
+        self.optimizer_initialized = True
+
+    def update(self):
+        """Push grads / apply optimizer (parity: module.py:631 + model.py:126).
+
+        With a kvstore the update runs "server-side" in the store (the
+        reference's dist path); without one, a local Updater applies it."""
+        assert self.binded and self.params_initialized and \
+            self.optimizer_initialized
+        self._params_dirty = True
+        for i, name in enumerate(self._param_names):
+            if self._exec._grad_req.get(name, "null") == "null":
+                continue
+            grad = self._exec.grad_dict.get(name)
+            if grad is None:
+                continue
+            weight = self._exec.arg_dict[name]
+            if self._kvstore is not None:
+                self._kvstore.push(i, grad)
+                self._kvstore.pull(i, out=weight)
+            else:
+                self._updater(i, grad, weight)
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())
+
+    def get_states(self, merge_multi_context=True):
+        return [self._exec.arg_dict[n] for n in self._state_names]
+
+    def set_states(self, states=None, value=None):
+        for n, s in zip(self._state_names, states or []):
+            self._exec.arg_dict[n]._data = s._data
+
+    def install_monitor(self, mon):
+        mon.install(self._exec)
+
+    def save_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._kvstore is not None:
+            self._kvstore.save_optimizer_states(fname)
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._kvstore is not None:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as f:
+                self._updater.set_states(f.read())
+
+    def reshape(self, data_shapes, label_shapes=None):
+        self.bind(data_shapes, label_shapes, self.for_training,
+                  self.inputs_need_grad, force_rebind=True)

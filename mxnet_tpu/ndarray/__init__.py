@@ -1,0 +1,165 @@
+"""mx.nd — the imperative op namespace.
+
+Parity: reference `python/mxnet/ndarray/` where every op function is
+code-generated at import time from the C registry
+(`python/mxnet/ndarray/register.py:156-168`). Here the same happens from the
+pure-Python registry in `mxnet_tpu.ops.registry`.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax.numpy as _jnp
+
+from ..ops import registry as _registry
+from ..base import dtype_np as _dtype_np
+from ..context import current_context
+from .ndarray import NDArray, _apply_op, make_nd_func, _AdhocOp
+
+# generate one function per registered op (incl. aliases)
+for _name in list(_registry.OPS):
+    globals()[_name] = make_nd_func(_registry.OPS[_name])
+
+from . import sparse
+from .sparse import RowSparseNDArray, CSRNDArray, BaseSparseNDArray
+
+
+# ---------------------------------------------------------------------------
+# creation functions (parity: python/mxnet/ndarray/utils.py + ndarray.py)
+# ---------------------------------------------------------------------------
+
+
+def array(source_array, ctx=None, dtype=None):
+    if isinstance(source_array, NDArray):
+        return NDArray(source_array._data, ctx=ctx, dtype=dtype)
+    if dtype is None and not isinstance(source_array, _np.ndarray):
+        dtype = _np.float32  # python lists default to float32 (mxnet parity)
+    arr = _np.asarray(source_array)
+    if dtype is None and arr.dtype == _np.float64:
+        dtype = _np.float32
+    return NDArray(arr, ctx=ctx, dtype=dtype)
+
+
+def zeros(shape, ctx=None, dtype=None, stype=None, **kwargs):
+    if stype and stype != "default":
+        return sparse.zeros(stype, shape, ctx=ctx, dtype=dtype)
+    if _np.isscalar(shape):
+        shape = (int(shape),)
+    return NDArray(_jnp.zeros(shape, dtype=_dtype_np(dtype)), ctx=ctx)
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs):
+    if _np.isscalar(shape):
+        shape = (int(shape),)
+    return NDArray(_jnp.ones(shape, dtype=_dtype_np(dtype)), ctx=ctx)
+
+
+def full(shape, val, ctx=None, dtype=None, out=None):
+    if _np.isscalar(shape):
+        shape = (int(shape),)
+    res = NDArray(_jnp.full(shape, val, dtype=_dtype_np(dtype)), ctx=ctx)
+    if out is not None:
+        out._data = res._data
+        return out
+    return res
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    out = _jnp.arange(start, stop, step, dtype=_dtype_np(dtype))
+    if repeat > 1:
+        out = _jnp.repeat(out, repeat)
+    return NDArray(out, ctx=ctx)
+
+
+def eye(N, M=0, k=0, ctx=None, dtype=None):
+    return NDArray(_jnp.eye(int(N), int(M) if M else None, k=int(k),
+                            dtype=_dtype_np(dtype)), ctx=ctx)
+
+
+def moveaxis(data, source, destination):
+    return NDArray(_jnp.moveaxis(data._data, source, destination), ctx=data._ctx)
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    return NDArray(_jnp.concatenate([a._data for a in arrays], axis=axis),
+                   ctx=arrays[0]._ctx)
+
+
+def split_v2(ary, indices_or_sections, axis=0, squeeze_axis=False):
+    parts = _jnp.split(ary._data, indices_or_sections, axis=axis)
+    if squeeze_axis:
+        parts = [_jnp.squeeze(p, axis=axis) for p in parts]
+    return [NDArray(p, ctx=ary._ctx) for p in parts]
+
+
+def waitall():
+    """Block until all launched work completes (parity: mx.nd.waitall)."""
+    import jax
+    (jax.device_put(0.0) + 0).block_until_ready()
+
+
+def load(fname):
+    from ..utils import serialization
+    return serialization.load_ndarrays(fname)
+
+
+def save(fname, data):
+    from ..utils import serialization
+    serialization.save_ndarrays(fname, data)
+
+
+def imdecode(buf, flag=1, to_rgb=True):
+    from ..image import imdecode as _imdecode
+    return _imdecode(buf, flag=flag, to_rgb=to_rgb)
+
+
+def onehot_encode(indices, out):
+    depth = out.shape[1]
+    res = globals()["one_hot"](indices, depth=depth)
+    out._data = res._data
+    return out
+
+
+# mxnet nd.power/maximum/minimum accept scalar or array on either side
+def _mixed_binary(tensor_op, scalar_op, rscalar_op=None):
+    def fn(lhs, rhs):
+        if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+            return _apply_op(_registry.get(tensor_op), (lhs, rhs), {})
+        if isinstance(lhs, NDArray):
+            return _apply_op(_registry.get(scalar_op), (lhs,),
+                             {"scalar": float(rhs)})
+        if isinstance(rhs, NDArray):
+            return _apply_op(_registry.get(rscalar_op or scalar_op), (rhs,),
+                             {"scalar": float(lhs)})
+        return _np_fallback(tensor_op)(lhs, rhs)
+    fn.__name__ = tensor_op
+    return fn
+
+
+def _np_fallback(name):
+    return {"broadcast_power": _np.power, "broadcast_maximum": _np.maximum,
+            "broadcast_minimum": _np.minimum, "broadcast_add": _np.add,
+            "broadcast_sub": _np.subtract, "broadcast_mul": _np.multiply,
+            "broadcast_div": _np.divide}[name]
+
+
+power = _mixed_binary("broadcast_power", "_power_scalar", "_rpower_scalar")
+maximum = _mixed_binary("broadcast_maximum", "_maximum_scalar")
+minimum = _mixed_binary("broadcast_minimum", "_minimum_scalar")
+add = _mixed_binary("broadcast_add", "_plus_scalar")
+subtract = _mixed_binary("broadcast_sub", "_minus_scalar", "_rminus_scalar")
+multiply = _mixed_binary("broadcast_mul", "_mul_scalar")
+divide = _mixed_binary("broadcast_div", "_div_scalar", "_rdiv_scalar")
+true_divide = divide
+
+
+# ---------------------------------------------------------------------------
+# sub-namespaces (parity: mxnet.ndarray.random / .linalg / .contrib)
+# ---------------------------------------------------------------------------
+from . import random  # noqa: E402
+from . import linalg  # noqa: E402
+from . import contrib  # noqa: E402
+from . import op  # noqa: E402

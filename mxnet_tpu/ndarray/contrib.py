@@ -1,0 +1,43 @@
+"""mx.nd.contrib (parity: python/mxnet/ndarray/contrib.py)."""
+from ..ops import registry as _registry
+from .ndarray import _apply_op
+
+
+def _make(opname):
+    od = _registry.get(opname)
+
+    def fn(*args, **kwargs):
+        return _apply_op(od, args, kwargs)
+
+    fn.__name__ = opname.replace("_contrib_", "")
+    return fn
+
+
+MultiBoxPrior = _make("_contrib_MultiBoxPrior")
+MultiBoxTarget = _make("_contrib_MultiBoxTarget")
+MultiBoxDetection = _make("_contrib_MultiBoxDetection")
+box_iou = _make("_contrib_box_iou")
+box_nms = _make("_contrib_box_nms")
+ctc_loss = _make("_contrib_ctc_loss")
+CTCLoss = ctc_loss
+count_sketch = _make("_contrib_count_sketch")
+fft = _make("_contrib_fft")
+ifft = _make("_contrib_ifft")
+Proposal = _make("_contrib_Proposal")
+BilinearResize2D = _make("_contrib_BilinearResize2D")
+AdaptiveAvgPooling2D = _make("_contrib_AdaptiveAvgPooling2D")
+quadratic = _make("quadratic")
+
+
+def foreach(body, data, init_states):
+    """Parity: contrib control-flow op `foreach` — here a Python loop in eager
+    mode; inside a CachedOp trace XLA unrolls or the user uses lax.scan via
+    hybridize-aware layers."""
+    from .ndarray import NDArray
+    states = init_states if isinstance(init_states, list) else [init_states]
+    outputs = []
+    for i in range(data.shape[0]):
+        out, states = body(data[i], states)
+        outputs.append(out)
+    from . import stack
+    return stack(*outputs, axis=0), states

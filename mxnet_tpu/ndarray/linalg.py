@@ -1,0 +1,25 @@
+"""mx.nd.linalg (parity: python/mxnet/ndarray/linalg.py over la_op.h)."""
+from ..ops import registry as _registry
+from .ndarray import _apply_op
+
+
+def _make(name):
+    od = _registry.get("linalg_" + name)
+
+    def fn(*args, **kwargs):
+        return _apply_op(od, args, kwargs)
+
+    fn.__name__ = name
+    return fn
+
+
+gemm = _make("gemm")
+gemm2 = _make("gemm2")
+potrf = _make("potrf")
+potri = _make("potri")
+trsm = _make("trsm")
+trmm = _make("trmm")
+sumlogdiag = _make("sumlogdiag")
+syrk = _make("syrk")
+gelqf = _make("gelqf")
+syevd = _make("syevd")

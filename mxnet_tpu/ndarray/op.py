@@ -1,0 +1,2 @@
+"""mx.nd.op — flat alias namespace (parity: mxnet.ndarray.op)."""
+from . import *  # noqa: F401,F403

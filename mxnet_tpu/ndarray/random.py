@@ -1,0 +1,107 @@
+"""mx.nd.random — sampling namespace (parity: python/mxnet/ndarray/random.py)."""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..ops import registry as _registry
+from .ndarray import NDArray, _apply_op
+
+
+def _dual(random_op, sample_op):
+    """mxnet semantics: scalar params -> _random_*, NDArray params -> _sample_*."""
+
+    def fn(*params, shape=None, dtype=None, ctx=None, out=None, **kwargs):
+        nd_params = [p for p in params if isinstance(p, NDArray)]
+        if nd_params:
+            call_kwargs = {"shape": shape}
+            if out is not None:
+                call_kwargs["out"] = out
+            return _apply_op(_registry.get(sample_op), tuple(params), call_kwargs)
+        call_kwargs = dict(kwargs)
+        call_kwargs.update({"shape": shape if shape is not None else (1,),
+                            "dtype": dtype or "float32"})
+        if ctx is not None:
+            call_kwargs["ctx"] = ctx
+        if out is not None:
+            call_kwargs["out"] = out
+        names = _PARAM_NAMES[random_op]
+        for n, p in zip(names, params):
+            call_kwargs[n] = float(p)
+        return _apply_op(_registry.get(random_op), (), call_kwargs)
+
+    return fn
+
+
+_PARAM_NAMES = {
+    "_random_uniform": ("low", "high"),
+    "_random_normal": ("loc", "scale"),
+    "_random_gamma": ("alpha", "beta"),
+    "_random_exponential": ("lam",),
+    "_random_poisson": ("lam",),
+    "_random_negative_binomial": ("k", "p"),
+    "_random_generalized_negative_binomial": ("mu", "alpha"),
+}
+
+
+def uniform(low=0, high=1, shape=None, dtype=None, ctx=None, out=None, **kw):
+    return _dual("_random_uniform", "_sample_uniform")(
+        low, high, shape=shape, dtype=dtype, ctx=ctx, out=out)
+
+
+def normal(loc=0, scale=1, shape=None, dtype=None, ctx=None, out=None, **kw):
+    return _dual("_random_normal", "_sample_normal")(
+        loc, scale, shape=shape, dtype=dtype, ctx=ctx, out=out)
+
+
+def randn(*shape, dtype=None, ctx=None, **kw):
+    loc = kw.get("loc", 0)
+    scale = kw.get("scale", 1)
+    return normal(loc, scale, shape=shape or (1,), dtype=dtype, ctx=ctx)
+
+
+def gamma(alpha=1, beta=1, shape=None, dtype=None, ctx=None, out=None, **kw):
+    return _dual("_random_gamma", "_sample_gamma")(
+        alpha, beta, shape=shape, dtype=dtype, ctx=ctx, out=out)
+
+
+def exponential(scale=1, shape=None, dtype=None, ctx=None, out=None, **kw):
+    if isinstance(scale, NDArray):
+        one = scale.__class__(1.0 / scale._data, ctx=scale._ctx)
+        return _dual("_random_exponential", "_sample_exponential")(
+            one, shape=shape, dtype=dtype, ctx=ctx, out=out)
+    return _dual("_random_exponential", "_sample_exponential")(
+        1.0 / scale, shape=shape, dtype=dtype, ctx=ctx, out=out)
+
+
+def poisson(lam=1, shape=None, dtype=None, ctx=None, out=None, **kw):
+    return _dual("_random_poisson", "_sample_poisson")(
+        lam, shape=shape, dtype=dtype, ctx=ctx, out=out)
+
+
+def negative_binomial(k=1, p=1, shape=None, dtype=None, ctx=None, out=None, **kw):
+    return _dual("_random_negative_binomial", "_sample_negative_binomial")(
+        k, p, shape=shape, dtype=dtype, ctx=ctx, out=out)
+
+
+def generalized_negative_binomial(mu=1, alpha=1, shape=None, dtype=None,
+                                  ctx=None, out=None, **kw):
+    return _dual("_random_generalized_negative_binomial",
+                 "_sample_generalized_negative_binomial")(
+        mu, alpha, shape=shape, dtype=dtype, ctx=ctx, out=out)
+
+
+def randint(low, high, shape=None, dtype="int32", ctx=None, out=None, **kw):
+    call_kwargs = {"low": int(low), "high": int(high),
+                   "shape": shape if shape is not None else (1,), "dtype": dtype}
+    if out is not None:
+        call_kwargs["out"] = out
+    return _apply_op(_registry.get("_random_randint"), (), call_kwargs)
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32", **kw):
+    return _apply_op(_registry.get("_sample_multinomial"), (data,),
+                     {"shape": shape, "get_prob": get_prob, "dtype": dtype})
+
+
+def shuffle(data, **kw):
+    return _apply_op(_registry.get("_shuffle"), (data,), {})

@@ -1,0 +1,249 @@
+"""Sparse NDArrays: row_sparse and CSR.
+
+Parity: reference `python/mxnet/ndarray/sparse.py` over the C++ storage types
+(`include/mxnet/ndarray.h:61-66`): RowSparseNDArray (indices + value rows)
+and CSRNDArray (indptr/indices/data).
+
+TPU-native redesign: XLA has no sparse storage, so components are dense
+jax.Arrays (BCOO-style pairs) and sparse math lowers to gather/scatter/
+segment-sum (see mxnet_tpu/ops/sparse.py). The capability surface —
+row_sparse_pull, sparse optimizer updates, retain, sparse dot — is preserved;
+the perf profile differs from CUDA (SURVEY §7 hard part (c)).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..base import dtype_np
+from ..context import current_context
+from ..ops import registry as _registry
+from .ndarray import NDArray
+
+
+class BaseSparseNDArray:
+    def __init__(self, shape, ctx=None, dtype=None):
+        self._shape = tuple(int(s) for s in shape)
+        self._ctx = ctx if ctx is not None else current_context()
+        self._dtype = dtype_np(dtype)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def context(self):
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+    def wait_to_read(self):
+        return self
+
+    def __repr__(self):
+        return "\n%s\n<%s %s @%s>" % (
+            self.asnumpy(), type(self).__name__,
+            "x".join(str(s) for s in self._shape), self._ctx)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """(indices[nnz], values[nnz, cols...]) pair; indices sorted ascending."""
+
+    def __init__(self, indices, values, shape, ctx=None):
+        super().__init__(shape, ctx=ctx, dtype=values.dtype)
+        self._indices = jnp.asarray(indices, dtype=jnp.int64)
+        self._values = values if isinstance(values, jnp.ndarray) else jnp.asarray(values)
+
+    stype = "row_sparse"
+
+    @property
+    def indices(self):
+        return NDArray(self._indices, ctx=self._ctx)
+
+    @property
+    def data(self):
+        return NDArray(self._values, ctx=self._ctx)
+
+    @classmethod
+    def from_dense(cls, arr):
+        data = arr._data if isinstance(arr, NDArray) else jnp.asarray(arr)
+        rows = np.asarray(jnp.any(data.reshape(data.shape[0], -1) != 0, axis=1))
+        idx = np.nonzero(rows)[0]
+        return cls(jnp.asarray(idx, dtype=jnp.int64), data[idx], data.shape,
+                   ctx=getattr(arr, "_ctx", None))
+
+    def todense(self):
+        dense = _registry.get("_rsp_to_dense").fn(
+            self._indices, self._values, num_rows=self._shape[0])
+        return NDArray(dense, ctx=self._ctx)
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            return self.todense()
+        raise ValueError("cannot cast row_sparse to %s" % stype)
+
+    def retain(self, indices):
+        idx = indices._data if isinstance(indices, NDArray) else jnp.asarray(indices)
+        new_idx, vals = _registry.get("sparse_retain").fn(
+            self._indices, self._values, idx)
+        return RowSparseNDArray(new_idx, vals, self._shape, ctx=self._ctx)
+
+    def copyto(self, other):
+        if isinstance(other, RowSparseNDArray):
+            other._indices = self._indices
+            other._values = self._values
+            return other
+        if isinstance(other, NDArray):
+            return self.todense().copyto(other)
+        raise TypeError(type(other))
+
+    def __add__(self, other):
+        if isinstance(other, RowSparseNDArray):
+            merged = self.todense() + other.todense()
+            return RowSparseNDArray.from_dense(merged)
+        return self.todense() + other
+
+
+class CSRNDArray(BaseSparseNDArray):
+    def __init__(self, data, indices, indptr, shape, ctx=None):
+        super().__init__(shape, ctx=ctx, dtype=data.dtype)
+        self._values = jnp.asarray(data)
+        self._indices = jnp.asarray(indices, dtype=jnp.int64)
+        self._indptr = jnp.asarray(indptr, dtype=jnp.int64)
+
+    stype = "csr"
+
+    @property
+    def data(self):
+        return NDArray(self._values, ctx=self._ctx)
+
+    @property
+    def indices(self):
+        return NDArray(self._indices, ctx=self._ctx)
+
+    @property
+    def indptr(self):
+        return NDArray(self._indptr, ctx=self._ctx)
+
+    @classmethod
+    def from_dense(cls, arr):
+        data = np.asarray(arr.asnumpy() if isinstance(arr, NDArray) else arr)
+        indptr = [0]
+        indices = []
+        values = []
+        for row in data:
+            nz = np.nonzero(row)[0]
+            indices.extend(nz.tolist())
+            values.extend(row[nz].tolist())
+            indptr.append(len(indices))
+        return cls(jnp.asarray(np.asarray(values, dtype=data.dtype)),
+                   jnp.asarray(indices, dtype=jnp.int64),
+                   jnp.asarray(indptr, dtype=jnp.int64), data.shape,
+                   ctx=getattr(arr, "_ctx", None))
+
+    def todense(self):
+        dense = _registry.get("_csr_to_dense").fn(
+            self._indptr, self._indices, self._values,
+            num_rows=self._shape[0], num_cols=self._shape[1])
+        return NDArray(dense, ctx=self._ctx)
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return self.todense()
+        raise ValueError("cannot cast csr to %s" % stype)
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            start = key.start or 0
+            stop = key.stop if key.stop is not None else self._shape[0]
+            dense = self.todense()._data[start:stop]
+            return CSRNDArray.from_dense(dense)
+        raise NotImplementedError("csr indexing supports row slices")
+
+
+# -- constructors (parity: mxnet.nd.sparse.row_sparse_array / csr_matrix) ---
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, (tuple, list)) and len(arg1) == 2 and not np.isscalar(arg1[0]):
+        values, indices = arg1
+        values = jnp.asarray(np.asarray(values, dtype=dtype_np(dtype)))
+        return RowSparseNDArray(jnp.asarray(np.asarray(indices, dtype=np.int64)),
+                                values, shape, ctx=ctx)
+    if isinstance(arg1, RowSparseNDArray):
+        return arg1
+    if isinstance(arg1, NDArray):
+        return RowSparseNDArray.from_dense(arg1)
+    return RowSparseNDArray.from_dense(NDArray(np.asarray(arg1, dtype=dtype_np(dtype))))
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, (tuple, list)) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        return CSRNDArray(jnp.asarray(np.asarray(data, dtype=dtype_np(dtype))),
+                          jnp.asarray(np.asarray(indices, dtype=np.int64)),
+                          jnp.asarray(np.asarray(indptr, dtype=np.int64)),
+                          shape, ctx=ctx)
+    if isinstance(arg1, CSRNDArray):
+        return arg1
+    if isinstance(arg1, NDArray):
+        return CSRNDArray.from_dense(arg1)
+    return CSRNDArray.from_dense(NDArray(np.asarray(arg1, dtype=dtype_np(dtype))))
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    if stype == "row_sparse":
+        ncols = shape[1:] if len(shape) > 1 else ()
+        return RowSparseNDArray(jnp.zeros((0,), dtype=jnp.int64),
+                                jnp.zeros((0,) + tuple(ncols), dtype=dtype_np(dtype)),
+                                shape, ctx=ctx)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dtype=dtype_np(dtype)),
+                          jnp.zeros((0,), dtype=jnp.int64),
+                          jnp.zeros((shape[0] + 1,), dtype=jnp.int64),
+                          shape, ctx=ctx)
+    raise ValueError(stype)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot (parity: dot-inl.h sparse kernels)."""
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray):
+        if transpose_a:
+            out = lhs.todense()._data.T @ rhs._data
+            return NDArray(out, ctx=rhs._ctx)
+        out = _registry.get("_csr_dot_dense").fn(
+            lhs._indptr, lhs._indices, lhs._values, rhs._data,
+            num_rows=lhs.shape[0])
+        return NDArray(out, ctx=rhs._ctx)
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        from . import dot as _dense_dot
+        return _dense_dot(lhs, rhs, transpose_a=transpose_a,
+                          transpose_b=transpose_b)
+    raise TypeError("unsupported sparse dot: %s x %s" % (type(lhs), type(rhs)))
+
+
+def add(lhs, rhs):
+    return lhs + rhs
+
+
+def elemwise_add(lhs, rhs):
+    return lhs + rhs
+
+
+def retain(data, indices):
+    return data.retain(indices)

@@ -1,0 +1,129 @@
+"""Custom operators defined in Python.
+
+Parity: reference `src/operator/custom/` + `python/mxnet/operator.py:426,472,
+692` — CustomOp/CustomOpProp/register let users write ops (forward+backward)
+in Python; the reference runs callbacks on a dedicated worker thread so they
+never block engine threads (custom-inl.h:50-170).
+
+TPU-native redesign: eager custom ops run inline (XLA dispatch is already
+async around them); inside jit traces a custom op can either be pure-JAX
+(then it traces straight through) or host-bound (then wrap with
+jax.pure_callback — the io_callback escape hatch of SURVEY §7(f)).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray
+from . import autograd
+
+_REGISTRY = {}
+
+
+class CustomOp:
+    """Base class for custom op execution (parity: operator.py CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst._data = src._data if isinstance(src, NDArray) else src
+        elif req == "add":
+            dst._data = dst._data + (src._data if isinstance(src, NDArray)
+                                     else src)
+        dst._version += 1
+
+
+class CustomOpProp:
+    """Op metadata: shapes, types, arity (parity: operator.py CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+
+def register(reg_name):
+    """Register a CustomOpProp subclass (parity: operator.py:692)."""
+
+    def do_register(prop_cls):
+        _REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return do_register
+
+
+def get(name):
+    if name not in _REGISTRY:
+        raise MXNetError("custom op %s not registered" % name)
+    return _REGISTRY[name]
+
+
+def invoke(op_type, *inputs, **params):
+    """Run a registered custom op imperatively (mx.nd.Custom equivalent)."""
+    prop_cls = get(op_type)
+    prop = prop_cls(**params)
+    in_shapes = [i.shape for i in inputs]
+    _, out_shapes, aux_shapes = prop.infer_shape(list(in_shapes))
+    op = prop.create_operator(None, in_shapes, [i.dtype for i in inputs])
+    import jax.numpy as jnp
+    outs = [NDArray(jnp.zeros(s)) for s in out_shapes]
+    aux = [NDArray(jnp.zeros(s)) for s in aux_shapes]
+    with autograd.pause():
+        op.forward(autograd.is_training(), ["write"] * len(outs),
+                   list(inputs), outs, aux)
+    if autograd.is_recording():
+        n_in = len(inputs)
+
+        def custom_backward(out_grads, input_vals, kwargs):
+            in_grads = [NDArray(jnp.zeros_like(v)) for v in input_vals]
+            with autograd.pause():
+                op.backward(["write"] * n_in,
+                            [NDArray(g) for g in out_grads],
+                            list(inputs), outs, in_grads, aux)
+            return [g._data for g in in_grads]
+
+        class _OpDef:
+            fn = None
+            differentiable = True
+
+        autograd.record_op(_OpDef, list(inputs), [i._data for i in inputs],
+                           outs, {}, custom_backward=custom_backward)
+    return outs[0] if len(outs) == 1 else outs
+
+
+# expose as nd.Custom (parity: mx.nd.Custom)
+def Custom(*inputs, op_type=None, **params):
+    assert op_type is not None, "op_type is required"
+    return invoke(op_type, *inputs, **params)

@@ -1,0 +1,18 @@
+"""Operator library (single registry, dual nd/sym frontends).
+
+Parity: reference `src/operator/` — the nnvm op registry consumed by both the
+imperative and symbolic paths. Submodules:
+  tensor      elemwise/broadcast/reduce/dot/indexing/matrix/ordering/init
+  nn          conv/pool/norm/activation/softmax/rnn/spatial ops
+  random_ops  samplers (jax.random backed)
+  contrib     SSD multibox, bounding boxes, CTC, count_sketch, etc.
+  sparse      row_sparse/CSR representations and ops (BCOO-style pairs)
+"""
+from . import registry
+from .registry import register, get, list_ops, OPS
+
+from . import tensor
+from . import nn
+from . import random_ops
+from . import contrib
+from . import sparse

@@ -1,0 +1,156 @@
+"""Random sampling ops.
+
+Parity: reference `src/operator/random/` (sample_op.h uniform/normal/gamma/
+exponential/poisson/negative_binomial/generalized_negative_binomial,
+multisample_op.h per-distribution-parameter draws, shuffle_op, multinomial)
+backed by per-device RandomGenerator (`src/common/random_generator.h`).
+
+TPU-native redesign: jax.random counter-based PRNG; the global key lives in
+mxnet_tpu.random and is threaded as a traced argument inside jit traces.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from ..random import next_key
+from ..base import dtype_np
+
+
+def _shp(shape):
+    if shape is None:
+        return ()
+    if np.isscalar(shape):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+@register("_random_uniform", differentiable=False, stochastic=True)
+def _random_uniform(low=0.0, high=1.0, shape=None, dtype="float32"):
+    return jax.random.uniform(next_key(), _shp(shape), dtype=dtype_np(dtype),
+                              minval=low, maxval=high)
+
+
+@register("_random_normal", differentiable=False, stochastic=True)
+def _random_normal(loc=0.0, scale=1.0, shape=None, dtype="float32"):
+    return loc + scale * jax.random.normal(next_key(), _shp(shape),
+                                           dtype=dtype_np(dtype))
+
+
+@register("_random_gamma", differentiable=False, stochastic=True)
+def _random_gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32"):
+    return beta * jax.random.gamma(next_key(), alpha, _shp(shape),
+                                   dtype=dtype_np(dtype))
+
+
+@register("_random_exponential", differentiable=False, stochastic=True)
+def _random_exponential(lam=1.0, shape=None, dtype="float32"):
+    return jax.random.exponential(next_key(), _shp(shape),
+                                  dtype=dtype_np(dtype)) / lam
+
+
+@register("_random_poisson", differentiable=False, stochastic=True)
+def _random_poisson(lam=1.0, shape=None, dtype="float32"):
+    return jax.random.poisson(next_key(), lam, _shp(shape)).astype(dtype_np(dtype))
+
+
+@register("_random_negative_binomial", differentiable=False, stochastic=True)
+def _random_negative_binomial(k=1, p=1.0, shape=None, dtype="float32"):
+    lam = jax.random.gamma(next_key(), float(k), _shp(shape)) * (1 - p) / p
+    return jax.random.poisson(next_key(), lam, _shp(shape)).astype(dtype_np(dtype))
+
+
+@register("_random_generalized_negative_binomial", differentiable=False,
+          stochastic=True)
+def _random_generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None,
+                                          dtype="float32"):
+    if alpha == 0.0:
+        return jax.random.poisson(next_key(), mu, _shp(shape)).astype(dtype_np(dtype))
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(next_key(), r, _shp(shape)) * (1 - p) / p
+    return jax.random.poisson(next_key(), lam, _shp(shape)).astype(dtype_np(dtype))
+
+
+@register("_random_randint", differentiable=False, stochastic=True)
+def _random_randint(low=0, high=1, shape=None, dtype="int32"):
+    return jax.random.randint(next_key(), _shp(shape), int(low), int(high),
+                              dtype=dtype_np(dtype))
+
+
+# sample_* variants: one draw per element of the parameter tensors
+# (parity: multisample_op.h)
+
+
+@register("_sample_uniform", differentiable=False, stochastic=True)
+def _sample_uniform(low, high, shape=None, dtype=None):
+    s = _shp(shape)
+    u = jax.random.uniform(next_key(), low.shape + s, dtype=low.dtype)
+    low_b = low.reshape(low.shape + (1,) * len(s))
+    high_b = high.reshape(high.shape + (1,) * len(s))
+    return (low_b + u * (high_b - low_b)).reshape(low.shape + s)
+
+
+@register("_sample_normal", differentiable=False, stochastic=True)
+def _sample_normal(mu, sigma, shape=None, dtype=None):
+    s = _shp(shape)
+    z = jax.random.normal(next_key(), mu.shape + s, dtype=mu.dtype)
+    return mu.reshape(mu.shape + (1,) * len(s)) + \
+        sigma.reshape(sigma.shape + (1,) * len(s)) * z
+
+
+@register("_sample_gamma", differentiable=False, stochastic=True)
+def _sample_gamma(alpha, beta, shape=None, dtype=None):
+    s = _shp(shape)
+    a = alpha.reshape(alpha.shape + (1,) * len(s))
+    g = jax.random.gamma(next_key(), jnp.broadcast_to(a, alpha.shape + s))
+    return g * beta.reshape(beta.shape + (1,) * len(s))
+
+
+@register("_sample_exponential", differentiable=False, stochastic=True)
+def _sample_exponential(lam, shape=None, dtype=None):
+    s = _shp(shape)
+    e = jax.random.exponential(next_key(), lam.shape + s, dtype=lam.dtype)
+    return e / lam.reshape(lam.shape + (1,) * len(s))
+
+
+@register("_sample_poisson", differentiable=False, stochastic=True)
+def _sample_poisson(lam, shape=None, dtype=None):
+    s = _shp(shape)
+    l = jnp.broadcast_to(lam.reshape(lam.shape + (1,) * len(s)), lam.shape + s)
+    return jax.random.poisson(next_key(), l).astype(lam.dtype)
+
+
+@register("_sample_multinomial", differentiable=False, stochastic=True)
+def _sample_multinomial(data, shape=None, get_prob=False, dtype="int32"):
+    """data: [..., K] probabilities; returns [..., *shape] class indices."""
+    s = _shp(shape) or ()
+    n = int(np.prod(s)) if s else 1
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    flat = logits.reshape(-1, data.shape[-1])
+    draws = jax.random.categorical(next_key(), flat[:, None, :].repeat(n, axis=1),
+                                   axis=-1)  # [B, n]
+    out = draws.reshape(data.shape[:-1] + (s if s else ()))
+    out = out.astype(dtype_np(dtype))
+    if get_prob:
+        logp = jnp.take_along_axis(
+            jax.nn.log_softmax(flat, axis=-1),
+            draws.astype(jnp.int32), axis=1).reshape(out.shape)
+        return out, logp
+    return out
+
+
+@register("_shuffle", differentiable=False, stochastic=True)
+def _shuffle(data):
+    """Shuffle along the first axis (parity: shuffle_op.cc)."""
+    return jax.random.permutation(next_key(), data, axis=0)
+
+
+@register("_sample_unique_zipfian", differentiable=False, stochastic=True)
+def _sample_unique_zipfian(range_max=1, shape=None):
+    s = _shp(shape)
+    u = jax.random.uniform(next_key(), s)
+    out = jnp.exp(u * jnp.log(float(range_max) + 1.0)) - 1.0
+    return jnp.clip(out.astype(jnp.int64), 0, range_max - 1)

@@ -1,0 +1,81 @@
+"""Operator registry — the single source of truth for the op library.
+
+Parity: the reference registers ~190 ops into the nnvm registry with
+attributes (FCompute, FGradient, shape/type inference) and code-gens the
+Python `mx.nd.*` / `mx.sym.*` namespaces from it
+(`src/operator/*`, `python/mxnet/ndarray/register.py:156`).
+
+TPU-native redesign: an op is a *pure JAX function* over jax.Arrays
+(positional args = tensors, keyword args = static params). Shape/dtype
+inference, fusion, memory planning and gradients all come from XLA/jax
+tracing, so the registry only records the function plus light metadata.
+Both the imperative namespace (`mxnet_tpu.ndarray`) and the symbolic one
+(`mxnet_tpu.symbol`) are generated from this table, mirroring the
+reference's single-registry / dual-frontend design.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+
+OPS = {}
+
+
+class OpDef:
+    __slots__ = ("name", "fn", "num_inputs", "num_outputs", "differentiable",
+                 "stochastic", "aliases", "doc")
+
+    def __init__(self, name, fn, num_inputs, num_outputs, differentiable,
+                 stochastic, aliases, doc):
+        self.name = name
+        self.fn = fn
+        self.num_inputs = num_inputs  # -1 = variadic (list input)
+        self.num_outputs = num_outputs
+        self.differentiable = differentiable
+        self.stochastic = stochastic
+        self.aliases = aliases
+        self.doc = doc
+
+    def __repr__(self):
+        return "OpDef(%s)" % self.name
+
+
+def register(name=None, *, num_outputs=1, differentiable=True,
+             stochastic=False, aliases=()):
+    """Register a pure-JAX op function.
+
+    The wrapped function's signature is ``fn(*tensors, **params)`` where every
+    positional argument is a jax.Array and every keyword argument is a static
+    (hashable) parameter — the analog of the reference's dmlc::Parameter
+    structs (`src/operator/.. *-inl.h`).
+    """
+
+    def deco(fn):
+        opname = name or fn.__name__
+        sig = inspect.signature(fn)
+        npos = 0
+        variadic = False
+        for p in sig.parameters.values():
+            if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD) and p.default is p.empty:
+                npos += 1
+            elif p.kind == p.VAR_POSITIONAL:
+                variadic = True
+        od = OpDef(opname, fn, -1 if variadic else npos, num_outputs,
+                   differentiable, stochastic, tuple(aliases), fn.__doc__ or "")
+        OPS[opname] = od
+        for a in aliases:
+            OPS[a] = od
+        return fn
+
+    return deco
+
+
+def get(name):
+    try:
+        return OPS[name]
+    except KeyError:
+        raise KeyError("Operator '%s' is not registered" % name) from None
+
+
+def list_ops():
+    return sorted(set(od.name for od in OPS.values()))

@@ -1,0 +1,92 @@
+"""Sparse storage ops (row_sparse / CSR).
+
+Parity: reference sparse support — `include/mxnet/ndarray.h:61-66` storage
+types, `src/operator/tensor/cast_storage-inl.h`, `sparse_retain`,
+`dot-inl.h` sparse×dense kernels.
+
+TPU-native redesign: XLA has no native sparse tensors, so row_sparse is a
+(indices[nnz], values[nnz, cols...]) dense pair and CSR is
+(indptr, indices, values) — BCOO-style. Ops below work on these component
+arrays; the user-facing RowSparseNDArray/CSRNDArray classes live in
+`mxnet_tpu.ndarray.sparse`. Gathers/scatters lower to XLA gather/scatter;
+perf cliffs differ from the CUDA kernels (documented in SURVEY §7 hard
+part (c)).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("_rsp_to_dense")
+def rsp_to_dense(indices, values, num_rows=0):
+    shape = (int(num_rows),) + values.shape[1:]
+    out = jnp.zeros(shape, dtype=values.dtype)
+    return out.at[indices.astype(jnp.int32)].add(values)
+
+
+@register("_dense_to_rsp", num_outputs=2, differentiable=False)
+def dense_to_rsp(dense):
+    """Full-row storage (all rows retained; zero rows stay zero rows).
+
+    Note: for static shapes we keep nnz == num_rows; truly compacted storage
+    happens host-side in RowSparseNDArray construction.
+    """
+    idx = jnp.arange(dense.shape[0], dtype=jnp.int64)
+    return idx, dense
+
+
+@register("_csr_to_dense")
+def csr_to_dense(indptr, indices, values, num_rows=0, num_cols=0):
+    nnz = values.shape[0]
+    rows = jnp.searchsorted(indptr.astype(jnp.int32),
+                            jnp.arange(nnz, dtype=jnp.int32), side="right") - 1
+    out = jnp.zeros((int(num_rows), int(num_cols)), dtype=values.dtype)
+    return out.at[rows, indices.astype(jnp.int32)].add(values)
+
+
+@register("sparse_retain", num_outputs=2)
+def sparse_retain(indices, values, new_idx):
+    """Retain only rows listed in new_idx (parity: sparse_retain op).
+
+    Rows of `new_idx` absent from `indices` produce zero rows.
+    """
+    pos = jnp.searchsorted(indices.astype(jnp.int64), new_idx.astype(jnp.int64))
+    pos = jnp.clip(pos, 0, indices.shape[0] - 1)
+    found = indices[pos].astype(jnp.int64) == new_idx.astype(jnp.int64)
+    vals = jnp.where(found.reshape((-1,) + (1,) * (values.ndim - 1)),
+                     values[pos], jnp.zeros((), dtype=values.dtype))
+    return new_idx, vals
+
+
+@register("_csr_dot_dense")
+def csr_dot_dense(indptr, indices, values, rhs, num_rows=0, transpose_lhs=False):
+    """dot(csr, dense) via segment-sum (parity: dot-inl.h csr kernels)."""
+    nnz = values.shape[0]
+    rows = jnp.searchsorted(indptr.astype(jnp.int32),
+                            jnp.arange(nnz, dtype=jnp.int32), side="right") - 1
+    cols = indices.astype(jnp.int32)
+    if transpose_lhs:
+        # out[c, :] += v * rhs[r, :]
+        contrib = values[:, None] * rhs[rows]
+        out = jnp.zeros((rhs.shape[1] if rhs.ndim > 1 else 1,), dtype=values.dtype)
+        ncols_out = int(jnp.max(cols)) + 1 if nnz else 0
+        raise NotImplementedError("use dense fallback for csr^T dot")
+    contrib = values[:, None] * rhs[cols]
+    out = jax.ops.segment_sum(contrib, rows, num_segments=int(num_rows))
+    return out.astype(rhs.dtype)
+
+
+@register("_rsp_dot_dense")
+def rsp_dot_dense(indices, values, rhs):
+    return jnp.matmul(values, rhs)  # caller scatters rows back
+
+
+@register("_rsp_elemwise_add", num_outputs=2)
+def rsp_elemwise_add(idx_a, val_a, idx_b, val_b):
+    """Add two row_sparse pairs -> merged (concatenated, caller may compact)."""
+    idx = jnp.concatenate([idx_a, idx_b])
+    vals = jnp.concatenate([val_a, val_b])
+    return idx, vals

@@ -1,0 +1,909 @@
+"""Tensor ops: elementwise, broadcast, reduce, dot, indexing, matrix
+manipulation, ordering, init.
+
+Parity: reference `src/operator/tensor/` (~35k LoC of C++/CUDA across
+elemwise_*, broadcast_reduce, dot, indexing_op, init_op, matrix_op,
+ordering_op, la_op). TPU-native redesign: every op is a pure jax.numpy/lax
+expression — XLA does the tiling/fusion the reference hand-wrote kernels for;
+gradients come from jax.vjp instead of registered FGradient entries.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+# ---------------------------------------------------------------------------
+# elementwise binary (parity: src/operator/tensor/elemwise_binary_op_basic.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("elemwise_add", aliases=("_plus", "_add"))
+def elemwise_add(lhs, rhs):
+    return jnp.add(lhs, rhs)
+
+
+@register("elemwise_sub", aliases=("_minus", "_sub"))
+def elemwise_sub(lhs, rhs):
+    return jnp.subtract(lhs, rhs)
+
+
+@register("elemwise_mul", aliases=("_mul",))
+def elemwise_mul(lhs, rhs):
+    return jnp.multiply(lhs, rhs)
+
+
+@register("elemwise_div", aliases=("_div",))
+def elemwise_div(lhs, rhs):
+    return jnp.divide(lhs, rhs)
+
+
+@register("_mod")
+def _mod(lhs, rhs):
+    return jnp.mod(lhs, rhs)
+
+
+@register("_power", aliases=("pow",))
+def _power(lhs, rhs):
+    return jnp.power(lhs, rhs)
+
+
+@register("_maximum")
+def _maximum(lhs, rhs):
+    return jnp.maximum(lhs, rhs)
+
+
+@register("_minimum")
+def _minimum(lhs, rhs):
+    return jnp.minimum(lhs, rhs)
+
+
+@register("_hypot")
+def _hypot(lhs, rhs):
+    return jnp.hypot(lhs, rhs)
+
+
+# comparison ops (non-differentiable; parity: elemwise_binary_op_logic.cc)
+for _name, _fn in [
+    ("_equal", jnp.equal), ("_not_equal", jnp.not_equal),
+    ("_greater", jnp.greater), ("_greater_equal", jnp.greater_equal),
+    ("_lesser", jnp.less), ("_lesser_equal", jnp.less_equal),
+    ("_logical_and", jnp.logical_and), ("_logical_or", jnp.logical_or),
+    ("_logical_xor", jnp.logical_xor),
+]:
+    def _mk(fn):
+        def cmp_op(lhs, rhs):
+            return fn(lhs, rhs).astype(jnp.result_type(lhs))
+        return cmp_op
+    register(_name, differentiable=False)(_mk(_fn))
+
+
+# ---------------------------------------------------------------------------
+# scalar variants (parity: elemwise_binary_scalar_op_*.cc — mxnet keeps
+# tensor∘scalar as separate ops so the scalar stays a static attribute)
+# ---------------------------------------------------------------------------
+
+
+@register("_plus_scalar")
+def _plus_scalar(data, scalar=0.0):
+    return data + jnp.asarray(scalar, dtype=data.dtype)
+
+
+@register("_minus_scalar")
+def _minus_scalar(data, scalar=0.0):
+    return data - jnp.asarray(scalar, dtype=data.dtype)
+
+
+@register("_rminus_scalar")
+def _rminus_scalar(data, scalar=0.0):
+    return jnp.asarray(scalar, dtype=data.dtype) - data
+
+
+@register("_mul_scalar")
+def _mul_scalar(data, scalar=1.0):
+    return data * jnp.asarray(scalar, dtype=data.dtype)
+
+
+@register("_div_scalar")
+def _div_scalar(data, scalar=1.0):
+    return data / jnp.asarray(scalar, dtype=data.dtype)
+
+
+@register("_rdiv_scalar")
+def _rdiv_scalar(data, scalar=1.0):
+    return jnp.asarray(scalar, dtype=data.dtype) / data
+
+
+@register("_mod_scalar")
+def _mod_scalar(data, scalar=1.0):
+    return jnp.mod(data, jnp.asarray(scalar, dtype=data.dtype))
+
+
+@register("_rmod_scalar")
+def _rmod_scalar(data, scalar=1.0):
+    return jnp.mod(jnp.asarray(scalar, dtype=data.dtype), data)
+
+
+@register("_power_scalar")
+def _power_scalar(data, scalar=1.0):
+    return jnp.power(data, jnp.asarray(scalar, dtype=data.dtype))
+
+
+@register("_rpower_scalar")
+def _rpower_scalar(data, scalar=1.0):
+    return jnp.power(jnp.asarray(scalar, dtype=data.dtype), data)
+
+
+@register("_maximum_scalar")
+def _maximum_scalar(data, scalar=0.0):
+    return jnp.maximum(data, jnp.asarray(scalar, dtype=data.dtype))
+
+
+@register("_minimum_scalar")
+def _minimum_scalar(data, scalar=0.0):
+    return jnp.minimum(data, jnp.asarray(scalar, dtype=data.dtype))
+
+
+for _name, _fn in [
+    ("_equal_scalar", jnp.equal), ("_not_equal_scalar", jnp.not_equal),
+    ("_greater_scalar", jnp.greater), ("_greater_equal_scalar", jnp.greater_equal),
+    ("_lesser_scalar", jnp.less), ("_lesser_equal_scalar", jnp.less_equal),
+]:
+    def _mks(fn):
+        def cmp_scalar(data, scalar=0.0):
+            return fn(data, jnp.asarray(scalar, dtype=data.dtype)).astype(data.dtype)
+        return cmp_scalar
+    register(_name, differentiable=False)(_mks(_fn))
+
+
+# ---------------------------------------------------------------------------
+# elementwise unary (parity: elemwise_unary_op_basic.cc + mshadow_op.h's 64
+# scalar functors — here each is one jnp call XLA fuses into neighbors)
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "abs": jnp.abs, "sign": jnp.sign, "rint": jnp.rint, "ceil": jnp.ceil,
+    "floor": jnp.floor, "trunc": jnp.trunc, "fix": jnp.trunc,
+    "square": jnp.square, "sqrt": jnp.sqrt,
+    "cbrt": jnp.cbrt, "exp": jnp.exp, "log": jnp.log, "log10": jnp.log10,
+    "log2": jnp.log2, "log1p": jnp.log1p, "expm1": jnp.expm1,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan, "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos, "arctan": jnp.arctan, "degrees": jnp.degrees,
+    "radians": jnp.radians, "sinh": jnp.sinh, "cosh": jnp.cosh,
+    "tanh": jnp.tanh, "arcsinh": jnp.arcsinh, "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh, "negative": jnp.negative,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": jax.scipy.special.gammaln,
+    "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv,
+}
+for _name, _fn in _UNARY.items():
+    def _mku(fn):
+        def unary_op(data):
+            return fn(data)
+        return unary_op
+    register(_name)(_mku(_fn))
+
+
+@register("reciprocal")
+def reciprocal(data):
+    return 1.0 / data
+
+
+@register("rsqrt")
+def rsqrt(data):
+    return lax.rsqrt(data)
+
+
+@register("rcbrt")
+def rcbrt(data):
+    return 1.0 / jnp.cbrt(data)
+
+
+@register("_copy", aliases=("identity",))
+def _copy(data):
+    return data + jnp.zeros((), dtype=data.dtype)  # force a fresh buffer
+
+
+@register("BlockGrad", aliases=("stop_gradient", "make_loss_identity"))
+def BlockGrad(data):
+    return lax.stop_gradient(data)
+
+
+@register("clip")
+def clip(data, a_min=0.0, a_max=1.0):
+    return jnp.clip(data, a_min, a_max)
+
+
+@register("Cast", aliases=("cast",), differentiable=False)
+def Cast(data, dtype="float32"):
+    from ..base import dtype_np
+    return data.astype(dtype_np(dtype))
+
+
+@register("logical_not", differentiable=False)
+def logical_not(data):
+    return jnp.logical_not(data).astype(data.dtype)
+
+
+@register("isnan", differentiable=False)
+def isnan(data):
+    return jnp.isnan(data)
+
+
+@register("isinf", differentiable=False)
+def isinf(data):
+    return jnp.isinf(data)
+
+
+# ---------------------------------------------------------------------------
+# broadcast binary (parity: broadcast_reduce_op + elemwise w/ broadcasting;
+# jnp broadcasts natively so these alias the elemwise impls)
+# ---------------------------------------------------------------------------
+
+for _bname, _efn in [
+    ("broadcast_add", jnp.add), ("broadcast_plus", jnp.add),
+    ("broadcast_sub", jnp.subtract), ("broadcast_minus", jnp.subtract),
+    ("broadcast_mul", jnp.multiply), ("broadcast_div", jnp.divide),
+    ("broadcast_mod", jnp.mod), ("broadcast_power", jnp.power),
+    ("broadcast_maximum", jnp.maximum), ("broadcast_minimum", jnp.minimum),
+    ("broadcast_hypot", jnp.hypot),
+]:
+    def _mkb(fn):
+        def bcast_op(lhs, rhs):
+            return fn(lhs, rhs)
+        return bcast_op
+    register(_bname)(_mkb(_efn))
+
+for _bname, _efn in [
+    ("broadcast_equal", jnp.equal), ("broadcast_not_equal", jnp.not_equal),
+    ("broadcast_greater", jnp.greater),
+    ("broadcast_greater_equal", jnp.greater_equal),
+    ("broadcast_lesser", jnp.less), ("broadcast_lesser_equal", jnp.less_equal),
+    ("broadcast_logical_and", jnp.logical_and),
+    ("broadcast_logical_or", jnp.logical_or),
+    ("broadcast_logical_xor", jnp.logical_xor),
+]:
+    def _mkbc(fn):
+        def bcast_cmp(lhs, rhs):
+            return fn(lhs, rhs).astype(jnp.result_type(lhs))
+        return bcast_cmp
+    register(_bname, differentiable=False)(_mkbc(_efn))
+
+
+@register("broadcast_to")
+def broadcast_to(data, shape=()):
+    shape = tuple(int(s) if int(s) != 0 else int(d)
+                  for s, d in zip(shape, data.shape))
+    return jnp.broadcast_to(data, shape)
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def broadcast_axis(data, axis=(), size=()):
+    axis = (axis,) if np.isscalar(axis) else tuple(axis)
+    size = (size,) if np.isscalar(size) else tuple(size)
+    shape = list(data.shape)
+    for a, s in zip(axis, size):
+        shape[a] = int(s)
+    return jnp.broadcast_to(data, tuple(shape))
+
+
+@register("broadcast_like")
+def broadcast_like(lhs, rhs):
+    return jnp.broadcast_to(lhs, rhs.shape)
+
+
+# ---------------------------------------------------------------------------
+# reductions (parity: broadcast_reduce-inl.h)
+# ---------------------------------------------------------------------------
+
+def _norm_axis(axis):
+    if axis is None or axis == ():
+        return None
+    if np.isscalar(axis):
+        return int(axis)
+    return tuple(int(a) for a in axis)
+
+
+def _make_reduce(jfn):
+    def reduce_op(data, axis=None, keepdims=False, exclude=False):
+        ax = _norm_axis(axis)
+        if exclude and ax is not None:
+            all_ax = set(range(data.ndim))
+            inc = {ax} if isinstance(ax, int) else set(a % data.ndim for a in ax)
+            ax = tuple(sorted(all_ax - inc))
+        return jfn(data, axis=ax, keepdims=bool(keepdims))
+    return reduce_op
+
+
+for _rname, _rfn in [
+    ("sum", jnp.sum), ("mean", jnp.mean), ("prod", jnp.prod),
+    ("max", jnp.max), ("min", jnp.min),
+]:
+    register(_rname)(_make_reduce(_rfn))
+
+register("nansum")(_make_reduce(jnp.nansum))
+register("nanprod")(_make_reduce(jnp.nanprod))
+
+
+@register("argmax", differentiable=False)
+def argmax(data, axis=None, keepdims=False):
+    ax = _norm_axis(axis)
+    out = jnp.argmax(data, axis=ax)
+    if keepdims and ax is not None:
+        out = jnp.expand_dims(out, ax)
+    return out.astype(jnp.float32)
+
+
+@register("argmin", differentiable=False)
+def argmin(data, axis=None, keepdims=False):
+    ax = _norm_axis(axis)
+    out = jnp.argmin(data, axis=ax)
+    if keepdims and ax is not None:
+        out = jnp.expand_dims(out, ax)
+    return out.astype(jnp.float32)
+
+
+@register("argmax_channel", differentiable=False)
+def argmax_channel(data):
+    return jnp.argmax(data, axis=-1).astype(jnp.float32)
+
+
+@register("norm")
+def norm(data, ord=2, axis=None, keepdims=False):
+    ax = _norm_axis(axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=ax, keepdims=bool(keepdims))
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=bool(keepdims)))
+
+
+@register("L2Normalization")
+def L2Normalization(data, eps=1e-10, mode="instance"):
+    """Parity: src/operator/l2_normalization-inl.h."""
+    if mode == "instance":
+        ax = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        ax = (1,)
+    elif mode == "spatial":
+        ax = tuple(range(2, data.ndim))
+    else:
+        raise ValueError("unknown mode %s" % mode)
+    nrm = jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=True) + eps)
+    return data / nrm
+
+
+@register("square_sum")
+def square_sum(data, axis=None, keepdims=False):
+    """Parity: src/operator/tensor/square_sum-inl.h (sparse fused square+sum)."""
+    return jnp.sum(jnp.square(data), axis=_norm_axis(axis), keepdims=bool(keepdims))
+
+
+# ---------------------------------------------------------------------------
+# dot / linalg (parity: dot-inl.h, la_op.h — MXU territory)
+# ---------------------------------------------------------------------------
+
+
+@register("dot")
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    a = lhs.T if transpose_a else lhs
+    b = rhs.T if transpose_b else rhs
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    # mxnet dot: contract last axis of a with first axis of b
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot")
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)
+
+
+@register("khatri_rao")
+def khatri_rao(*mats):
+    """Column-wise Khatri-Rao product (parity: contrib krprod.cc)."""
+    out = mats[0]
+    for m in mats[1:]:
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, out.shape[1])
+    return out
+
+
+@register("linalg_gemm")
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@register("linalg_gemm2")
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register("linalg_potrf")
+def linalg_potrf(A):
+    return jnp.linalg.cholesky(A)
+
+
+@register("linalg_potri")
+def linalg_potri(A):
+    L = A
+    inv = jnp.linalg.inv(jnp.matmul(L, jnp.swapaxes(L, -1, -2)))
+    return inv
+
+
+@register("linalg_trsm")
+def linalg_trsm(A, B, transpose=False, rightside=False, alpha=1.0, lower=True):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    low = bool(lower) != bool(transpose)
+    if rightside:
+        # X A = alpha B  ->  A^T X^T = alpha B^T
+        xt = jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(a, -1, -2), jnp.swapaxes(alpha * B, -1, -2), lower=not low)
+        return jnp.swapaxes(xt, -1, -2)
+    return jax.scipy.linalg.solve_triangular(a, alpha * B, lower=low)
+
+
+@register("linalg_trmm")
+def linalg_trmm(A, B, transpose=False, rightside=False, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    return alpha * (jnp.matmul(B, a) if rightside else jnp.matmul(a, B))
+
+
+@register("linalg_sumlogdiag")
+def linalg_sumlogdiag(A):
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("linalg_syrk")
+def linalg_syrk(A, transpose=False, alpha=1.0):
+    at = jnp.swapaxes(A, -1, -2)
+    return alpha * (jnp.matmul(at, A) if transpose else jnp.matmul(A, at))
+
+
+@register("linalg_gelqf", num_outputs=2)
+def linalg_gelqf(A):
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2))
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("linalg_syevd", num_outputs=2)
+def linalg_syevd(A):
+    w, v = jnp.linalg.eigh(A)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+# ---------------------------------------------------------------------------
+# matrix manipulation (parity: matrix_op-inl.h)
+# ---------------------------------------------------------------------------
+
+
+@register("Reshape", aliases=("reshape",))
+def Reshape(data, shape=(), reverse=False):
+    return jnp.reshape(data, _infer_reshape(data.shape, shape, reverse))
+
+
+def _infer_reshape(dshape, tshape, reverse=False):
+    """Implements mxnet's reshape special codes 0,-1,-2,-3,-4
+    (parity: matrix_op-inl.h InferReshapeShape)."""
+    tshape = list(tshape)
+    if reverse:
+        dshape = tuple(reversed(dshape))
+        tshape = list(reversed(tshape))
+    out = []
+    src = list(dshape)
+    i = 0  # index into src
+    j = 0
+    while j < len(tshape):
+        t = tshape[j]
+        if t == 0:
+            out.append(src[i]); i += 1
+        elif t == -1:
+            out.append(-1); i += 1
+        elif t == -2:
+            out.extend(src[i:]); i = len(src)
+        elif t == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif t == -4:
+            a, b = tshape[j + 1], tshape[j + 2]
+            if a == -1:
+                a = src[i] // b
+            if b == -1:
+                b = src[i] // a
+            out.extend([a, b]); i += 1; j += 2
+        else:
+            out.append(int(t))
+            # advance src cursor heuristically
+            if i < len(src):
+                i += 1
+        j += 1
+    if out.count(-1) == 1:
+        known = int(np.prod([x for x in out if x != -1])) or 1
+        total = int(np.prod(dshape)) if dshape else 1
+        out[out.index(-1)] = total // known
+    if reverse:
+        out = list(reversed(out))
+    return tuple(out)
+
+
+@register("Flatten", aliases=("flatten",))
+def Flatten(data):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register("transpose")
+def transpose(data, axes=()):
+    if not axes:
+        axes = None
+    return jnp.transpose(data, axes)
+
+
+@register("expand_dims")
+def expand_dims(data, axis=0):
+    return jnp.expand_dims(data, axis)
+
+
+@register("squeeze")
+def squeeze(data, axis=None):
+    return jnp.squeeze(data, axis=_norm_axis(axis))
+
+
+@register("slice", aliases=("crop",))
+def slice_op(data, begin=(), end=(), step=()):
+    idx = []
+    step = tuple(step) if step else (None,) * len(begin)
+    for b, e, s in zip(begin, end, step):
+        idx.append(builtins_slice(b, e, s))
+    return data[tuple(idx)]
+
+
+def builtins_slice(b, e, s):
+    b = None if b is None else int(b)
+    e = None if e is None else int(e)
+    s = None if s is None else int(s)
+    return slice(b, e, s)
+
+
+@register("slice_axis")
+def slice_axis(data, axis=0, begin=0, end=None):
+    idx = [slice(None)] * data.ndim
+    idx[axis] = slice(begin, None if end is None else int(end))
+    return data[tuple(idx)]
+
+
+@register("slice_like")
+def slice_like(data, shape_like, axes=()):
+    axes = tuple(axes) if axes else tuple(range(shape_like.ndim))
+    idx = [slice(None)] * data.ndim
+    for a in axes:
+        idx[a] = slice(0, shape_like.shape[a])
+    return data[tuple(idx)]
+
+
+@register("Concat", aliases=("concat",))
+def Concat(*data, dim=1):
+    return jnp.concatenate(data, axis=dim)
+
+
+@register("stack")
+def stack(*data, axis=0):
+    return jnp.stack(data, axis=axis)
+
+
+@register("SliceChannel", aliases=("split",), num_outputs=-1)
+def SliceChannel(data, num_outputs=1, axis=1, squeeze_axis=False):
+    parts = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if num_outputs > 1 else parts[0]
+
+
+@register("swapaxes", aliases=("SwapAxis",))
+def swapaxes(data, dim1=0, dim2=0):
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+@register("flip", aliases=("reverse",))
+def flip(data, axis=0):
+    ax = _norm_axis(axis)
+    return jnp.flip(data, axis=ax)
+
+
+@register("tile")
+def tile(data, reps=()):
+    return jnp.tile(data, tuple(int(r) for r in reps))
+
+
+@register("repeat")
+def repeat(data, repeats=1, axis=None):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@register("Pad", aliases=("pad",))
+def Pad(data, mode="constant", pad_width=(), constant_value=0.0):
+    pw = np.asarray(pad_width, dtype=np.int64).reshape(-1, 2)
+    pw = [tuple(p) for p in pw]
+    if mode == "constant":
+        return jnp.pad(data, pw, mode="constant", constant_values=constant_value)
+    if mode == "edge":
+        return jnp.pad(data, pw, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(data, pw, mode="reflect")
+    raise ValueError("unknown pad mode %s" % mode)
+
+
+@register("diag")
+def diag(data, k=0):
+    if data.ndim == 1:
+        return jnp.diag(data, k)
+    return jnp.diagonal(data, offset=k, axis1=-2, axis2=-1)
+
+
+@register("where")
+def where(condition, x, y):
+    return jnp.where(condition.astype(bool), x, y)
+
+
+@register("depth_to_space")
+def depth_to_space(data, block_size=1):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register("space_to_depth")
+def space_to_depth(data, block_size=1):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+@register("reshape_like")
+def reshape_like(lhs, rhs):
+    return jnp.reshape(lhs, rhs.shape)
+
+
+@register("shape_array", differentiable=False)
+def shape_array(data):
+    return jnp.asarray(data.shape, dtype=jnp.int64)
+
+
+@register("size_array", differentiable=False)
+def size_array(data):
+    return jnp.asarray([data.size], dtype=jnp.int64)
+
+
+# ---------------------------------------------------------------------------
+# indexing / embedding / take / scatter (parity: indexing_op.h)
+# ---------------------------------------------------------------------------
+
+
+@register("Embedding")
+def Embedding(data, weight, input_dim=0, output_dim=0, dtype="float32",
+              sparse_grad=False):
+    """Parity: src/operator/tensor/indexing_op.h Embedding.
+
+    TPU note: gather lowers to a dynamic-gather HLO; sparse_grad maps to
+    row-sparse grads in the reference — here grads stay dense (XLA scatter-add)
+    with the row_sparse surface handled at the KVStore level.
+    """
+    idx = data.astype(jnp.int32)
+    return jnp.take(weight, idx, axis=0)
+
+
+@register("take")
+def take(a, indices, axis=0, mode="clip"):
+    idx = indices.astype(jnp.int32)
+    return jnp.take(a, idx, axis=axis, mode="clip" if mode == "clip" else "wrap")
+
+
+@register("batch_take")
+def batch_take(a, indices):
+    idx = indices.astype(jnp.int32)
+    return jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
+
+
+@register("pick")
+def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[axis] - 1)
+    out = jnp.take_along_axis(data, jnp.expand_dims(idx, axis), axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register("one_hot", differentiable=False)
+def one_hot(indices, depth=0, on_value=1.0, off_value=0.0, dtype="float32"):
+    from ..base import dtype_np
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth)
+    return (oh * (on_value - off_value) + off_value).astype(dtype_np(dtype))
+
+
+@register("gather_nd")
+def gather_nd(data, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register("scatter_nd")
+def scatter_nd(data, indices, shape=()):
+    idx = tuple(indices.astype(jnp.int32))
+    out = jnp.zeros(tuple(int(s) for s in shape), dtype=data.dtype)
+    return out.at[idx].add(data)
+
+
+@register("_scatter_set_nd")
+def _scatter_set_nd(lhs, rhs, indices, shape=()):
+    idx = tuple(indices.astype(jnp.int32))
+    return lhs.at[idx].set(rhs)
+
+
+@register("SequenceMask")
+def SequenceMask(data, sequence_length=None, use_sequence_length=False,
+                 value=0.0, axis=0):
+    """Parity: src/operator/sequence_mask-inl.h (time-major [T,N,...])."""
+    if not use_sequence_length or sequence_length is None:
+        return data
+    T = data.shape[axis]
+    steps = jnp.arange(T)
+    if axis == 0:
+        mask = steps[:, None] < sequence_length[None, :].astype(jnp.int32)
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    else:  # axis == 1, batch-major
+        mask = steps[None, :] < sequence_length[:, None].astype(jnp.int32)
+        mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, dtype=data.dtype))
+
+
+@register("SequenceLast")
+def SequenceLast(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        idx = [slice(None)] * data.ndim
+        idx[axis] = -1
+        return data[tuple(idx)]
+    last = (sequence_length.astype(jnp.int32) - 1)
+    if axis == 0:
+        return jnp.take_along_axis(
+            data, last.reshape((1, -1) + (1,) * (data.ndim - 2)), axis=0)[0]
+    return jnp.take_along_axis(
+        data, last.reshape((-1, 1) + (1,) * (data.ndim - 2)), axis=1)[:, 0]
+
+
+@register("SequenceReverse")
+def SequenceReverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=0)
+    T = data.shape[0]
+    steps = jnp.arange(T)[:, None]
+    L = sequence_length.astype(jnp.int32)[None, :]
+    src = jnp.where(steps < L, L - 1 - steps, steps)
+    src = src.reshape(src.shape + (1,) * (data.ndim - 2))
+    return jnp.take_along_axis(data, jnp.broadcast_to(src, data.shape), axis=0)
+
+
+# ---------------------------------------------------------------------------
+# ordering (parity: ordering_op-inl.h)
+# ---------------------------------------------------------------------------
+
+
+@register("sort")
+def sort(data, axis=-1, is_ascend=True):
+    out = jnp.sort(data, axis=axis)
+    return out if is_ascend else jnp.flip(out, axis=axis)
+
+
+@register("argsort", differentiable=False)
+def argsort(data, axis=-1, is_ascend=True, dtype="float32"):
+    from ..base import dtype_np
+    out = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(dtype_np(dtype))
+
+
+@register("topk", differentiable=False, num_outputs=-1)
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    from ..base import dtype_np
+    x = jnp.moveaxis(data, axis, -1)
+    vals, idx = lax.top_k(-x if is_ascend else x, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis).astype(dtype_np(dtype))
+    if ret_typ == "indices":
+        return idx
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idx
+    if ret_typ == "mask":
+        x2 = jnp.moveaxis(jnp.zeros_like(data), axis, -1).reshape(-1, data.shape[axis])
+        ii = jnp.moveaxis(idx, axis, -1).reshape(-1, k).astype(jnp.int32)
+        rows = jnp.arange(x2.shape[0])[:, None]
+        x2 = x2.at[rows, ii].set(1)
+        return jnp.moveaxis(x2.reshape(jnp.moveaxis(data, axis, -1).shape), -1, axis)
+    raise ValueError("unknown ret_typ %s" % ret_typ)
+
+
+# ---------------------------------------------------------------------------
+# init ops (parity: init_op.h)
+# ---------------------------------------------------------------------------
+
+def _dt(dtype):
+    from ..base import dtype_np
+    return dtype_np(dtype)
+
+
+@register("_zeros", differentiable=False)
+def _zeros(shape=(), dtype="float32"):
+    return jnp.zeros(tuple(int(s) for s in shape), dtype=_dt(dtype))
+
+
+@register("_ones", differentiable=False)
+def _ones(shape=(), dtype="float32"):
+    return jnp.ones(tuple(int(s) for s in shape), dtype=_dt(dtype))
+
+
+@register("_full", differentiable=False)
+def _full(shape=(), value=0.0, dtype="float32"):
+    return jnp.full(tuple(int(s) for s in shape), value, dtype=_dt(dtype))
+
+
+@register("_arange", differentiable=False)
+def _arange(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32"):
+    out = jnp.arange(start, stop, step, dtype=_dt(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("zeros_like", differentiable=False)
+def zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@register("ones_like", differentiable=False)
+def ones_like(data):
+    return jnp.ones_like(data)
+
+
+@register("_eye", differentiable=False)
+def _eye(N=0, M=0, k=0, dtype="float32"):
+    return jnp.eye(int(N), int(M) if M else None, k=int(k), dtype=_dt(dtype))
+
+
+# ---------------------------------------------------------------------------
+# misc / contrib-adjacent
+# ---------------------------------------------------------------------------
+
+
+@register("smooth_l1")
+def smooth_l1(data, scalar=1.0):
+    s2 = scalar * scalar
+    return jnp.where(jnp.abs(data) < 1.0 / s2,
+                     0.5 * s2 * jnp.square(data),
+                     jnp.abs(data) - 0.5 / s2)
+
+
+@register("quadratic")
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    """Parity: src/operator/contrib/quadratic_op-inl.h (the tutorial op)."""
+    return a * jnp.square(data) + b * data + c
+
+
+@register("add_n", aliases=("ElementWiseSum", "_sum"))
+def add_n(*args):
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out

@@ -1,0 +1,647 @@
+"""Optimizers.
+
+Parity: reference `python/mxnet/optimizer.py` (17 classes, optimizer.py:35-1453)
++ the fused C++ update kernels (`src/operator/optimizer_op-inl.h`).
+
+TPU-native redesign: every optimizer's math is a *pure* update function
+(weight, grad, states) -> (new_weight, new_states) in jnp — so the same rule
+runs eagerly (Updater path), inside the Gluon fused jit train step, and
+sharded under pjit (the reference's "server-side optimizer" capability maps
+to running these rules on sharded state inside the step function). Sparse
+(row_sparse) grads apply lazily to touched rows via scatter, mirroring the
+reference's lazy_update path.
+"""
+from __future__ import annotations
+
+import math
+import pickle
+import logging
+
+import numpy as np
+import jax.numpy as jnp
+
+from .ndarray import NDArray
+from .ndarray.sparse import RowSparseNDArray
+from .registry import get_register_func, get_create_func
+
+
+class Optimizer:
+    opt_registry = {}
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        if param_idx2name is None:
+            param_idx2name = {}
+        self.idx2name = param_idx2name.copy()
+        self.sym_info = (sym.attr_dict(), sym.list_arguments()) if sym is not None \
+            else ({}, [])
+        self.param_dict = param_dict if param_dict else {}
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    # -- registry ----------------------------------------------------------
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise ValueError("Cannot find optimizer %s" % name)
+
+    # -- state -------------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype == np.float16:
+            master = NDArray(weight._data.astype(jnp.float32), ctx=weight.context)
+            return (master, self.create_state(index, master))
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == np.float16:
+            master, st = state
+            g32 = NDArray(grad._data.astype(jnp.float32)) \
+                if isinstance(grad, NDArray) else grad
+            self.update(index, master, g32, st)
+            weight._data = master._data.astype(jnp.float16)
+            weight._version += 1
+        else:
+            self.update(index, weight, grad, state)
+
+    # -- lr / wd bookkeeping (parity: optimizer.py Optimizer base) ---------
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning("LRScheduler of the optimizer has already been "
+                              "defined.")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym_info[0]:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym_info[0]:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    # -- helpers ------------------------------------------------------------
+    def _preprocess_grad(self, grad):
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+    def _sparse_rows(self, grad):
+        """Return (rows, grad_rows) for row_sparse grads, else None."""
+        if isinstance(grad, RowSparseNDArray):
+            g = grad._values * self.rescale_grad
+            if self.clip_gradient is not None:
+                g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+            return grad._indices.astype(jnp.int32), g
+        return None
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+
+
+def _assign(weight, new):
+    weight._data = new.astype(weight._data.dtype)
+    weight._version += 1
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum, multi-precision, and lazy sparse updates
+    (parity: optimizer.py:483 + optimizer_op-inl.h sgd_update/sgd_mom_update)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros(weight.shape, dtype=weight._data.dtype),
+                       ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        sparse = self._sparse_rows(grad)
+        if sparse is not None and self.lazy_update:
+            rows, g = sparse
+            w_rows = weight._data[rows]
+            g = g + wd * w_rows
+            if state is not None:
+                m_rows = state._data[rows]
+                m_rows = self.momentum * m_rows - lr * g
+                state._data = state._data.at[rows].set(m_rows)
+                _assign(weight, weight._data.at[rows].add(m_rows))
+            else:
+                _assign(weight, weight._data.at[rows].add(-lr * g))
+            return
+        g = (grad.todense()._data * self.rescale_grad
+             if isinstance(grad, RowSparseNDArray)
+             else self._preprocess_grad(grad))
+        g = g + wd * weight._data
+        if state is not None:
+            m = self.momentum * state._data - lr * g
+            state._data = m
+            _assign(weight, weight._data + m)
+        else:
+            _assign(weight, weight._data - lr * g)
+
+
+@register
+class Signum(Optimizer):
+    """Parity: optimizer.py Signum (signSGD + momentum variant)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros(weight.shape, dtype=weight._data.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad)
+        if state is not None:
+            m = self.momentum * state._data - (1 - self.momentum) * (
+                g + wd * weight._data)
+            state._data = m
+            new_w = (1 - lr * self.wd_lh) * weight._data + lr * jnp.sign(m)
+        else:
+            new_w = (1 - lr * (wd + self.wd_lh)) * weight._data - \
+                lr * jnp.sign(g)
+        _assign(weight, new_w)
+
+
+@register
+class FTML(Optimizer):
+    """Parity: optimizer.py FTML (Follow The Moving Leader)."""
+
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, dtype=weight._data.dtype)
+        return (NDArray(z), NDArray(z), NDArray(z))  # d, v, z
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        g = self._preprocess_grad(grad) + wd * weight._data
+        d, v, z = state
+        v_t = self.beta2 * v._data + (1 - self.beta2) * jnp.square(g)
+        d_t = (1 - self.beta1 ** t) / lr * (
+            jnp.sqrt(v_t / (1 - self.beta2 ** t)) + self.epsilon)
+        sigma_t = d_t - self.beta1 * d._data
+        z_t = self.beta1 * z._data + (1 - self.beta1) * g - \
+            sigma_t * weight._data
+        v._data, d._data, z._data = v_t, d_t, z_t
+        _assign(weight, -z_t / d_t)
+
+
+@register
+class LBSGD(Optimizer):
+    """Large-batch SGD w/ LARS-style layerwise scaling (parity: optimizer.py
+    LBSGD; warmup strategies simplified to 'linear')."""
+
+    def __init__(self, momentum=0.0, multi_precision=False,
+                 warmup_strategy="linear", warmup_epochs=5, batch_scale=1,
+                 updates_per_epoch=32, begin_epoch=0, num_epochs=60, **kwargs):
+        super().__init__(multi_precision=multi_precision, **kwargs)
+        self.momentum = momentum
+        self.warmup_epochs = warmup_epochs
+        self.batch_scale = batch_scale
+        self.updates_per_epoch = updates_per_epoch
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros(weight.shape, dtype=weight._data.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        warm_steps = self.warmup_epochs * self.updates_per_epoch
+        if self.num_update < warm_steps:
+            lr = lr * self.num_update / max(1, warm_steps)
+        g = self._preprocess_grad(grad)
+        wnorm = jnp.linalg.norm(weight._data)
+        gnorm = jnp.linalg.norm(g)
+        phi = jnp.where((wnorm > 0) & (gnorm > 0),
+                        wnorm / (gnorm + wd * wnorm + 1e-12), 1.0)
+        g = g + wd * weight._data
+        m = self.momentum * state._data - lr * phi * g
+        state._data = m
+        _assign(weight, weight._data + m)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (parity: optimizer.py DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        mom = None if self.momentum == 0.0 else \
+            NDArray(jnp.zeros(weight.shape, dtype=weight._data.dtype))
+        prev = NDArray(weight._data + 0)
+        return (mom, prev)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad)
+        mom, prev = state
+        comp = g + wd * weight._data + self.lamda * g * g * (
+            weight._data - prev._data)
+        if mom is not None:
+            m = self.momentum * mom._data - lr * comp
+            mom._data = m
+            new_w = weight._data + m
+        else:
+            new_w = weight._data - lr * comp
+        prev._data = weight._data
+        _assign(weight, new_w)
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (parity: optimizer.py NAG)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros(weight.shape, dtype=weight._data.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad) + wd * weight._data
+        if state is not None:
+            m = self.momentum * state._data + g
+            state._data = m
+            _assign(weight, weight._data - lr * (g + self.momentum * m))
+        else:
+            _assign(weight, weight._data - lr * g)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (parity: optimizer.py SGLD)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad) + wd * weight._data
+        from . import random as _rng
+        import jax
+        noise = jax.random.normal(_rng.next_key(), weight.shape,
+                                  dtype=weight._data.dtype) * math.sqrt(lr)
+        _assign(weight, weight._data - lr / 2 * g + noise)
+
+
+@register
+class ccSGD(SGD):
+    """Parity: optimizer.py ccSGD — alias of SGD kept for back-compat."""
+
+
+@register
+class Adam(Optimizer):
+    """Parity: optimizer.py Adam + adam_update kernels; lazy sparse update."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, dtype=weight._data.dtype)
+        return (NDArray(z), NDArray(z))  # mean, var
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr_t = lr * math.sqrt(coef2) / coef1
+        mean, var = state
+        sparse = self._sparse_rows(grad)
+        if sparse is not None and self.lazy_update:
+            rows, g = sparse
+            g = g + wd * weight._data[rows]
+            m_r = self.beta1 * mean._data[rows] + (1 - self.beta1) * g
+            v_r = self.beta2 * var._data[rows] + (1 - self.beta2) * jnp.square(g)
+            mean._data = mean._data.at[rows].set(m_r)
+            var._data = var._data.at[rows].set(v_r)
+            upd = lr_t * m_r / (jnp.sqrt(v_r) + self.epsilon)
+            _assign(weight, weight._data.at[rows].add(-upd))
+            return
+        g = (grad.todense()._data * self.rescale_grad
+             if isinstance(grad, RowSparseNDArray)
+             else self._preprocess_grad(grad))
+        g = g + wd * weight._data
+        mean._data = self.beta1 * mean._data + (1 - self.beta1) * g
+        var._data = self.beta2 * var._data + (1 - self.beta2) * jnp.square(g)
+        _assign(weight, weight._data -
+                lr_t * mean._data / (jnp.sqrt(var._data) + self.epsilon))
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros(weight.shape, dtype=weight._data.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad) + wd * weight._data
+        state._data = state._data + jnp.square(g)
+        _assign(weight, weight._data -
+                lr * g / jnp.sqrt(state._data + self.float_stable_eps))
+
+
+@register
+class RMSProp(Optimizer):
+    """Parity: optimizer.py RMSProp (centered=False Tieleman, True Graves)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, dtype=weight._data.dtype)
+        if self.centered:
+            return (NDArray(z), NDArray(z), NDArray(z))  # n, g, delta
+        return (NDArray(z),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad) + wd * weight._data
+        if self.centered:
+            n, gbar, delta = state
+            n._data = (1 - self.gamma1) * jnp.square(g) + self.gamma1 * n._data
+            gbar._data = (1 - self.gamma1) * g + self.gamma1 * gbar._data
+            delta._data = self.gamma2 * delta._data - lr * g / jnp.sqrt(
+                n._data - jnp.square(gbar._data) + self.epsilon)
+            new_w = weight._data + delta._data
+        else:
+            (n,) = state
+            n._data = (1 - self.gamma1) * jnp.square(g) + self.gamma1 * n._data
+            new_w = weight._data - lr * g / jnp.sqrt(n._data + self.epsilon)
+        if self.clip_weights:
+            new_w = jnp.clip(new_w, -self.clip_weights, self.clip_weights)
+        _assign(weight, new_w)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, dtype=weight._data.dtype)
+        return (NDArray(z), NDArray(z))  # acc_g, acc_delta
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        g = self._preprocess_grad(grad) + wd * weight._data
+        acc_g, acc_d = state
+        acc_g._data = self.rho * acc_g._data + (1 - self.rho) * jnp.square(g)
+        delta = jnp.sqrt(acc_d._data + self.epsilon) / \
+            jnp.sqrt(acc_g._data + self.epsilon) * g
+        acc_d._data = self.rho * acc_d._data + (1 - self.rho) * jnp.square(delta)
+        _assign(weight, weight._data - delta)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, dtype=weight._data.dtype)
+        return (NDArray(z), NDArray(z))  # z, n
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad)
+        z, n = state
+        sigma = (jnp.sqrt(n._data + jnp.square(g)) - jnp.sqrt(n._data)) / lr
+        z._data = z._data + g - sigma * weight._data
+        n._data = n._data + jnp.square(g)
+        new_w = jnp.where(
+            jnp.abs(z._data) <= self.lamda1, 0.0,
+            -(z._data - jnp.sign(z._data) * self.lamda1) /
+            ((self.beta + jnp.sqrt(n._data)) / lr + wd))
+        _assign(weight, new_w)
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, dtype=weight._data.dtype)
+        return (NDArray(z), NDArray(z))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr /= (1.0 - self.beta1 ** t)
+        g = self._preprocess_grad(grad) + wd * weight._data
+        m, u = state
+        m._data = self.beta1 * m._data + (1 - self.beta1) * g
+        u._data = jnp.maximum(self.beta2 * u._data, jnp.abs(g))
+        _assign(weight, weight._data - lr * m._data / (u._data + 1e-8))
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, dtype=weight._data.dtype)
+        return (NDArray(z), NDArray(z))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        g = self._preprocess_grad(grad) + wd * weight._data
+        mom_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        mom_tp1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * mom_t
+        m_sched_next = self.m_schedule * mom_tp1
+        m, v = state
+        gp = g / (1.0 - self.m_schedule)
+        m._data = self.beta1 * m._data + (1 - self.beta1) * g
+        v._data = self.beta2 * v._data + (1 - self.beta2) * jnp.square(g)
+        m_hat = m._data / (1.0 - m_sched_next)
+        v_hat = v._data / (1.0 - self.beta2 ** t)
+        m_bar = (1.0 - mom_t) * gp + mom_tp1 * m_hat
+        _assign(weight, weight._data -
+                lr * m_bar / (jnp.sqrt(v_hat) + self.epsilon))
+
+
+@register
+class Test(Optimizer):
+    """Parity: optimizer.py Test — trivial optimizer used by unit tests."""
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros(weight.shape, dtype=weight._data.dtype))
+
+    def update(self, index, weight, grad, state):
+        g = self._preprocess_grad(grad)  # already applies rescale_grad
+        _assign(weight, weight._data + g)
+        state._data = weight._data
+
+
+class Updater:
+    """Applies per-key optimizer state (parity: optimizer.py:1453 get_updater;
+    the KVStore server-side update path)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = \
+                self.optimizer.create_state_multi_precision(index, weight)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def set_states(self, states):
+        states = pickle.loads(states) if isinstance(states, bytes) else states
+        if isinstance(states, tuple) and len(states) == 2:
+            self.states, self.optimizer = states
+        else:
+            self.states = states
+        self.states_synced = dict.fromkeys(self.states.keys(), False)
+
+    def get_states(self, dump_optimizer=False):
+        def to_host(v):
+            if isinstance(v, NDArray):
+                return v.asnumpy()
+            if isinstance(v, (tuple, list)):
+                return type(v)(to_host(x) for x in v)
+            return v
+        host_states = {k: to_host(v) for k, v in self.states.items()}
+        return pickle.dumps((host_states, self.optimizer) if dump_optimizer
+                            else host_states)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)
+
+
+# convenience aliases (parity: mx.optimizer.sgd etc. lowercased lookups)
+def create_optimizer(name, **kwargs):
+    return Optimizer.create_optimizer(name, **kwargs)

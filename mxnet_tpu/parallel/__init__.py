@@ -1,0 +1,15 @@
+"""Parallelism: mesh, sharded training, collectives, sequence parallelism.
+
+This package is the TPU-native replacement for the reference's distributed
+machinery (SURVEY §2.3/§5.8):
+  reference                         ->  here
+  DataParallelExecutorGroup         ->  mesh data-axis sharding (GSPMD)
+  KVStore device/nccl reduce        ->  lax.psum over ICI inside the step
+  ps-lite dist_sync push/pull       ->  multi-host mesh collectives over DCN
+  group2ctx model parallelism       ->  tensor-parallel shardings (upgrade)
+  (absent) sequence parallelism     ->  ring attention (capability upgrade)
+"""
+from .mesh import build_mesh, data_parallel_mesh, mesh_sharding
+from .trainer import TrainStep
+from .ring_attention import ring_attention, ring_attention_sharded
+from . import collectives

@@ -1,0 +1,51 @@
+"""Device mesh construction + sharding helpers.
+
+The mesh replaces the reference's context lists (`ctx=[mx.gpu(i) ...]`) and
+hostfile topology (`tools/launch.py`): axes are named for their parallelism
+role — 'dp' (data), 'tp' (tensor), 'pp' (pipeline), 'sp' (sequence/context),
+'ep' (expert). Shardings ride ICI within a slice; DCN spans multi-slice axes
+(leading axes by convention).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def build_mesh(axes, devices=None):
+    """Build a named mesh, e.g. build_mesh({'dp': 4, 'tp': 2}).
+
+    Axis sizes of -1 absorb the remaining devices (like reshape's -1).
+    """
+    devices = devices if devices is not None else jax.devices()
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    n = len(devices)
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = n // known
+    total = int(np.prod(sizes))
+    assert total <= n, "mesh %s needs %d devices, have %d" % (axes, total, n)
+    dev_array = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(dev_array, names)
+
+
+def data_parallel_mesh(devices=None):
+    return build_mesh({"dp": -1}, devices)
+
+
+def mesh_sharding(mesh, *spec):
+    """NamedSharding shorthand: mesh_sharding(mesh, 'dp', None)."""
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_batch(mesh, array, axis_name="dp", batch_dim=0):
+    """Place a host batch sharded along the data axis of the mesh."""
+    spec = [None] * array.ndim
+    spec[batch_dim] = axis_name
+    return jax.device_put(array, NamedSharding(mesh, P(*spec)))
+
+
+def replicate(mesh, array):
+    return jax.device_put(array, NamedSharding(mesh, P()))

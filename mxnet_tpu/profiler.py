@@ -1,0 +1,174 @@
+"""Profiler.
+
+Parity: reference `src/profiler/` (chrome://tracing JSON events, aggregate
+per-op summary table, modes, pause/resume) + `python/mxnet/profiler.py`
+(set_config/set_state/dump/pause/resume, custom Domains/Tasks/Counters),
+env autostart MXNET_PROFILER_AUTOSTART.
+
+TPU-native redesign: device-side op timing comes from jax.profiler (XPlane
+traces viewable in TensorBoard/Perfetto — richer than the reference's
+chrome://tracing). This module adds the reference's UX on top: a Python-side
+event recorder that also emits chrome://tracing JSON, an aggregate summary
+table, and the scoped Task/Frame/Counter API.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import threading
+from collections import defaultdict
+
+import jax
+
+_state = {"running": False, "config": {"filename": "profile.json",
+                                       "aggregate_stats": True},
+          "events": [], "lock": threading.Lock(), "jax_trace_dir": None}
+
+
+def set_config(**kwargs):
+    """Parity: profiler.py set_config (filename, profile_all, ...)."""
+    _state["config"].update(kwargs)
+
+
+def set_state(state="stop", profile_process="worker"):
+    if state == "run":
+        _state["running"] = True
+        trace_dir = _state["config"].get("xplane_dir")
+        if trace_dir:
+            jax.profiler.start_trace(trace_dir)
+            _state["jax_trace_dir"] = trace_dir
+    else:
+        if _state["jax_trace_dir"]:
+            jax.profiler.stop_trace()
+            _state["jax_trace_dir"] = None
+        _state["running"] = False
+
+
+def pause(profile_process="worker"):
+    _state["running"] = False
+
+
+def resume(profile_process="worker"):
+    _state["running"] = True
+
+
+def is_running():
+    return _state["running"]
+
+
+def record_event(name, category, start_us, dur_us, args=None):
+    if not _state["running"]:
+        return
+    with _state["lock"]:
+        _state["events"].append({"name": name, "cat": category, "ph": "X",
+                                 "ts": start_us, "dur": dur_us,
+                                 "pid": os.getpid(),
+                                 "tid": threading.get_ident(),
+                                 "args": args or {}})
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write chrome://tracing JSON (parity: MXDumpProfile)."""
+    fname = _state["config"].get("filename", "profile.json")
+    with _state["lock"]:
+        events = list(_state["events"])
+    with open(fname, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return fname
+
+
+def dumps(reset=False):
+    """Aggregate per-op summary table (parity: aggregate_stats.cc)."""
+    agg = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])
+    with _state["lock"]:
+        for e in _state["events"]:
+            s = agg[e["name"]]
+            s[0] += 1
+            s[1] += e["dur"] / 1000.0
+            s[2] = min(s[2], e["dur"] / 1000.0)
+            s[3] = max(s[3], e["dur"] / 1000.0)
+        if reset:
+            _state["events"] = []
+    lines = ["%-40s %8s %12s %12s %12s %12s" % (
+        "Name", "Calls", "Total(ms)", "Min(ms)", "Max(ms)", "Avg(ms)")]
+    for name, (calls, total, mn, mx) in sorted(agg.items(),
+                                               key=lambda kv: -kv[1][1]):
+        lines.append("%-40s %8d %12.3f %12.3f %12.3f %12.3f" % (
+            name, calls, total, mn if calls else 0.0, mx, total / max(1, calls)))
+    return "\n".join(lines)
+
+
+class scope:
+    """Time a region (used by internal instrumentation and users)."""
+
+    def __init__(self, name, category="user"):
+        self._name = name
+        self._cat = category
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns() // 1000
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter_ns() // 1000
+        record_event(self._name, self._cat, self._t0, t1 - self._t0)
+
+
+class Domain:
+    """Parity: profiler.py Domain — grouping namespace for tasks/counters."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def new_task(self, name):
+        return Task(self, name)
+
+    def new_counter(self, name, value=None):
+        return Counter(self, name, value)
+
+    def new_frame(self, name):
+        return Frame(self, name)
+
+
+class Task:
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter_ns() // 1000
+
+    def stop(self):
+        if self._t0 is not None:
+            t1 = time.perf_counter_ns() // 1000
+            record_event(self.name, self.domain.name, self._t0, t1 - self._t0)
+            self._t0 = None
+
+
+Frame = Task
+
+
+class Counter:
+    def __init__(self, domain, name, value=None):
+        self.domain = domain
+        self.name = name
+        self.value = value or 0
+
+    def set_value(self, value):
+        self.value = value
+        record_event(self.name, self.domain.name,
+                     time.perf_counter_ns() // 1000, 0,
+                     {"value": value})
+
+    def increment(self, delta=1):
+        self.set_value(self.value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self.value - delta)
+
+
+# env autostart (parity: MXNET_PROFILER_AUTOSTART, docs/faq/env_var.md:105)
+if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":
+    set_state("run")

@@ -1,0 +1,65 @@
+"""Global random state.
+
+Parity: reference seeds a per-device stateful RandomGenerator
+(`src/common/random_generator.h`, python `mxnet/random.py`). JAX is
+functional, so we keep one process-global PRNG key that ops split from.
+
+Inside a jit trace (hybridized CachedOp / Module bind) a *traced* key is
+threaded through the compiled function as an explicit argument so stochastic
+ops (dropout, samplers) stay correct across calls without retracing — the
+trace-local key + fold_in counter below implements that seam.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import jax
+
+
+class _RandomState(threading.local):
+    def __init__(self):
+        super().__init__()
+        self.key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        self.trace_key = None  # set while tracing a CachedOp
+        self.trace_counter = 0
+
+
+_STATE = _RandomState()
+
+
+def seed(seed_state, ctx="all"):
+    """Seed the global generator (parity: mx.random.seed)."""
+    _STATE.key = jax.random.PRNGKey(int(seed_state) & 0x7FFFFFFF)
+    _STATE.trace_counter = 0
+    np.random.seed(int(seed_state) & 0xFFFFFFFF)
+
+
+def next_key():
+    """Return a fresh PRNG key (concrete eagerly, traced inside a jit trace)."""
+    if _STATE.trace_key is not None:
+        _STATE.trace_counter += 1
+        return jax.random.fold_in(_STATE.trace_key, _STATE.trace_counter)
+    _STATE.key, sub = jax.random.split(_STATE.key)
+    return sub
+
+
+class trace_key_scope:
+    """Context manager installing a traced base key during jit tracing."""
+
+    def __init__(self, key):
+        self._key = key
+        self._saved = None
+
+    def __enter__(self):
+        self._saved = (_STATE.trace_key, _STATE.trace_counter)
+        _STATE.trace_key = self._key
+        _STATE.trace_counter = 0
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.trace_key, _STATE.trace_counter = self._saved
+
+
+# Imperative sampling API (mx.random.*) is populated by mxnet_tpu.ndarray at
+# import time (uniform/normal/randint/...) — see ndarray/__init__.py.

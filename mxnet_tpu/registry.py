@@ -1,0 +1,59 @@
+"""Generic class registry (parity: python/mxnet/registry.py — used by
+optimizer/initializer/metric/lr_scheduler registration and JSON round-trip)."""
+from __future__ import annotations
+
+import json
+
+_REGISTRIES = {}
+
+
+def _get_registry(base_class, nickname):
+    key = nickname
+    if key not in _REGISTRIES:
+        _REGISTRIES[key] = {}
+    return _REGISTRIES[key]
+
+
+def get_register_func(base_class, nickname):
+    registry = _get_registry(base_class, nickname)
+
+    def register(klass, name=None):
+        assert issubclass(klass, base_class), \
+            "can only register subclass of %s" % base_class.__name__
+        nm = (name or klass.__name__).lower()
+        registry[nm] = klass
+        return klass
+
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    registry = _get_registry(base_class, nickname)
+
+    def alias(*aliases):
+        def reg(klass):
+            for a in aliases:
+                registry[a.lower()] = klass
+            return klass
+        return reg
+
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    registry = _get_registry(base_class, nickname)
+
+    def create(*args, **kwargs):
+        if len(args) and isinstance(args[0], base_class):
+            return args[0]
+        if len(args) and isinstance(args[0], str) and args[0].startswith("["):
+            name, kw = json.loads(args[0])
+            return registry[name.lower()](**kw)
+        name = args[0] if args else kwargs.pop(nickname)
+        args = args[1:]
+        if name.lower() not in registry:
+            raise ValueError("%s is not registered as a %s (known: %s)"
+                             % (name, nickname, sorted(registry)))
+        return registry[name.lower()](*args, **kwargs)
+
+    return create

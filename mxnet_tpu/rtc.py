@@ -1,0 +1,55 @@
+"""Runtime kernel compilation.
+
+Parity: reference NVRTC runtime CUDA kernels (`src/common/rtc.cc:35-69`,
+`python/mxnet/rtc.py` CudaModule/CudaKernel).
+
+TPU-native redesign: user-authored kernels are Pallas kernels (Mosaic-
+compiled at trace time) — the TPU analog of NVRTC. `PallasModule` wraps a
+user kernel function; `CudaModule` is kept as a compat alias that raises
+with guidance, since CUDA C source cannot target TPU.
+"""
+from __future__ import annotations
+
+import functools
+
+from .base import MXNetError
+
+
+class PallasModule:
+    """Wrap a pallas kernel body into callable kernels.
+
+    Example:
+        def body(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * 2
+        mod = PallasModule(body)
+        y = mod(x_ndarray)  # out_shape defaults to input shape
+    """
+
+    def __init__(self, kernel_body, out_shape=None, grid=None, **pallas_kwargs):
+        self._body = kernel_body
+        self._out_shape = out_shape
+        self._grid = grid
+        self._kwargs = pallas_kwargs
+
+    def __call__(self, *inputs):
+        import jax
+        from jax.experimental import pallas as pl
+        from .ndarray import NDArray
+
+        vals = [i._data if isinstance(i, NDArray) else i for i in inputs]
+        out_shape = self._out_shape or jax.ShapeDtypeStruct(
+            vals[0].shape, vals[0].dtype)
+        interpret = jax.default_backend() == "cpu"
+        fn = pl.pallas_call(self._body, out_shape=out_shape,
+                            grid=self._grid, interpret=interpret,
+                            **self._kwargs)
+        out = fn(*vals)
+        return NDArray(out)
+
+
+class CudaModule:
+    def __init__(self, source, options=(), exports=()):
+        raise MXNetError(
+            "CudaModule targets CUDA GPUs; on TPU write a Pallas kernel and "
+            "wrap it with mxnet_tpu.rtc.PallasModule (see "
+            "/opt/skills/guides/pallas_guide.md for the kernel playbook)")

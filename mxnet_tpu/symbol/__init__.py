@@ -1,0 +1,685 @@
+"""mx.sym — lazy symbolic graphs, jit-compiled on bind.
+
+Parity: reference `python/mxnet/symbol/symbol.py` (Symbol composition,
+simple_bind:1284, bind:1548) over nnvm::Symbol/Graph.
+
+TPU-native redesign: a Symbol is a lightweight Python DAG of op nodes; *all*
+graph passes the reference implemented in C++ (shape/type inference
+`infer_graph_attr_pass.cc`, memory planning `PlanMemory`, op fusion, bulking
+`graph_executor.cc:1343`) are delegated to XLA by evaluating the DAG inside
+`jax.jit` at bind time (see mxnet_tpu/executor.py). Shape inference uses
+jax.eval_shape over the same DAG — one code path, no separate shape
+functions per op. Parameter-variable auto-creation and their shape rules
+(the one genuinely symbolic piece of information) live in _OP_INPUT_NAMES /
+_param_shape below.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..ops import registry as _registry
+from ..ops.nn import rnn_param_size
+from ..base import MXNetError, dtype_np
+from .. import name as _name_mod
+from .. import attribute as _attr_mod
+
+
+# ---------------------------------------------------------------------------
+# graph nodes
+# ---------------------------------------------------------------------------
+
+class SymNode:
+    __slots__ = ("op", "name", "inputs", "kwargs", "attr", "is_aux",
+                 "shape_hint", "dtype_hint", "init_hint", "num_outputs")
+
+    def __init__(self, op, name, inputs, kwargs, attr=None, is_aux=False,
+                 shape_hint=None, dtype_hint=None, init_hint=None):
+        self.op = op                      # OpDef or None for variables
+        self.name = name
+        self.inputs = inputs              # list of (SymNode, out_idx)
+        self.kwargs = kwargs
+        self.attr = attr or {}
+        self.is_aux = is_aux
+        self.shape_hint = shape_hint
+        self.dtype_hint = dtype_hint
+        self.init_hint = init_hint
+        self.num_outputs = _static_num_outputs(op, kwargs) if op else 1
+
+
+def _static_num_outputs(opdef, kwargs):
+    if opdef is None:
+        return 1
+    name = opdef.name
+    if name == "SliceChannel":
+        return int(kwargs.get("num_outputs", 1))
+    if name == "topk":
+        return 2 if kwargs.get("ret_typ") == "both" else 1
+    if name == "RNN":
+        if kwargs.get("state_outputs"):
+            return 3 if kwargs.get("mode", "lstm") == "lstm" else 2
+        return 1
+    if name == "BatchNorm":
+        return 3
+    if name == "_contrib_MultiBoxTarget":
+        return 3
+    if name in ("linalg_gelqf", "linalg_syevd", "sparse_retain",
+                "_dense_to_rsp"):
+        return 2
+    if name == "_sample_multinomial":
+        return 2 if kwargs.get("get_prob") else 1
+    # NB: don't call bare builtins shadowable by generated op names (max/min/
+    # sum/abs are all registered ops injected into this module's globals)
+    return opdef.num_outputs if opdef.num_outputs > 1 else 1
+
+
+# tensor-input names per op that auto-creates parameter variables when the
+# caller omits them (parity: nnvm FListInputNames + the executor's implicit
+# variable creation). aux entries mirror list_auxiliary_states.
+_OP_INPUT_NAMES = {
+    "FullyConnected": (("data", "weight", "bias"), ()),
+    "Convolution": (("data", "weight", "bias"), ()),
+    "Deconvolution": (("data", "weight", "bias"), ()),
+    "BatchNorm": (("data", "gamma", "beta"), ("moving_mean", "moving_var")),
+    "LayerNorm": (("data", "gamma", "beta"), ()),
+    "InstanceNorm": (("data", "gamma", "beta"), ()),
+    "Embedding": (("data", "weight"), ()),
+    "RNN": (("data", "parameters", "state", "state_cell"), ()),
+    "LeakyReLU": (("data", "gamma"), ()),
+    "SoftmaxOutput": (("data", "label"), ()),
+    "LinearRegressionOutput": (("data", "label"), ()),
+    "MAERegressionOutput": (("data", "label"), ()),
+    "LogisticRegressionOutput": (("data", "label"), ()),
+    "SVMOutput": (("data", "label"), ()),
+}
+
+
+def _op_skips_bias(kwargs):
+    return bool(kwargs.get("no_bias", False))
+
+
+class Symbol:
+    """An output list over the DAG (parity: nnvm::Symbol)."""
+
+    def __init__(self, outputs):
+        self._outputs = list(outputs)  # list of (SymNode, out_idx)
+
+    # -- construction helpers ----------------------------------------------
+    @property
+    def name(self):
+        node, idx = self._outputs[0]
+        return node.name
+
+    def __repr__(self):
+        return "<Symbol %s>" % ", ".join(n.name for n, _ in self._outputs)
+
+    def __iter__(self):
+        for i in range(len(self._outputs)):
+            yield Symbol([self._outputs[i]])
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index in names:
+                return Symbol([self._outputs[names.index(index)]])
+            # allow bare node name
+            for i, (n, idx) in enumerate(self._outputs):
+                if n.name == index:
+                    return Symbol([self._outputs[i]])
+            raise ValueError("cannot find output %s" % index)
+        return Symbol([self._outputs[index]])
+
+    # -- graph traversal ----------------------------------------------------
+    def _topo(self):
+        order, seen = [], set()
+        stack = [(n, False) for n, _ in reversed(self._outputs)]
+        while stack:
+            node, done = stack.pop()
+            if done:
+                order.append(node)
+                continue
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            stack.append((node, True))
+            for inp, _ in reversed(node.inputs):
+                if id(inp) not in seen:
+                    stack.append((inp, False))
+        return order
+
+    def list_arguments(self):
+        return [n.name for n in self._topo() if n.op is None and not n.is_aux]
+
+    def list_auxiliary_states(self):
+        return [n.name for n in self._topo() if n.op is None and n.is_aux]
+
+    def list_outputs(self):
+        out = []
+        for node, idx in self._outputs:
+            if node.num_outputs > 1:
+                out.append("%s_output%d" % (node.name, idx))
+            else:
+                out.append("%s_output" % node.name)
+        return out
+
+    def list_inputs(self):
+        return [n.name for n in self._topo() if n.op is None]
+
+    def get_internals(self):
+        nodes = self._topo()
+        outs = []
+        for n in nodes:
+            for i in range(n.num_outputs):
+                outs.append((n, i))
+        return Symbol(outs)
+
+    def get_children(self):
+        node, _ = self._outputs[0]
+        if not node.inputs:
+            return None
+        return Symbol(list(node.inputs))
+
+    def attr(self, key):
+        node, _ = self._outputs[0]
+        return node.attr.get(key)
+
+    def attr_dict(self):
+        out = {}
+        for n in self._topo():
+            if n.attr:
+                out[n.name] = dict(n.attr)
+        return out
+
+    def _set_attr(self, **kwargs):
+        node, _ = self._outputs[0]
+        node.attr.update({k: str(v) for k, v in kwargs.items()})
+
+    # -- arithmetic ---------------------------------------------------------
+    def _binary(self, other, op, scalar_op, swap=False):
+        if isinstance(other, Symbol):
+            a, b = (other, self) if swap else (self, other)
+            return create(op, a, b)
+        return create(scalar_op, self, scalar=float(other))
+
+    def __add__(self, other):
+        return self._binary(other, "elemwise_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binary(other, "elemwise_sub", "_minus_scalar")
+
+    def __rsub__(self, other):
+        return self._binary(other, "elemwise_sub", "_rminus_scalar", swap=True) \
+            if isinstance(other, Symbol) else \
+            create("_rminus_scalar", self, scalar=float(other))
+
+    def __mul__(self, other):
+        return self._binary(other, "elemwise_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binary(other, "elemwise_div", "_div_scalar")
+
+    def __rtruediv__(self, other):
+        return create("_rdiv_scalar", self, scalar=float(other))
+
+    __div__ = __truediv__
+
+    def __pow__(self, other):
+        return self._binary(other, "_power", "_power_scalar")
+
+    def __neg__(self):
+        return create("negative", self)
+
+    def __eq__(self, other):
+        return self._binary(other, "_equal", "_equal_scalar")
+
+    def __ne__(self, other):
+        return self._binary(other, "_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, other):
+        return self._binary(other, "_greater", "_greater_scalar")
+
+    def __ge__(self, other):
+        return self._binary(other, "_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, other):
+        return self._binary(other, "_lesser", "_lesser_scalar")
+
+    def __le__(self, other):
+        return self._binary(other, "_lesser_equal", "_lesser_equal_scalar")
+
+    def __hash__(self):
+        return id(self)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        import sys
+        mod = sys.modules[__name__]
+        fn = getattr(mod, name, None)
+        if fn is None or not callable(fn):
+            raise AttributeError("Symbol has no attribute %r" % name)
+        this = self
+
+        def method(*args, **kwargs):
+            return fn(this, *args, **kwargs)
+
+        return method
+
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return create("Reshape", self, shape=shape, **kwargs)
+
+    # -- shape / dtype inference -------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        try:
+            arg_shapes, out_shapes, aux_shapes = self._infer_shape_impl(kwargs)
+            return arg_shapes, out_shapes, aux_shapes
+        except MXNetError:
+            raise
+
+    def infer_shape_partial(self, *args, **kwargs):
+        try:
+            return self._infer_shape_impl(kwargs, partial=True)
+        except Exception:
+            return None, None, None
+
+    def _infer_shape_impl(self, known, partial=False):
+        import jax
+
+        shapes = {}   # node id -> tuple of ShapeDtypeStruct per output
+        var_shape = {}
+        order = self._topo()
+        for node in order:
+            if node.op is None:
+                shp = known.get(node.name, node.shape_hint)
+                if shp is not None:
+                    dt = dtype_np(node.dtype_hint)
+                    shapes[id(node)] = (jax.ShapeDtypeStruct(tuple(shp), dt),)
+                    var_shape[node.name] = tuple(shp)
+                continue
+            # resolve unshaped parameter inputs with op-specific rules
+            in_specs = []
+            for pos, (inp, oidx) in enumerate(node.inputs):
+                if id(inp) not in shapes:
+                    if inp.op is None:
+                        rule = _param_shape(node, pos, shapes, known)
+                        if rule is None:
+                            if partial:
+                                in_specs = None
+                                break
+                            raise MXNetError(
+                                "cannot infer shape of argument '%s' for op "
+                                "%s" % (inp.name, node.op.name))
+                        dt = dtype_np(inp.dtype_hint)
+                        shapes[id(inp)] = (jax.ShapeDtypeStruct(rule, dt),)
+                        var_shape[inp.name] = rule
+                    else:
+                        raise MXNetError("graph order violation")
+                in_specs.append(shapes[id(inp)][oidx])
+            if in_specs is None:
+                continue
+            kwargs = node.kwargs
+
+            def node_fn(*ins):
+                from .. import autograd
+                with autograd._RecordingStateScope(False, True):
+                    out = node.op.fn(*ins, **kwargs)
+                return out
+
+            try:
+                from .. import random as _rng
+                import jax as _jax
+                with _rng.trace_key_scope(_jax.random.PRNGKey(0)):
+                    out = jax.eval_shape(node_fn, *in_specs)
+            except Exception as e:  # noqa: BLE001
+                if partial:
+                    continue
+                raise MXNetError("shape inference failed at op %s(%s): %s"
+                                 % (node.op.name, node.name, e)) from e
+            outs = out if isinstance(out, tuple) else (out,)
+            shapes[id(node)] = tuple(outs)
+
+        arg_shapes = [var_shape.get(n) for n in self.list_arguments()]
+        aux_shapes = [var_shape.get(n) for n in self.list_auxiliary_states()]
+        out_shapes = []
+        for node, idx in self._outputs:
+            s = shapes.get(id(node))
+            out_shapes.append(tuple(s[idx].shape) if s else None)
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        args_t = [np.float32] * len(self.list_arguments())
+        outs_t = [np.float32] * len(self.list_outputs())
+        aux_t = [np.float32] * len(self.list_auxiliary_states())
+        return args_t, outs_t, aux_t
+
+    # -- evaluation (shared by Executor and eval()) ------------------------
+    def _eval(self, values, train=False):
+        """Interpret the DAG given {var_name: jax array}. Returns
+        (outputs, aux_updates) where aux_updates maps aux var name -> new val
+        (BatchNorm moving stats, functional-threaded)."""
+        from .. import autograd
+
+        computed = {}
+        aux_updates = {}
+        order = self._topo()
+        with autograd._RecordingStateScope(False, train):
+            for node in order:
+                if node.op is None:
+                    if node.name not in values:
+                        raise MXNetError("missing argument '%s'" % node.name)
+                    computed[id(node)] = (values[node.name],)
+                    continue
+                ins = [computed[id(inp)][oidx] for inp, oidx in node.inputs]
+                out = node.op.fn(*ins, **node.kwargs)
+                outs = out if isinstance(out, tuple) else (out,)
+                if node.op.name == "BatchNorm" and train and \
+                        not node.kwargs.get("use_global_stats", False):
+                    # functional moving-stat update (parity: aux mutation in
+                    # src/operator/nn/batch_norm-inl.h)
+                    momentum = node.kwargs.get("momentum", 0.9)
+                    mm_node = node.inputs[3][0]
+                    mv_node = node.inputs[4][0]
+                    if mm_node.op is None:
+                        aux_updates[mm_node.name] = (
+                            momentum * ins[3] + (1 - momentum) * outs[1])
+                    if mv_node.op is None:
+                        aux_updates[mv_node.name] = (
+                            momentum * ins[4] + (1 - momentum) * outs[2])
+                    outs = (outs[0], outs[1], outs[2])
+                computed[id(node)] = outs
+        outputs = []
+        for node, idx in self._outputs:
+            o = computed[id(node)]
+            # BatchNorm as terminal symbol: expose only the normalized output
+            outputs.append(o[idx] if idx < len(o) else o[0])
+        return outputs, aux_updates
+
+    def eval(self, ctx=None, **kwargs):
+        from ..ndarray import NDArray
+        vals = {k: v._data for k, v in kwargs.items()}
+        outs, _ = self._eval(vals, train=False)
+        return [NDArray(o, ctx=ctx) for o in outs]
+
+    # -- binding -----------------------------------------------------------
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    stype_dict=None, group2ctx=None, shared_arg_names=None,
+                    shared_exec=None, shared_buffer=None, **kwargs):
+        from ..executor import Executor
+        return Executor.simple_bind(self, ctx, grad_req=grad_req,
+                                    type_dict=type_dict, **kwargs)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from ..executor import Executor
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+
+    # -- serialization (parity: symbol JSON, nnvm::Graph save/load) --------
+    def tojson(self):
+        order = self._topo()
+        node_index = {id(n): i for i, n in enumerate(order)}
+        nodes = []
+        for n in order:
+            nodes.append({
+                "op": "null" if n.op is None else n.op.name,
+                "name": n.name,
+                "attrs": {k: repr(v) for k, v in n.kwargs.items()} if n.op else {},
+                "inputs": [[node_index[id(i)], oi, 0] for i, oi in n.inputs],
+                "is_aux": n.is_aux,
+            })
+        heads = [[node_index[id(n)], i, 0] for n, i in self._outputs]
+        return json.dumps({"nodes": nodes, "heads": heads,
+                           "mxnet_tpu_version": 1}, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # grouping / misc
+    def get_backend_symbol(self, backend):
+        return self
+
+    def simple_bind_shapes(self, **kwargs):
+        return self.infer_shape(**kwargs)
+
+    def debug_str(self):
+        lines = []
+        for n in self._topo():
+            if n.op is None:
+                lines.append("Variable:%s" % n.name)
+            else:
+                ins = ", ".join(i.name for i, _ in n.inputs)
+                lines.append("%s(%s) -> %s" % (n.op.name, ins, n.name))
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# parameter shape rules (the info nnvm shape functions provided backwards)
+# ---------------------------------------------------------------------------
+
+def _first_input_shape(node, shapes):
+    inp, oidx = node.inputs[0]
+    s = shapes.get(id(inp))
+    return tuple(s[oidx].shape) if s else None
+
+
+def _param_shape(node, pos, shapes, known):
+    op = node.op.name
+    kw = node.kwargs
+    data_shape = _first_input_shape(node, shapes)
+    if data_shape is None:
+        return None
+    names = _OP_INPUT_NAMES.get(op)
+    pname = names[0][pos] if names and pos < len(names[0]) else None
+    if op == "FullyConnected":
+        num_hidden = int(kw.get("num_hidden"))
+        in_units = int(np.prod(data_shape[1:])) if kw.get("flatten", True) \
+            else data_shape[-1]
+        if pname == "weight":
+            return (num_hidden, in_units)
+        if pname == "bias":
+            return (num_hidden,)
+    if op in ("Convolution",):
+        nf = int(kw.get("num_filter"))
+        g = int(kw.get("num_group", 1))
+        kernel = tuple(int(k) for k in kw.get("kernel", ()))
+        if pname == "weight":
+            return (nf, data_shape[1] // g) + kernel
+        if pname == "bias":
+            return (nf,)
+    if op == "Deconvolution":
+        nf = int(kw.get("num_filter"))
+        g = int(kw.get("num_group", 1))
+        kernel = tuple(int(k) for k in kw.get("kernel", ()))
+        if pname == "weight":
+            return (data_shape[1], nf // g) + kernel
+        if pname == "bias":
+            return (nf,)
+    if op in ("BatchNorm", "LayerNorm", "InstanceNorm"):
+        axis = int(kw.get("axis", 1 if op != "LayerNorm" else -1))
+        return (data_shape[axis],)
+    if op == "Embedding":
+        return (int(kw.get("input_dim")), int(kw.get("output_dim")))
+    if op == "LeakyReLU":
+        return (data_shape[1],)
+    if op == "RNN":
+        H = int(kw.get("state_size"))
+        L = int(kw.get("num_layers", 1))
+        bi = bool(kw.get("bidirectional", False))
+        dirs = 2 if bi else 1
+        if pname == "parameters":
+            return (rnn_param_size(L, data_shape[2], H, bi,
+                                   kw.get("mode", "lstm")),)
+        if pname in ("state", "state_cell"):
+            return (L * dirs, data_shape[1], H)
+    if op in ("SoftmaxOutput", "SVMOutput"):
+        if pname == "label":
+            return tuple(data_shape[:-1])
+    if op in ("LinearRegressionOutput", "MAERegressionOutput",
+              "LogisticRegressionOutput"):
+        if pname == "label":
+            return tuple(data_shape)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# symbol construction API
+# ---------------------------------------------------------------------------
+
+
+def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
+             dtype=None, init=None, stype=None, **kwargs):
+    attr = _attr_mod.current().get(attr)
+    if lr_mult is not None:
+        attr["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        attr["__wd_mult__"] = str(wd_mult)
+    node = SymNode(None, name, [], {}, attr=attr, shape_hint=shape,
+                   dtype_hint=dtype, init_hint=init)
+    return Symbol([(node, 0)])
+
+
+var = Variable
+
+
+def Group(symbols):
+    outs = []
+    for s in symbols:
+        outs.extend(s._outputs)
+    return Symbol(outs)
+
+
+def create(op_name, *args, name=None, attr=None, **kwargs):
+    """Create an op node (parity: symbol op codegen, _symbol_creator)."""
+    opdef = _registry.get(op_name)
+    hint = opdef.name.lower().lstrip("_")
+    name = _name_mod.current().get(name, hint)
+    attr = _attr_mod.current().get(attr)
+
+    inputs = []
+    sym_args = [a for a in args if isinstance(a, Symbol)]
+    for a in sym_args:
+        inputs.append(a._outputs[0])
+
+    names = _OP_INPUT_NAMES.get(opdef.name)
+    if names is not None:
+        input_names, aux_names = names
+        want = list(input_names)
+        if opdef.name in ("FullyConnected", "Convolution", "Deconvolution") \
+                and _op_skips_bias(kwargs):
+            want.remove("bias")
+        if opdef.name == "RNN" and kwargs.get("mode", "lstm") != "lstm":
+            want.remove("state_cell")
+        # pull tensor kwargs (e.g. weight=some_sym)
+        for i, nm in enumerate(want):
+            if i < len(inputs):
+                continue
+            if nm in kwargs and isinstance(kwargs[nm], Symbol):
+                inputs.append(kwargs.pop(nm)._outputs[0])
+            else:
+                v = Variable("%s_%s" % (name, nm))
+                inputs.append(v._outputs[0])
+        for nm in aux_names:
+            if nm in kwargs and isinstance(kwargs[nm], Symbol):
+                inputs.append(kwargs.pop(nm)._outputs[0])
+            else:
+                v = Variable("%s_%s" % (name, nm))
+                v._outputs[0][0].is_aux = True
+                inputs.append(v._outputs[0])
+    else:
+        # tensor kwargs for list-less ops
+        for k in list(kwargs):
+            if isinstance(kwargs[k], Symbol):
+                inputs.append(kwargs.pop(k)._outputs[0])
+
+    node = SymNode(opdef, name, inputs, kwargs, attr=attr)
+    return Symbol([(node, i) for i in range(node.num_outputs)]) \
+        if node.num_outputs > 1 and opdef.name != "BatchNorm" \
+        else Symbol([(node, 0)])
+
+
+def _make_sym_func(opname):
+    def sym_func(*args, **kwargs):
+        return create(opname, *args, **kwargs)
+
+    sym_func.__name__ = opname
+    return sym_func
+
+
+for _n in list(_registry.OPS):
+    globals()[_n] = _make_sym_func(_n)
+
+
+def zeros(shape, dtype=None, **kwargs):
+    return create("_zeros", shape=tuple(shape), dtype=dtype or "float32", **kwargs)
+
+
+def ones(shape, dtype=None, **kwargs):
+    return create("_ones", shape=tuple(shape), dtype=dtype or "float32", **kwargs)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, dtype=None, **kwargs):
+    return create("_arange", start=start, stop=stop, step=step, repeat=repeat,
+                  dtype=dtype or "float32", **kwargs)
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def load_json(json_str):
+    data = json.loads(json_str)
+    nodes = []
+    for spec in data["nodes"]:
+        inputs = [(nodes[i], oi) for i, oi, _ in spec["inputs"]]
+        if spec["op"] == "null":
+            node = SymNode(None, spec["name"], [], {}, is_aux=spec.get("is_aux", False))
+        else:
+            kwargs = {k: eval(v) for k, v in spec.get("attrs", {}).items()}  # noqa: S307 — values were repr()'d by tojson
+            node = SymNode(_registry.get(spec["op"]), spec["name"], inputs, kwargs)
+        nodes.append(node)
+    heads = [(nodes[i], oi) for i, oi, _ in data["heads"]]
+    return Symbol(heads)
+
+
+# sub-namespaces mirroring mx.sym.random / linalg / contrib
+class _SubNS:
+    def __init__(self, prefix, mapping):
+        for pub, opname in mapping.items():
+            setattr(self, pub, _make_sym_func(opname))
+
+
+random = _SubNS("random", {
+    "uniform": "_random_uniform", "normal": "_random_normal",
+    "gamma": "_random_gamma", "exponential": "_random_exponential",
+    "poisson": "_random_poisson", "randint": "_random_randint",
+    "multinomial": "_sample_multinomial", "shuffle": "_shuffle",
+})
+linalg = _SubNS("linalg", {
+    "gemm": "linalg_gemm", "gemm2": "linalg_gemm2", "potrf": "linalg_potrf",
+    "potri": "linalg_potri", "trsm": "linalg_trsm", "trmm": "linalg_trmm",
+    "sumlogdiag": "linalg_sumlogdiag", "syrk": "linalg_syrk",
+    "gelqf": "linalg_gelqf", "syevd": "linalg_syevd",
+})
+contrib = _SubNS("contrib", {
+    "MultiBoxPrior": "_contrib_MultiBoxPrior",
+    "MultiBoxTarget": "_contrib_MultiBoxTarget",
+    "MultiBoxDetection": "_contrib_MultiBoxDetection",
+    "box_nms": "_contrib_box_nms", "box_iou": "_contrib_box_iou",
+    "ctc_loss": "_contrib_ctc_loss", "fft": "_contrib_fft",
+    "ifft": "_contrib_ifft", "count_sketch": "_contrib_count_sketch",
+    "Proposal": "_contrib_Proposal",
+    "BilinearResize2D": "_contrib_BilinearResize2D",
+    "AdaptiveAvgPooling2D": "_contrib_AdaptiveAvgPooling2D",
+    "quadratic": "quadratic",
+})

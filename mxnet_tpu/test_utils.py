@@ -1,0 +1,323 @@
+"""Test utilities.
+
+Parity: reference `python/mxnet/test_utils.py` — assert_almost_equal:470,
+check_numeric_gradient:792 (finite differences), check_symbolic_forward/
+backward, check_consistency:1207 (cross-context), rand_ndarray:339,
+default_context, simple data generators.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .context import Context, cpu, current_context
+from .ndarray import NDArray
+from . import ndarray as nd
+from .ndarray.sparse import CSRNDArray, RowSparseNDArray
+
+
+def default_context():
+    return current_context()
+
+
+def set_default_context(ctx):
+    Context._default_ctx.value = ctx
+
+
+def default_dtype():
+    return np.float32
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1),
+            np.random.randint(1, dim2 + 1))
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=num_dim))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None,
+                 distribution=None):
+    """Random (optionally sparse) ndarray (parity: test_utils.py:339)."""
+    density = density if density is not None else 0.5
+    dtype = dtype or np.float32
+    if stype == "default":
+        return NDArray(np.random.uniform(-1, 1, shape).astype(dtype))
+    dense = np.random.uniform(-1, 1, shape).astype(dtype)
+    mask = np.random.rand(shape[0]) < density
+    dense[~mask] = 0
+    if stype == "row_sparse":
+        return RowSparseNDArray.from_dense(NDArray(dense))
+    if stype == "csr":
+        flat_mask = np.random.rand(*shape) < density
+        dense = np.where(flat_mask, dense, 0)
+        return CSRNDArray.from_dense(NDArray(dense))
+    raise ValueError(stype)
+
+
+def rand_sparse_ndarray(shape, stype, density=None, dtype=None):
+    arr = rand_ndarray(shape, stype, density, dtype)
+    return arr, (arr._indices if hasattr(arr, "_indices") else None)
+
+
+def np_reduce(dat, axis, keepdims, numpy_reduce_func):
+    if isinstance(axis, int):
+        axis = [axis]
+    else:
+        axis = list(axis) if axis is not None else range(len(dat.shape))
+    ret = dat
+    for i in reversed(sorted(axis)):
+        ret = numpy_reduce_func(ret, axis=i)
+    if keepdims:
+        keepdims_shape = list(dat.shape)
+        for i in axis:
+            keepdims_shape[i] = 1
+        ret = ret.reshape(tuple(keepdims_shape))
+    return ret
+
+
+def find_max_violation(a, b, rtol=None, atol=None):
+    rtol = 1e-5 if rtol is None else rtol
+    atol = 1e-20 if atol is None else atol
+    diff = np.abs(a - b)
+    tol = atol + rtol * np.abs(b)
+    violation = diff / (tol + 1e-20)
+    loc = np.argmax(violation)
+    idx = np.unravel_index(loc, violation.shape)
+    return idx, np.max(violation)
+
+
+def same(a, b):
+    return np.array_equal(a, b)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
+                        equal_nan=False):
+    """Parity: test_utils.py:470."""
+    rtol = 1e-5 if rtol is None else rtol
+    atol = 1e-20 if atol is None else atol
+    a = a.asnumpy() if hasattr(a, "asnumpy") else np.asarray(a)
+    b = b.asnumpy() if hasattr(b, "asnumpy") else np.asarray(b)
+    a = np.asarray(a, dtype=np.float64) if a.dtype.kind not in "fc" else a
+    b = np.asarray(b, dtype=np.float64) if b.dtype.kind not in "fc" else b
+    if np.allclose(np.asarray(a, np.float64), np.asarray(b, np.float64),
+                   rtol=rtol, atol=atol, equal_nan=equal_nan):
+        return
+    index, rel = find_max_violation(np.asarray(a, np.float64),
+                                    np.asarray(b, np.float64), rtol, atol)
+    raise AssertionError(
+        "Error %f exceeds tolerance rtol=%f, atol=%f. Location of maximum "
+        "error:%s, a=%f, b=%f" % (rel, rtol, atol, str(index),
+                                  np.asarray(a, np.float64)[index],
+                                  np.asarray(b, np.float64)[index]))
+
+
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False):
+    try:
+        assert_almost_equal(a, b, rtol, atol, equal_nan=equal_nan)
+        return True
+    except AssertionError:
+        return False
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    inputs = {k: NDArray(np.asarray(v, dtype=np.float32))
+              if not isinstance(v, NDArray) else v
+              for k, v in inputs.items()}
+    exe = sym.bind(ctx, args=inputs, grad_req="null")
+    exe.forward(is_train=is_train)
+    outputs = [x.asnumpy() for x in exe.outputs]
+    if len(outputs) == 1:
+        outputs = outputs[0]
+    return outputs
+
+
+def _parse_location(sym, location, ctx):
+    if isinstance(location, dict):
+        wrong = set(location.keys()) - set(sym.list_arguments())
+        assert not wrong, "Location keys %s not in arguments %s" % (
+            wrong, sym.list_arguments())
+        location = {k: np.asarray(v) if not isinstance(v, NDArray)
+                    else v.asnumpy() for k, v in location.items()}
+    else:
+        location = {k: np.asarray(v) if not isinstance(v, NDArray)
+                    else v.asnumpy()
+                    for k, v in zip(sym.list_arguments(), location)}
+    return {k: NDArray(v.astype(np.float32) if v.dtype == np.float64 else v)
+            for k, v in location.items()}
+
+
+def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
+                           rtol=1e-2, atol=None, grad_nodes=None,
+                           use_forward_train=True, ctx=None,
+                           grad_stype_dict=None, dtype=np.float32):
+    """Finite-difference gradient check (parity: test_utils.py:792)."""
+    location = _parse_location(sym, location, ctx)
+    if grad_nodes is None:
+        grad_nodes = [k for k in location]
+    aux = {k: NDArray(np.asarray(v)) for k, v in (aux_states or {}).items()} \
+        if isinstance(aux_states, dict) else None
+
+    def fwd(loc_np):
+        args = {k: NDArray(v) for k, v in loc_np.items()}
+        exe = sym.bind(ctx, args=args, grad_req="null",
+                       aux_states=aux)
+        exe.forward(is_train=use_forward_train)
+        return sum(float(np.sum(o.asnumpy())) for o in exe.outputs)
+
+    # analytic grads via backward with all-ones head
+    args = {k: v.copy() for k, v in location.items()}
+    req = {k: ("write" if k in grad_nodes else "null") for k in args}
+    exe = sym.bind(ctx, args=args, grad_req=req, aux_states=aux)
+    exe.forward(is_train=use_forward_train)
+    exe.backward()
+    analytic = {k: exe.grad_dict[k].asnumpy() for k in grad_nodes}
+
+    loc_np = {k: v.asnumpy().astype(np.float64) for k, v in location.items()}
+    for name in grad_nodes:
+        arr = loc_np[name]
+        num_grad = np.zeros_like(arr)
+        it = np.nditer(arr, flags=["multi_index"])
+        while not it.finished:
+            idx = it.multi_index
+            orig = arr[idx]
+            arr[idx] = orig + numeric_eps / 2
+            f_plus = fwd({k: v.astype(np.float32) for k, v in loc_np.items()})
+            arr[idx] = orig - numeric_eps / 2
+            f_minus = fwd({k: v.astype(np.float32) for k, v in loc_np.items()})
+            arr[idx] = orig
+            num_grad[idx] = (f_plus - f_minus) / numeric_eps
+            it.iternext()
+        assert_almost_equal(analytic[name], num_grad, rtol=rtol,
+                            atol=atol if atol is not None else 1e-4,
+                            names=("analytic_%s" % name, "numeric_%s" % name))
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-5, atol=None,
+                           aux_states=None, ctx=None, equal_nan=False,
+                           dtype=np.float32):
+    location = _parse_location(sym, location, ctx)
+    aux = {k: NDArray(np.asarray(v)) for k, v in (aux_states or {}).items()} \
+        if isinstance(aux_states, dict) else None
+    exe = sym.bind(ctx, args=location, grad_req="null", aux_states=aux)
+    exe.forward(is_train=False)
+    if isinstance(expected, dict):
+        expected = [expected[k] for k in sym.list_outputs()]
+    for out, exp in zip(exe.outputs, expected):
+        assert_almost_equal(out.asnumpy(), exp, rtol=rtol,
+                            atol=atol if atol is not None else 1e-20,
+                            equal_nan=equal_nan)
+    return exe.outputs
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-5,
+                            atol=None, aux_states=None, grad_req="write",
+                            ctx=None, grad_stypes=None, equal_nan=False,
+                            dtype=np.float32):
+    location = _parse_location(sym, location, ctx)
+    aux = {k: NDArray(np.asarray(v)) for k, v in (aux_states or {}).items()} \
+        if isinstance(aux_states, dict) else None
+    if isinstance(expected, (list, tuple)):
+        expected = dict(zip(sym.list_arguments(), expected))
+    req = {k: (grad_req if isinstance(grad_req, str)
+               else grad_req.get(k, "write")) for k in location}
+    for k in req:
+        if k not in expected and req[k] == "write":
+            req[k] = "null" if not isinstance(grad_req, dict) else req[k]
+    exe = sym.bind(ctx, args=location, grad_req=req, aux_states=aux)
+    exe.forward(is_train=True)
+    ograds = [NDArray(np.asarray(g, dtype=np.float32)) for g in out_grads] \
+        if out_grads is not None else None
+    exe.backward(ograds)
+    for name, exp in expected.items():
+        assert_almost_equal(exe.grad_dict[name].asnumpy(), exp, rtol=rtol,
+                            atol=atol if atol is not None else 1e-20,
+                            equal_nan=equal_nan)
+    return [exe.grad_dict.get(k) for k in sym.list_arguments()]
+
+
+def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
+                      arg_params=None, aux_params=None, tol=None,
+                      raise_on_err=True, ground_truth=None, equal_nan=False):
+    """Cross-context consistency (parity: test_utils.py:1207). On this stack
+    the contexts are cpu vs tpu — the CPU↔TPU harness of SURVEY §4."""
+    tol = tol or 1e-3
+    if isinstance(sym, (list, tuple)):
+        syms = list(sym)
+    else:
+        syms = [sym] * len(ctx_list)
+    outputs = []
+    grads = []
+    for s, spec in zip(syms, ctx_list):
+        ctx = spec.get("ctx", cpu())
+        shapes = {k: v for k, v in spec.items()
+                  if k not in ("ctx", "type_dict")}
+        exe = s.simple_bind(ctx, grad_req=grad_req,
+                            type_dict=spec.get("type_dict"), **shapes)
+        if arg_params:
+            for k, v in arg_params.items():
+                if k in exe.arg_dict:
+                    exe.arg_dict[k]._data = NDArray(np.asarray(v))._data
+        else:
+            np.random.seed(0)
+            for k in sorted(exe.arg_dict):
+                if k not in shapes:
+                    exe.arg_dict[k]._data = NDArray(
+                        np.random.normal(0, scale,
+                                         exe.arg_dict[k].shape).astype(
+                            np.float32))._data
+        np.random.seed(1)
+        for k in sorted(shapes):
+            exe.arg_dict[k]._data = NDArray(
+                np.random.normal(0, scale, shapes[k]).astype(np.float32))._data
+        exe.forward(is_train=grad_req != "null")
+        outputs.append([o.asnumpy() for o in exe.outputs])
+        if grad_req != "null":
+            exe.backward()
+            grads.append({k: v.asnumpy() for k, v in exe.grad_dict.items()})
+    ref = ground_truth or outputs[0]
+    for out in outputs[1:]:
+        for o, r in zip(out, ref):
+            assert_almost_equal(o, r, rtol=tol, atol=tol,
+                                equal_nan=equal_nan)
+    return outputs
+
+
+def get_mnist():
+    """Synthetic-backed MNIST dict (parity: test_utils.get_mnist)."""
+    from .gluon.data.vision.datasets import _synthetic
+    tr_d, tr_l = _synthetic(6000, (28, 28, 1), 10, 42)
+    te_d, te_l = _synthetic(1000, (28, 28, 1), 10, 43)
+    return {"train_data": tr_d.transpose(0, 3, 1, 2).astype(np.float32) / 255,
+            "train_label": tr_l,
+            "test_data": te_d.transpose(0, 3, 1, 2).astype(np.float32) / 255,
+            "test_label": te_l}
+
+
+def get_mnist_iterator(batch_size, input_shape, num_parts=1, part_index=0):
+    from .io import NDArrayIter
+    mnist = get_mnist()
+    flat = len(input_shape) == 1
+    shape = (-1,) + tuple(input_shape)
+    train = NDArrayIter(mnist["train_data"].reshape(shape)[part_index::num_parts],
+                        mnist["train_label"][part_index::num_parts],
+                        batch_size, shuffle=True)
+    val = NDArrayIter(mnist["test_data"].reshape(shape), mnist["test_label"],
+                      batch_size)
+    return train, val
+
+
+def list_gpus():
+    from .context import num_gpus
+    return list(range(num_gpus()))
+
+
+def set_env_var(key, val, default_val=""):
+    import os
+    prev_val = os.environ.get(key, default_val)
+    os.environ[key] = val
+    return prev_val

@@ -1,0 +1,12 @@
+"""Utility subpackage: serialization, download, docs helpers.
+
+Parity: reference `python/mxnet/ndarray/utils.py` (save/load) and
+`src/ndarray/ndarray.cc` legacy binary serialization — replaced by a
+portable .npz-based container (see serialization.py).
+"""
+from . import serialization
+from .serialization import save_ndarrays, load_ndarrays
+
+def makedirs(d):
+    import os
+    os.makedirs(d, exist_ok=True)

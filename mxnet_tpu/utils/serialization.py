@@ -1,0 +1,73 @@
+"""NDArray (de)serialization.
+
+Parity: reference legacy binary NDArray format (`src/ndarray/ndarray.cc`
+Save/Load, C API MXNDArraySave/Load `src/c_api/c_api.cc:279,302`) used by
+.params checkpoints.
+
+TPU-native redesign: a named .npz container (numpy archive) — portable,
+inspectable, and byte-stable across hosts. The dict/list duality of the
+reference format is preserved: a saved list round-trips as a list, a dict as
+a dict. bfloat16 is stored as uint16 raw bits with a dtype tag.
+"""
+from __future__ import annotations
+
+import io
+import zipfile
+
+import numpy as np
+import jax.numpy as jnp
+
+_BF16_TAG = "__bf16__:"
+_LIST_TAG = "__list__:"
+
+
+def _to_np(arr):
+    from ..ndarray import NDArray
+    data = arr._data if isinstance(arr, NDArray) else arr
+    npd = np.asarray(data)
+    if npd.dtype == jnp.bfloat16.dtype:
+        return npd.view(np.uint16), True
+    return npd, False
+
+
+def save_ndarrays(fname, data):
+    from ..ndarray import NDArray
+    if isinstance(data, NDArray):
+        data = [data]
+    arrays = {}
+    if isinstance(data, dict):
+        for k, v in data.items():
+            npd, bf16 = _to_np(v)
+            arrays[(_BF16_TAG if bf16 else "") + k] = npd
+    elif isinstance(data, (list, tuple)):
+        for i, v in enumerate(data):
+            npd, bf16 = _to_np(v)
+            arrays[(_BF16_TAG if bf16 else "") + _LIST_TAG + str(i)] = npd
+    else:
+        raise TypeError("save expects NDArray, list, or dict")
+    with open(fname, "wb") as f:  # file handle: stops savez appending '.npz'
+        np.savez(f, **arrays)
+
+
+def load_ndarrays(fname):
+    from ..ndarray import NDArray
+    try:
+        archive = np.load(fname, allow_pickle=False)
+    except (zipfile.BadZipFile, ValueError):
+        raise IOError("not an mxnet_tpu .params/.npz archive: %s" % fname)
+    items = {}
+    is_list = False
+    for key in archive.files:
+        name = key
+        arr = archive[key]
+        if name.startswith(_BF16_TAG):
+            name = name[len(_BF16_TAG):]
+            arr = arr.view(jnp.bfloat16.dtype)
+        if name.startswith(_LIST_TAG):
+            is_list = True
+            items[int(name[len(_LIST_TAG):])] = NDArray(jnp.asarray(arr))
+        else:
+            items[name] = NDArray(jnp.asarray(arr))
+    if is_list:
+        return [items[i] for i in sorted(items)]
+    return items

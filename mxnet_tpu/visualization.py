@@ -1,0 +1,134 @@
+"""Network visualization (parity: python/mxnet/visualization.py —
+print_summary table + plot_network graphviz)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64,
+                                                                  .74, 1.)):
+    """Parity: visualization.py print_summary — layer table with params."""
+    if shape is None:
+        shape = {}
+    show_shape = bool(shape)
+    out_shapes = {}
+    if show_shape:
+        internals = symbol.get_internals()
+        _, out_shapes_list, _ = internals.infer_shape(**shape)
+        for name, s in zip(internals.list_outputs(), out_shapes_list):
+            out_shapes[name] = s
+    conf = symbol._topo()
+    to_display = ["Layer (type)", "Output Shape", "Param #",
+                  "Previous Layer"]
+    positions = [int(line_length * p) for p in positions]
+
+    def print_row(fields, positions):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[:positions[i]]
+            line += " " * (positions[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(to_display, positions)
+    print("=" * line_length)
+    total_params = 0
+    arg_names = set(symbol.list_arguments())
+    aux_names = set(symbol.list_auxiliary_states())
+    known_shapes = {}
+    if show_shape:
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**shape)
+        known_shapes.update(zip(symbol.list_arguments(), arg_shapes))
+        known_shapes.update(zip(symbol.list_auxiliary_states(), aux_shapes))
+    for node in conf:
+        if node.op is None:
+            continue
+        name = node.name
+        op = node.op.name
+        cur_param = 0
+        for inp, _ in node.inputs:
+            if inp.op is None and inp.name not in shape and \
+                    inp.name in known_shapes and known_shapes[inp.name]:
+                cur_param += int(np.prod(known_shapes[inp.name]))
+        out_name = name + "_output"
+        out_shape = out_shapes.get(out_name, out_shapes.get(
+            name + "_output0", ""))
+        pred = ",".join(i.name for i, _ in node.inputs if i.op is not None)
+        print_row(["%s (%s)" % (name, op), str(out_shape), cur_param, pred],
+                  positions)
+        total_params += cur_param
+    print("=" * line_length)
+    print("Total params: %d" % total_params)
+    print("_" * line_length)
+    return total_params
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Graphviz network plot (parity: visualization.py plot_network)."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise MXNetError("plot_network requires the graphviz python package")
+    node_attrs = node_attrs or {}
+    node_attr = {"shape": "box", "fixedsize": "true", "width": "1.3",
+                 "height": "0.8034", "style": "filled"}
+    node_attr.update(node_attrs)
+    dot = Digraph(name=title, format=save_format)
+    conf = symbol._topo()
+    for node in conf:
+        if node.op is None:
+            if hide_weights and node.name != "data":
+                continue
+            dot.node(name=node.name, label=node.name,
+                     **dict(node_attr, fillcolor="#8dd3c7"))
+        else:
+            dot.node(name=node.name,
+                     label="%s\n%s" % (node.op.name, node.name),
+                     **dict(node_attr, fillcolor="#80b1d3"))
+    names = {n.name for n in conf
+             if n.op is not None or not hide_weights or n.name == "data"}
+    for node in conf:
+        if node.op is None:
+            continue
+        for inp, _ in node.inputs:
+            if inp.name in names:
+                dot.edge(tail_name=inp.name, head_name=node.name)
+    return dot
+
+
+def block_summary(block, *inputs):
+    """Summary for Gluon blocks (parity: Block.summary)."""
+    rows = []
+    hooks = []
+
+    def add_hook(b):
+        def hook(blk, inp, out):
+            nparams = sum(int(np.prod(p.shape)) for p in
+                          blk._reg_params.values()
+                          if p.shape and all(s > 0 for s in p.shape))
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            rows.append((blk.name, type(blk).__name__,
+                         [tuple(o.shape) for o in outs
+                          if hasattr(o, "shape")], nparams))
+        hooks.append((b, b.register_forward_hook(hook)))
+
+    block.apply(add_hook)
+    try:
+        block(*inputs)
+    finally:
+        for b, h in hooks:
+            b._forward_hooks.pop(h, None)
+    print("%-30s %-20s %-30s %12s" % ("Layer", "Type", "Output Shape",
+                                      "Params"))
+    print("=" * 96)
+    total = 0
+    for name, typ, shapes, nparams in rows:
+        print("%-30s %-20s %-30s %12d" % (name, typ, str(shapes), nparams))
+        total += nparams
+    print("=" * 96)
+    print("Total params: %d" % total)
+    return total

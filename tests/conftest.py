@@ -1,0 +1,28 @@
+"""Test configuration: run on a virtual 8-device CPU mesh so sharding tests
+exercise multi-chip code paths without TPU hardware (set before jax import)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+import jax
+
+# tests compare against float64 numpy references; keep MXU-style low-precision
+# matmuls out of the correctness suite (bench keeps the fast default)
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(autouse=True)
+def _seed_all():
+    """Determinism per test (parity: reference @with_seed(),
+    tests/python/unittest/common.py:97)."""
+    import mxnet_tpu as mx
+    np.random.seed(0)
+    mx.random.seed(0)
+    yield

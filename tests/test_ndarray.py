@@ -1,0 +1,191 @@
+"""NDArray basics (parity: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_create_and_asnumpy():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == np.float32
+    assert_almost_equal(a.asnumpy(), np.array([[1, 2], [3, 4]]))
+
+
+def test_creation_helpers():
+    assert_almost_equal(nd.zeros((2, 3)).asnumpy(), np.zeros((2, 3)))
+    assert_almost_equal(nd.ones((2, 3)).asnumpy(), np.ones((2, 3)))
+    assert_almost_equal(nd.full((2,), 3.5).asnumpy(), np.full((2,), 3.5))
+    assert_almost_equal(nd.arange(0, 10, 2).asnumpy(), np.arange(0, 10, 2))
+    e = nd.eye(3)
+    assert_almost_equal(e.asnumpy(), np.eye(3))
+
+
+def test_arithmetic():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([4.0, 5.0, 6.0])
+    assert_almost_equal((a + b).asnumpy(), [5, 7, 9])
+    assert_almost_equal((a - b).asnumpy(), [-3, -3, -3])
+    assert_almost_equal((a * b).asnumpy(), [4, 10, 18])
+    assert_almost_equal((b / a).asnumpy(), [4, 2.5, 2])
+    assert_almost_equal((a + 1).asnumpy(), [2, 3, 4])
+    assert_almost_equal((1 + a).asnumpy(), [2, 3, 4])
+    assert_almost_equal((2 - a).asnumpy(), [1, 0, -1])
+    assert_almost_equal((a ** 2).asnumpy(), [1, 4, 9])
+    assert_almost_equal((-a).asnumpy(), [-1, -2, -3])
+    assert_almost_equal(abs(nd.array([-1.0, 2.0])).asnumpy(), [1, 2])
+
+
+def test_comparisons():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([3.0, 2.0, 1.0])
+    assert_almost_equal((a == b).asnumpy(), [0, 1, 0])
+    assert_almost_equal((a > b).asnumpy(), [0, 0, 1])
+    assert_almost_equal((a >= 2).asnumpy(), [0, 1, 1])
+
+
+def test_inplace():
+    a = nd.array([1.0, 2.0])
+    a += 1
+    assert_almost_equal(a.asnumpy(), [2, 3])
+    a *= 2
+    assert_almost_equal(a.asnumpy(), [4, 6])
+
+
+def test_indexing():
+    a = nd.array(np.arange(12).reshape(3, 4))
+    assert_almost_equal(a[1].asnumpy(), [4, 5, 6, 7])
+    assert_almost_equal(a[1:3].asnumpy(), np.arange(12).reshape(3, 4)[1:3])
+    assert_almost_equal(a[1, 2].asnumpy(), 6)
+    a[0, 0] = 100.0
+    assert float(a[0, 0].asscalar()) == 100.0
+    idx = nd.array([0, 2])
+    assert a[idx].shape == (2, 4)
+
+
+def test_reshape_transpose():
+    a = nd.array(np.arange(6).reshape(2, 3))
+    assert a.reshape(3, 2).shape == (3, 2)
+    assert a.reshape((-1,)).shape == (6,)
+    assert a.T.shape == (3, 2)
+    assert a.reshape((0, -1)).shape == (2, 3)
+    # mxnet special codes
+    b = nd.zeros((2, 3, 4))
+    assert b.reshape((-2,)).shape == (2, 3, 4)
+    assert b.reshape((-3, 4)).shape == (6, 4)
+
+
+def test_reduce_methods():
+    a = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert float(a.sum().asscalar()) == 15
+    assert_almost_equal(a.sum(axis=0).asnumpy(), [3, 5, 7])
+    assert_almost_equal(a.mean(axis=1).asnumpy(), [1, 4])
+    assert float(a.max().asscalar()) == 5
+    assert float(nd.sum(a, axis=1, keepdims=True).shape[1]) == 1
+    # exclude semantics
+    assert_almost_equal(nd.sum(a, axis=0, exclude=True).asnumpy(), [3, 12])
+
+
+def test_dot():
+    a = nd.array(np.random.rand(3, 4).astype(np.float32))
+    b = nd.array(np.random.rand(4, 5).astype(np.float32))
+    assert_almost_equal(nd.dot(a, b).asnumpy(), a.asnumpy() @ b.asnumpy(),
+                        rtol=1e-4, atol=1e-5)
+    c = nd.dot(a, a, transpose_b=True)
+    assert c.shape == (3, 3)
+
+
+def test_astype_copy():
+    a = nd.array([1.5, 2.5])
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = a.copy()
+    c[0] = 99.0
+    assert float(a[0].asscalar()) == 1.5
+
+
+def test_broadcast_ops():
+    a = nd.ones((2, 1))
+    b = nd.ones((1, 3))
+    assert (a + b).shape == (2, 3)
+    assert nd.broadcast_to(a, shape=(2, 5)).shape == (2, 5)
+    assert nd.broadcast_add(a, b).shape == (2, 3)
+
+
+def test_concat_split_stack():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.Concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    parts = nd.split(c, num_outputs=2, axis=0)
+    assert parts[0].shape == (2, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+
+
+def test_take_one_hot_pick():
+    w = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    idx = nd.array([0, 2])
+    assert_almost_equal(nd.take(w, idx).asnumpy(), w.asnumpy()[[0, 2]])
+    oh = nd.one_hot(nd.array([0, 1, 2]), depth=4)
+    assert oh.shape == (3, 4)
+    data = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    picked = nd.pick(data, nd.array([0, 1]), axis=1)
+    assert_almost_equal(picked.asnumpy(), [1, 4])
+
+
+def test_topk_sort_argmax():
+    a = nd.array([[3.0, 1.0, 2.0]])
+    assert_almost_equal(nd.sort(a).asnumpy(), [[1, 2, 3]])
+    assert_almost_equal(nd.argmax(a, axis=1).asnumpy(), [0])
+    assert_almost_equal(nd.argsort(a).asnumpy(), [[1, 2, 0]])
+    v, i = nd.topk(a, k=2, ret_typ="both")
+    assert_almost_equal(v.asnumpy(), [[3, 2]])
+    assert_almost_equal(i.asnumpy(), [[0, 2]])
+
+
+def test_wait_and_context():
+    a = nd.ones((4,))
+    a.wait_to_read()
+    nd.waitall()
+    assert a.context.device_type in ("cpu", "tpu", "gpu")
+    b = a.as_in_context(mx.cpu(0))
+    assert b.context == mx.cpu(0)
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "test.params")
+    d = {"x": nd.ones((2, 2)), "y": nd.zeros((3,))}
+    nd.save(fname, d)
+    loaded = nd.load(fname)
+    assert set(loaded.keys()) == {"x", "y"}
+    assert_almost_equal(loaded["x"].asnumpy(), np.ones((2, 2)))
+    lst = [nd.ones((2,)), nd.zeros((1,))]
+    nd.save(fname, lst)
+    loaded = nd.load(fname)
+    assert isinstance(loaded, list) and len(loaded) == 2
+
+
+def test_norm_clip():
+    a = nd.array([[3.0, 4.0]])
+    assert abs(float(nd.norm(a).asscalar()) - 5.0) < 1e-5
+    assert_almost_equal(nd.clip(nd.array([-1.0, 0.5, 2.0]), 0.0, 1.0).asnumpy(),
+                        [0, 0.5, 1])
+
+
+def test_random_ops():
+    mx.random.seed(42)
+    u = nd.random.uniform(0, 1, shape=(100,))
+    assert u.shape == (100,)
+    assert 0 <= float(u.min().asscalar()) and float(u.max().asscalar()) <= 1
+    n = nd.random.normal(0, 1, shape=(1000,))
+    assert abs(float(n.mean().asscalar())) < 0.2
+    r = nd.random.randint(0, 10, shape=(50,))
+    assert r.dtype == np.int32
+    mx.random.seed(7)
+    a = nd.random.uniform(shape=(5,)).asnumpy()
+    mx.random.seed(7)
+    b = nd.random.uniform(shape=(5,)).asnumpy()
+    assert_almost_equal(a, b)
