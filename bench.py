@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 training throughput (img/s) on one chip.
+
+Baseline: 109 img/s — the reference's published ResNet-50 batch-32 number on
+1x K80 (example/image-classification/README.md:147-157, BASELINE.md).
+
+Runs the fully-fused TrainStep (forward + softmax CE loss + backward + SGD
+momentum update in ONE donated XLA program) on synthetic ImageNet-shaped
+data. Prints one JSON line.
+
+Env knobs: BENCH_BATCH (default 256), BENCH_STEPS (default 20),
+BENCH_SMOKE=1 for a tiny CPU-friendly config.
+"""
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    smoke = os.environ.get("BENCH_SMOKE", "") == "1"
+    batch = int(os.environ.get("BENCH_BATCH", "8" if smoke else "256"))
+    steps = int(os.environ.get("BENCH_STEPS", "3" if smoke else "20"))
+    image = 32 if smoke else 224
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel.trainer import TrainStep
+
+    net = vision.resnet18_v1() if smoke else vision.resnet50_v1()
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.zeros((1, 3, image, image)))  # finish deferred shape inference
+
+    step = TrainStep(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+                     {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4})
+
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    # synthetic batch staged on device once (as the reference's
+    # benchmark_score.py does); input-pipeline overlap is measured elsewhere
+    x = jnp.asarray(rng.uniform(-1, 1, (batch, 3, image, image))
+                    .astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 1000, (batch,)).astype(np.int32))
+    x.block_until_ready()
+
+    float(step(x, y))  # compile + warmup
+    float(step(x, y))
+
+    t0 = time.perf_counter()
+    loss = None
+    for _ in range(steps):
+        loss = step(x, y)
+    float(loss)  # block on the last step
+    dt = time.perf_counter() - t0
+
+    img_s = batch * steps / dt
+    if smoke:
+        print(json.dumps({"metric": "smoke_resnet18_train_img_per_sec",
+                          "value": round(img_s, 2), "unit": "img/s",
+                          "vs_baseline": 0.0}))
+    else:
+        print(json.dumps({
+            "metric": "resnet50_train_img_per_sec",
+            "value": round(img_s, 2),
+            "unit": "img/s",
+            "vs_baseline": round(img_s / 109.0, 3),
+        }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+"""Functionalize a Gluon net into a pure ``fn(param_values, x)`` suitable
+for jax.jit / pjit over a Mesh.
+
+This is the seam between the imperative Gluon API (mutable Parameters, the
+reference's `gluon/block.py` model) and XLA's functional compilation model:
+parameter buffers are temporarily swapped for tracers while the eager net
+is traced, exactly like TrainStep's fused step (parallel/trainer.py).
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..ndarray import NDArray
+from .. import autograd
+from .. import random as _random
+
+
+@contextlib.contextmanager
+def swap_param_buffers(plist, values):
+    """Temporarily replace each Parameter's device buffer with ``values``
+    (typically tracers during a jit trace); restore the originals on exit.
+
+    Yields the injected list so callers can detect in-trace writes — a
+    parameter whose ``_data._data`` no longer ``is`` its injected value was
+    set_data()-ed during the trace (BatchNorm running stats) and must be
+    threaded out as an extra output by the caller.
+    """
+    saved = [(p._data._data, p._data._entry) for p in plist]
+    try:
+        injected = list(values)
+        for p, v in zip(plist, injected):
+            p._data._data = v
+            p._data._entry = None
+        yield injected
+    finally:
+        for p, (d, e) in zip(plist, saved):
+            p._data._data = d
+            p._data._entry = e
+
+
+def functionalize(net, train_mode=False):
+    """Return ``(apply_fn, names, values)``.
+
+    ``apply_fn(param_values, x, key=None)`` is pure and jittable: it runs
+    ``net.forward`` with ``param_values`` (a tuple aligned with ``names``)
+    injected in place of the stored parameter buffers and returns the raw
+    ``jax.Array`` output. ``values`` is the current parameter tuple, ready
+    to pass as the first argument (and to shard with jax.device_put).
+
+    ``train_mode=True`` requires a ``key`` argument per call (stochastic
+    layers like Dropout draw from it; without it a concrete key would be
+    baked into the jitted program and every call would reuse one mask).
+    Note: in train mode, BatchNorm running-stat writes are DISCARDED by
+    apply_fn — use TrainStep (parallel/trainer.py), which threads them out
+    as aux outputs, for actual training loops.
+
+    The net must be fully initialized (run one dummy forward first if it
+    uses deferred shape inference).
+    """
+    params = net.collect_params()
+    names = list(params.keys())
+    plist = [params[n] for n in names]
+    for n, p in zip(names, plist):
+        if p._data is None:
+            raise RuntimeError(
+                "functionalize: parameter %s is uninitialized; call "
+                "net.initialize() and one dummy forward first" % n)
+    values = tuple(p._data._data for p in plist)
+
+    def apply_fn(param_values, x, key=None):
+        if train_mode and key is None:
+            raise ValueError(
+                "functionalize(train_mode=True): pass a PRNG key per call, "
+                "or stochastic layers would bake one mask into the program")
+        key_scope = (_random.trace_key_scope(key) if key is not None
+                     else contextlib.nullcontext())
+        with swap_param_buffers(plist, param_values):
+            with autograd._RecordingStateScope(False, train_mode), key_scope:
+                out = net.forward(NDArray(x))
+            return out._data
+
+    return apply_fn, names, values

@@ -121,19 +121,17 @@ class TrainStep:
             """Trace the eager net with tracer-backed parameter buffers.
             Returns (mean_loss, {plist_index: mutated_value}) where the aux
             dict carries BatchNorm running-stat writes."""
-            saved = [(p._data._data, p._data._entry) for p in plist]
-            try:
-                injected = []
-                gi = ni = 0
-                for p, has_grad in zip(plist, grad_mask):
-                    v = grad_vals[gi] if has_grad else nograd_vals[ni]
-                    if has_grad:
-                        gi += 1
-                    else:
-                        ni += 1
-                    p._data._data = v
-                    p._data._entry = None
-                    injected.append(v)
+            merged = []
+            gi = ni = 0
+            for has_grad in grad_mask:
+                if has_grad:
+                    merged.append(grad_vals[gi])
+                    gi += 1
+                else:
+                    merged.append(nograd_vals[ni])
+                    ni += 1
+            from .functional import swap_param_buffers
+            with swap_param_buffers(plist, merged) as injected:
                 with autograd._RecordingStateScope(False, True), \
                         _random.trace_key_scope(key):
                     out = net.forward(NDArray(x))
@@ -141,11 +139,7 @@ class TrainStep:
                 loss_val = jnp.mean(loss._data)
                 aux_upd = {i: p._data._data for i, p in enumerate(plist)
                            if p._data._data is not injected[i]}
-                return loss_val, aux_upd
-            finally:
-                for p, (d, e) in zip(plist, saved):
-                    p._data._data = d
-                    p._data._entry = e
+            return loss_val, aux_upd
 
         def step(grad_vals, nograd_vals, opt_state, x, y, key, lr, t):
             (loss_val, aux_upd), grads = jax.value_and_grad(
