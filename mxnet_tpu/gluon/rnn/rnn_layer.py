@@ -24,12 +24,12 @@ class _RNNLayer(HybridBlock):
                  bidirectional, input_size, i2h_weight_initializer,
                  h2h_weight_initializer, i2h_bias_initializer,
                  h2h_bias_initializer, mode, **kwargs):
+        self._mode = mode  # before super(): _alias() needs it
         super().__init__(**kwargs)
         assert layout in ("TNC", "NTC"), \
             "Invalid layout %s; must be one of ['TNC' or 'NTC']" % layout
         self._hidden_size = hidden_size
         self._num_layers = num_layers
-        self._mode = mode
         self._layout = layout
         self._dropout = dropout
         self._dir = 2 if bidirectional else 1

@@ -624,6 +624,14 @@ class Updater:
             self.states, self.optimizer = states
         else:
             self.states = states
+
+        def to_device(v):
+            if isinstance(v, np.ndarray):
+                return NDArray(v)
+            if isinstance(v, (tuple, list)):
+                return type(v)(to_device(x) for x in v)
+            return v
+        self.states = {k: to_device(v) for k, v in self.states.items()}
         self.states_synced = dict.fromkeys(self.states.keys(), False)
 
     def get_states(self, dump_optimizer=False):

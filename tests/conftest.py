@@ -15,6 +15,10 @@ import pytest
 
 import jax
 
+# sitecustomize (axon TPU plugin) imports jax before this file runs, so the
+# env vars above are too late for jax.config — force the platform here too
+jax.config.update("jax_platforms", "cpu")
+
 # tests compare against float64 numpy references; keep MXU-style low-precision
 # matmuls out of the correctness suite (bench keeps the fast default)
 jax.config.update("jax_default_matmul_precision", "highest")

@@ -1,0 +1,335 @@
+"""Gluon API tests (parity: reference tests/python/unittest/test_gluon.py,
+test_gluon_rnn.py — layers, Parameter/ParameterDict, hybridize consistency,
+Trainer, save/load).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.gluon import nn, rnn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def rand(*shape):
+    return np.random.uniform(-1, 1, shape).astype(np.float32)
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(4, 3))
+    p.initialize(init=mx.init.One())
+    assert p.shape == (4, 3)
+    assert_almost_equal(p.data().asnumpy(), np.ones((4, 3), np.float32))
+    p.set_data(nd.zeros((4, 3)))
+    assert_almost_equal(p.data().asnumpy(), np.zeros((4, 3), np.float32))
+
+
+def test_parameter_dict_shared():
+    shared = gluon.ParameterDict("net_")
+    shared.get("weight", shape=(2, 2))
+    child = gluon.ParameterDict("net_", shared=shared)
+    w = child.get("weight")
+    assert w is shared.get("weight")
+
+
+def test_dense_forward():
+    # bias keeps its own zeros initializer (reference Dense default), so
+    # init=One() only fills the weight
+    layer = nn.Dense(3, in_units=4, use_bias=True)
+    layer.initialize(init=mx.init.One())
+    x = rand(2, 4)
+    out = layer(nd.array(x)).asnumpy()
+    assert_almost_equal(out, np.repeat(x.sum(1, keepdims=True), 3, axis=1),
+                        rtol=1e-5, atol=1e-5)
+
+
+def test_deferred_init_and_shape_inference():
+    layer = nn.Dense(7)
+    layer.initialize()
+    out = layer(nd.zeros((5, 11)))
+    assert out.shape == (5, 7)
+    assert layer.weight.shape == (7, 11)
+
+
+def test_sequential_and_children():
+    net = nn.Sequential()
+    net.add(nn.Dense(4, activation="relu"), nn.Dense(2))
+    net.initialize()
+    out = net(nd.zeros((3, 5)))
+    assert out.shape == (3, 2)
+    assert len(net) == 2
+    assert len(net.collect_params().keys()) == 4
+
+
+def test_hybridize_consistency():
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="tanh"), nn.BatchNorm(), nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    x = nd.array(rand(4, 6))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    assert_almost_equal(eager, hybrid, rtol=1e-4, atol=1e-5)
+    # second call hits the cached program
+    hybrid2 = net(x).asnumpy()
+    assert_almost_equal(hybrid, hybrid2, rtol=1e-6)
+
+
+def test_conv_layers():
+    for layer, shape, oshape in [
+            (nn.Conv2D(4, 3, padding=1, in_channels=2), (1, 2, 8, 8),
+             (1, 4, 8, 8)),
+            (nn.Conv1D(4, 3, in_channels=2), (1, 2, 8), (1, 4, 6)),
+            (nn.Conv2DTranspose(4, 2, strides=2, in_channels=2),
+             (1, 2, 4, 4), (1, 4, 8, 8)),
+            (nn.MaxPool2D(2, 2), (1, 2, 8, 8), (1, 2, 4, 4)),
+            (nn.AvgPool2D(2, 2), (1, 2, 8, 8), (1, 2, 4, 4)),
+            (nn.GlobalAvgPool2D(), (1, 2, 8, 8), (1, 2, 1, 1)),
+            (nn.GlobalMaxPool2D(), (1, 2, 8, 8), (1, 2, 1, 1))]:
+        layer.initialize()
+        assert layer(nd.zeros(shape)).shape == oshape, type(layer).__name__
+
+
+def test_pool_values():
+    x = rand(1, 1, 4, 4)
+    p = nn.MaxPool2D(2, 2)
+    p.initialize()
+    out = p(nd.array(x)).asnumpy()
+    assert_almost_equal(out, x.reshape(1, 1, 2, 2, 2, 2).max((3, 5)),
+                        rtol=1e-6)
+
+
+def test_embedding_layer():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    idx = nd.array(np.array([1, 2, 1], np.float32))
+    out = emb(idx)
+    assert out.shape == (3, 4)
+    w = emb.weight.data().asnumpy()
+    assert_almost_equal(out.asnumpy(), w[[1, 2, 1]], rtol=1e-6)
+
+
+def test_batchnorm_running_stats_update():
+    bn = nn.BatchNorm(in_channels=3)
+    bn.initialize()
+    x = nd.array(rand(8, 3, 4, 4) * 3 + 2)
+    rm0 = bn.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        bn(x)
+    rm1 = bn.running_mean.data().asnumpy()
+    assert not np.allclose(rm0, rm1), "running mean must update in training"
+    # inference doesn't update
+    bn(x)
+    assert_almost_equal(bn.running_mean.data().asnumpy(), rm1, rtol=1e-6)
+
+
+def test_activations_layers():
+    x = nd.array(rand(2, 5))
+    for layer, ref in [
+            (nn.LeakyReLU(0.1),
+             lambda v: np.where(v > 0, v, 0.1 * v)),
+            (nn.ELU(1.0), lambda v: np.where(v > 0, v, np.expm1(v))),
+            (nn.Swish(), lambda v: v / (1 + np.exp(-v)))]:
+        layer.initialize()
+        assert_almost_equal(layer(x).asnumpy(), ref(x.asnumpy()), rtol=1e-4,
+                            atol=1e-5)
+
+
+def test_save_load_params(tmp_path):
+    def build():
+        net = nn.HybridSequential(prefix="mynet_")
+        with net.name_scope():  # children must live in the net's scope
+            net.add(nn.Dense(5, activation="relu"), nn.Dense(2))
+        return net
+    net = build()
+    net.initialize(mx.init.Xavier())
+    x = nd.array(rand(3, 4))
+    out = net(x).asnumpy()
+    f = str(tmp_path / "net.params")
+    net.save_params(f)
+
+    net2 = build()
+    net2.load_params(f)
+    assert_almost_equal(net2(x).asnumpy(), out, rtol=1e-6)
+
+
+def test_trainer_sgd_matches_manual():
+    net = nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize(init=mx.init.One())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    x = nd.array(np.array([[1.0, 2.0]], np.float32))
+    with autograd.record():
+        y = net(x)
+    y.backward()
+    trainer.step(1)
+    # w <- w - 0.5 * x  (grad of sum(y) wrt w is x)
+    assert_almost_equal(net.weight.data().asnumpy(),
+                        np.array([[0.5, 0.0]], np.float32), rtol=1e-5,
+                        atol=1e-6)
+
+
+def test_trainer_state_roundtrip(tmp_path):
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9})
+    x = nd.array(rand(4, 3))
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    tr.step(4)
+    f = str(tmp_path / "trainer.states")
+    tr.save_states(f)
+    tr.load_states(f)
+
+
+def test_grad_accumulation():
+    net = nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize(init=mx.init.One())
+    net.weight.grad_req = "add"
+    x = nd.array(np.ones((1, 2), np.float32))
+    for _ in range(3):
+        with autograd.record():
+            y = net(x)
+        y.backward()
+    assert_almost_equal(net.weight.grad().asnumpy(),
+                        3 * np.ones((1, 2), np.float32), rtol=1e-6)
+    net.collect_params().zero_grad()
+    assert_almost_equal(net.weight.grad().asnumpy(),
+                        np.zeros((1, 2), np.float32))
+
+
+# ---------------- RNN ----------------
+
+def test_rnn_cells_shapes():
+    for cell_cls, nstate in [(rnn.RNNCell, 1), (rnn.LSTMCell, 2),
+                             (rnn.GRUCell, 1)]:
+        cell = cell_cls(16, input_size=8)
+        cell.initialize()
+        x = nd.array(rand(4, 8))
+        states = cell.begin_state(batch_size=4)
+        assert len(states) == nstate
+        out, new_states = cell(x, states)
+        assert out.shape == (4, 16)
+        assert len(new_states) == nstate
+
+
+def test_rnn_cell_unroll():
+    cell = rnn.LSTMCell(8, input_size=4)
+    cell.initialize()
+    inputs = [nd.array(rand(2, 4)) for _ in range(5)]
+    outputs, states = cell.unroll(5, inputs, layout="TNC",
+                                  merge_outputs=False)
+    assert len(outputs) == 5 and outputs[0].shape == (2, 8)
+
+
+def test_rnn_layer_vs_cell():
+    np.random.seed(0)
+    layer = rnn.LSTM(6, input_size=3)
+    layer.initialize()
+    x = nd.array(rand(7, 2, 3))  # TNC
+    out = layer(x)
+    assert out.shape == (7, 2, 6)
+
+
+def test_bidirectional_cell():
+    cell = rnn.BidirectionalCell(rnn.GRUCell(4, input_size=3),
+                                 rnn.GRUCell(4, input_size=3))
+    cell.initialize()
+    inputs = [nd.array(rand(2, 3)) for _ in range(5)]
+    outputs, _ = cell.unroll(5, inputs, merge_outputs=False)
+    assert outputs[0].shape == (2, 8)
+
+
+def test_sequential_rnn_and_dropout_cell():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(8, input_size=4))
+    stack.add(rnn.DropoutCell(0.5))
+    stack.add(rnn.LSTMCell(6, input_size=8))
+    stack.initialize()
+    x = nd.array(rand(2, 4))
+    states = stack.begin_state(batch_size=2)
+    out, _ = stack(x, states)
+    assert out.shape == (2, 6)
+
+
+def test_residual_zoneout_cells():
+    base = rnn.RNNCell(4, input_size=4)
+    res = rnn.ResidualCell(base)
+    res.initialize()
+    x = nd.array(rand(2, 4))
+    out, _ = res(x, res.begin_state(batch_size=2))
+    assert out.shape == (2, 4)
+
+
+# ---------------- data ----------------
+
+def test_dataset_dataloader():
+    X = rand(20, 3)
+    Y = np.arange(20, dtype=np.float32)
+    ds = gluon.data.ArrayDataset(X, Y)
+    assert len(ds) == 20
+    loader = gluon.data.DataLoader(ds, batch_size=6, shuffle=False,
+                                   last_batch="keep")
+    batches = list(loader)
+    assert len(batches) == 4
+    xb, yb = batches[0]
+    assert xb.shape == (6, 3)
+    assert_almost_equal(yb.asnumpy(), Y[:6])
+    assert batches[-1][0].shape == (2, 3)
+
+
+def test_dataloader_shuffle_covers_all():
+    X = np.arange(30, dtype=np.float32).reshape(30, 1)
+    ds = gluon.data.ArrayDataset(X)
+    loader = gluon.data.DataLoader(ds, batch_size=10, shuffle=True)
+    seen = np.concatenate([b.asnumpy().ravel() for b in loader])
+    assert_almost_equal(np.sort(seen), X.ravel())
+
+
+def test_dataset_transform():
+    X = rand(10, 2)
+    ds = gluon.data.ArrayDataset(X).transform(lambda x: x * 2)
+    out = ds[3]
+    assert_almost_equal(np.asarray(out), X[3] * 2, rtol=1e-6)
+
+
+def test_samplers():
+    from mxnet_tpu.gluon.data import sampler
+    s = list(sampler.SequentialSampler(5))
+    assert s == [0, 1, 2, 3, 4]
+    r = list(sampler.RandomSampler(5))
+    assert sorted(r) == [0, 1, 2, 3, 4]
+    b = list(sampler.BatchSampler(sampler.SequentialSampler(5), 2,
+                                  last_batch="discard"))
+    assert b == [[0, 1], [2, 3]]
+
+
+def test_split_and_load():
+    x = nd.array(rand(8, 3))
+    parts = gluon.utils.split_and_load(x, [mx.cpu(0), mx.cpu(1)])
+    assert len(parts) == 2 and parts[0].shape == (4, 3)
+    clipped = gluon.utils.clip_global_norm(
+        [nd.array(np.ones((2, 2), np.float32) * 10)], 1.0)
+    assert clipped < 20.0 + 1e-3
+
+
+def test_model_zoo_constructs():
+    from mxnet_tpu.gluon.model_zoo import vision
+    for factory in [vision.resnet18_v1, vision.resnet18_v2,
+                    vision.squeezenet1_0, vision.mobilenet0_25,
+                    vision.mobilenet_v2_0_25]:
+        net = factory()
+        net.initialize(mx.init.Xavier())
+        out = net(nd.zeros((1, 3, 32, 32)))
+        assert out.shape == (1, 1000), factory.__name__
+
+
+def test_model_zoo_get_model():
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+    net = get_model("resnet18_v1", classes=10)
+    net.initialize()
+    assert net(nd.zeros((1, 3, 32, 32))).shape == (1, 10)

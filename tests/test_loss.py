@@ -1,0 +1,131 @@
+"""Gluon loss tests (parity: reference tests/python/unittest/test_loss.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.gluon import loss as gloss
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def rand(*shape):
+    return np.random.uniform(-1, 1, shape).astype(np.float32)
+
+
+def test_l2_l1():
+    pred, label = rand(4, 3), rand(4, 3)
+    l2 = gloss.L2Loss()(nd.array(pred), nd.array(label)).asnumpy()
+    assert_almost_equal(l2, 0.5 * ((pred - label) ** 2).mean(1) * 3 / 3,
+                        rtol=1e-4, atol=1e-5)
+    assert_almost_equal(l2, 0.5 * ((pred - label) ** 2).mean(1), rtol=1e-4,
+                        atol=1e-5)
+    l1 = gloss.L1Loss()(nd.array(pred), nd.array(label)).asnumpy()
+    assert_almost_equal(l1, np.abs(pred - label).mean(1), rtol=1e-4,
+                        atol=1e-5)
+
+
+def test_softmax_ce():
+    pred = rand(5, 4)
+    label = np.array([0, 1, 2, 3, 0], np.float32)
+    out = gloss.SoftmaxCrossEntropyLoss()(nd.array(pred),
+                                          nd.array(label)).asnumpy()
+    e = np.exp(pred - pred.max(1, keepdims=True))
+    p = e / e.sum(1, keepdims=True)
+    expected = -np.log(p[np.arange(5), label.astype(int)])
+    assert_almost_equal(out, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_ce_sparse_vs_dense():
+    pred = rand(3, 4)
+    sparse_label = np.array([1, 0, 3], np.float32)
+    dense = np.eye(4, dtype=np.float32)[sparse_label.astype(int)]
+    a = gloss.SoftmaxCrossEntropyLoss()(nd.array(pred),
+                                        nd.array(sparse_label)).asnumpy()
+    b = gloss.SoftmaxCrossEntropyLoss(sparse_label=False)(
+        nd.array(pred), nd.array(dense)).asnumpy()
+    assert_almost_equal(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_sigmoid_bce():
+    pred = rand(4, 3)
+    label = (rand(4, 3) > 0).astype(np.float32)
+    out = gloss.SigmoidBinaryCrossEntropyLoss()(
+        nd.array(pred), nd.array(label)).asnumpy()
+    p = 1 / (1 + np.exp(-pred))
+    expected = -(label * np.log(p) + (1 - label) * np.log(1 - p)).mean(1)
+    assert_almost_equal(out, expected, rtol=1e-3, atol=1e-4)
+
+
+def test_kl_div():
+    pred = np.abs(rand(3, 4)) + 0.1
+    pred = pred / pred.sum(1, keepdims=True)
+    label = np.abs(rand(3, 4)) + 0.1
+    label = label / label.sum(1, keepdims=True)
+    # reference loss.py: from_logits=False applies log_softmax to pred
+    out = gloss.KLDivLoss(from_logits=False)(
+        nd.array(pred), nd.array(label)).asnumpy()
+    lsm = pred - np.log(np.exp(pred).sum(1, keepdims=True))
+    expected = (label * (np.log(label) - lsm)).mean(1)
+    assert_almost_equal(out, expected, rtol=1e-3, atol=1e-4)
+
+
+def test_huber_hinge():
+    pred = np.array([[0.5], [2.0]], np.float32)
+    label = np.array([[0.0], [0.0]], np.float32)
+    h = gloss.HuberLoss(rho=1.0)(nd.array(pred), nd.array(label)).asnumpy()
+    assert_almost_equal(h, np.array([0.5 * 0.25, 2.0 - 0.5], np.float32),
+                        rtol=1e-4, atol=1e-5)
+    hinge_pred = np.array([[0.5], [2.0]], np.float32)
+    hinge_label = np.array([[1.0], [1.0]], np.float32)
+    hg = gloss.HingeLoss()(nd.array(hinge_pred),
+                           nd.array(hinge_label)).asnumpy()
+    assert_almost_equal(hg, np.array([0.5, 0.0], np.float32), rtol=1e-5,
+                        atol=1e-6)
+
+
+def test_triplet():
+    anchor, pos, neg = rand(3, 4), rand(3, 4), rand(3, 4)
+    out = gloss.TripletLoss(margin=1.0)(
+        nd.array(anchor), nd.array(pos), nd.array(neg)).asnumpy()
+    expected = np.maximum(
+        ((anchor - pos) ** 2 - (anchor - neg) ** 2).sum(1) + 1.0, 0)
+    assert_almost_equal(out, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_ctc_loss_gluon():
+    T, B, C = 6, 2, 5
+    pred = rand(B, T, C)  # NTC default layout
+    label = np.array([[1, 2, -1, -1], [2, 3, 4, -1]], np.float32)
+    out = gloss.CTCLoss()(nd.array(pred), nd.array(label)).asnumpy()
+    assert out.shape == (B,)
+    assert np.isfinite(out).all() and (out > 0).all()
+
+
+def test_poisson_nll():
+    pred = np.abs(rand(3, 2)) + 0.5
+    label = np.abs(rand(3, 2))
+    # reference PoissonNLLLoss returns the scalar mean over all elements
+    out = gloss.PoissonNLLLoss(from_logits=False)(
+        nd.array(pred), nd.array(label)).asnumpy()
+    expected = (pred - label * np.log(pred + 1e-8)).mean()
+    assert_almost_equal(np.asarray(out).ravel(), [expected], rtol=1e-3,
+                        atol=1e-4)
+
+
+def test_sample_weight():
+    pred, label = rand(4, 3), rand(4, 3)
+    w = np.array([[1.0], [0.0], [1.0], [0.0]], np.float32)
+    out = gloss.L1Loss()(nd.array(pred), nd.array(label),
+                         nd.array(w)).asnumpy()
+    assert out[1] == 0.0 and out[3] == 0.0
+
+
+def test_loss_is_differentiable():
+    from mxnet_tpu import autograd
+    net_w = nd.array(rand(3, 4))
+    net_w.attach_grad()
+    label = nd.array(np.array([0, 1, 2], np.float32))
+    with autograd.record():
+        loss = gloss.SoftmaxCrossEntropyLoss()(net_w, label).sum()
+    loss.backward()
+    assert net_w.grad is not None
+    assert float(np.abs(net_w.grad.asnumpy()).sum()) > 0

@@ -1,0 +1,101 @@
+"""Metric tests (parity: reference tests/python/unittest/test_metric.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_accuracy():
+    m = mx.metric.Accuracy()
+    pred = nd.array(np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]],
+                             np.float32))
+    label = nd.array(np.array([1, 0, 0], np.float32))
+    m.update([label], [pred])
+    assert m.get()[1] == 2.0 / 3
+
+
+def test_topk_accuracy():
+    m = mx.metric.TopKAccuracy(top_k=2)
+    pred = nd.array(np.array([[0.5, 0.3, 0.2], [0.1, 0.2, 0.7]], np.float32))
+    label = nd.array(np.array([1, 0], np.float32))
+    m.update([label], [pred])
+    assert m.get()[1] == 0.5  # label 1 is top-2 of row0; label 0 not in row1
+
+
+def test_f1():
+    m = mx.metric.F1()
+    pred = nd.array(np.array([[0.2, 0.8], [0.8, 0.2], [0.3, 0.7],
+                              [0.6, 0.4]], np.float32))
+    label = nd.array(np.array([1, 0, 0, 1], np.float32))
+    m.update([label], [pred])
+    # tp=1 fp=1 fn=1 -> precision=0.5 recall=0.5 f1=0.5
+    assert abs(m.get()[1] - 0.5) < 1e-6
+
+
+def test_mae_mse_rmse():
+    pred = nd.array(np.array([[1.0], [2.0]], np.float32))
+    label = nd.array(np.array([[0.0], [4.0]], np.float32))
+    mae = mx.metric.MAE()
+    mae.update([label], [pred])
+    assert abs(mae.get()[1] - 1.5) < 1e-6
+    mse = mx.metric.MSE()
+    mse.update([label], [pred])
+    assert abs(mse.get()[1] - 2.5) < 1e-6
+    rmse = mx.metric.RMSE()
+    rmse.update([label], [pred])
+    assert abs(rmse.get()[1] - np.sqrt(2.5)) < 1e-6
+
+
+def test_perplexity_crossentropy_nll():
+    pred = nd.array(np.array([[0.25, 0.75], [0.5, 0.5]], np.float32))
+    label = nd.array(np.array([1, 0], np.float32))
+    ce = mx.metric.CrossEntropy()
+    ce.update([label], [pred])
+    expected = -(np.log(0.75) + np.log(0.5)) / 2
+    assert abs(ce.get()[1] - expected) < 1e-5
+    pp = mx.metric.Perplexity(ignore_label=None)
+    pp.update([label], [pred])
+    assert abs(pp.get()[1] - np.exp(expected)) < 1e-4
+    nll = mx.metric.NegativeLogLikelihood()
+    nll.update([label], [pred])
+    assert abs(nll.get()[1] - expected) < 1e-5
+
+
+def test_pearson():
+    m = mx.metric.PearsonCorrelation()
+    pred = nd.array(np.array([[1.0], [2.0], [3.0]], np.float32))
+    label = nd.array(np.array([[1.0], [2.0], [3.0]], np.float32))
+    m.update([label], [pred])
+    assert abs(m.get()[1] - 1.0) < 1e-5
+
+
+def test_composite_and_create():
+    m = mx.metric.create(["acc", "mse"])
+    assert isinstance(m, mx.metric.CompositeEvalMetric)
+    pred = nd.array(np.array([[0.1, 0.9]], np.float32))
+    label = nd.array(np.array([1], np.float32))
+    m.update([label], [pred])
+    names, values = m.get()
+    assert "accuracy" in names[0]
+
+
+def test_custom_metric():
+    def feval(label, pred):
+        return float(np.abs(label - pred).sum())
+    m = mx.metric.CustomMetric(feval, name="myabs")
+    m.update([nd.array(np.array([1.0], np.float32))],
+             [nd.array(np.array([0.0], np.float32))])
+    assert m.get()[1] == 1.0
+    m2 = mx.metric.np(lambda l, p: 0.5)
+    m2.update([nd.array(np.array([1.0], np.float32))],
+              [nd.array(np.array([0.0], np.float32))])
+    assert m2.get()[1] == 0.5
+
+
+def test_loss_metric_and_reset():
+    m = mx.metric.Loss()
+    m.update(None, [nd.array(np.array([2.0, 4.0], np.float32))])
+    assert abs(m.get()[1] - 3.0) < 1e-6
+    m.reset()
+    assert np.isnan(m.get()[1])

@@ -1,0 +1,777 @@
+"""Operator unit tests (parity: reference tests/python/unittest/test_operator.py —
+numpy-reference forward checks + finite-difference gradient checks via
+check_numeric_gradient / check_symbolic_forward / check_symbolic_backward).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.test_utils import (assert_almost_equal, check_numeric_gradient,
+                                  check_symbolic_forward,
+                                  check_symbolic_backward, simple_forward)
+
+
+def rand(*shape):
+    return np.random.uniform(-1, 1, shape).astype(np.float32)
+
+
+# ---------------- elementwise unary ----------------
+
+@pytest.mark.parametrize("name,npf", [
+    ("relu", lambda x: np.maximum(x, 0)),
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+    ("tanh", np.tanh),
+    ("exp", np.exp),
+    ("log", lambda x: np.log(np.abs(x) + 1.5)),
+    ("sqrt", lambda x: np.sqrt(np.abs(x) + 1.5)),
+    ("square", np.square),
+    ("abs", np.abs),
+    ("sign", np.sign),
+    ("floor", np.floor),
+    ("ceil", np.ceil),
+    ("rint", np.rint),
+    ("sin", np.sin),
+    ("cos", np.cos),
+    ("arctan", np.arctan),
+    ("erf", None),
+    ("log1p", lambda x: np.log1p(np.abs(x))),
+    ("expm1", np.expm1),
+])
+def test_unary_forward(name, npf):
+    x = rand(3, 4)
+    if name in ("log", "sqrt"):
+        x = np.abs(x) + 1.5
+        npf2 = {"log": np.log, "sqrt": np.sqrt}[name]
+    elif name == "log1p":
+        x = np.abs(x)
+        npf2 = np.log1p
+    elif name == "erf":
+        import math
+        npf2 = np.vectorize(math.erf)
+    else:
+        npf2 = npf
+    out = getattr(nd, name)(nd.array(x)).asnumpy()
+    assert_almost_equal(out, npf2(x).astype(np.float32), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("name", ["sigmoid", "tanh", "exp", "square",
+                                  "softsign", "softrelu"])
+def test_unary_grad(name):
+    data = sym.Variable("data")
+    s = getattr(sym, name)(data)
+    check_numeric_gradient(s, [rand(3, 3)], rtol=5e-2, atol=1e-3)
+
+
+def test_reciprocal_rsqrt_rcbrt():
+    x = np.abs(rand(3, 4)) + 1.0
+    assert_almost_equal(nd.reciprocal(nd.array(x)).asnumpy(), 1 / x, rtol=1e-5)
+    assert_almost_equal(nd.rsqrt(nd.array(x)).asnumpy(), 1 / np.sqrt(x),
+                        rtol=1e-5)
+    assert_almost_equal(nd.rcbrt(nd.array(x)).asnumpy(), 1 / np.cbrt(x),
+                        rtol=1e-5)
+
+
+def test_clip():
+    data = sym.Variable("data")
+    s = sym.clip(data, a_min=-0.5, a_max=0.5)
+    x = rand(4, 5) * 2
+    check_symbolic_forward(s, [x], [np.clip(x, -0.5, 0.5)], rtol=1e-6,
+                           atol=1e-6)
+    # grad is 1 inside the clip range, 0 outside
+    og = np.ones_like(x)
+    expected = og * ((x > -0.5) & (x < 0.5))
+    check_symbolic_backward(s, [x], [og], [expected], rtol=1e-6, atol=1e-6)
+
+
+# ---------------- binary / broadcast ----------------
+
+def test_elemwise_binary():
+    a, b = rand(3, 4), rand(3, 4)
+    assert_almost_equal(nd.elemwise_add(nd.array(a), nd.array(b)).asnumpy(),
+                        a + b, rtol=1e-6)
+    assert_almost_equal(nd.elemwise_mul(nd.array(a), nd.array(b)).asnumpy(),
+                        a * b, rtol=1e-6)
+    assert_almost_equal(nd.elemwise_div(nd.array(a), nd.array(b + 3)).asnumpy(),
+                        a / (b + 3), rtol=1e-5)
+
+
+@pytest.mark.parametrize("name,npf", [
+    ("broadcast_add", np.add), ("broadcast_mul", np.multiply),
+    ("broadcast_sub", np.subtract), ("broadcast_maximum", np.maximum),
+    ("broadcast_minimum", np.minimum), ("broadcast_power", None),
+    ("broadcast_hypot", np.hypot),
+])
+def test_broadcast_binary(name, npf):
+    a = rand(2, 1, 3)
+    b = np.abs(rand(1, 4, 3)) + 0.5
+    if name == "broadcast_power":
+        a = np.abs(a) + 0.5
+        npf = np.power
+    out = getattr(nd, name)(nd.array(a), nd.array(b)).asnumpy()
+    assert_almost_equal(out, npf(a, b).astype(np.float32), rtol=1e-5,
+                        atol=1e-6)
+
+
+def test_broadcast_binary_grad():
+    a_s = sym.Variable("a")
+    b_s = sym.Variable("b")
+    s = sym.broadcast_mul(a_s, b_s)
+    check_numeric_gradient(s, {"a": rand(2, 1, 3), "b": rand(1, 4, 3)},
+                           rtol=5e-2, atol=1e-3)
+
+
+def test_comparison_ops():
+    a, b = rand(3, 4), rand(3, 4)
+    assert_almost_equal(nd.broadcast_greater(nd.array(a), nd.array(b))
+                        .asnumpy(), (a > b).astype(np.float32))
+    assert_almost_equal(nd.broadcast_equal(nd.array(a), nd.array(a))
+                        .asnumpy(), np.ones_like(a))
+
+
+def test_scalar_ops():
+    x = rand(3, 4)
+    a = nd.array(x)
+    assert_almost_equal((a + 2).asnumpy(), x + 2, rtol=1e-6)
+    assert_almost_equal((2 - a).asnumpy(), 2 - x, rtol=1e-6)
+    assert_almost_equal((a * 3).asnumpy(), x * 3, rtol=1e-6)
+    assert_almost_equal((1 / (a + 3)).asnumpy(), 1 / (x + 3), rtol=1e-5)
+    assert_almost_equal((a ** 2).asnumpy(), x ** 2, rtol=1e-5)
+
+
+# ---------------- reductions ----------------
+
+@pytest.mark.parametrize("name,npf", [
+    ("sum", np.sum), ("mean", np.mean), ("max", np.max), ("min", np.min),
+    ("prod", np.prod),
+])
+@pytest.mark.parametrize("axis,keepdims", [(None, False), (0, False),
+                                           (1, True), ((0, 2), False)])
+def test_reduce(name, npf, axis, keepdims):
+    x = rand(2, 3, 4)
+    out = getattr(nd, name)(nd.array(x), axis=axis, keepdims=keepdims)
+    expected = npf(x, axis=axis, keepdims=keepdims)
+    assert_almost_equal(out.asnumpy(), np.asarray(expected, np.float32),
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_sum_grad():
+    data = sym.Variable("data")
+    s = sym.sum(data, axis=1)
+    x = rand(3, 4)
+    check_symbolic_backward(s, [x], [np.ones((3,), np.float32)],
+                            [np.ones_like(x)], rtol=1e-6, atol=1e-6)
+
+
+def test_argmax_argmin_norm():
+    x = rand(4, 5)
+    assert_almost_equal(nd.argmax(nd.array(x), axis=1).asnumpy(),
+                        np.argmax(x, 1).astype(np.float32))
+    assert_almost_equal(nd.argmin(nd.array(x), axis=0).asnumpy(),
+                        np.argmin(x, 0).astype(np.float32))
+    assert_almost_equal(nd.norm(nd.array(x)).asnumpy(),
+                        np.array(np.linalg.norm(x), np.float32), rtol=1e-5)
+
+
+def test_nansum():
+    x = rand(3, 4)
+    x[0, 0] = np.nan
+    assert_almost_equal(nd.nansum(nd.array(x), axis=0).asnumpy(),
+                        np.nansum(x, 0), rtol=1e-5, atol=1e-6)
+
+
+# ---------------- shape manipulation ----------------
+
+def test_reshape_special():
+    # MXNet reshape special codes: 0 copy, -1 infer, -2 copy-rest, -3 merge
+    x = rand(2, 3, 4)
+    assert nd.reshape(nd.array(x), shape=(0, -1)).shape == (2, 12)
+    assert nd.reshape(nd.array(x), shape=(-1, 4)).shape == (6, 4)
+    assert nd.reshape(nd.array(x), shape=(-2,)).shape == (2, 3, 4)
+    assert nd.reshape(nd.array(x), shape=(-3, 4)).shape == (6, 4)
+    assert nd.Reshape(nd.array(x), shape=(4, 3, 2)).shape == (4, 3, 2)
+
+
+def test_transpose_swap_flip():
+    x = rand(2, 3, 4)
+    assert_almost_equal(nd.transpose(nd.array(x), axes=(2, 0, 1)).asnumpy(),
+                        x.transpose(2, 0, 1))
+    assert_almost_equal(nd.swapaxes(nd.array(x), dim1=0, dim2=2).asnumpy(),
+                        x.swapaxes(0, 2))
+    assert_almost_equal(nd.flip(nd.array(x), axis=1).asnumpy(),
+                        x[:, ::-1, :])
+
+
+def test_expand_squeeze():
+    x = rand(2, 1, 4)
+    assert nd.expand_dims(nd.array(x), axis=0).shape == (1, 2, 1, 4)
+    assert nd.squeeze(nd.array(x), axis=1).shape == (2, 4)
+
+
+def test_slice_ops():
+    x = rand(4, 5, 6)
+    assert_almost_equal(nd.slice(nd.array(x), begin=(1, 0, 2),
+                                 end=(3, 4, 6)).asnumpy(), x[1:3, 0:4, 2:6])
+    assert_almost_equal(nd.slice_axis(nd.array(x), axis=1, begin=1,
+                                      end=4).asnumpy(), x[:, 1:4, :])
+    y = rand(2, 3, 4)
+    assert nd.slice_like(nd.array(x), nd.array(y)).shape == (2, 3, 4)
+
+
+def test_concat_split_stack():
+    a, b = rand(2, 3), rand(2, 3)
+    assert_almost_equal(nd.concat(nd.array(a), nd.array(b), dim=1).asnumpy(),
+                        np.concatenate([a, b], 1))
+    parts = nd.split(nd.array(a), num_outputs=3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 1)
+    assert_almost_equal(nd.stack(nd.array(a), nd.array(b), axis=0).asnumpy(),
+                        np.stack([a, b], 0))
+
+
+def test_concat_backward():
+    a_s, b_s = sym.Variable("a"), sym.Variable("b")
+    s = sym.Concat(a_s, b_s, dim=1)
+    a, b = rand(2, 2), rand(2, 3)
+    og = rand(2, 5)
+    check_symbolic_backward(s, {"a": a, "b": b}, [og],
+                            {"a": og[:, :2], "b": og[:, 2:]}, rtol=1e-6,
+                            atol=1e-6)
+
+
+def test_tile_repeat_pad():
+    x = rand(2, 3)
+    assert_almost_equal(nd.tile(nd.array(x), reps=(2, 2)).asnumpy(),
+                        np.tile(x, (2, 2)))
+    assert_almost_equal(nd.repeat(nd.array(x), repeats=2, axis=1).asnumpy(),
+                        np.repeat(x, 2, 1))
+    x4 = rand(1, 1, 3, 3)
+    padded = nd.pad(nd.array(x4), mode="constant",
+                    pad_width=(0, 0, 0, 0, 1, 1, 1, 1), constant_value=0)
+    assert padded.shape == (1, 1, 5, 5)
+    assert_almost_equal(padded.asnumpy()[0, 0, 1:4, 1:4], x4[0, 0])
+    edge = nd.pad(nd.array(x4), mode="edge",
+                  pad_width=(0, 0, 0, 0, 1, 1, 1, 1))
+    assert_almost_equal(edge.asnumpy()[0, 0],
+                        np.pad(x4[0, 0], 1, mode="edge"))
+
+
+def test_depth_space():
+    x = rand(1, 4, 2, 2)
+    d2s = nd.depth_to_space(nd.array(x), block_size=2)
+    assert d2s.shape == (1, 1, 4, 4)
+    back = nd.space_to_depth(d2s, block_size=2)
+    assert_almost_equal(back.asnumpy(), x, rtol=1e-6)
+
+
+def test_where_diag():
+    cond = (rand(3, 3) > 0).astype(np.float32)
+    a, b = rand(3, 3), rand(3, 3)
+    assert_almost_equal(
+        nd.where(nd.array(cond), nd.array(a), nd.array(b)).asnumpy(),
+        np.where(cond > 0, a, b))
+    x = rand(4, 4)
+    assert_almost_equal(nd.diag(nd.array(x)).asnumpy(), np.diag(x))
+
+
+# ---------------- indexing ----------------
+
+def test_take_embedding():
+    w = rand(10, 4)
+    idx = np.array([1, 3, 5], np.float32)
+    assert_almost_equal(nd.take(nd.array(w), nd.array(idx)).asnumpy(),
+                        w[idx.astype(int)])
+    emb = nd.Embedding(nd.array(idx), nd.array(w), input_dim=10, output_dim=4)
+    assert_almost_equal(emb.asnumpy(), w[idx.astype(int)], rtol=1e-6)
+
+
+def test_embedding_grad():
+    data_s = sym.Variable("data")
+    w_s = sym.Variable("weight")
+    s = sym.Embedding(data_s, w_s, input_dim=6, output_dim=3)
+    idx = np.array([0, 2, 2], np.float32)
+    w = rand(6, 3)
+    og = rand(3, 3)
+    expected_w = np.zeros_like(w)
+    for i, j in enumerate(idx.astype(int)):
+        expected_w[j] += og[i]
+    check_symbolic_backward(s, {"data": idx, "weight": w}, [og],
+                            {"weight": expected_w}, grad_req={"data": "null",
+                                                              "weight": "write"},
+                            rtol=1e-5, atol=1e-6)
+
+
+def test_pick_one_hot_batch_take():
+    x = rand(4, 5)
+    idx = np.array([0, 2, 4, 1], np.float32)
+    assert_almost_equal(nd.pick(nd.array(x), nd.array(idx), axis=1).asnumpy(),
+                        x[np.arange(4), idx.astype(int)])
+    oh = nd.one_hot(nd.array(idx), depth=5)
+    assert_almost_equal(oh.asnumpy(), np.eye(5, dtype=np.float32)
+                        [idx.astype(int)])
+    assert_almost_equal(
+        nd.batch_take(nd.array(x), nd.array(idx)).asnumpy(),
+        x[np.arange(4), idx.astype(int)])
+
+
+def test_gather_scatter_nd():
+    # MXNet convention: indices shape (M, N) — indices[:, i] is point i
+    x = rand(3, 4)
+    indices = np.array([[0, 2], [1, 3]], np.float32)  # points (0,1), (2,3)
+    got = nd.gather_nd(nd.array(x), nd.array(indices)).asnumpy()
+    assert_almost_equal(got, x[[0, 2], [1, 3]])
+    data = np.array([7.0, 9.0], np.float32)
+    scat = nd.scatter_nd(nd.array(data), nd.array(indices), shape=(3, 4))
+    expected = np.zeros((3, 4), np.float32)
+    expected[0, 1] = 7
+    expected[2, 3] = 9
+    assert_almost_equal(scat.asnumpy(), expected)
+
+
+# ---------------- ordering ----------------
+
+def test_sort_argsort_topk():
+    x = rand(3, 6)
+    assert_almost_equal(nd.sort(nd.array(x), axis=1).asnumpy(), np.sort(x, 1))
+    assert_almost_equal(nd.sort(nd.array(x), axis=1, is_ascend=False)
+                        .asnumpy(), -np.sort(-x, 1))
+    assert_almost_equal(nd.argsort(nd.array(x), axis=1).asnumpy(),
+                        np.argsort(x, 1).astype(np.float32))
+    topv = nd.topk(nd.array(x), k=2, axis=1, ret_typ="value")
+    assert_almost_equal(topv.asnumpy(), -np.sort(-x, 1)[:, :2])
+    topi = nd.topk(nd.array(x), k=1, axis=1)  # default ret indices
+    assert_almost_equal(topi.asnumpy().ravel(),
+                        np.argmax(x, 1).astype(np.float32))
+
+
+# ---------------- linalg / dot ----------------
+
+def test_dot_batch_dot():
+    a, b = rand(3, 4), rand(4, 5)
+    assert_almost_equal(nd.dot(nd.array(a), nd.array(b)).asnumpy(), a @ b,
+                        rtol=1e-5, atol=1e-5)
+    assert_almost_equal(
+        nd.dot(nd.array(a), nd.array(rand(3, 5).T.copy().T), transpose_a=True)
+        .shape, (4, 5))
+    ba, bb = rand(2, 3, 4), rand(2, 4, 5)
+    assert_almost_equal(nd.batch_dot(nd.array(ba), nd.array(bb)).asnumpy(),
+                        np.matmul(ba, bb), rtol=1e-5, atol=1e-5)
+
+
+def test_dot_grad():
+    a_s, b_s = sym.Variable("a"), sym.Variable("b")
+    s = sym.dot(a_s, b_s)
+    check_numeric_gradient(s, {"a": rand(3, 3), "b": rand(3, 2)}, rtol=5e-2,
+                           atol=1e-3)
+
+
+def test_linalg_gemm_potrf():
+    a, b, c = rand(3, 4), rand(4, 5), rand(3, 5)
+    out = nd.linalg_gemm(nd.array(a), nd.array(b), nd.array(c),
+                         alpha=2.0, beta=0.5).asnumpy()
+    assert_almost_equal(out, 2.0 * (a @ b) + 0.5 * c, rtol=1e-5, atol=1e-5)
+    out2 = nd.linalg_gemm2(nd.array(a), nd.array(b)).asnumpy()
+    assert_almost_equal(out2, a @ b, rtol=1e-5, atol=1e-5)
+    m = rand(4, 4)
+    spd = m @ m.T + 4 * np.eye(4, dtype=np.float32)
+    L = nd.linalg_potrf(nd.array(spd)).asnumpy()
+    assert_almost_equal(L @ L.T, spd, rtol=1e-4, atol=1e-4)
+    sld = nd.linalg_sumlogdiag(nd.array(np.abs(m) + 1)).asnumpy()
+    assert_almost_equal(sld, np.sum(np.log(np.diag(np.abs(m) + 1))),
+                        rtol=1e-5)
+
+
+def test_linalg_syrk_trsm():
+    a = rand(3, 4)
+    assert_almost_equal(nd.linalg_syrk(nd.array(a)).asnumpy(), a @ a.T,
+                        rtol=1e-5, atol=1e-5)
+    m = rand(3, 3)
+    tri = np.tril(m) + 3 * np.eye(3, dtype=np.float32)
+    b = rand(3, 2)
+    x = nd.linalg_trsm(nd.array(tri), nd.array(b)).asnumpy()
+    assert_almost_equal(tri @ x, b, rtol=1e-4, atol=1e-4)
+
+
+def test_khatri_rao():
+    a, b = rand(2, 3), rand(4, 3)
+    out = nd.khatri_rao(nd.array(a), nd.array(b)).asnumpy()
+    expected = np.vstack([np.kron(a[:, i], b[:, i]) for i in range(3)]).T
+    assert_almost_equal(out, expected.astype(np.float32), rtol=1e-5,
+                        atol=1e-5)
+
+
+# ---------------- nn ops ----------------
+
+def test_fully_connected():
+    x, w, b = rand(4, 5), rand(3, 5), rand(3)
+    data_s = sym.Variable("data")
+    s = sym.FullyConnected(data_s, name="fc", num_hidden=3)
+    out = simple_forward(s, data=x, fc_weight=w, fc_bias=b)
+    assert_almost_equal(out, x @ w.T + b, rtol=1e-5, atol=1e-5)
+
+
+def test_fully_connected_grad():
+    data_s = sym.Variable("data")
+    s = sym.FullyConnected(data_s, name="fc", num_hidden=2)
+    check_numeric_gradient(s, {"data": rand(3, 4), "fc_weight": rand(2, 4),
+                               "fc_bias": rand(2)}, rtol=5e-2, atol=1e-3)
+
+
+def test_convolution_identity():
+    # 1x1 kernel with identity weights = passthrough
+    x = rand(2, 3, 5, 5)
+    w = np.zeros((3, 3, 1, 1), np.float32)
+    for i in range(3):
+        w[i, i, 0, 0] = 1
+    out = nd.Convolution(nd.array(x), nd.array(w), kernel=(1, 1),
+                         num_filter=3, no_bias=True).asnumpy()
+    assert_almost_equal(out, x, rtol=1e-5, atol=1e-5)
+
+
+def test_convolution_vs_numpy():
+    x = rand(1, 1, 5, 5)
+    w = rand(2, 1, 3, 3)
+    out = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                         num_filter=2, no_bias=True).asnumpy()
+    # direct correlation
+    expected = np.zeros((1, 2, 3, 3), np.float32)
+    for f in range(2):
+        for i in range(3):
+            for j in range(3):
+                expected[0, f, i, j] = np.sum(x[0, 0, i:i+3, j:j+3] *
+                                              w[f, 0])
+    assert_almost_equal(out, expected, rtol=1e-4, atol=1e-4)
+
+
+def test_convolution_grad():
+    data_s = sym.Variable("data")
+    s = sym.Convolution(data_s, name="conv", kernel=(2, 2), num_filter=2,
+                        no_bias=True)
+    check_numeric_gradient(s, {"data": rand(1, 1, 4, 4),
+                               "conv_weight": rand(2, 1, 2, 2)},
+                           rtol=5e-2, atol=1e-3)
+
+
+def test_deconvolution_shape():
+    x = rand(1, 2, 4, 4)
+    w = rand(2, 3, 2, 2)  # (in, out, kh, kw)
+    out = nd.Deconvolution(nd.array(x), nd.array(w), kernel=(2, 2),
+                           num_filter=3, stride=(2, 2), no_bias=True)
+    assert out.shape == (1, 3, 8, 8)
+
+
+def test_pooling():
+    x = rand(1, 1, 4, 4)
+    mp = nd.Pooling(nd.array(x), kernel=(2, 2), pool_type="max",
+                    stride=(2, 2)).asnumpy()
+    expected = x.reshape(1, 1, 2, 2, 2, 2).max(axis=(3, 5))
+    assert_almost_equal(mp, expected, rtol=1e-6)
+    ap = nd.Pooling(nd.array(x), kernel=(2, 2), pool_type="avg",
+                    stride=(2, 2)).asnumpy()
+    assert_almost_equal(ap, x.reshape(1, 1, 2, 2, 2, 2).mean(axis=(3, 5)),
+                        rtol=1e-5)
+    gp = nd.Pooling(nd.array(x), kernel=(2, 2), pool_type="max",
+                    global_pool=True).asnumpy()
+    assert_almost_equal(gp, x.max(axis=(2, 3), keepdims=True), rtol=1e-6)
+
+
+def test_pooling_grad():
+    data_s = sym.Variable("data")
+    s = sym.Pooling(data_s, kernel=(2, 2), pool_type="max", stride=(2, 2))
+    check_numeric_gradient(s, [rand(1, 1, 4, 4)], rtol=5e-2, atol=1e-3)
+
+
+def test_softmax_ops():
+    x = rand(3, 5)
+    e = np.exp(x - x.max(1, keepdims=True))
+    sm = e / e.sum(1, keepdims=True)
+    assert_almost_equal(nd.softmax(nd.array(x)).asnumpy(), sm, rtol=1e-5,
+                        atol=1e-6)
+    assert_almost_equal(nd.log_softmax(nd.array(x)).asnumpy(), np.log(sm),
+                        rtol=1e-4, atol=1e-5)
+    assert_almost_equal(nd.softmin(nd.array(x)).asnumpy(),
+                        np.exp(-x - (-x).max(1, keepdims=True)) /
+                        np.exp(-x - (-x).max(1, keepdims=True)).sum(
+                            1, keepdims=True), rtol=1e-5, atol=1e-6)
+
+
+def test_softmax_grad():
+    data = sym.Variable("data")
+    s = sym.softmax(data)
+    check_numeric_gradient(s, [rand(3, 4)], rtol=5e-2, atol=1e-3)
+
+
+def test_softmax_output_grad():
+    # SoftmaxOutput backward = (softmax - onehot) / normalization
+    data_s = sym.Variable("data")
+    label_s = sym.Variable("label")
+    s = sym.SoftmaxOutput(data_s, label_s)
+    x = rand(4, 3)
+    y = np.array([0, 1, 2, 1], np.float32)
+    e = np.exp(x - x.max(1, keepdims=True))
+    p = e / e.sum(1, keepdims=True)
+    expected = p.copy()
+    expected[np.arange(4), y.astype(int)] -= 1
+    check_symbolic_backward(s, {"data": x, "label": y}, [np.ones_like(x)],
+                            {"data": expected},
+                            grad_req={"data": "write", "label": "null"},
+                            rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_train_stats():
+    x = rand(4, 3, 5, 5) * 2 + 1
+    gamma = np.ones(3, np.float32)
+    beta = np.zeros(3, np.float32)
+    rm = np.zeros(3, np.float32)
+    rv = np.ones(3, np.float32)
+    with mx.autograd.train_mode():
+        out, mean, var = nd.BatchNorm(nd.array(x), nd.array(gamma),
+                                      nd.array(beta), nd.array(rm),
+                                      nd.array(rv), output_mean_var=True)
+    assert_almost_equal(mean.asnumpy(), x.mean(axis=(0, 2, 3)), rtol=1e-4,
+                        atol=1e-4)
+    got = out.asnumpy()
+    expected = (x - x.mean((0, 2, 3), keepdims=True).reshape(1, 3, 1, 1)) / \
+        np.sqrt(x.var((0, 2, 3)).reshape(1, 3, 1, 1) + 1e-3)
+    assert_almost_equal(got, expected, rtol=1e-2, atol=1e-2)
+
+
+def test_layernorm():
+    x = rand(4, 6)
+    gamma = rand(6)
+    beta = rand(6)
+    out = nd.LayerNorm(nd.array(x), nd.array(gamma), nd.array(beta)).asnumpy()
+    mu = x.mean(-1, keepdims=True)
+    sig = x.std(-1, keepdims=True)
+    assert_almost_equal(out, (x - mu) / (sig + 1e-5) * gamma + beta,
+                        rtol=1e-3, atol=1e-3)
+
+
+def test_lrn_l2norm():
+    x = rand(2, 4, 3, 3)
+    out = nd.LRN(nd.array(x), nsize=3).asnumpy()
+    assert out.shape == x.shape
+    l2 = nd.L2Normalization(nd.array(rand(3, 4))).asnumpy()
+    assert_almost_equal(np.sum(l2 ** 2, 1), np.ones(3), rtol=1e-4, atol=1e-4)
+
+
+def test_dropout_modes():
+    x = np.ones((100, 100), np.float32)
+    with mx.autograd.train_mode():
+        out = nd.Dropout(nd.array(x), p=0.5).asnumpy()
+    # eval: identity
+    out_eval = nd.Dropout(nd.array(x), p=0.5).asnumpy()
+    assert_almost_equal(out_eval, x)
+    kept = out[out != 0]
+    assert abs((out == 0).mean() - 0.5) < 0.05
+    assert_almost_equal(kept, np.full_like(kept, 2.0), rtol=1e-5)
+
+
+def test_activation_leaky():
+    x = rand(3, 4)
+    assert_almost_equal(nd.LeakyReLU(nd.array(x), slope=0.1).asnumpy(),
+                        np.where(x > 0, x, 0.1 * x), rtol=1e-5)
+    assert_almost_equal(
+        nd.Activation(nd.array(x), act_type="softrelu").asnumpy(),
+        np.log1p(np.exp(x)), rtol=1e-4, atol=1e-5)
+
+
+def test_upsampling_bilinear_resize():
+    x = rand(1, 2, 3, 3)
+    up = nd.UpSampling(nd.array(x), scale=2, sample_type="nearest")
+    assert up.shape == (1, 2, 6, 6)
+    assert_almost_equal(up.asnumpy()[0, 0, ::2, ::2], x[0, 0], rtol=1e-6)
+    br = nd.contrib.BilinearResize2D(nd.array(x), height=5, width=7)
+    assert br.shape == (1, 2, 5, 7)
+    aa = nd.contrib.AdaptiveAvgPooling2D(nd.array(x), output_size=1)
+    assert_almost_equal(aa.asnumpy().squeeze(), x.mean((0, 2, 3)), rtol=1e-5,
+                        atol=1e-5)
+
+
+def test_sequence_ops():
+    x = rand(4, 2, 3)  # (seq, batch, feat)
+    lens = np.array([2, 3], np.float32)
+    masked = nd.SequenceMask(nd.array(x), nd.array(lens),
+                             use_sequence_length=True).asnumpy()
+    assert_almost_equal(masked[:2, 0], x[:2, 0])
+    assert (masked[2:, 0] == 0).all()
+    last = nd.SequenceLast(nd.array(x), nd.array(lens),
+                           use_sequence_length=True).asnumpy()
+    assert_almost_equal(last[0], x[1, 0])
+    assert_almost_equal(last[1], x[2, 1])
+    rev = nd.SequenceReverse(nd.array(x)).asnumpy()
+    assert_almost_equal(rev, x[::-1])
+
+
+def test_rnn_op_shapes():
+    # fused RNN op: LSTM mode
+    seq, batch, inp, hid = 5, 2, 4, 6
+    x = rand(seq, batch, inp)
+    from mxnet_tpu.ops.nn import rnn_param_size
+    psize = rnn_param_size(1, inp, hid, False, "lstm")
+    params = rand(psize)
+    state = np.zeros((1, batch, hid), np.float32)
+    out = nd.RNN(nd.array(x), nd.array(params), nd.array(state),
+                 nd.array(state.copy()), state_size=hid, num_layers=1,
+                 mode="lstm")
+    assert out.shape == (seq, batch, hid)
+
+
+def test_grid_bilinear_sampler():
+    x = rand(1, 1, 4, 4)
+    # identity affine grid
+    affine = np.array([[1, 0, 0, 0, 1, 0]], np.float32)
+    grid = nd.GridGenerator(nd.array(affine), transform_type="affine",
+                            target_shape=(4, 4))
+    out = nd.BilinearSampler(nd.array(x), grid).asnumpy()
+    assert_almost_equal(out, x, rtol=1e-4, atol=1e-4)
+    st = nd.SpatialTransformer(nd.array(x), nd.array(affine),
+                               target_shape=(4, 4),
+                               transform_type="affine",
+                               sampler_type="bilinear").asnumpy()
+    assert_almost_equal(st, x, rtol=1e-4, atol=1e-4)
+
+
+def test_roi_pooling():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)
+    out = nd.ROIPooling(nd.array(x), nd.array(rois), pooled_size=(2, 2),
+                        spatial_scale=1.0).asnumpy()
+    assert out.shape == (1, 1, 2, 2)
+    assert out[0, 0, 1, 1] == 15.0
+
+
+# ---------------- loss-ish ops ----------------
+
+def test_smooth_l1():
+    x = np.array([-2.0, -0.5, 0.0, 0.5, 2.0], np.float32)
+    out = nd.smooth_l1(nd.array(x), scalar=1.0).asnumpy()
+    expected = np.where(np.abs(x) < 1, 0.5 * x ** 2, np.abs(x) - 0.5)
+    assert_almost_equal(out, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_quadratic():
+    x = rand(3, 3)
+    out = nd.quadratic(nd.array(x), a=2.0, b=3.0, c=1.0).asnumpy()
+    assert_almost_equal(out, 2 * x ** 2 + 3 * x + 1, rtol=1e-5, atol=1e-5)
+
+
+def test_regression_outputs():
+    x, y = rand(4, 3), rand(4, 3)
+    data_s, label_s = sym.Variable("data"), sym.Variable("label")
+    s = sym.LinearRegressionOutput(data_s, label_s)
+    # reference regression_output-inl.h scales grad by 1/num_output (feature
+    # count per sample), not batch size
+    check_symbolic_backward(s, {"data": x, "label": y},
+                            [np.ones_like(x)], {"data": (x - y) / 3},
+                            grad_req={"data": "write", "label": "null"},
+                            rtol=1e-5, atol=1e-6)
+
+
+def test_ctc_loss():
+    # blank-free trivial case against reference computation
+    T, B, C = 4, 1, 3
+    acts = rand(T, B, C)
+    labels = np.array([[1, 2]], np.float32)
+    loss = nd.ctc_loss(nd.array(acts), nd.array(labels)).asnumpy()
+    assert loss.shape == (B,)
+    assert np.isfinite(loss).all() and (loss > 0).all()
+
+
+def test_make_loss_blockgrad():
+    x = rand(3, 3)
+    data = sym.Variable("data")
+    s = sym.MakeLoss(sym.square(data))
+    check_symbolic_backward(s, [x], None, [2 * x], rtol=1e-5, atol=1e-6)
+    s2 = sym.BlockGrad(data)
+    check_symbolic_backward(s2, [x], [np.ones_like(x)], [np.zeros_like(x)],
+                            rtol=1e-6, atol=1e-6)
+
+
+# ---------------- contrib ----------------
+
+def test_multibox_prior():
+    x = nd.zeros((1, 3, 4, 4))
+    anchors = nd.contrib.MultiBoxPrior(x, sizes=(0.5,), ratios=(1.0,))
+    assert anchors.shape == (1, 16, 4)
+    a = anchors.asnumpy()[0]
+    # all anchors have the requested size
+    w = a[:, 2] - a[:, 0]
+    assert_almost_equal(w, np.full(16, 0.5), rtol=1e-4, atol=1e-4)
+
+
+def test_box_iou_nms():
+    b1 = np.array([[0, 0, 2, 2]], np.float32)
+    b2 = np.array([[1, 1, 3, 3]], np.float32)
+    iou = nd.contrib.box_iou(nd.array(b1), nd.array(b2)).asnumpy()
+    assert_almost_equal(iou, np.array([[1 / 7]], np.float32), rtol=1e-4,
+                        atol=1e-4)
+    # default layout: id at 0, score at 1, corners at 2:6
+    boxes = np.array([[[0, 0.9, 0, 0, 2, 2], [0, 0.8, 0.1, 0.1, 2, 2],
+                       [0, 0.7, 5, 5, 7, 7]]], np.float32)
+    kept = nd.contrib.box_nms(nd.array(boxes), overlap_thresh=0.5,
+                              id_index=0).asnumpy()
+    assert kept[0, 1, 1] == -1  # suppressed (score overwritten with -1)
+    assert kept[0, 0, 1] == 0.9 and kept[0, 2, 1] == 0.7
+
+
+def test_fft_ifft():
+    x = rand(2, 8)
+    f = nd.contrib.fft(nd.array(x))
+    assert f.shape == (2, 16)
+    back = nd.contrib.ifft(f).asnumpy()
+    assert_almost_equal(back, x, rtol=1e-4, atol=1e-4)
+
+
+def test_count_sketch():
+    x = rand(2, 8)
+    h = np.random.randint(0, 4, (8,)).astype(np.float32)
+    s = (np.random.randint(0, 2, (8,)) * 2 - 1).astype(np.float32)
+    out = nd.contrib.count_sketch(nd.array(x), nd.array(h), nd.array(s),
+                                  out_dim=4)
+    assert out.shape == (2, 4)
+    assert_almost_equal(out.asnumpy().sum(1), (x * s).sum(1), rtol=1e-4,
+                        atol=1e-4)
+
+
+# ---------------- random ----------------
+
+def test_random_moments():
+    mx.random.seed(42)
+    u = nd.random.uniform(0, 1, shape=(2000,)).asnumpy()
+    assert 0.45 < u.mean() < 0.55 and u.min() >= 0 and u.max() <= 1
+    n = nd.random.normal(2.0, 3.0, shape=(4000,)).asnumpy()
+    assert abs(n.mean() - 2.0) < 0.3 and abs(n.std() - 3.0) < 0.3
+    g = nd.random.gamma(9.0, 0.5, shape=(4000,)).asnumpy()
+    assert abs(g.mean() - 4.5) < 0.3
+    p = nd.random.poisson(5.0, shape=(4000,)).asnumpy()
+    assert abs(p.mean() - 5.0) < 0.4
+
+
+def test_random_seed_determinism():
+    mx.random.seed(7)
+    a = nd.random.uniform(shape=(5,)).asnumpy()
+    mx.random.seed(7)
+    b = nd.random.uniform(shape=(5,)).asnumpy()
+    assert_almost_equal(a, b)
+
+
+def test_sample_ops():
+    # NDArray-valued params dispatch to the _sample_* per-row variants
+    mu = nd.array(np.array([0.0, 10.0], np.float32))
+    sig = nd.array(np.array([1.0, 1.0], np.float32))
+    s = nd.random.normal(mu, sig, shape=(500,)).asnumpy()
+    assert s.shape == (2, 500)
+    assert abs(s[0].mean() - 0.0) < 0.3
+    assert abs(s[1].mean() - 10.0) < 0.3
+    mn = nd.random.multinomial(nd.array(np.array([[0, 0, 1, 0],
+                                                  [1, 0, 0, 0]],
+                                                 np.float32)),
+                               shape=(20,)).asnumpy()
+    assert (mn[0] == 2).all() and (mn[1] == 0).all()
+
+
+def test_shuffle():
+    x = np.arange(20, dtype=np.float32)
+    out = nd.random.shuffle(nd.array(x)).asnumpy()
+    assert_almost_equal(np.sort(out), x)

@@ -1,0 +1,173 @@
+"""Optimizer tests (parity: reference tests/python/unittest/test_optimizer.py
+— each optimizer vs a numpy reference update, plus Updater state save/load).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+ALL_OPTS = ["sgd", "nag", "adam", "adagrad", "rmsprop", "adadelta", "ftrl",
+            "adamax", "nadam", "signum", "ftml", "dcasgd", "sgld", "lbsgd"]
+
+
+def run_steps(name, nsteps=5, **kwargs):
+    o = opt.create_optimizer(name, learning_rate=0.1, **kwargs)
+    updater = opt.get_updater(o)
+    w = nd.array(np.array([1.0, -2.0, 3.0], np.float32))
+    for t in range(nsteps):
+        g = w * 0.2 + 0.1
+        updater(0, g, w)
+    return w.asnumpy()
+
+
+@pytest.mark.parametrize("name", ALL_OPTS)
+def test_optimizer_decreases_quadratic(name):
+    """All optimizers must make progress on a convex quadratic
+    f(w) = 0.1*w^2 + 0.1*w (gradient 0.2w + 0.1, minimum at -0.5)."""
+    if name == "sgld":  # Langevin noise dominates at this scale; just run it
+        run_steps(name, nsteps=5)
+        return
+    w_end = run_steps(name, nsteps=20)
+    f0 = 0.1 * np.array([1.0, -2.0, 3.0]) ** 2 + \
+        0.1 * np.array([1.0, -2.0, 3.0])
+    f1 = 0.1 * w_end ** 2 + 0.1 * w_end
+    assert f1.sum() < f0.sum(), "%s failed to reduce objective" % name
+
+
+def test_sgd_matches_numpy():
+    o = opt.SGD(learning_rate=0.1, momentum=0.9, wd=0.01,
+                rescale_grad=1.0)
+    updater = opt.get_updater(o)
+    w = nd.array(np.array([1.0, 2.0], np.float32))
+    wn = np.array([1.0, 2.0], np.float32)
+    mom = np.zeros_like(wn)
+    for _ in range(5):
+        g = np.array([0.3, -0.4], np.float32)
+        updater(0, nd.array(g), w)
+        mom = 0.9 * mom - 0.1 * (g + 0.01 * wn)
+        wn = wn + mom
+        assert_almost_equal(w.asnumpy(), wn, rtol=1e-4, atol=1e-5)
+
+
+def test_adam_matches_numpy():
+    o = opt.Adam(learning_rate=0.01)
+    updater = opt.get_updater(o)
+    w = nd.array(np.array([1.0, 2.0], np.float32))
+    wn = np.array([1.0, 2.0], np.float64)
+    m = np.zeros(2)
+    v = np.zeros(2)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    for t in range(1, 6):
+        g = np.array([0.3, -0.4])
+        updater(0, nd.array(g.astype(np.float32)), w)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        lr_t = 0.01 * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        wn = wn - lr_t * m / (np.sqrt(v) + eps)
+        assert_almost_equal(w.asnumpy(), wn.astype(np.float32), rtol=1e-4,
+                            atol=1e-5)
+
+
+def test_adagrad_matches_numpy():
+    o = opt.AdaGrad(learning_rate=0.5, eps=1e-7)
+    updater = opt.get_updater(o)
+    w = nd.array(np.array([1.0], np.float32))
+    wn = np.array([1.0], np.float64)
+    h = np.zeros(1)
+    for _ in range(4):
+        g = np.array([0.5])
+        updater(0, nd.array(g.astype(np.float32)), w)
+        h += g * g
+        wn = wn - 0.5 * g / np.sqrt(h + 1e-7)
+        assert_almost_equal(w.asnumpy(), wn.astype(np.float32), rtol=1e-4,
+                            atol=1e-5)
+
+
+def test_lr_scheduler_in_optimizer():
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    o = opt.SGD(learning_rate=1.0, lr_scheduler=sched)
+    updater = opt.get_updater(o)
+    w = nd.array(np.array([0.0], np.float32))
+    deltas = []
+    prev = 0.0
+    for _ in range(6):
+        updater(0, nd.array(np.array([1.0], np.float32)), w)
+        cur = float(w.asnumpy()[0])
+        deltas.append(prev - cur)
+        prev = cur
+    # lr: steps 1-2 at 1.0, 3-4 at 0.5, 5-6 at 0.25
+    assert abs(deltas[0] - 1.0) < 1e-5
+    assert abs(deltas[3] - 0.5) < 1e-5
+    assert abs(deltas[5] - 0.25) < 1e-5
+
+
+def test_wd_and_rescale():
+    o = opt.SGD(learning_rate=0.1, wd=0.1, rescale_grad=0.5)
+    updater = opt.get_updater(o)
+    w = nd.array(np.array([2.0], np.float32))
+    updater(0, nd.array(np.array([4.0], np.float32)), w)
+    # grad = 0.5*4 + 0.1*2 = 2.2 ; w = 2 - 0.22
+    assert_almost_equal(w.asnumpy(), np.array([1.78], np.float32), rtol=1e-5)
+
+
+def test_clip_gradient():
+    o = opt.SGD(learning_rate=1.0, clip_gradient=0.5)
+    updater = opt.get_updater(o)
+    w = nd.array(np.array([0.0], np.float32))
+    updater(0, nd.array(np.array([10.0], np.float32)), w)
+    assert_almost_equal(w.asnumpy(), np.array([-0.5], np.float32), rtol=1e-5)
+
+
+def test_multi_precision_sgd():
+    o = opt.SGD(learning_rate=0.1, momentum=0.9, multi_precision=True)
+    updater = opt.get_updater(o)
+    w = nd.array(np.array([1.0, 2.0], np.float32)).astype("float16")
+    updater(0, nd.array(np.array([0.1, 0.1], np.float32)).astype("float16"),
+            w)
+    assert w.dtype == np.float16
+
+
+def test_updater_states_roundtrip():
+    o = opt.Adam(learning_rate=0.01)
+    updater = opt.get_updater(o)
+    w = nd.array(np.array([1.0, 2.0], np.float32))
+    updater(0, nd.array(np.array([0.3, -0.4], np.float32)), w)
+    # dump_optimizer=True also carries the per-index update counts (Adam
+    # bias correction) — without it t resets, as in the reference
+    blob = updater.get_states(dump_optimizer=True)
+    o2 = opt.Adam(learning_rate=0.01)
+    updater2 = opt.get_updater(o2)
+    updater2.set_states(blob)
+    w1, w2 = w.asnumpy().copy(), nd.array(w.asnumpy())
+    updater(0, nd.array(np.array([0.3, -0.4], np.float32)), w)
+    w2nd = nd.array(w1)
+    updater2(0, nd.array(np.array([0.3, -0.4], np.float32)), w2nd)
+    assert_almost_equal(w.asnumpy(), w2nd.asnumpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_per_param_lr_mult():
+    o = opt.SGD(learning_rate=1.0)
+    o.set_lr_mult({"slow": 0.1})
+    o.set_wd_mult({})
+    # idx2name mapping drives the mult lookup
+    o.idx2name = {0: "slow", 1: "fast"}
+    updater = opt.get_updater(o)
+    ws = nd.array(np.array([0.0], np.float32))
+    wf = nd.array(np.array([0.0], np.float32))
+    g = nd.array(np.array([1.0], np.float32))
+    updater(0, g, ws)
+    updater(1, g, wf)
+    assert_almost_equal(ws.asnumpy(), np.array([-0.1], np.float32),
+                        rtol=1e-5)
+    assert_almost_equal(wf.asnumpy(), np.array([-1.0], np.float32),
+                        rtol=1e-5)
+
+
+def test_create_optimizer_registry():
+    for name in ALL_OPTS:
+        o = opt.create_optimizer(name, learning_rate=0.1)
+        assert isinstance(o, opt.Optimizer)
