@@ -161,13 +161,20 @@ class KVStore:
         kvstore_dist.h:437 — maps to a gather over the stored table)."""
         if row_ids is None:
             raise MXNetError("row_sparse_pull requires row_ids")
-        keys, outs = self._key_list(key, out)
+        if out is None and isinstance(key, (list, tuple)):
+            keys, outs = list(key), [None] * len(key)
+        else:
+            keys, outs = self._key_list(key, out)
         if isinstance(row_ids, NDArray):
             row_ids = [row_ids] * len(keys)
+        results = []
         for k, o, rid in zip(keys, outs, row_ids):
             stored = self._store[k]
             rsp = stored if isinstance(stored, RowSparseNDArray) else \
                 RowSparseNDArray.from_dense(stored)
+            if o is None:
+                results.append(rsp.retain(rid))
+                continue
             olist = o if isinstance(o, (list, tuple)) else [o]
             ridlist = rid if isinstance(rid, (list, tuple)) else [rid] * len(olist)
             for oo, rr in zip(olist, ridlist):
@@ -177,6 +184,10 @@ class KVStore:
                     oo._values = ret._values
                 else:
                     oo._data = ret.todense()._data
+            results.append(o)
+        if out is None:
+            return results[0] if not isinstance(key, (list, tuple)) \
+                else results
 
     # -- distributed hooks (overridden by the mesh-backed stores) -----------
     def _reduce_global(self, value, priority=0):

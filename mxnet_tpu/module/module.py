@@ -60,6 +60,7 @@ class Module(BaseModule):
         self._exec = None
         self._data_shapes = None
         self._label_shapes = None
+        self._static_output_shapes = None
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
@@ -110,9 +111,20 @@ class Module(BaseModule):
     @property
     def output_shapes(self):
         assert self.binded
-        return [(n, o.shape) for n, o in
-                zip(self._output_names, self._exec.outputs)] \
-            if self._exec.outputs else None
+        if self._exec.outputs:
+            return [(n, o.shape) for n, o in
+                    zip(self._output_names, self._exec.outputs)]
+        # no forward yet: infer statically from the symbol (SequentialModule
+        # wires the next module's data shapes from this before any forward)
+        if self._static_output_shapes is None:
+            try:
+                shapes = dict(self._data_shapes + (self._label_shapes or []))
+                _, out_shapes, _ = self._symbol.infer_shape(**shapes)
+            except MXNetError:
+                return None  # e.g. stateful symbols with unknowable shapes
+            self._static_output_shapes = [
+                (n, s) for n, s in zip(self._output_names, out_shapes)]
+        return self._static_output_shapes
 
     # -- params -------------------------------------------------------------
     def get_params(self):
@@ -157,8 +169,14 @@ class Module(BaseModule):
         if self.binded and not force_rebind:
             self.logger.warning("Already bound, ignoring bind()")
             return
+        if self.binded and self.params_initialized:
+            # rebind/reshape: capture trained params from the executor being
+            # discarded so the re-sync below restores them, not stale/random
+            # values (parity: exec_group.set_params on rebind)
+            self._arg_params, self._aux_params = self.get_params()
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
+        self._static_output_shapes = None
         shapes = {}
         norm_data = []
         for d in data_shapes:
@@ -189,6 +207,11 @@ class Module(BaseModule):
             arg, aux = shared_module.get_params()
             self._exec.copy_params_from(arg, aux)
             self.params_initialized = True
+        elif self.params_initialized:
+            # Module.load flow: loaded _arg/_aux_params predate this bind —
+            # re-sync them into the fresh executor (parity: module.py:364
+            # exec_group.set_params after bind)
+            self.init_params(force_init=True)
 
     # -- compute ------------------------------------------------------------
     def forward(self, data_batch, is_train=None):

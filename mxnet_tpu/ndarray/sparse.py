@@ -62,7 +62,7 @@ class RowSparseNDArray(BaseSparseNDArray):
 
     def __init__(self, indices, values, shape, ctx=None):
         super().__init__(shape, ctx=ctx, dtype=values.dtype)
-        self._indices = jnp.asarray(indices, dtype=jnp.int64)
+        self._indices = jnp.asarray(indices, dtype=jnp.int32)
         self._values = values if isinstance(values, jnp.ndarray) else jnp.asarray(values)
 
     stype = "row_sparse"
@@ -80,7 +80,7 @@ class RowSparseNDArray(BaseSparseNDArray):
         data = arr._data if isinstance(arr, NDArray) else jnp.asarray(arr)
         rows = np.asarray(jnp.any(data.reshape(data.shape[0], -1) != 0, axis=1))
         idx = np.nonzero(rows)[0]
-        return cls(jnp.asarray(idx, dtype=jnp.int64), data[idx], data.shape,
+        return cls(jnp.asarray(idx, dtype=jnp.int32), data[idx], data.shape,
                    ctx=getattr(arr, "_ctx", None))
 
     def todense(self):
@@ -121,8 +121,8 @@ class CSRNDArray(BaseSparseNDArray):
     def __init__(self, data, indices, indptr, shape, ctx=None):
         super().__init__(shape, ctx=ctx, dtype=data.dtype)
         self._values = jnp.asarray(data)
-        self._indices = jnp.asarray(indices, dtype=jnp.int64)
-        self._indptr = jnp.asarray(indptr, dtype=jnp.int64)
+        self._indices = jnp.asarray(indices, dtype=jnp.int32)
+        self._indptr = jnp.asarray(indptr, dtype=jnp.int32)
 
     stype = "csr"
 
@@ -150,8 +150,8 @@ class CSRNDArray(BaseSparseNDArray):
             values.extend(row[nz].tolist())
             indptr.append(len(indices))
         return cls(jnp.asarray(np.asarray(values, dtype=data.dtype)),
-                   jnp.asarray(indices, dtype=jnp.int64),
-                   jnp.asarray(indptr, dtype=jnp.int64), data.shape,
+                   jnp.asarray(indices, dtype=jnp.int32),
+                   jnp.asarray(indptr, dtype=jnp.int32), data.shape,
                    ctx=getattr(arr, "_ctx", None))
 
     def todense(self):
@@ -183,7 +183,7 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
     if isinstance(arg1, (tuple, list)) and len(arg1) == 2 and not np.isscalar(arg1[0]):
         values, indices = arg1
         values = jnp.asarray(np.asarray(values, dtype=dtype_np(dtype)))
-        return RowSparseNDArray(jnp.asarray(np.asarray(indices, dtype=np.int64)),
+        return RowSparseNDArray(jnp.asarray(np.asarray(indices, dtype=np.int32)),
                                 values, shape, ctx=ctx)
     if isinstance(arg1, RowSparseNDArray):
         return arg1
@@ -196,8 +196,8 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
     if isinstance(arg1, (tuple, list)) and len(arg1) == 3:
         data, indices, indptr = arg1
         return CSRNDArray(jnp.asarray(np.asarray(data, dtype=dtype_np(dtype))),
-                          jnp.asarray(np.asarray(indices, dtype=np.int64)),
-                          jnp.asarray(np.asarray(indptr, dtype=np.int64)),
+                          jnp.asarray(np.asarray(indices, dtype=np.int32)),
+                          jnp.asarray(np.asarray(indptr, dtype=np.int32)),
                           shape, ctx=ctx)
     if isinstance(arg1, CSRNDArray):
         return arg1
@@ -209,13 +209,13 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
 def zeros(stype, shape, ctx=None, dtype=None):
     if stype == "row_sparse":
         ncols = shape[1:] if len(shape) > 1 else ()
-        return RowSparseNDArray(jnp.zeros((0,), dtype=jnp.int64),
+        return RowSparseNDArray(jnp.zeros((0,), dtype=jnp.int32),
                                 jnp.zeros((0,) + tuple(ncols), dtype=dtype_np(dtype)),
                                 shape, ctx=ctx)
     if stype == "csr":
         return CSRNDArray(jnp.zeros((0,), dtype=dtype_np(dtype)),
-                          jnp.zeros((0,), dtype=jnp.int64),
-                          jnp.zeros((shape[0] + 1,), dtype=jnp.int64),
+                          jnp.zeros((0,), dtype=jnp.int32),
+                          jnp.zeros((shape[0] + 1,), dtype=jnp.int32),
                           shape, ctx=ctx)
     raise ValueError(stype)
 

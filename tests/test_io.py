@@ -1,0 +1,157 @@
+"""Data IO tests (parity: reference tests/python/unittest/test_io.py,
+test_recordio.py — NDArrayIter batching/shuffle/pad, CSVIter, LibSVMIter,
+RecordIO round-trips)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_ndarray_iter_basic():
+    X = np.arange(40, dtype=np.float32).reshape(10, 4)
+    Y = np.arange(10, dtype=np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=5)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (5, 4)
+    assert_almost_equal(batches[0].data[0].asnumpy(), X[:5])
+    assert_almost_equal(batches[1].label[0].asnumpy(), Y[5:])
+
+
+def test_ndarray_iter_pad():
+    X = np.arange(28, dtype=np.float32).reshape(7, 4)
+    it = mx.io.NDArrayIter(X, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 2
+    # reset + reiterate gives same count
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_ndarray_iter_discard():
+    X = np.arange(28, dtype=np.float32).reshape(7, 4)
+    it = mx.io.NDArrayIter(X, batch_size=3, last_batch_handle="discard")
+    assert len(list(it)) == 2
+
+
+def test_ndarray_iter_shuffle_covers_all():
+    X = np.arange(30, dtype=np.float32).reshape(30, 1)
+    it = mx.io.NDArrayIter(X, batch_size=10, shuffle=True)
+    seen = np.concatenate([b.data[0].asnumpy().ravel() for b in it])
+    assert_almost_equal(np.sort(seen), X.ravel())
+
+
+def test_ndarray_iter_dict_data():
+    it = mx.io.NDArrayIter({"a": np.zeros((6, 2), np.float32),
+                            "b": np.ones((6, 3), np.float32)},
+                           batch_size=2)
+    names = [d.name for d in it.provide_data]
+    assert sorted(names) == ["a", "b"]
+
+
+def test_resize_iter():
+    X = np.zeros((8, 2), np.float32)
+    base = mx.io.NDArrayIter(X, batch_size=2)
+    it = mx.io.ResizeIter(base, 2)
+    assert len(list(it)) == 2
+    it.reset()
+    assert len(list(it)) == 2
+
+
+def test_prefetching_iter():
+    X = np.arange(16, dtype=np.float32).reshape(8, 2)
+    base = mx.io.NDArrayIter(X, batch_size=2)
+    it = mx.io.PrefetchingIter(base)
+    batches = list(it)
+    assert len(batches) == 4
+    assert_almost_equal(batches[0].data[0].asnumpy(), X[:2])
+
+
+def test_csv_iter(tmp_path):
+    f = str(tmp_path / "data.csv")
+    data = np.random.uniform(size=(9, 3)).astype(np.float32)
+    np.savetxt(f, data, delimiter=",", fmt="%.6f")
+    it = mx.io.CSVIter(data_csv=f, data_shape=(3,), batch_size=3)
+    batches = list(it)
+    assert len(batches) == 3
+    got = np.concatenate([b.data[0].asnumpy() for b in batches])
+    assert_almost_equal(got, data, rtol=1e-4, atol=1e-5)
+
+
+def test_libsvm_iter(tmp_path):
+    f = str(tmp_path / "data.libsvm")
+    with open(f, "w") as fh:
+        fh.write("1 0:0.5 2:1.5\n")
+        fh.write("0 1:2.0\n")
+        fh.write("1 0:1.0 1:2.0 2:3.0\n")
+        fh.write("0 2:4.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=f, data_shape=(3,), batch_size=2)
+    batches = list(it)
+    assert len(batches) == 2
+    first = batches[0].data[0].asnumpy() if not hasattr(
+        batches[0].data[0], "todense") else \
+        batches[0].data[0].todense().asnumpy()
+    assert_almost_equal(first, np.array([[0.5, 0, 1.5], [0, 2.0, 0]],
+                                        np.float32))
+
+
+def test_recordio_roundtrip(tmp_path):
+    f = str(tmp_path / "data.rec")
+    writer = mx.recordio.MXRecordIO(f, "w")
+    for i in range(5):
+        writer.write(b"record-%d" % i)
+    writer.close()
+    reader = mx.recordio.MXRecordIO(f, "r")
+    for i in range(5):
+        assert reader.read() == b"record-%d" % i
+    assert reader.read() is None
+    reader.close()
+
+
+def test_indexed_recordio(tmp_path):
+    f = str(tmp_path / "data.rec")
+    idx = str(tmp_path / "data.idx")
+    writer = mx.recordio.MXIndexedRecordIO(idx, f, "w")
+    for i in range(5):
+        writer.write_idx(i, b"rec-%d" % i)
+    writer.close()
+    reader = mx.recordio.MXIndexedRecordIO(idx, f, "r")
+    assert reader.read_idx(3) == b"rec-3"
+    assert reader.read_idx(0) == b"rec-0"
+    assert sorted(reader.keys) == [0, 1, 2, 3, 4]
+    reader.close()
+
+
+def test_recordio_pack_unpack_img(tmp_path):
+    header = mx.recordio.IRHeader(0, 3.0, 7, 0)
+    img = (np.random.uniform(0, 255, (4, 4, 3))).astype(np.uint8)
+    packed = mx.recordio.pack_img(header, img, quality=100, img_fmt=".png")
+    hdr, arr = mx.recordio.unpack_img(packed)
+    assert hdr.label == 3.0 and hdr.id == 7
+    assert arr.shape == (4, 4, 3)
+    assert np.abs(arr.astype(int) - img.astype(int)).max() <= 2
+
+
+def test_ndarray_save_load(tmp_path):
+    f = str(tmp_path / "arrays.nd")
+    d = {"w": nd.array(np.eye(3, dtype=np.float32)),
+         "b": nd.ones((2,))}
+    nd.save(f, d)
+    loaded = nd.load(f)
+    assert_almost_equal(loaded["w"].asnumpy(), np.eye(3))
+    nd.save(f, [nd.zeros((2, 2))])
+    as_list = nd.load(f)
+    assert isinstance(as_list, list) and as_list[0].shape == (2, 2)
+
+
+def test_mnist_synthetic_iterator():
+    train, val = mx.test_utils.get_mnist_iterator(batch_size=32,
+                                                  input_shape=(1, 28, 28))
+    b = next(iter(train))
+    assert b.data[0].shape == (32, 1, 28, 28)
+    assert b.label[0].shape == (32,)

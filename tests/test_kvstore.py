@@ -1,0 +1,145 @@
+"""KVStore tests (parity: reference tests/python/unittest/test_kvstore.py —
+local/device types, aggregation, updater, 2-bit compression math; the
+nightly dist shapes are exercised on the virtual 8-device mesh in
+test_parallel.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def rand(*shape):
+    return np.random.uniform(-1, 1, shape).astype(np.float32)
+
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+
+
+@pytest.mark.parametrize("kv_type", ["local", "device", "tpu"])
+def test_single_kv_pair(kv_type):
+    kv = mx.kv.create(kv_type)
+    kv.init(3, nd.ones(SHAPE))
+    out = nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), np.ones(SHAPE))
+
+
+def test_init_pull_list():
+    kv = mx.kv.create("local")
+    kv.init(KEYS, [nd.ones(SHAPE)] * len(KEYS))
+    outs = [nd.zeros(SHAPE) for _ in KEYS]
+    kv.pull(KEYS, out=outs)
+    for o in outs:
+        assert_almost_equal(o.asnumpy(), np.ones(SHAPE))
+
+
+def test_push_aggregation():
+    kv = mx.kv.create("local")
+    kv.init(3, nd.zeros(SHAPE))
+    # push a list of 4 device shards for one key -> summed
+    kv.push(3, [nd.ones(SHAPE)] * 4)
+    out = nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), 4 * np.ones(SHAPE))
+
+
+def test_push_updater_default_add():
+    kv = mx.kv.create("local")
+    kv.init(3, nd.ones(SHAPE))
+    kv.push(3, nd.ones(SHAPE))
+    out = nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), 2 * np.ones(SHAPE))
+
+
+def test_custom_updater():
+    kv = mx.kv.create("local")
+    updates = []
+
+    def update(key, grad, weight):
+        updates.append(key)
+        weight[:] = weight - 0.1 * grad
+
+    kv._set_updater(update)
+    kv.init(3, nd.ones(SHAPE))
+    kv.push(3, nd.ones(SHAPE))
+    out = nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    assert updates == [3]
+    assert_almost_equal(out.asnumpy(), 0.9 * np.ones(SHAPE), rtol=1e-5)
+
+
+def test_set_optimizer():
+    kv = mx.kv.create("local")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+    kv.init(3, nd.ones(SHAPE))
+    kv.push(3, nd.ones(SHAPE))
+    out = nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out.asnumpy(), 0.5 * np.ones(SHAPE), rtol=1e-5)
+
+
+def test_string_keys():
+    kv = mx.kv.create("local")
+    kv.init("w0", nd.ones(SHAPE))
+    kv.push("w0", nd.ones(SHAPE) * 3)
+    out = nd.zeros(SHAPE)
+    kv.pull("w0", out=out)
+    assert_almost_equal(out.asnumpy(), 4 * np.ones(SHAPE))
+
+
+def test_row_sparse_pull():
+    kv = mx.kv.create("local")
+    dense = nd.array(rand(6, 3))
+    rsp = RowSparseNDArray.from_dense(dense)
+    kv.init("emb", rsp)
+    out = RowSparseNDArray.from_dense(nd.zeros((6, 3)))
+    row_ids = nd.array(np.array([1, 4], np.float32))
+    got = kv.row_sparse_pull("emb", row_ids=row_ids)
+    g = got.todense().asnumpy() if hasattr(got, "todense") else got.asnumpy()
+    d = dense.asnumpy()
+    assert_almost_equal(g[1], d[1], rtol=1e-6)
+    assert_almost_equal(g[4], d[4], rtol=1e-6)
+    untouched = [i for i in range(6) if i not in (1, 4)]
+    for i in untouched:
+        assert_almost_equal(g[i], np.zeros(3, np.float32))
+
+
+def test_two_bit_compression_math():
+    """Pure compression math (parity: reference
+    tests/nightly/dist_sync_kvstore.py:28 compute_expected_2bit_quantization)."""
+    from mxnet_tpu.kvstore import _TwoBitCompressor
+    comp = _TwoBitCompressor(threshold=0.5)
+    g = np.array([[0.7, -0.6, 0.2], [-0.1, 1.5, -2.0]], np.float32)
+    import jax.numpy as jnp
+    out = np.asarray(comp.compress("k", jnp.asarray(g)))
+    # values >= threshold -> +threshold, <= -threshold -> -threshold, else 0
+    expected = np.where(g >= 0.5, 0.5, np.where(g <= -0.5, -0.5, 0))
+    assert_almost_equal(out, expected.astype(np.float32))
+    # error feedback: residual carries the truncated part into the next call
+    out2 = np.asarray(comp.compress("k", jnp.asarray(np.zeros_like(g))))
+    resid = g - expected
+    expected2 = np.where(resid >= 0.5, 0.5, np.where(resid <= -0.5, -0.5, 0))
+    assert_almost_equal(out2, expected2.astype(np.float32))
+
+
+def test_gradient_compression_trainer_knob():
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init(0, nd.zeros((2, 2)))
+    kv.push(0, nd.array(np.array([[1.0, 0.1], [-1.0, -0.1]], np.float32)))
+    out = nd.zeros((2, 2))
+    kv.pull(0, out=out)
+    assert_almost_equal(out.asnumpy(),
+                        np.array([[0.5, 0.0], [-0.5, 0.0]], np.float32))
+
+
+def test_kvstore_type_and_rank():
+    kv = mx.kv.create("local")
+    assert kv.type == "local"
+    assert kv.rank == 0
+    assert kv.num_workers == 1

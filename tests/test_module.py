@@ -1,0 +1,265 @@
+"""Module/Symbol/Executor API tests (parity: reference tests/python/unittest/
+test_module.py, test_symbol.py, test_executor.py, tests/python/train/)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def rand(*shape):
+    return np.random.uniform(-1, 1, shape).astype(np.float32)
+
+
+# ---------------- symbol ----------------
+
+def test_symbol_compose_and_arguments():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=4)
+    act = sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = sym.FullyConnected(act, name="fc2", num_hidden=2)
+    args = fc2.list_arguments()
+    assert args == ["data", "fc1_weight", "fc1_bias", "fc2_weight",
+                    "fc2_bias"]
+    assert fc2.list_outputs() == ["fc2_output"]
+
+
+def test_symbol_infer_shape():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, name="fc", num_hidden=4)
+    arg_shapes, out_shapes, aux_shapes = fc.infer_shape(data=(3, 7))
+    assert arg_shapes == [(3, 7), (4, 7), (4,)]
+    assert out_shapes == [(3, 4)]
+
+
+def test_symbol_grouping_and_internals():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=4)
+    fc2 = sym.FullyConnected(fc1, name="fc2", num_hidden=2)
+    grp = sym.Group([fc1, fc2])
+    assert len(grp.list_outputs()) == 2
+    internals = fc2.get_internals()
+    assert "fc1_output" in internals.list_outputs()
+    sliced = internals["fc1_output"]
+    assert sliced.list_outputs() == ["fc1_output"]
+
+
+def test_symbol_json_roundtrip(tmp_path):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, name="fc", num_hidden=3)
+    js = net.tojson()
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    f = str(tmp_path / "sym.json")
+    net.save(f)
+    net3 = sym.load(f)
+    assert net3.list_arguments() == net.list_arguments()
+
+
+def test_symbol_arithmetic():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = (a + b) * 2 - a / (b + 3)
+    exe = c.bind(mx.cpu(), args={"a": nd.array(rand(2, 2)),
+                                 "b": nd.array(rand(2, 2))})
+    exe.forward()
+    an = exe.arg_dict["a"].asnumpy()
+    bn = exe.arg_dict["b"].asnumpy()
+    assert_almost_equal(exe.outputs[0].asnumpy(),
+                        (an + bn) * 2 - an / (bn + 3), rtol=1e-5, atol=1e-5)
+
+
+# ---------------- executor ----------------
+
+def test_executor_forward_backward():
+    data = sym.Variable("data")
+    out = sym.sum(sym.square(data))
+    x = rand(3, 3)
+    exe = out.bind(mx.cpu(), args={"data": nd.array(x)}, grad_req="write")
+    exe.forward(is_train=True)
+    assert_almost_equal(exe.outputs[0].asnumpy(), np.sum(x ** 2), rtol=1e-5)
+    exe.backward()
+    assert_almost_equal(exe.grad_dict["data"].asnumpy(), 2 * x, rtol=1e-5,
+                        atol=1e-5)
+
+
+def test_simple_bind():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, name="fc", num_hidden=4)
+    exe = fc.simple_bind(mx.cpu(), data=(2, 5))
+    assert exe.arg_dict["fc_weight"].shape == (4, 5)
+    exe.arg_dict["data"][:] = nd.array(rand(2, 5))
+    exe.forward()
+    assert exe.outputs[0].shape == (2, 4)
+
+
+def test_executor_reshape():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, name="fc", num_hidden=4)
+    exe = fc.simple_bind(mx.cpu(), data=(2, 5))
+    exe2 = exe.reshape(data=(8, 5))
+    exe2.forward()
+    assert exe2.outputs[0].shape == (8, 4)
+
+
+# ---------------- module ----------------
+
+def _mlp():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=16)
+    act = sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = sym.FullyConnected(act, name="fc2", num_hidden=4)
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _toy_iter(batch=8, n=64):
+    np.random.seed(0)
+    X = np.random.uniform(-1, 1, (n, 6)).astype(np.float32)
+    W = np.random.uniform(-1, 1, (6, 4)).astype(np.float32)
+    Y = (X @ W).argmax(1).astype(np.float32)
+    return mx.io.NDArrayIter(X, Y, batch, shuffle=True,
+                             label_name="softmax_label")
+
+
+def test_module_bind_forward():
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 6))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    batch = mx.io.DataBatch(data=[nd.array(rand(8, 6))],
+                            label=[nd.zeros((8,))])
+    mod.forward(batch)
+    out = mod.get_outputs()[0]
+    assert out.shape == (8, 4)
+    probs = out.asnumpy()
+    assert_almost_equal(probs.sum(1), np.ones(8), rtol=1e-4, atol=1e-4)
+
+
+def test_module_fit_converges():
+    train = _toy_iter()
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=12, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier(),
+            eval_metric="acc")
+    score = mod.score(_toy_iter(), "acc")
+    assert score[0][1] > 0.9, "Module.fit failed to learn: %s" % score
+
+
+def test_module_save_load_checkpoint(tmp_path):
+    train = _toy_iter()
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=2, optimizer="sgd",
+            initializer=mx.init.Xavier())
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 2)
+    s, arg, aux = mx.model.load_checkpoint(prefix, 2)
+    assert "fc1_weight" in arg
+
+    mod2 = mx.mod.Module.load(prefix, 2)
+    mod2.bind(data_shapes=[("data", (8, 6))],
+              label_shapes=[("softmax_label", (8,))])
+    batch = mx.io.DataBatch(data=[nd.array(rand(8, 6))],
+                            label=[nd.zeros((8,))])
+    mod.forward(batch, is_train=False)
+    mod2.forward(batch, is_train=False)
+    assert_almost_equal(mod.get_outputs()[0].asnumpy(),
+                        mod2.get_outputs()[0].asnumpy(), rtol=1e-5,
+                        atol=1e-6)
+
+
+def test_module_optimizer_states(tmp_path):
+    train = _toy_iter()
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=1, optimizer="adam",
+            initializer=mx.init.Xavier())
+    f = str(tmp_path / "opt.states")
+    mod.save_optimizer_states(f)
+    mod.load_optimizer_states(f)
+
+
+def test_module_predict():
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    it = _toy_iter()
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    out = mod.predict(it)
+    assert out.shape[0] == 64 and out.shape[1] == 4
+
+
+def test_bucketing_module():
+    def gen_sym(seq_len):
+        data = sym.Variable("data")
+        fc = sym.FullyConnected(data, name="fc", num_hidden=4)
+        return sym.SoftmaxOutput(fc, name="softmax"), ("data",), \
+            ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(gen_sym, default_bucket_key=10,
+                                 context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd")
+    # switch to a smaller bucket — params shared
+    batch = mx.io.DataBatch(data=[nd.array(rand(4, 10))],
+                            label=[nd.zeros((4,))], bucket_key=10,
+                            provide_data=[("data", (4, 10))],
+                            provide_label=[("softmax_label", (4,))])
+    mod.forward(batch)
+    assert mod.get_outputs()[0].shape == (4, 4)
+
+
+def test_sequential_module():
+    net1 = sym.FullyConnected(sym.Variable("data"), name="fc1", num_hidden=8)
+    net2 = sym.SoftmaxOutput(sym.FullyConnected(sym.Variable("data"),
+                                                name="fc2", num_hidden=4),
+                             name="softmax")
+    smod = mx.mod.SequentialModule()
+    smod.add(mx.mod.Module(net1, label_names=[], context=mx.cpu()))
+    smod.add(mx.mod.Module(net2, context=mx.cpu()), take_labels=True,
+             auto_wiring=True)
+    smod.bind(data_shapes=[("data", (2, 6))],
+              label_shapes=[("softmax_label", (2,))])
+    smod.init_params(initializer=mx.init.Xavier())
+    batch = mx.io.DataBatch(data=[nd.array(rand(2, 6))],
+                            label=[nd.zeros((2,))])
+    smod.forward(batch)
+    assert smod.get_outputs()[0].shape == (2, 4)
+
+
+def test_module_reshape_preserves_params():
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 6))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    w_before = mod.get_params()[0]["fc1_weight"].asnumpy()
+    mod.reshape(data_shapes=[("data", (4, 6))],
+                label_shapes=[("softmax_label", (4,))])
+    w_after = mod.get_params()[0]["fc1_weight"].asnumpy()
+    assert_almost_equal(w_before, w_after, rtol=1e-6)
+    batch = mx.io.DataBatch(data=[nd.array(rand(4, 6))],
+                            label=[nd.zeros((4,))])
+    mod.forward(batch)
+    assert mod.get_outputs()[0].shape == (4, 4)
+
+
+def test_kvstore_row_sparse_pull_list_keys():
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+    kv = mx.kv.create("local")
+    kv.init(["a", "b"], [RowSparseNDArray.from_dense(nd.ones((4, 2))),
+                         RowSparseNDArray.from_dense(nd.ones((4, 2)) * 2)])
+    rid = nd.array(np.array([0, 2], np.float32))
+    got = kv.row_sparse_pull(["a", "b"], row_ids=[rid, rid])
+    assert got[0].todense().asnumpy()[0, 0] == 1.0
+    assert got[1].todense().asnumpy()[2, 1] == 2.0
+
+
+def test_feedforward_legacy():
+    train = _toy_iter()
+    model = mx.model.FeedForward(symbol=_mlp(), ctx=mx.cpu(), num_epoch=3,
+                                 optimizer="sgd", learning_rate=0.5,
+                                 initializer=mx.init.Xavier())
+    model.fit(X=train)
+    preds = model.predict(_toy_iter())
+    assert preds.shape == (64, 4)

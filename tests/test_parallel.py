@@ -1,0 +1,214 @@
+"""Parallelism tests on the virtual 8-device CPU mesh (parity: the reference's
+nightly dist tests — dist_sync_kvstore.py shapes — plus the TPU-native
+capability upgrades: tensor/sequence parallelism, ring attention)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.parallel import mesh as pmesh
+from mxnet_tpu.parallel import collectives as coll
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def rand(*shape):
+    return np.random.uniform(-1, 1, shape).astype(np.float32)
+
+
+def test_build_mesh():
+    m = pmesh.build_mesh({"dp": 4, "tp": 2})
+    assert m.shape == {"dp": 4, "tp": 2}
+    m2 = pmesh.build_mesh({"dp": -1})
+    assert m2.shape == {"dp": 8}
+    m3 = pmesh.build_mesh({"dp": 2, "tp": -1})
+    assert m3.shape == {"dp": 2, "tp": 4}
+
+
+def test_shard_batch_and_replicate():
+    m = pmesh.data_parallel_mesh()
+    x = rand(16, 3)
+    sharded = pmesh.shard_batch(m, jnp.asarray(x))
+    assert sharded.sharding.spec[0] == "dp"
+    rep = pmesh.replicate(m, jnp.asarray(x))
+    assert_almost_equal(np.asarray(rep), x)
+
+
+def test_collectives_psum_allgather():
+    from jax.experimental.shard_map import shard_map
+    m = pmesh.build_mesh({"dp": 8})
+    x = jnp.arange(8.0)
+
+    out = shard_map(lambda v: coll.allreduce(v, "dp"), mesh=m,
+                    in_specs=P("dp"), out_specs=P("dp"))(x)
+    assert_almost_equal(np.asarray(out), np.full(8, x.sum()))
+
+    mean = shard_map(lambda v: coll.allreduce_mean(v, "dp"), mesh=m,
+                     in_specs=P("dp"), out_specs=P("dp"))(x)
+    assert_almost_equal(np.asarray(mean), np.full(8, float(np.mean(
+        np.arange(8.0)))))
+
+    # all_gather output is replicated, which the static VMA checker can't
+    # infer — disable it (the value check below proves replication)
+    gath = shard_map(lambda v: coll.all_gather(v, "dp"), mesh=m,
+                     in_specs=P("dp"), out_specs=P(),
+                     check_rep=False)(x)
+    assert_almost_equal(np.asarray(gath), np.arange(8.0))
+
+
+def test_ring_permute():
+    from jax.experimental.shard_map import shard_map
+    m = pmesh.build_mesh({"dp": 8})
+    x = jnp.arange(8.0)
+    out = shard_map(lambda v: coll.ring_permute(v, "dp", shift=1), mesh=m,
+                    in_specs=P("dp"), out_specs=P("dp"))(x)
+    # each shard receives its left neighbor's value
+    assert_almost_equal(np.asarray(out), np.roll(np.arange(8.0), 1))
+
+
+def test_reduce_scatter():
+    from jax.experimental.shard_map import shard_map
+    m = pmesh.build_mesh({"dp": 8})
+    x = jnp.asarray(rand(8, 8))
+    # each device holds one row; psum_scatter leaves device i with element i
+    # of the row-sum
+    out = shard_map(lambda v: coll.reduce_scatter(v[0], "dp"), mesh=m,
+                    in_specs=P("dp", None), out_specs=P("dp"))(x)
+    assert_almost_equal(np.asarray(out), np.asarray(x).sum(0), rtol=1e-5,
+                        atol=1e-5)
+
+
+def test_ring_attention_matches_reference():
+    from mxnet_tpu.parallel.ring_attention import (ring_attention_sharded,
+                                                   attention_reference)
+    m = pmesh.build_mesh({"sp": 8})
+    B, H, S, D = 2, 2, 32, 8  # S sharded 8-way -> 4 per device
+    np.random.seed(3)
+    q, k, v = rand(B, H, S, D), rand(B, H, S, D), rand(B, H, S, D)
+    out = ring_attention_sharded(m, jnp.asarray(q), jnp.asarray(k),
+                                 jnp.asarray(v))
+    ref = attention_reference(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    assert_almost_equal(np.asarray(out), np.asarray(ref), rtol=1e-3,
+                        atol=1e-4)
+
+
+def test_ring_attention_causal():
+    from mxnet_tpu.parallel.ring_attention import (ring_attention_sharded,
+                                                   attention_reference)
+    m = pmesh.build_mesh({"sp": 8})
+    B, H, S, D = 1, 2, 16, 4
+    np.random.seed(4)
+    q, k, v = rand(B, H, S, D), rand(B, H, S, D), rand(B, H, S, D)
+    out = ring_attention_sharded(m, jnp.asarray(q), jnp.asarray(k),
+                                 jnp.asarray(v), causal=True)
+    ref = attention_reference(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                              causal=True)
+    assert_almost_equal(np.asarray(out), np.asarray(ref), rtol=1e-3,
+                        atol=1e-4)
+
+
+def test_trainstep_dp_matches_single_device():
+    """Data-parallel fused step over the mesh == single-device step."""
+    from mxnet_tpu.gluon import nn, loss as gloss
+    from mxnet_tpu.parallel.trainer import TrainStep
+
+    def build():
+        np.random.seed(0)
+        net = nn.HybridSequential(prefix="n_")
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+        net.initialize(mx.init.Xavier())
+        net(nd.zeros((1, 6)))
+        return net
+
+    lossfn = gloss.SoftmaxCrossEntropyLoss()
+    x = rand(16, 6)
+    y = np.random.randint(0, 4, (16,)).astype(np.float32)
+
+    mx.random.seed(0)
+    net_a = build()
+    step_a = TrainStep(net_a, lossfn, "sgd", {"learning_rate": 0.1})
+    for _ in range(3):
+        la = float(step_a(x, y))
+
+    mx.random.seed(0)
+    net_b = build()
+    m = pmesh.build_mesh({"dp": 8})
+    step_b = TrainStep(net_b, lossfn, "sgd", {"learning_rate": 0.1}, mesh=m)
+    for _ in range(3):
+        lb = float(step_b(x, y))
+    assert abs(la - lb) < 1e-4, (la, lb)
+    step_a.sync_params()
+    step_b.sync_params()
+    for (n1, p1), (n2, p2) in zip(net_a.collect_params().items(),
+                                  net_b.collect_params().items()):
+        assert_almost_equal(p1.data().asnumpy(), p2.data().asnumpy(),
+                            rtol=1e-4, atol=1e-5)
+
+
+def test_trainstep_tensor_parallel_matches():
+    """dp x tp sharded step == unsharded step (GSPMD inserts collectives)."""
+    from mxnet_tpu.gluon import nn, loss as gloss
+    from mxnet_tpu.parallel.trainer import TrainStep
+
+    def build():
+        np.random.seed(1)
+        net = nn.HybridSequential(prefix="t_")
+        with net.name_scope():
+            net.add(nn.Dense(8, activation="tanh"), nn.Dense(4))
+        net.initialize(mx.init.Xavier())
+        net(nd.zeros((1, 5)))
+        return net
+
+    lossfn = gloss.SoftmaxCrossEntropyLoss()
+    x = rand(8, 5)
+    y = np.random.randint(0, 4, (8,)).astype(np.float32)
+
+    net_a = build()
+    step_a = TrainStep(net_a, lossfn, "sgd", {"learning_rate": 0.1})
+    la = float(step_a(x, y))
+
+    net_b = build()
+    m = pmesh.build_mesh({"dp": 4, "tp": 2})
+    shardings = {n: P("tp", None) for n in net_b.collect_params()
+                 if n.endswith("weight")}
+    step_b = TrainStep(net_b, lossfn, "sgd", {"learning_rate": 0.1},
+                       mesh=m, param_shardings=shardings)
+    lb = float(step_b(x, y))
+    assert abs(la - lb) < 1e-4
+
+
+def test_kvstore_tpu_on_mesh():
+    kv = mx.kv.create("tpu")
+    kv.init(0, nd.ones((4, 4)))
+    kv.push(0, [nd.ones((4, 4)) * (i + 1) for i in range(4)])
+    out = nd.zeros((4, 4))
+    kv.pull(0, out=out)
+    assert_almost_equal(out.asnumpy(), np.full((4, 4), 1 + 2 + 3 + 4 + 1.0))
+
+
+def test_dist_sync_shapes():
+    """The reference nightly test pushes shapes around the big-array bound
+    (dist_sync_kvstore.py:36-60); here the analogous large/small keys flow
+    through the same aggregation path."""
+    kv = mx.kv.create("device")
+    big = (1200, 1100)  # > bigarray bound in the reference
+    kv.init("big", nd.zeros(big))
+    kv.push("big", [nd.ones(big)] * 2)
+    out = nd.zeros(big)
+    kv.pull("big", out=out)
+    assert float(out.asnumpy()[0, 0]) == 2.0
+
+
+def test_multichip_dryrun_entry():
+    import importlib
+    import sys
+    sys.path.insert(0, "/root/repo")
+    try:
+        g = importlib.import_module("__graft_entry__")
+        g.dryrun_multichip(8)
+    finally:
+        sys.path.pop(0)
