@@ -1,0 +1,545 @@
+// mxnet_tpu native runtime: threaded dependency engine, RecordIO, and a
+// parallel JPEG decode pipeline.
+//
+// Parity (capability, not translation):
+//   - Engine*: the reference's threaded dependency engine
+//     (src/engine/threaded_engine.cc var-queue protocol: writes exclusive,
+//     reads shared; ops dispatch when all their vars clear). Used here for
+//     host-side async work (IO prefetch, callbacks) — device compute is
+//     XLA's async dispatch.
+//   - Rec*: dmlc-core recordio framing (magic + little-endian length,
+//     4-byte alignment), bit-compatible with mxnet_tpu/recordio.py.
+//   - ImgIter*: src/io/iter_image_recordio_2.cc — chunked reader +
+//     multi-threaded JPEG decode + augment (crop/mirror/resize) + batching.
+//
+// Build: g++ -O2 -fPIC -shared -o libmxtpu_native.so mxtpu_native.cc
+//        -ljpeg -lpthread
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <queue>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <jpeglib.h>
+#include <csetjmp>
+
+extern "C" {
+
+// ===========================================================================
+// Thread pool
+// ===========================================================================
+namespace {
+
+class ThreadPool {
+ public:
+  explicit ThreadPool(int n) : stop_(false) {
+    for (int i = 0; i < n; ++i)
+      workers_.emplace_back([this] { Loop(); });
+  }
+  ~ThreadPool() {
+    {
+      std::unique_lock<std::mutex> lk(m_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto &t : workers_) t.join();
+  }
+  void Enqueue(std::function<void()> fn) {
+    {
+      std::unique_lock<std::mutex> lk(m_);
+      q_.push(std::move(fn));
+    }
+    cv_.notify_one();
+  }
+
+ private:
+  void Loop() {
+    for (;;) {
+      std::function<void()> fn;
+      {
+        std::unique_lock<std::mutex> lk(m_);
+        cv_.wait(lk, [this] { return stop_ || !q_.empty(); });
+        if (stop_ && q_.empty()) return;
+        fn = std::move(q_.front());
+        q_.pop();
+      }
+      fn();
+    }
+  }
+  std::mutex m_;
+  std::condition_variable cv_;
+  std::queue<std::function<void()>> q_;
+  std::vector<std::thread> workers_;
+  bool stop_;
+};
+
+// ===========================================================================
+// Dependency engine: per-var queues, writes exclusive / reads shared
+// ===========================================================================
+struct EngineOp;
+
+struct EngineVar {
+  std::mutex m;
+  struct Waiter {
+    EngineOp *op;
+    bool is_write;
+  };
+  std::deque<Waiter> queue;
+  int running_reads = 0;
+  bool running_write = false;
+};
+
+struct EngineOp {
+  std::function<void()> fn;
+  std::vector<EngineVar *> reads;
+  std::vector<EngineVar *> writes;
+  std::atomic<int> pending{0};
+};
+
+class Engine {
+ public:
+  explicit Engine(int n_threads)
+      : pool_(n_threads > 0 ? n_threads
+                            : (int)std::thread::hardware_concurrency()) {}
+
+  ~Engine() {
+    WaitAll();
+    for (EngineVar *v : vars_) delete v;
+  }
+
+  EngineVar *NewVar() {
+    std::unique_lock<std::mutex> lk(vars_m_);
+    vars_.push_back(new EngineVar());
+    return vars_.back();
+  }
+
+  void Push(std::function<void()> fn, std::vector<EngineVar *> reads,
+            std::vector<EngineVar *> writes) {
+    auto *op = new EngineOp();
+    op->fn = std::move(fn);
+    op->reads = std::move(reads);
+    op->writes = std::move(writes);
+    outstanding_.fetch_add(1);
+    // +1 guard so the op can't fire while we're still registering deps
+    op->pending.store(1 + (int)op->reads.size() + (int)op->writes.size());
+    for (EngineVar *v : op->reads) {
+      std::unique_lock<std::mutex> lk(v->m);
+      if (v->queue.empty() && !v->running_write) {
+        ++v->running_reads;
+        Grant(op);
+      } else {
+        v->queue.push_back({op, false});
+      }
+    }
+    for (EngineVar *v : op->writes) {
+      std::unique_lock<std::mutex> lk(v->m);
+      if (v->queue.empty() && !v->running_write && v->running_reads == 0) {
+        v->running_write = true;
+        Grant(op);
+      } else {
+        v->queue.push_back({op, true});
+      }
+    }
+    Grant(op);  // release the guard
+  }
+
+  void WaitAll() {
+    std::unique_lock<std::mutex> lk(wait_m_);
+    wait_cv_.wait(lk, [this] { return outstanding_.load() == 0; });
+  }
+
+ private:
+  void Grant(EngineOp *op) {
+    if (op->pending.fetch_sub(1) == 1) {
+      pool_.Enqueue([this, op] { Run(op); });
+    }
+  }
+
+  void Run(EngineOp *op) {
+    op->fn();
+    for (EngineVar *v : op->reads) {
+      std::unique_lock<std::mutex> lk(v->m);
+      --v->running_reads;
+      ScheduleNext(v);
+    }
+    for (EngineVar *v : op->writes) {
+      std::unique_lock<std::mutex> lk(v->m);
+      v->running_write = false;
+      ScheduleNext(v);
+    }
+    delete op;
+    if (outstanding_.fetch_sub(1) == 1) {
+      std::unique_lock<std::mutex> lk(wait_m_);
+      wait_cv_.notify_all();
+    }
+  }
+
+  // caller holds v->m
+  void ScheduleNext(EngineVar *v) {
+    while (!v->queue.empty()) {
+      auto w = v->queue.front();
+      if (w.is_write) {
+        if (v->running_reads == 0 && !v->running_write) {
+          v->queue.pop_front();
+          v->running_write = true;
+          Grant(w.op);
+        }
+        return;  // writer blocks everything behind it
+      }
+      if (v->running_write) return;
+      v->queue.pop_front();
+      ++v->running_reads;
+      Grant(w.op);
+    }
+  }
+
+  ThreadPool pool_;
+  std::mutex vars_m_;
+  std::vector<EngineVar *> vars_;
+  std::atomic<int> outstanding_{0};
+  std::mutex wait_m_;
+  std::condition_variable wait_cv_;
+};
+
+}  // namespace
+
+void *EngineCreate(int num_threads) { return new Engine(num_threads); }
+void EngineFree(void *h) { delete static_cast<Engine *>(h); }
+void *EngineNewVar(void *h) { return static_cast<Engine *>(h)->NewVar(); }
+
+typedef void (*engine_cb)(void *);
+
+void EnginePush(void *h, engine_cb fn, void *arg, void **read_vars,
+                int n_read, void **write_vars, int n_write) {
+  std::vector<EngineVar *> reads(n_read), writes(n_write);
+  for (int i = 0; i < n_read; ++i)
+    reads[i] = static_cast<EngineVar *>(read_vars[i]);
+  for (int i = 0; i < n_write; ++i)
+    writes[i] = static_cast<EngineVar *>(write_vars[i]);
+  static_cast<Engine *>(h)->Push([fn, arg] { fn(arg); }, std::move(reads),
+                                 std::move(writes));
+}
+
+void EngineWaitAll(void *h) { static_cast<Engine *>(h)->WaitAll(); }
+
+// ===========================================================================
+// RecordIO (framing matches mxnet_tpu/recordio.py: <magic u32><len u32>
+// <data><pad to 4B>)
+// ===========================================================================
+namespace {
+constexpr uint32_t kRecMagic = 0xced7230a;
+}
+
+struct RecWriter {
+  FILE *fp;
+};
+
+void *RecWriterCreate(const char *path) {
+  FILE *fp = fopen(path, "wb");
+  if (!fp) return nullptr;
+  return new RecWriter{fp};
+}
+
+int64_t RecWriterTell(void *h) {
+  return ftell(static_cast<RecWriter *>(h)->fp);
+}
+
+void RecWriterWrite(void *h, const char *buf, uint64_t len) {
+  FILE *fp = static_cast<RecWriter *>(h)->fp;
+  uint32_t hdr[2] = {kRecMagic, (uint32_t)len};
+  fwrite(hdr, 4, 2, fp);
+  fwrite(buf, 1, len, fp);
+  static const char zeros[4] = {0, 0, 0, 0};
+  size_t pad = (4 - (len % 4)) % 4;
+  if (pad) fwrite(zeros, 1, pad, fp);
+}
+
+void RecWriterClose(void *h) {
+  auto *w = static_cast<RecWriter *>(h);
+  if (w) {
+    fclose(w->fp);
+    delete w;
+  }
+}
+
+struct RecReader {
+  FILE *fp;
+  std::vector<char> buf;
+};
+
+void *RecReaderCreate(const char *path) {
+  FILE *fp = fopen(path, "rb");
+  if (!fp) return nullptr;
+  return new RecReader{fp, {}};
+}
+
+void RecReaderSeek(void *h, int64_t pos) {
+  fseek(static_cast<RecReader *>(h)->fp, pos, SEEK_SET);
+}
+
+int64_t RecReaderTell(void *h) {
+  return ftell(static_cast<RecReader *>(h)->fp);
+}
+
+// returns record length, or -1 at EOF / bad magic. *data valid until next read
+int64_t RecReaderRead(void *h, const char **data) {
+  auto *r = static_cast<RecReader *>(h);
+  uint32_t hdr[2];
+  if (fread(hdr, 4, 2, r->fp) != 2) return -1;
+  if (hdr[0] != kRecMagic) return -1;
+  uint32_t len = hdr[1];
+  r->buf.resize(len);
+  if (fread(r->buf.data(), 1, len, r->fp) != len) return -1;
+  size_t pad = (4 - (len % 4)) % 4;
+  if (pad) fseek(r->fp, (long)pad, SEEK_CUR);
+  *data = r->buf.data();
+  return (int64_t)len;
+}
+
+void RecReaderClose(void *h) {
+  auto *r = static_cast<RecReader *>(h);
+  if (r) {
+    fclose(r->fp);
+    delete r;
+  }
+}
+
+// ===========================================================================
+// JPEG decode + augment + batch pipeline
+// ===========================================================================
+namespace {
+
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jb;
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  longjmp(reinterpret_cast<JpegErr *>(cinfo->err)->jb, 1);
+}
+
+// decode to RGB u8 (H, W, 3); returns false on corrupt input
+bool DecodeJpeg(const uint8_t *data, size_t len, std::vector<uint8_t> *out,
+                int *h, int *w) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t *>(data), (unsigned long)len);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  *h = cinfo.output_height;
+  *w = cinfo.output_width;
+  out->resize((size_t)(*h) * (*w) * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t *row = out->data() + (size_t)cinfo.output_scanline * (*w) * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// bilinear resize + optional crop + mirror, writing CHW float32
+void ResizeToCHW(const uint8_t *src, int sh, int sw, int cy, int cx, int ch,
+                 int cw, bool mirror, float *dst, int dh, int dw) {
+  for (int y = 0; y < dh; ++y) {
+    float fy = (ch > 1 && dh > 1) ? (float)y * (ch - 1) / (dh - 1) : 0.f;
+    int y0 = (int)fy;
+    int y1 = y0 + 1 < ch ? y0 + 1 : y0;
+    float wy = fy - y0;
+    for (int x = 0; x < dw; ++x) {
+      int xx = mirror ? (dw - 1 - x) : x;
+      float fx = (cw > 1 && dw > 1) ? (float)xx * (cw - 1) / (dw - 1) : 0.f;
+      int x0 = (int)fx;
+      int x1 = x0 + 1 < cw ? x0 + 1 : x0;
+      float wx = fx - x0;
+      const uint8_t *p00 = src + ((size_t)(cy + y0) * sw + (cx + x0)) * 3;
+      const uint8_t *p01 = src + ((size_t)(cy + y0) * sw + (cx + x1)) * 3;
+      const uint8_t *p10 = src + ((size_t)(cy + y1) * sw + (cx + x0)) * 3;
+      const uint8_t *p11 = src + ((size_t)(cy + y1) * sw + (cx + x1)) * 3;
+      for (int c = 0; c < 3; ++c) {
+        float v = (1 - wy) * ((1 - wx) * p00[c] + wx * p01[c]) +
+                  wy * ((1 - wx) * p10[c] + wx * p11[c]);
+        dst[(size_t)c * dh * dw + (size_t)y * dw + x] = v;
+      }
+    }
+  }
+}
+
+struct IRHeader {  // matches struct.pack("IfQQ")
+  uint32_t flag;
+  float label;
+  uint64_t id;
+  uint64_t id2;
+};
+
+struct ImgIter {
+  std::string rec_path;
+  int batch, h, w, c;
+  bool shuffle, rand_crop, rand_mirror;
+  int n_threads;
+  std::mt19937 rng;
+  std::vector<int64_t> offsets;  // record start positions
+  std::vector<size_t> order;
+  size_t cursor = 0;
+  ThreadPool *pool = nullptr;
+};
+
+}  // namespace
+
+void *ImgIterCreate(const char *rec_path, int batch, int h, int w, int c,
+                    int shuffle, int num_threads, int rand_crop,
+                    int rand_mirror, unsigned seed) {
+  auto *it = new ImgIter();
+  it->rec_path = rec_path;
+  it->batch = batch;
+  it->h = h;
+  it->w = w;
+  it->c = c;
+  it->shuffle = shuffle != 0;
+  it->rand_crop = rand_crop != 0;
+  it->rand_mirror = rand_mirror != 0;
+  it->n_threads =
+      num_threads > 0 ? num_threads : (int)std::thread::hardware_concurrency();
+  it->rng.seed(seed);
+  // index the rec file once (positions of every record)
+  FILE *fp = fopen(rec_path, "rb");
+  if (!fp) {
+    delete it;
+    return nullptr;
+  }
+  uint32_t hdr[2];
+  for (;;) {
+    int64_t pos = ftell(fp);
+    if (fread(hdr, 4, 2, fp) != 2 || hdr[0] != kRecMagic) break;
+    it->offsets.push_back(pos);
+    uint32_t len = hdr[1];
+    fseek(fp, (long)(len + (4 - len % 4) % 4), SEEK_CUR);
+  }
+  fclose(fp);
+  it->order.resize(it->offsets.size());
+  for (size_t i = 0; i < it->order.size(); ++i) it->order[i] = i;
+  if (it->shuffle)
+    std::shuffle(it->order.begin(), it->order.end(), it->rng);
+  it->pool = new ThreadPool(it->n_threads);
+  return it;
+}
+
+int64_t ImgIterSize(void *h) {
+  return (int64_t)static_cast<ImgIter *>(h)->offsets.size();
+}
+
+void ImgIterReset(void *h) {
+  auto *it = static_cast<ImgIter *>(h);
+  it->cursor = 0;
+  if (it->shuffle)
+    std::shuffle(it->order.begin(), it->order.end(), it->rng);
+}
+
+// Fills data_out[batch, c, h, w] (float32) and label_out[batch].
+// Returns number of samples written (0 => epoch end).
+int ImgIterNext(void *h, float *data_out, float *label_out) {
+  auto *it = static_cast<ImgIter *>(h);
+  size_t remaining = it->order.size() - it->cursor;
+  int n = (int)(remaining < (size_t)it->batch ? remaining : it->batch);
+  if (n == 0) return 0;
+
+  std::atomic<int> done{0};
+  std::mutex done_m;
+  std::condition_variable done_cv;
+
+  for (int i = 0; i < n; ++i) {
+    size_t rec_index = it->order[it->cursor + i];
+    int64_t pos = it->offsets[rec_index];
+    // per-task crop/mirror decisions from the iter RNG (deterministic order)
+    uint32_t r1 = it->rng();
+    uint32_t r2 = it->rng();
+    uint32_t r3 = it->rng();
+    float *dslot = data_out + (size_t)i * it->c * it->h * it->w;
+    float *lslot = label_out + i;
+    it->pool->Enqueue([it, pos, dslot, lslot, r1, r2, r3, &done, &done_m,
+                       &done_cv, n] {
+      FILE *fp = fopen(it->rec_path.c_str(), "rb");
+      uint32_t hdr[2];
+      std::vector<char> raw;
+      bool ok = false;
+      if (fp) {
+        fseek(fp, (long)pos, SEEK_SET);
+        if (fread(hdr, 4, 2, fp) == 2 && hdr[0] == kRecMagic) {
+          raw.resize(hdr[1]);
+          ok = fread(raw.data(), 1, hdr[1], fp) == hdr[1];
+        }
+        fclose(fp);
+      }
+      float label = 0.f;
+      std::vector<uint8_t> rgb;
+      int sh = 0, sw = 0;
+      if (ok && raw.size() > sizeof(IRHeader)) {
+        IRHeader irh;
+        memcpy(&irh, raw.data(), sizeof(IRHeader));
+        const uint8_t *payload = (const uint8_t *)raw.data() + sizeof(IRHeader);
+        size_t plen = raw.size() - sizeof(IRHeader);
+        if (irh.flag > 0) {  // multi-label: first label, skip label floats
+          memcpy(&label, payload, 4);
+          payload += irh.flag * 4;
+          plen -= irh.flag * 4;
+        } else {
+          label = irh.label;
+        }
+        ok = DecodeJpeg(payload, plen, &rgb, &sh, &sw);
+      }
+      if (ok) {
+        int cy = 0, cx = 0, ch = sh, cw = sw;
+        if (it->rand_crop && sh != sw) {  // random square crop
+          int side = sh < sw ? sh : sw;
+          cy = sh == side ? 0 : (int)(r1 % (uint32_t)(sh - side));
+          cx = sw == side ? 0 : (int)(r2 % (uint32_t)(sw - side));
+          ch = cw = side;
+        }
+        bool mirror = it->rand_mirror && (r3 & 1);
+        ResizeToCHW(rgb.data(), sh, sw, cy, cx, ch, cw, mirror, dslot, it->h,
+                    it->w);
+        *lslot = label;
+      } else {
+        memset(dslot, 0, sizeof(float) * it->c * it->h * it->w);
+        *lslot = -1.f;
+      }
+      if (done.fetch_add(1) + 1 == n) {
+        std::unique_lock<std::mutex> lk(done_m);
+        done_cv.notify_all();
+      }
+    });
+  }
+  {
+    std::unique_lock<std::mutex> lk(done_m);
+    done_cv.wait(lk, [&] { return done.load() == n; });
+  }
+  it->cursor += n;
+  return n;
+}
+
+void ImgIterFree(void *h) {
+  auto *it = static_cast<ImgIter *>(h);
+  if (it) {
+    delete it->pool;
+    delete it;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,232 @@
+"""Native C++ runtime tests (parity: tests/cpp/engine/threaded_engine_test.cc
+randomized dependency workloads; recordio round-trips; the ImageRecordIter
+pipeline)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import native
+from mxnet_tpu.test_utils import assert_almost_equal
+
+pytestmark = pytest.mark.skipif(not native.AVAILABLE,
+                                reason="native library not built")
+
+
+# ---------------- dependency engine ----------------
+
+def test_engine_runs_tasks():
+    eng = native.NativeEngine(4)
+    results = []
+    lock = threading.Lock()
+    for i in range(50):
+        def fn(i=i):
+            with lock:
+                results.append(i)
+        eng.push(fn)
+    eng.wait_all()
+    assert sorted(results) == list(range(50))
+    eng.close()
+
+
+def test_engine_write_exclusive():
+    """Writes to the same var must serialize (the var-queue protocol,
+    threaded_engine.cc:51-122)."""
+    eng = native.NativeEngine(8)
+    var = eng.new_var()
+    counter = {"v": 0, "concurrent": 0, "max_concurrent": 0}
+    lock = threading.Lock()
+
+    def writer():
+        with lock:
+            counter["concurrent"] += 1
+            counter["max_concurrent"] = max(counter["max_concurrent"],
+                                            counter["concurrent"])
+        time.sleep(0.001)
+        counter["v"] += 1  # unprotected on purpose: engine must serialize
+        with lock:
+            counter["concurrent"] -= 1
+
+    for _ in range(40):
+        eng.push(writer, write_vars=[var])
+    eng.wait_all()
+    assert counter["v"] == 40
+    assert counter["max_concurrent"] == 1
+    eng.close()
+
+
+def test_engine_reads_shared_writes_ordered():
+    """Readers may run concurrently; a writer waits for preceding readers
+    and blocks following ones."""
+    eng = native.NativeEngine(8)
+    var = eng.new_var()
+    log = []
+    lock = threading.Lock()
+
+    def reader(i):
+        time.sleep(0.002)
+        with lock:
+            log.append(("r", i))
+
+    def writer():
+        with lock:
+            log.append(("w", None))
+
+    for i in range(6):
+        eng.push(lambda i=i: reader(i), read_vars=[var])
+    eng.push(writer, write_vars=[var])
+    for i in range(6, 12):
+        eng.push(lambda i=i: reader(i), read_vars=[var])
+    eng.wait_all()
+    w_pos = [k for k, (t, _) in enumerate(log) if t == "w"][0]
+    first = {i for t, i in log[:w_pos] if t == "r"}
+    after = {i for t, i in log[w_pos + 1:] if t == "r"}
+    assert first == set(range(6))
+    assert after == set(range(6, 12))
+    eng.close()
+
+
+def test_engine_dependency_chain_orders():
+    """A chain w(v) -> w(v) -> ... must execute in push order."""
+    eng = native.NativeEngine(8)
+    var = eng.new_var()
+    seq = []
+    for i in range(20):
+        eng.push(lambda i=i: seq.append(i), write_vars=[var])
+    eng.wait_all()
+    assert seq == list(range(20))
+    eng.close()
+
+
+def test_engine_independent_vars_parallel():
+    eng = native.NativeEngine(4)
+    start = time.time()
+    vars_ = [eng.new_var() for _ in range(4)]
+    for v in vars_:
+        eng.push(lambda: time.sleep(0.05), write_vars=[v])
+    eng.wait_all()
+    elapsed = time.time() - start
+    assert elapsed < 0.15, "independent writers should run in parallel"
+    eng.close()
+
+
+# ---------------- recordio ----------------
+
+def test_native_recordio_roundtrip(tmp_path):
+    f = str(tmp_path / "n.rec")
+    w = native.RecWriter(f)
+    for i in range(10):
+        w.write(b"payload-%03d" % i)
+    w.close()
+    r = native.RecReader(f)
+    for i in range(10):
+        assert r.read() == b"payload-%03d" % i
+    assert r.read() is None
+    r.close()
+
+
+def test_native_python_recordio_compat(tmp_path):
+    """Native-written files read with the Python reader and vice versa."""
+    f1 = str(tmp_path / "native.rec")
+    w = native.RecWriter(f1)
+    w.write(b"from-native")
+    w.close()
+    pr = mx.recordio.MXRecordIO(f1, "r")
+    assert pr.read() == b"from-native"
+    pr.close()
+
+    f2 = str(tmp_path / "python.rec")
+    pw = mx.recordio.MXRecordIO(f2, "w")
+    pw.write(b"from-python-reader")
+    pw.close()
+    nr = native.RecReader(f2)
+    assert nr.read() == b"from-python-reader"
+    nr.close()
+
+
+def _make_rec(tmp_path, n=12, size=(24, 32)):
+    """Pack n synthetic JPEGs with labels into a rec file."""
+    from PIL import Image
+    import io as pyio
+    f = str(tmp_path / "imgs.rec")
+    w = mx.recordio.MXRecordIO(f, "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        arr = np.full(size + (3,), i * 20 % 255, np.uint8)
+        arr[:, :, 1] = rng.randint(0, 255)
+        buf = pyio.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG", quality=95)
+        packed = mx.recordio.pack(
+            mx.recordio.IRHeader(0, float(i % 4), i, 0), buf.getvalue())
+        w.write(packed)
+    w.close()
+    return f
+
+
+def test_native_image_iter(tmp_path):
+    rec = _make_rec(tmp_path)
+    it = native.NativeImageIter(rec, batch_size=4, data_shape=(3, 16, 16))
+    assert len(it) == 12
+    total = 0
+    labels = []
+    while True:
+        out = it.next_batch()
+        if out is None:
+            break
+        data, label, n = out
+        assert data.shape == (4, 3, 16, 16)
+        assert np.isfinite(data).all() and data.max() <= 255.0
+        labels.extend(label[:n].tolist())
+        total += n
+    assert total == 12
+    assert labels == [float(i % 4) for i in range(12)]
+    it.reset()
+    assert it.next_batch() is not None
+    it.close()
+
+
+def test_native_image_iter_decode_matches_pil(tmp_path):
+    """Decoded pixels must match PIL within JPEG tolerance (no resize)."""
+    from PIL import Image
+    import io as pyio
+    f = str(tmp_path / "one.rec")
+    w = mx.recordio.MXRecordIO(f, "w")
+    arr = (np.arange(16 * 16 * 3) % 251).astype(np.uint8).reshape(16, 16, 3)
+    buf = pyio.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=100)
+    jpg = buf.getvalue()
+    w.write(mx.recordio.pack(mx.recordio.IRHeader(0, 7.0, 0, 0), jpg))
+    w.close()
+    it = native.NativeImageIter(f, batch_size=1, data_shape=(3, 16, 16))
+    data, label, n = it.next_batch()
+    assert n == 1 and label[0] == 7.0
+    ref = np.asarray(Image.open(pyio.BytesIO(jpg))).astype(np.float32)
+    got = data[0].transpose(1, 2, 0)
+    assert np.abs(got - ref).max() <= 4.0, "decode mismatch vs PIL"
+    it.close()
+
+
+def test_image_record_iter_facade(tmp_path):
+    rec = _make_rec(tmp_path)
+    it = mx.io.ImageRecordIter(path_imgrec=rec, batch_size=4,
+                               data_shape=(3, 16, 16), shuffle=True,
+                               prefetch=False)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 3, 16, 16)
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_native_image_iter_shuffle_epochs_differ(tmp_path):
+    rec = _make_rec(tmp_path, n=16)
+    it = native.NativeImageIter(rec, batch_size=16, data_shape=(3, 8, 8),
+                                shuffle=True, seed=1)
+    _, l1, _ = it.next_batch()
+    order1 = l1.copy()
+    it.reset()
+    _, l2, _ = it.next_batch()
+    assert sorted(order1.tolist()) == sorted(l2.tolist())
+    it.close()

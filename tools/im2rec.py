@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""im2rec: pack an image dataset into RecordIO (parity: reference
+tools/im2rec.cc / tools/im2rec.py — .lst generation + multithreaded packing
+with an index file for random access).
+
+Usage:
+  python tools/im2rec.py --list prefix image_root     # make prefix.lst
+  python tools/im2rec.py prefix image_root            # pack prefix.rec/.idx
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mxnet_tpu import recordio  # noqa: E402
+
+_EXTS = {".jpg", ".jpeg", ".png"}
+
+
+def list_images(root, recursive=True):
+    cat = {}
+    items = []
+    i = 0
+    for path, dirs, files in sorted(os.walk(root, followlinks=True)):
+        dirs.sort()
+        for fname in sorted(files):
+            if os.path.splitext(fname)[1].lower() not in _EXTS:
+                continue
+            fpath = os.path.join(path, fname)
+            label_key = os.path.relpath(path, root)
+            if label_key not in cat:
+                cat[label_key] = len(cat)
+            items.append((i, os.path.relpath(fpath, root), cat[label_key]))
+            i += 1
+        if not recursive:
+            break
+    return items
+
+
+def write_list(prefix, items, shuffle=False):
+    if shuffle:
+        random.shuffle(items)
+    with open(prefix + ".lst", "w") as f:
+        for idx, relpath, label in items:
+            f.write("%d\t%f\t%s\n" % (idx, float(label), relpath))
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield int(parts[0]), [float(x) for x in parts[1:-1]], parts[-1]
+
+
+def encode_item(root, relpath, labels, idx, quality, resize, center_crop):
+    fpath = os.path.join(root, relpath)
+    with open(fpath, "rb") as f:
+        buf = f.read()
+    if resize or center_crop:
+        import io as pyio
+        import numpy as np
+        from PIL import Image
+        img = Image.open(pyio.BytesIO(buf)).convert("RGB")
+        if center_crop:
+            side = min(img.size)
+            left = (img.size[0] - side) // 2
+            top = (img.size[1] - side) // 2
+            img = img.crop((left, top, left + side, top + side))
+        if resize:
+            w, h = img.size
+            if w < h:
+                img = img.resize((resize, int(h * resize / w)))
+            else:
+                img = img.resize((int(w * resize / h), resize))
+        out = pyio.BytesIO()
+        img.save(out, format="JPEG", quality=quality)
+        buf = out.getvalue()
+    if len(labels) == 1:
+        header = recordio.IRHeader(0, labels[0], idx, 0)
+    else:
+        header = recordio.IRHeader(len(labels), labels, idx, 0)
+    return recordio.pack(header, buf)
+
+
+def make_rec(prefix, root, num_thread=8, quality=95, resize=0,
+             center_crop=False):
+    items = list(read_list(prefix + ".lst"))
+    writer = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
+                                        "w")
+    with ThreadPoolExecutor(max_workers=num_thread) as pool:
+        packed = pool.map(
+            lambda it: (it[0], encode_item(root, it[2], it[1], it[0],
+                                           quality, resize, center_crop)),
+            items)
+        for idx, blob in packed:
+            writer.write_idx(idx, blob)
+    writer.close()
+    print("wrote %s.rec (%d records)" % (prefix, len(items)))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix")
+    ap.add_argument("root")
+    ap.add_argument("--list", action="store_true",
+                    help="generate prefix.lst from the image directory")
+    ap.add_argument("--shuffle", action="store_true")
+    ap.add_argument("--num-thread", type=int, default=8)
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--resize", type=int, default=0,
+                    help="resize shorter edge to this many pixels")
+    ap.add_argument("--center-crop", action="store_true")
+    ap.add_argument("--no-recursive", action="store_true")
+    args = ap.parse_args()
+    if args.list:
+        items = list_images(args.root, recursive=not args.no_recursive)
+        write_list(args.prefix, items, shuffle=args.shuffle)
+        print("wrote %s.lst (%d images)" % (args.prefix, len(items)))
+    else:
+        if not os.path.exists(args.prefix + ".lst"):
+            items = list_images(args.root,
+                                recursive=not args.no_recursive)
+            write_list(args.prefix, items, shuffle=args.shuffle)
+        make_rec(args.prefix, args.root, num_thread=args.num_thread,
+                 quality=args.quality, resize=args.resize,
+                 center_crop=args.center_crop)
+
+
+if __name__ == "__main__":
+    main()
