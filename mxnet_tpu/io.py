@@ -533,11 +533,13 @@ class NativeImageRecordIter(DataIter):
 # RecordIO otherwise.
 def ImageRecordIter(**kwargs):
     from . import native
+    shape = tuple(kwargs.get("data_shape") or ())
     native_ok = (native.AVAILABLE and kwargs.get("path_imgrec")
                  and not kwargs.get("force_python", False)
                  # features only the Python pipeline implements
                  and int(kwargs.get("num_parts", 1)) == 1
-                 and int(kwargs.get("label_width", 1)) == 1)
+                 and int(kwargs.get("label_width", 1)) == 1
+                 and len(shape) == 3 and shape[0] == 3)  # RGB decode only
     if native_ok:
         it = NativeImageRecordIter(
             path_imgrec=kwargs["path_imgrec"],

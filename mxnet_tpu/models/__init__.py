@@ -9,3 +9,9 @@ from .lenet import get_lenet, get_mlp, LeNet
 from .word_lm import RNNModel
 from .ssd import SSDLite
 from .sparse_linear import SparseLinear
+
+# mesh-first transformer LM (capability upgrade: dp/tp/sp/ep parallelism)
+from .transformer import (TransformerConfig, init_transformer_params,
+                          transformer_apply, transformer_shardings,
+                          make_train_step as make_transformer_train_step,
+                          lm_loss)

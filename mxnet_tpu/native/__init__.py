@@ -46,7 +46,13 @@ def _load():
                 "native build failed, using pure-python fallbacks: %s",
                 out.decode() if isinstance(out, bytes) else out)
             return
-    lib = ctypes.CDLL(_SO)
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError as e:  # stale/ABI-incompatible .so: fall back, don't crash
+        import logging
+        logging.getLogger(__name__).warning(
+            "native library unloadable, using pure-python fallbacks: %s", e)
+        return
 
     lib.EngineCreate.restype = ctypes.c_void_p
     lib.EngineCreate.argtypes = [ctypes.c_int]
@@ -105,14 +111,27 @@ class NativeEngine:
     def __init__(self, num_threads=0):
         assert AVAILABLE, "native library unavailable"
         self._h = _lib.EngineCreate(num_threads)
-        self._keepalive = []
+        self._keepalive = {}
+        self._token = 0
 
     def new_var(self):
         return _lib.EngineNewVar(self._h)
 
     def push(self, fn, read_vars=(), write_vars=()):
-        cb = _ENGINE_CB(lambda _arg: fn())
-        self._keepalive.append(cb)
+        token = self._token
+        self._token += 1
+
+        def trampoline(_arg):
+            try:
+                fn()
+            finally:
+                # self-release so long-running push streams don't accumulate
+                # callbacks (dict ops are GIL-protected; the object stays
+                # alive for the duration of this call)
+                self._keepalive.pop(token, None)
+
+        cb = _ENGINE_CB(trampoline)
+        self._keepalive[token] = cb
         n_r, n_w = len(read_vars), len(write_vars)
         r = (ctypes.c_void_p * max(n_r, 1))(*read_vars)
         w = (ctypes.c_void_p * max(n_w, 1))(*write_vars)

@@ -312,12 +312,12 @@ def Convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     dilate = _tup(dilate, nd, 1)
     pad = _tup(pad, nd, 0)
     dn = lax.conv_dimension_numbers(data.shape, weight.shape, _dimnums(nd))
+    # bf16 convs accumulate in f32 on the MXU by default; forcing
+    # preferred_element_type here breaks the conv transpose rule under AD
     out = lax.conv_general_dilated(
         data, weight, window_strides=stride,
         padding=[(p, p) for p in pad], rhs_dilation=dilate,
-        dimension_numbers=dn, feature_group_count=num_group,
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None)
-    out = out.astype(data.dtype)
+        dimension_numbers=dn, feature_group_count=num_group)
     if bias is not None and not no_bias:
         out = out + bias.reshape((1, -1) + (1,) * nd)
     return out

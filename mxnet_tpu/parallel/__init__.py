@@ -13,3 +13,5 @@ from .mesh import build_mesh, data_parallel_mesh, mesh_sharding
 from .trainer import TrainStep
 from .ring_attention import ring_attention, ring_attention_sharded
 from . import collectives
+from .pipeline import gpipe_apply
+from .functional import functionalize, swap_param_buffers

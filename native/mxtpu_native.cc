@@ -29,6 +29,9 @@
 #include <thread>
 #include <vector>
 
+#include <fcntl.h>
+#include <unistd.h>
+
 #include <jpeglib.h>
 #include <csetjmp>
 
@@ -124,6 +127,18 @@ class Engine {
 
   void Push(std::function<void()> fn, std::vector<EngineVar *> reads,
             std::vector<EngineVar *> writes) {
+    // dedup (reference: CheckDuplicate, threaded_engine.cc:228) — a var in
+    // both lists is a write; duplicates within a list collapse. Without
+    // this, an op would queue behind its own grant and deadlock.
+    std::sort(writes.begin(), writes.end());
+    writes.erase(std::unique(writes.begin(), writes.end()), writes.end());
+    std::sort(reads.begin(), reads.end());
+    reads.erase(std::unique(reads.begin(), reads.end()), reads.end());
+    std::vector<EngineVar *> pure_reads;
+    for (EngineVar *v : reads)
+      if (!std::binary_search(writes.begin(), writes.end(), v))
+        pure_reads.push_back(v);
+    reads = std::move(pure_reads);
     auto *op = new EngineOp();
     op->fn = std::move(fn);
     op->reads = std::move(reads);
@@ -391,6 +406,7 @@ struct IRHeader {  // matches struct.pack("IfQQ")
 
 struct ImgIter {
   std::string rec_path;
+  int fd = -1;
   int batch, h, w, c;
   bool shuffle, rand_crop, rand_mirror;
   int n_threads;
@@ -406,6 +422,7 @@ struct ImgIter {
 void *ImgIterCreate(const char *rec_path, int batch, int h, int w, int c,
                     int shuffle, int num_threads, int rand_crop,
                     int rand_mirror, unsigned seed) {
+  if (c != 3) return nullptr;  // decode path is RGB-only (CHW c==3)
   auto *it = new ImgIter();
   it->rec_path = rec_path;
   it->batch = batch;
@@ -433,6 +450,7 @@ void *ImgIterCreate(const char *rec_path, int batch, int h, int w, int c,
     fseek(fp, (long)(len + (4 - len % 4) % 4), SEEK_CUR);
   }
   fclose(fp);
+  it->fd = open(rec_path, O_RDONLY);
   it->order.resize(it->offsets.size());
   for (size_t i = 0; i < it->order.size(); ++i) it->order[i] = i;
   if (it->shuffle)
@@ -475,17 +493,16 @@ int ImgIterNext(void *h, float *data_out, float *label_out) {
     float *lslot = label_out + i;
     it->pool->Enqueue([it, pos, dslot, lslot, r1, r2, r3, &done, &done_m,
                        &done_cv, n] {
-      FILE *fp = fopen(it->rec_path.c_str(), "rb");
+      // pread: positioned reads on one shared fd are thread-safe and keep
+      // OS readahead effective (no per-sample open/seek/close)
       uint32_t hdr[2];
       std::vector<char> raw;
       bool ok = false;
-      if (fp) {
-        fseek(fp, (long)pos, SEEK_SET);
-        if (fread(hdr, 4, 2, fp) == 2 && hdr[0] == kRecMagic) {
-          raw.resize(hdr[1]);
-          ok = fread(raw.data(), 1, hdr[1], fp) == hdr[1];
-        }
-        fclose(fp);
+      if (it->fd >= 0 &&
+          pread(it->fd, hdr, 8, (off_t)pos) == 8 && hdr[0] == kRecMagic) {
+        raw.resize(hdr[1]);
+        ok = pread(it->fd, raw.data(), hdr[1], (off_t)pos + 8) ==
+             (ssize_t)hdr[1];
       }
       float label = 0.f;
       std::vector<uint8_t> rgb;
@@ -496,13 +513,18 @@ int ImgIterNext(void *h, float *data_out, float *label_out) {
         const uint8_t *payload = (const uint8_t *)raw.data() + sizeof(IRHeader);
         size_t plen = raw.size() - sizeof(IRHeader);
         if (irh.flag > 0) {  // multi-label: first label, skip label floats
-          memcpy(&label, payload, 4);
-          payload += irh.flag * 4;
-          plen -= irh.flag * 4;
+          size_t lbytes = (size_t)irh.flag * 4;
+          if (lbytes + 4 <= plen) {
+            memcpy(&label, payload, 4);
+            payload += lbytes;
+            plen -= lbytes;
+          } else {
+            ok = false;  // corrupt/truncated record
+          }
         } else {
           label = irh.label;
         }
-        ok = DecodeJpeg(payload, plen, &rgb, &sh, &sw);
+        if (ok) ok = DecodeJpeg(payload, plen, &rgb, &sh, &sw);
       }
       if (ok) {
         int cy = 0, cx = 0, ch = sh, cw = sw;
@@ -520,9 +542,11 @@ int ImgIterNext(void *h, float *data_out, float *label_out) {
         memset(dslot, 0, sizeof(float) * it->c * it->h * it->w);
         *lslot = -1.f;
       }
-      if (done.fetch_add(1) + 1 == n) {
+      {
+        // increment under the lock: otherwise the waiter can observe
+        // done==n and destroy these stack objects before notify_all
         std::unique_lock<std::mutex> lk(done_m);
-        done_cv.notify_all();
+        if (done.fetch_add(1) + 1 == n) done_cv.notify_all();
       }
     });
   }
@@ -538,6 +562,7 @@ void ImgIterFree(void *h) {
   auto *it = static_cast<ImgIter *>(h);
   if (it) {
     delete it->pool;
+    if (it->fd >= 0) close(it->fd);
     delete it;
   }
 }

@@ -230,3 +230,34 @@ def test_native_image_iter_shuffle_epochs_differ(tmp_path):
     _, l2, _ = it.next_batch()
     assert sorted(order1.tolist()) == sorted(l2.tolist())
     it.close()
+
+
+def test_engine_var_in_read_and_write():
+    """A var listed as both read and write must not deadlock (treated as
+    write, like the reference's CheckDuplicate dedup)."""
+    eng = native.NativeEngine(4)
+    var = eng.new_var()
+    ran = []
+    eng.push(lambda: ran.append(1), read_vars=[var], write_vars=[var])
+    eng.push(lambda: ran.append(2), read_vars=[var, var],
+             write_vars=[var, var])
+    eng.wait_all()
+    assert ran == [1, 2]
+    eng.close()
+
+
+def test_engine_keepalive_self_release():
+    eng = native.NativeEngine(2)
+    for _ in range(100):
+        eng.push(lambda: None)
+    eng.wait_all()
+    import time
+    time.sleep(0.05)  # callbacks finish popping themselves
+    assert len(eng._keepalive) == 0
+    eng.close()
+
+
+def test_native_image_iter_rejects_non_rgb(tmp_path):
+    rec = _make_rec(tmp_path, n=2)
+    with pytest.raises(IOError):
+        native.NativeImageIter(rec, batch_size=1, data_shape=(1, 8, 8))
