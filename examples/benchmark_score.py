@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Inference throughput sweep over the model zoo (parity: reference
+example/image-classification/benchmark_score.py)."""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.gluon.model_zoo import vision  # noqa: E402
+from mxnet_tpu.parallel.functional import functionalize  # noqa: E402
+
+
+def score(model_name, batch, image_size, steps=10):
+    import jax
+    import jax.numpy as jnp
+    net = vision.get_model(model_name)
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.zeros((1, 3, image_size, image_size)))
+    apply_fn, _, values = functionalize(net)
+    fn = jax.jit(apply_fn)
+    x = jnp.asarray(np.random.uniform(
+        -1, 1, (batch, 3, image_size, image_size)).astype(np.float32))
+    fn(values, x).block_until_ready()  # compile
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(values, x)
+    out.block_until_ready()
+    return batch * steps / (time.perf_counter() - t0)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--models", default="resnet18_v1,resnet50_v1,"
+                    "mobilenet0_25,squeezenet1_0")
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--batch-sizes", default="1,32")
+    args = ap.parse_args()
+    for model in args.models.split(","):
+        for batch in (int(b) for b in args.batch_sizes.split(",")):
+            ips = score(model, batch, args.image_size)
+            print("model %s, batch %d: %.1f img/s" % (model, batch, ips))
+
+
+if __name__ == "__main__":
+    main()

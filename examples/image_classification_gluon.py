@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Gluon image classification (parity: reference
+example/gluon/image_classification.py): model-zoo net + Trainer, with
+optional fused TrainStep (the TPU performance path) and data-parallel mesh.
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon, autograd  # noqa: E402
+from mxnet_tpu.gluon import loss as gloss  # noqa: E402
+from mxnet_tpu.gluon.model_zoo import vision  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet18_v1")
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--image-size", type=int, default=32)
+    ap.add_argument("--classes", type=int, default=10)
+    ap.add_argument("--num-batches", type=int, default=30)
+    ap.add_argument("--fused", action="store_true",
+                    help="use the fused TrainStep (one XLA program)")
+    ap.add_argument("--mesh-dp", type=int, default=0,
+                    help="shard the batch over N devices")
+    args = ap.parse_args()
+
+    net = vision.get_model(args.model, classes=args.classes)
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.zeros((1, 3, args.image_size, args.image_size)))
+
+    rng = np.random.RandomState(0)
+    X = rng.uniform(-1, 1, (args.batch_size, 3, args.image_size,
+                            args.image_size)).astype(np.float32)
+    # synthetic class prototypes so the run shows real learning
+    protos = rng.uniform(-1, 1, (args.classes, 3, args.image_size,
+                                 args.image_size)).astype(np.float32)
+    Y = rng.randint(0, args.classes, args.batch_size)
+    X = 0.7 * protos[Y] + 0.3 * X
+    lossfn = gloss.SoftmaxCrossEntropyLoss()
+
+    if args.fused:
+        from mxnet_tpu.parallel.trainer import TrainStep
+        mesh = None
+        if args.mesh_dp:
+            from mxnet_tpu.parallel.mesh import build_mesh
+            mesh = build_mesh({"dp": args.mesh_dp})
+        step = TrainStep(net, lossfn, "sgd",
+                         {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh)
+        t0 = time.time()
+        for i in range(args.num_batches):
+            loss = step(X, Y.astype(np.float32))
+        print("fused: %.1f img/s, final loss %.4f" %
+              (args.batch_size * args.num_batches / (time.time() - t0),
+               float(loss)))
+    else:
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9})
+        t0 = time.time()
+        for i in range(args.num_batches):
+            with autograd.record():
+                L = lossfn(net(mx.nd.array(X)),
+                           mx.nd.array(Y.astype(np.float32))).mean()
+            L.backward()
+            trainer.step(args.batch_size)
+        print("eager: %.1f img/s, final loss %.4f" %
+              (args.batch_size * args.num_batches / (time.time() - t0),
+               float(L.asnumpy())))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""Sparse linear classification with row_sparse weights + kvstore pulls
+(parity: reference example/sparse/linear_classification/train.py — BASELINE
+config 5). Synthetic sparse data."""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-features", type=int, default=1000)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-batches", type=int, default=60)
+    ap.add_argument("--density", type=float, default=0.05)
+    ap.add_argument("--kv-store", default="local")
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    true_w = rng.uniform(-1, 1, (args.num_features,))
+    kv = mx.kv.create(args.kv_store)
+    model = mx.models.SparseLinear(args.num_features, num_classes=2,
+                                   kvstore=kv, learning_rate=0.1)
+
+    correct = total = 0
+    for i in range(args.num_batches):
+        mask = rng.uniform(size=(args.batch_size, args.num_features)) < \
+            args.density
+        x = mx.nd.array((rng.uniform(-1, 1, mask.shape) * mask)
+                        .astype(np.float32))
+        y = ((x.asnumpy() @ true_w) > 0).astype(np.float32)
+        loss = model.step(x, mx.nd.array(y))
+        if i >= args.num_batches - 10:  # accuracy over the last 10 batches
+            pred = model.forward(x).asnumpy().argmax(1)
+            correct += int((pred == y).sum())
+            total += args.batch_size
+        if i % 20 == 0:
+            print("batch %d loss %.4f" % (i, float(loss)))
+    print("accuracy (last 10 batches): %.3f" % (correct / total))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""MNIST training via the Module API (parity: reference
+example/image-classification/train_mnist.py + common/fit.py).
+
+Runs on synthetic MNIST by default (hermetic); point --data at real MNIST
+idx files to use them.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", default="mlp", choices=["mlp", "lenet"])
+    ap.add_argument("--batch-size", type=int, default=96)
+    ap.add_argument("--num-epochs", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--kv-store", default="local")
+    ap.add_argument("--model-prefix", default=None)
+    args = ap.parse_args()
+
+    if args.network == "mlp":
+        net = mx.models.get_mlp()
+        shape = (784,)
+    else:
+        net = mx.models.get_lenet()
+        shape = (1, 28, 28)
+
+    train, val = mx.test_utils.get_mnist_iterator(args.batch_size, shape)
+    kv = mx.kv.create(args.kv_store)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    cbs = [mx.callback.Speedometer(args.batch_size, 50)]
+    if args.model_prefix:
+        epoch_cb = mx.callback.do_checkpoint(args.model_prefix)
+    else:
+        epoch_cb = None
+    mod.fit(train, eval_data=val,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            initializer=mx.init.Xavier(),
+            eval_metric="acc",
+            batch_end_callback=cbs,
+            epoch_end_callback=epoch_cb,
+            kvstore=kv,
+            num_epoch=args.num_epochs)
+    print("final validation:", mod.score(val, "acc"))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""LSTM word language model (parity: reference example/rnn/word_lm/train.py
+— truncated BPTT over a token stream; BASELINE config 3). Synthetic corpus
+by default; pass --text for a real file."""
+import argparse
+import math
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon, autograd  # noqa: E402
+from mxnet_tpu.gluon import loss as gloss  # noqa: E402
+
+
+def batchify(tokens, batch_size):
+    n = len(tokens) // batch_size
+    return np.asarray(tokens[:n * batch_size]).reshape(
+        batch_size, n).T  # (T, N)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--text", default=None)
+    ap.add_argument("--vocab", type=int, default=200)
+    ap.add_argument("--emsize", type=int, default=64)
+    ap.add_argument("--nhid", type=int, default=128)
+    ap.add_argument("--nlayers", type=int, default=2)
+    ap.add_argument("--bptt", type=int, default=16)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--lr", type=float, default=0.003)
+    ap.add_argument("--optimizer", default="adam")
+    args = ap.parse_args()
+
+    if args.text:
+        with open(args.text) as f:
+            words = f.read().split()
+        vocab = {w: i for i, w in enumerate(dict.fromkeys(words))}
+        tokens = [vocab[w] for w in words]
+        args.vocab = len(vocab)
+    else:  # synthetic markov-ish corpus
+        rng = np.random.RandomState(0)
+        tokens = [0]
+        for _ in range(20000):
+            tokens.append((tokens[-1] * 7 + rng.randint(0, 3)) % args.vocab)
+
+    data = batchify(tokens, args.batch_size)
+    model = mx.models.RNNModel(mode="lstm", vocab_size=args.vocab,
+                               num_embed=args.emsize, num_hidden=args.nhid,
+                               num_layers=args.nlayers)
+    model.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(model.collect_params(), args.optimizer,
+                            {"learning_rate": args.lr})
+    lossfn = gloss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        total, count = 0.0, 0
+        hidden = model.begin_state(batch_size=args.batch_size)
+        t0 = time.time()
+        for i in range(0, data.shape[0] - 1 - args.bptt, args.bptt):
+            x = mx.nd.array(data[i:i + args.bptt].astype(np.float32))
+            y = mx.nd.array(
+                data[i + 1:i + 1 + args.bptt].astype(np.float32))
+            hidden = [h.detach() for h in hidden]  # truncated BPTT
+            with autograd.record():
+                out, hidden = model(x, hidden)
+                L = lossfn(out, y.reshape((-1,))).mean()
+            L.backward()
+            gluon.utils.clip_global_norm(
+                [p.grad() for p in model.collect_params().values()
+                 if p.grad_req != "null"], 0.25 * args.bptt *
+                args.batch_size)
+            trainer.step(args.batch_size)
+            total += float(L.asnumpy())
+            count += 1
+        ppl = math.exp(total / max(count, 1))
+        print("epoch %d: ppl %.2f (%.1f tok/s)" %
+              (epoch, ppl, count * args.bptt * args.batch_size /
+               (time.time() - t0)))
+
+
+if __name__ == "__main__":
+    main()

@@ -65,3 +65,4 @@ from .name import NameManager
 from . import operator
 from .operator import CustomOp, CustomOpProp
 from . import rtc
+from . import contrib

@@ -1,0 +1,7 @@
+"""mx.contrib — experimental/auxiliary python subsystems.
+
+Parity: reference `python/mxnet/contrib/` (quantization, autograd helpers,
+text embeddings, onnx import, tensorboard glue). INT8 quantization is the
+load-bearing member here; the others are thin or gated.
+"""
+from . import quantization

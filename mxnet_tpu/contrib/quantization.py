@@ -1,0 +1,248 @@
+"""INT8 model quantization driver.
+
+Parity: reference `python/mxnet/contrib/quantization.py` — `quantize_model`
+rewrites a float Symbol into an int8 inference graph (the C++
+`quantize_graph_pass.cc` equivalent done at the Python DAG level here),
+pre-quantizes weights, and calibrates activation ranges from data
+('naive' min/max or 'entropy' KL-optimal thresholds).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..symbol import Symbol, SymNode, Variable
+from ..ops import registry as _registry
+from ..ndarray import NDArray
+
+QUANTIZABLE = {"FullyConnected", "Convolution"}
+
+
+# ---------------------------------------------------------------------------
+# calibration
+# ---------------------------------------------------------------------------
+
+def _collect_layer_stats(sym, arg_params, aux_params, calib_data,
+                         collect_names, num_calib_examples=None, ctx=None):
+    """Run the fp32 graph over calib batches; gather per-tensor min/max and
+    histograms for the requested internal outputs."""
+    internals = sym.get_internals()
+    outs = internals.list_outputs()
+    wanted = [n for n in collect_names if n in outs]
+    group = Symbol(sum((internals[n]._outputs for n in wanted), []))
+
+    stats = {n: {"min": np.inf, "max": -np.inf, "samples": []}
+             for n in wanted}
+    seen = 0
+    calib_data.reset()
+    for batch in calib_data:
+        args = dict(arg_params)
+        args["data"] = batch.data[0]
+        exe = group.bind(ctx, args=args, grad_req="null",
+                         aux_states=dict(aux_params) if aux_params else None)
+        exe.forward(is_train=False)
+        for n, out in zip(wanted, exe.outputs):
+            a = out.asnumpy()
+            st = stats[n]
+            st["min"] = min(st["min"], float(a.min()))
+            st["max"] = max(st["max"], float(a.max()))
+            st["samples"].append(np.abs(a).ravel())
+        seen += batch.data[0].shape[0]
+        if num_calib_examples is not None and seen >= num_calib_examples:
+            break
+    return stats
+
+
+def _kl_optimal_threshold(samples, num_bins=2001, num_quantized_bins=255):
+    """Entropy calibration: the |x| threshold minimizing KL divergence
+    between the fp32 distribution and its int8 projection (parity:
+    _LayerOutputCollector/_get_optimal_threshold)."""
+    arr = np.concatenate(samples)
+    amax = float(arr.max()) if arr.size else 1e-8
+    if amax <= 0:
+        return 1e-8
+    hist, edges = np.histogram(arr, bins=num_bins, range=(0, amax))
+    best_kl, best_t = np.inf, amax
+    for i in range(num_quantized_bins, num_bins + 1,
+                   max(1, (num_bins - num_quantized_bins) // 64)):
+        p = hist[:i].astype(np.float64).copy()
+        p[-1] += hist[i:].sum()  # clip tail into the last bin
+        if p.sum() == 0:
+            continue
+        # project p onto num_quantized_bins then expand back
+        factor = i / num_quantized_bins
+        q = np.zeros(i)
+        for j in range(num_quantized_bins):
+            lo, hi = int(j * factor), max(int((j + 1) * factor), int(
+                j * factor) + 1)
+            chunk = p[lo:hi]
+            nz = (chunk > 0).sum()
+            if nz:
+                q[lo:hi] = np.where(chunk > 0, chunk.sum() / nz, 0)
+        pn = p / p.sum()
+        qs = q.sum()
+        if qs == 0:
+            continue
+        qn = q / qs
+        mask = pn > 0
+        kl = float(np.sum(pn[mask] * np.log(
+            pn[mask] / np.maximum(qn[mask], 1e-12))))
+        if kl < best_kl:
+            best_kl, best_t = kl, float(edges[i if i < len(edges) else -1])
+    return max(best_t, 1e-8)
+
+
+# ---------------------------------------------------------------------------
+# graph rewrite
+# ---------------------------------------------------------------------------
+
+def _quantize_weight(arr):
+    a = arr.asnumpy() if isinstance(arr, NDArray) else np.asarray(arr)
+    amax = max(float(np.abs(a).max()), 1e-12)
+    q = np.clip(np.rint(a * (127.0 / amax)), -127, 127).astype(np.int8)
+    return q, -amax, amax
+
+
+def quantize_model(sym, arg_params, aux_params=None, excluded_sym_names=(),
+                   calib_mode="none", calib_data=None,
+                   num_calib_examples=None, quantized_dtype="int8",
+                   ctx=None):
+    """Rewrite FullyConnected/Convolution nodes to int8 (parity:
+    contrib.quantization.quantize_model).
+
+    Returns (quantized_symbol, quantized_arg_params, aux_params)."""
+    assert quantized_dtype == "int8"
+    excluded = set(excluded_sym_names)
+
+    # 1. calibrate activation ranges at the inputs of quantizable nodes
+    ranges = {}
+    if calib_mode != "none":
+        assert calib_data is not None, "calib_mode needs calib_data"
+        node_inputs = []
+        for node in _walk(sym):
+            if node.op is not None and node.op.name in QUANTIZABLE and \
+                    node.name not in excluded:
+                inp_node, inp_idx = node.inputs[0]
+                node_inputs.append(_output_name(inp_node, inp_idx))
+        stats = _collect_layer_stats(sym, arg_params, aux_params or {},
+                                     calib_data, node_inputs,
+                                     num_calib_examples, ctx=ctx)
+        for n, st in stats.items():
+            if calib_mode == "naive":
+                amax = max(abs(st["min"]), abs(st["max"]), 1e-8)
+            elif calib_mode == "entropy":
+                amax = _kl_optimal_threshold(st["samples"])
+            else:
+                raise ValueError("unknown calib_mode %s" % calib_mode)
+            ranges[n] = amax
+
+    # 2. rewrite the DAG bottom-up
+    new_args = {k: v for k, v in arg_params.items()}
+    memo = {}
+
+    def clone(node):
+        if node in memo:
+            return memo[node]
+        new_inputs = [(clone(n), i) for n, i in node.inputs]
+        if node.op is None:
+            cloned = node  # variables are shared
+        elif node.op.name in QUANTIZABLE and node.name not in excluded:
+            cloned = _quantize_node(node, new_inputs, new_args, ranges)
+        else:
+            cloned = SymNode(node.op, node.name, new_inputs, dict(node.kwargs),
+                             attr=dict(node.attr))
+        memo[node] = cloned
+        return cloned
+
+    def _quantize_node(node, new_inputs, new_args, ranges):
+        opname = node.op.name
+        data_in = new_inputs[0]
+        weight_node, _ = node.inputs[1]
+        wname = weight_node.name
+        no_bias = bool(node.kwargs.get("no_bias", False))
+
+        # pre-quantize the weight (and bias) params
+        qw, wmin, wmax = _quantize_weight(new_args[wname])
+        new_args[wname + "_quantized"] = NDArray(qw)
+        new_args.pop(wname, None)
+        qweight = Variable(wname + "_quantized")._outputs[0]
+        wmin_s = _const_var(wname + "_min", wmin, new_args)
+        wmax_s = _const_var(wname + "_max", wmax, new_args)
+
+        bias_inputs = []
+        if not no_bias and len(node.inputs) > 2:
+            bias_node, _ = node.inputs[2]
+            bname = bias_node.name
+            qb, bmin, bmax = _quantize_weight(new_args[bname])
+            new_args[bname + "_quantized"] = NDArray(qb)
+            new_args.pop(bname, None)
+            qbias = Variable(bname + "_quantized")._outputs[0]
+            bmin_s = _const_var(bname + "_min", bmin, new_args)
+            bmax_s = _const_var(bname + "_max", bmax, new_args)
+            bias_inputs = [qbias, bmin_s, bmax_s]
+
+        # activation range: calibrated, else dynamic per-batch min/max
+        inp_node, inp_idx = node.inputs[0]
+        iname = _output_name(inp_node, inp_idx)
+        if iname in ranges:
+            amax = ranges[iname]
+            dmin = _const_var(node.name + "_calib_min", -amax, new_args)
+            dmax = _const_var(node.name + "_calib_max", amax, new_args)
+        else:
+            mn = SymNode(_registry.get("min"), node.name + "_dyn_min",
+                         [data_in], {})
+            mx_ = SymNode(_registry.get("max"), node.name + "_dyn_max",
+                          [data_in], {})
+            dmin, dmax = (mn, 0), (mx_, 0)
+
+        qdata = SymNode(_registry.get("_contrib_quantize"),
+                        node.name + "_quantize", [data_in, dmin, dmax], {})
+
+        qkwargs = dict(node.kwargs)
+        qop = "_contrib_quantized_fully_connected" \
+            if opname == "FullyConnected" else "_contrib_quantized_conv"
+        ins = [(qdata, 0), qweight, (qdata, 1), (qdata, 2), wmin_s, wmax_s]
+        if no_bias or len(node.inputs) <= 2:
+            qkwargs["no_bias"] = True
+        else:
+            ins += bias_inputs  # (bias, min_bias, max_bias) trail
+        qnode = SymNode(_registry.get(qop), node.name + "_quantized",
+                        ins, qkwargs)
+        deq = SymNode(_registry.get("_contrib_dequantize"),
+                      node.name + "_dequantize",
+                      [(qnode, 0), (qnode, 1), (qnode, 2)], {})
+        return deq
+
+    new_outputs = [(clone(n), i) for n, i in sym._outputs]
+    return Symbol(new_outputs), new_args, dict(aux_params or {})
+
+
+def _const_var(name, value, new_args):
+    """A scalar parameter variable carrying a calibrated range."""
+    new_args[name] = NDArray(np.float32(value).reshape(()))
+    return Variable(name)._outputs[0]
+
+
+def _output_name(node, idx):
+    if node.op is None:
+        return node.name
+    outs = node.num_outputs
+    if outs == 1:
+        return node.name + "_output"
+    return "%s_output%d" % (node.name, idx)
+
+
+def _walk(sym):
+    seen = []
+    visited = set()
+
+    def visit(node):
+        if id(node) in visited:
+            return
+        visited.add(id(node))
+        for n, _ in node.inputs:
+            visit(n)
+        seen.append(node)
+
+    for n, _ in sym._outputs:
+        visit(n)
+    return seen

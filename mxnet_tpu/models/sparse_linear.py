@@ -54,7 +54,7 @@ class SparseLinear:
         bgrad = jnp.sum(dscore, axis=0)
         # only rows with any non-zero feature received gradient -> row_sparse
         touched = np.nonzero(np.asarray(jnp.any(xd != 0, axis=0)))[0]
-        wgrad = RowSparseNDArray(jnp.asarray(touched, dtype=jnp.int64),
+        wgrad = RowSparseNDArray(jnp.asarray(touched, dtype=jnp.int32),
                                  wgrad_dense[touched],
                                  wgrad_dense.shape)
         return float(loss), wgrad, NDArray(bgrad)

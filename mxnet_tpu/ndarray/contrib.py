@@ -27,6 +27,13 @@ Proposal = _make("_contrib_Proposal")
 BilinearResize2D = _make("_contrib_BilinearResize2D")
 AdaptiveAvgPooling2D = _make("_contrib_AdaptiveAvgPooling2D")
 quadratic = _make("quadratic")
+quantize = _make("_contrib_quantize")
+dequantize = _make("_contrib_dequantize")
+requantize = _make("_contrib_requantize")
+quantized_fully_connected = _make("_contrib_quantized_fully_connected")
+quantized_conv = _make("_contrib_quantized_conv")
+quantized_pooling = _make("_contrib_quantized_pooling")
+quantized_flatten = _make("_contrib_quantized_flatten")
 
 
 def foreach(body, data, init_states):

@@ -368,6 +368,43 @@ class NDArray:
 # ---------------------------------------------------------------------------
 
 
+# Eager op jit cache: compile each (op, static kwargs, train-mode) once and
+# reuse — the analog of the reference's cached engine operators
+# (graph_executor.cc InitCachedOps; here per *imperative* op, so eager mode
+# gets compiled-kernel dispatch instead of per-call retracing of op bodies
+# with internal control flow like the fused RNN's lax.scan).
+_JIT_CACHE = {}
+_JIT_BLACKLIST = set()
+_JIT_CACHE_CAP = 8192
+_EAGER_JIT = os.environ.get("MXNET_EAGER_JIT", "1") != "0"
+
+
+def _freeze(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    return v
+
+
+def _jitted_op(opdef, key, make_closed):
+    """Return a jitted wrapper for the op, or None if not cacheable."""
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+        if len(_JIT_CACHE) >= _JIT_CACHE_CAP:
+            return None
+        closed = make_closed()
+        if opdef.stochastic:
+            def wrapper(rng, *tensors):
+                with _random.trace_key_scope(rng):
+                    return closed(*tensors)
+        else:
+            wrapper = closed
+        fn = jax.jit(wrapper)
+        _JIT_CACHE[key] = fn
+    return fn
+
+
 def _apply_op(opdef, args, kwargs):
     """Unwrap NDArrays, run the pure-JAX op (XLA dispatches async), wrap
     outputs, and record on the autograd tape if inside record()."""
@@ -389,12 +426,37 @@ def _apply_op(opdef, args, kwargs):
 
     rng_key = None
     recording = autograd.is_recording()
-    if opdef.stochastic and _random._STATE.trace_key is None:
+    in_trace = _random._STATE.trace_key is not None
+    if opdef.stochastic and not in_trace:
         rng_key = _random.next_key()
-        with _random.trace_key_scope(rng_key):
+
+    jit_fn = None
+    if _EAGER_JIT and not in_trace and not isinstance(opdef, _AdhocOp) \
+            and opdef.name not in _JIT_BLACKLIST:
+        try:
+            key = (opdef.fn, _freeze(static_args), tuple(nd_positions),
+                   _freeze(kwargs), autograd.is_training())
+            hash(key)
+        except TypeError:
+            key = None
+        if key is not None:
+            jit_fn = _jitted_op(opdef, key, lambda: closed_fn)
+
+    if jit_fn is not None:
+        try:
+            res = jit_fn(rng_key, *vals) if opdef.stochastic \
+                else jit_fn(*vals)
+        except (jax.errors.TracerArrayConversionError,
+                jax.errors.ConcretizationTypeError, TypeError):
+            # op body isn't traceable (host syncs etc.): run raw from now on
+            _JIT_BLACKLIST.add(opdef.name)
+            jit_fn = None
+    if jit_fn is None:
+        if opdef.stochastic and rng_key is not None:
+            with _random.trace_key_scope(rng_key):
+                res = closed_fn(*vals)
+        else:
             res = closed_fn(*vals)
-    else:
-        res = closed_fn(*vals)
 
     result_ctx = (ctx or (nd_inputs[0]._ctx if nd_inputs else current_context()))
     if isinstance(res, tuple):

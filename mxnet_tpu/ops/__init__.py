@@ -16,3 +16,4 @@ from . import nn
 from . import random_ops
 from . import contrib
 from . import sparse
+from . import quantization

@@ -1,0 +1,158 @@
+"""INT8 quantization ops.
+
+Parity: reference `src/operator/quantization/` — quantize/dequantize/
+requantize plus quantized conv/FC/pooling/flatten, used by the INT8
+inference path (`quantize_graph_pass.cc`; python driver
+`python/mxnet/contrib/quantization.py`).
+
+TPU-native notes: the MXU multiplies int8 natively (s8 x s8 -> s32), which
+lax.dot_general expresses via preferred_element_type=int32. Convolutions
+compute from the integer values in float32 (exact for products summed below
+2^24, which int8 kernels satisfy) — XLA lowers either form onto the MXU.
+Ranges travel with the tensors as (min, max) scalars, as in the reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+_INT8_RANGE = 127.0
+
+
+def _q_scale(min_range, max_range):
+    amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    return _INT8_RANGE / jnp.maximum(amax, 1e-12)
+
+
+@register("_contrib_quantize", num_outputs=3, differentiable=False,
+          aliases=("quantize",))
+def quantize(data, min_range, max_range, out_type="int8"):
+    """float -> int8 with symmetric scaling (parity: quantize-inl.h).
+
+    Returns (quantized, min_output, max_output)."""
+    assert out_type == "int8", "TPU path quantizes to int8"
+    scale = _q_scale(min_range, max_range)
+    q = jnp.clip(jnp.rint(data * scale), -127, 127).astype(jnp.int8)
+    amax = _INT8_RANGE / scale
+    return q, -amax, amax
+
+
+@register("_contrib_dequantize", differentiable=False,
+          aliases=("dequantize",))
+def dequantize(data, min_range, max_range, out_type="float32"):
+    """int8/int32 -> float (parity: dequantize-inl.h)."""
+    amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    if data.dtype == jnp.int8:
+        scale = amax / _INT8_RANGE
+    else:  # int32 accumulators: range maps the full int32 span
+        scale = amax / float(2 ** 31 - 1)
+    return data.astype(jnp.float32) * scale
+
+
+@register("_contrib_requantize", num_outputs=3, differentiable=False,
+          aliases=("requantize",))
+def requantize(data, min_range, max_range, min_calib_range=None,
+               max_calib_range=None):
+    """int32 -> int8, optionally with calibrated output ranges
+    (parity: requantize-inl.h)."""
+    real = dequantize(data, min_range, max_range)
+    if min_calib_range is not None and max_calib_range is not None:
+        lo, hi = float(min_calib_range), float(max_calib_range)
+    else:
+        lo = float(jnp.min(real))
+        hi = float(jnp.max(real))
+    return quantize(real, jnp.float32(lo), jnp.float32(hi))
+
+
+def _int32_range_of_product(min_a, max_a, min_b, max_b, inner):
+    """Output (min,max) convention for int32 accumulators: the range that
+    maps the int32 span onto real values (reference
+    quantization_utils.h GetQuantizedToFloatScale composition)."""
+    scale_a = _q_scale(min_a, max_a)
+    scale_b = _q_scale(min_b, max_b)
+    real_per_unit = 1.0 / (scale_a * scale_b)
+    amax = real_per_unit * float(2 ** 31 - 1)
+    return -amax, amax
+
+
+@register("_contrib_quantized_fully_connected", num_outputs=3,
+          differentiable=False, aliases=("quantized_fully_connected",))
+def quantized_fully_connected(data, weight, min_data, max_data,
+                              min_weight, max_weight, bias=None,
+                              min_bias=None, max_bias=None, num_hidden=0,
+                              no_bias=False, flatten=True):
+    """int8 x int8 -> int32 matmul on the MXU (parity:
+    quantized_fully_connected.cc). Bias (if any) is int8 quantized with the
+    product scale, added in int32."""
+    x = data.reshape(data.shape[0], -1) if flatten else data
+    out = lax.dot_general(x, weight,
+                          (((x.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    if bias is not None and not no_bias:
+        # bias arrives int8 with its own range; rescale into product units
+        scale_d = _q_scale(min_data, max_data)
+        scale_w = _q_scale(min_weight, max_weight)
+        scale_b = _q_scale(min_bias, max_bias)
+        rescale = (scale_d * scale_w) / scale_b
+        out = out + jnp.rint(bias.astype(jnp.float32) *
+                             rescale).astype(jnp.int32)
+    lo, hi = _int32_range_of_product(min_data, max_data, min_weight,
+                                     max_weight, x.shape[-1])
+    return out, jnp.float32(lo), jnp.float32(hi)
+
+
+@register("_contrib_quantized_conv", num_outputs=3, differentiable=False,
+          aliases=("quantized_conv",))
+def quantized_conv(data, weight, min_data, max_data, min_weight,
+                   max_weight, bias=None, min_bias=None, max_bias=None,
+                   kernel=(), stride=(), dilate=(), pad=(), num_filter=0,
+                   num_group=1, no_bias=False, layout="NCHW"):
+    """int8 conv accumulating in int32 (parity: quantized_conv.cc).
+    Integer values computed in f32 (exact below 2^24) then rounded — XLA
+    places the contraction on the MXU either way."""
+    from .nn import Convolution
+    out_f = Convolution(data.astype(jnp.float32),
+                        weight.astype(jnp.float32), None, kernel=kernel,
+                        stride=stride, dilate=dilate, pad=pad,
+                        num_filter=num_filter, num_group=num_group,
+                        no_bias=True)
+    out = jnp.rint(out_f).astype(jnp.int32)
+    if bias is not None and not no_bias:
+        scale_d = _q_scale(min_data, max_data)
+        scale_w = _q_scale(min_weight, max_weight)
+        scale_b = _q_scale(min_bias, max_bias)
+        rescale = (scale_d * scale_w) / scale_b
+        b = jnp.rint(bias.astype(jnp.float32) * rescale).astype(jnp.int32)
+        out = out + b.reshape((1, -1) + (1,) * (out.ndim - 2))
+    lo, hi = _int32_range_of_product(min_data, max_data, min_weight,
+                                     max_weight, 0)
+    return out, jnp.float32(lo), jnp.float32(hi)
+
+
+@register("_contrib_quantized_pooling", num_outputs=3, differentiable=False,
+          aliases=("quantized_pooling",))
+def quantized_pooling(data, min_data, max_data, kernel=(), stride=(),
+                      pad=(), pool_type="max", global_pool=False,
+                      pooling_convention="valid"):
+    """Pooling on int8 keeps the input range (parity:
+    quantized_pooling.cc)."""
+    from .nn import Pooling
+    out = Pooling(data.astype(jnp.float32), kernel=kernel, stride=stride,
+                  pad=pad, pool_type=pool_type, global_pool=global_pool,
+                  pooling_convention=pooling_convention)
+    if pool_type == "max":
+        out = out.astype(jnp.int8)
+    else:  # avg emits int8 after rounding
+        out = jnp.clip(jnp.rint(out), -127, 127).astype(jnp.int8)
+    return out, min_data, max_data
+
+
+@register("_contrib_quantized_flatten", num_outputs=3, differentiable=False,
+          aliases=("quantized_flatten",))
+def quantized_flatten(data, min_data, max_data):
+    return (data.reshape(data.shape[0], -1), min_data, max_data)

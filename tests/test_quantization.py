@@ -1,0 +1,154 @@
+"""INT8 quantization tests (parity: reference tests/python/quantization/
+test_quantization.py — quantize/dequantize/requantize math, quantized
+conv/FC vs fp32 reference, quantize_model graph rewrite + calibration)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.contrib.quantization import quantize_model
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def rand(*shape):
+    return np.random.uniform(-1, 1, shape).astype(np.float32)
+
+
+def test_quantize_dequantize_roundtrip():
+    x = rand(4, 5) * 3
+    q, qmin, qmax = nd.contrib.quantize(
+        nd.array(x), nd.array(np.float32(x.min()).reshape(())),
+        nd.array(np.float32(x.max()).reshape(())))
+    assert q.dtype == np.int8
+    back = nd.contrib.dequantize(q, qmin, qmax).asnumpy()
+    amax = np.abs(x).max()
+    assert np.abs(back - x).max() <= amax / 127 + 1e-6
+
+
+def test_quantize_saturates():
+    x = np.array([[-10.0, 0.0, 10.0]], np.float32)
+    q, _, _ = nd.contrib.quantize(
+        nd.array(x), nd.array(np.float32(-1.0).reshape(())),
+        nd.array(np.float32(1.0).reshape(())))
+    qa = q.asnumpy()
+    assert qa[0, 0] == -127 and qa[0, 2] == 127 and qa[0, 1] == 0
+
+
+def test_quantized_fc_matches_fp32():
+    np.random.seed(1)
+    x, w, b = rand(8, 6), rand(4, 6), rand(4)
+    data = sym.Variable("data")
+    fp32 = sym.FullyConnected(data, name="fc", num_hidden=4)
+    args = {"fc_weight": nd.array(w), "fc_bias": nd.array(b)}
+    exe = fp32.bind(mx.cpu(), args={**args, "data": nd.array(x)},
+                    grad_req="null")
+    exe.forward()
+    ref = exe.outputs[0].asnumpy()
+
+    qsym, qargs, _ = quantize_model(fp32, args)
+    qexe = qsym.bind(mx.cpu(), args={**qargs, "data": nd.array(x)},
+                     grad_req="null")
+    qexe.forward()
+    got = qexe.outputs[0].asnumpy()
+    rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.05, rel
+
+
+def test_quantized_conv_matches_fp32():
+    np.random.seed(2)
+    x = rand(2, 3, 8, 8)
+    data = sym.Variable("data")
+    fp32 = sym.Convolution(data, name="conv", kernel=(3, 3), num_filter=4,
+                           no_bias=True)
+    args = {"conv_weight": nd.array(rand(4, 3, 3, 3))}
+    exe = fp32.bind(mx.cpu(), args={**args, "data": nd.array(x)},
+                    grad_req="null")
+    exe.forward()
+    ref = exe.outputs[0].asnumpy()
+
+    qsym, qargs, _ = quantize_model(fp32, args)
+    qexe = qsym.bind(mx.cpu(), args={**qargs, "data": nd.array(x)},
+                     grad_req="null")
+    qexe.forward()
+    got = qexe.outputs[0].asnumpy()
+    rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.05, rel
+
+
+def _mlp_and_args():
+    np.random.seed(0)
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=16)
+    act = sym.Activation(fc1, name="relu", act_type="relu")
+    fc2 = sym.FullyConnected(act, name="fc2", num_hidden=4)
+    args = {"fc1_weight": nd.array(rand(16, 8)),
+            "fc1_bias": nd.array(rand(16)),
+            "fc2_weight": nd.array(rand(4, 16)),
+            "fc2_bias": nd.array(rand(4))}
+    return fc2, args
+
+
+def test_quantize_model_naive_calibration():
+    net, args = _mlp_and_args()
+    x = rand(32, 8)
+    exe = net.bind(mx.cpu(), args={**args, "data": nd.array(x)},
+                   grad_req="null")
+    exe.forward()
+    ref = exe.outputs[0].asnumpy()
+
+    calib = mx.io.NDArrayIter(x, np.zeros(32, np.float32), batch_size=16)
+    qsym, qargs, _ = quantize_model(net, args, calib_mode="naive",
+                                    calib_data=calib)
+    # ranges became baked params, no dynamic min/max nodes remain
+    assert any(k.endswith("_calib_min") for k in qargs)
+    qexe = qsym.bind(mx.cpu(), args={**qargs, "data": nd.array(x)},
+                     grad_req="null")
+    qexe.forward()
+    got = qexe.outputs[0].asnumpy()
+    rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.05, rel
+
+
+def test_quantize_model_entropy_calibration_runs():
+    """Entropy (KL) calibration suits peaked activation distributions;
+    check it runs and stays sane on gaussian-ish data."""
+    net, args = _mlp_and_args()
+    x = (np.random.randn(64, 8) ** 3 / 3).astype(np.float32)  # peaked
+    calib = mx.io.NDArrayIter(x, np.zeros(64, np.float32), batch_size=32)
+    qsym, qargs, _ = quantize_model(net, args, calib_mode="entropy",
+                                    calib_data=calib)
+    qexe = qsym.bind(mx.cpu(), args={**qargs, "data": nd.array(x)},
+                     grad_req="null")
+    qexe.forward()
+    assert np.isfinite(qexe.outputs[0].asnumpy()).all()
+
+
+def test_quantize_model_excluded_layers():
+    net, args = _mlp_and_args()
+    qsym, qargs, _ = quantize_model(net, args,
+                                    excluded_sym_names=["fc2"])
+    # fc2 stays fp32: its weight is untouched
+    assert "fc2_weight" in qargs
+    assert "fc1_weight_quantized" in qargs
+    x = rand(8, 8)
+    qexe = qsym.bind(mx.cpu(), args={**qargs, "data": nd.array(x)},
+                     grad_req="null")
+    qexe.forward()
+    assert qexe.outputs[0].shape == (8, 4)
+
+
+def test_quantized_pooling_and_flatten():
+    x8 = np.random.randint(-127, 128, (1, 2, 4, 4)).astype(np.int8)
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.quantization import (quantized_pooling,
+                                            quantized_flatten)
+    out, mn, mx_ = quantized_pooling(jnp.asarray(x8), jnp.float32(-1),
+                                     jnp.float32(1), kernel=(2, 2),
+                                     stride=(2, 2), pool_type="max")
+    assert out.dtype == jnp.int8
+    ref = x8.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+    assert (np.asarray(out) == ref).all()
+    flat, _, _ = quantized_flatten(jnp.asarray(x8), jnp.float32(-1),
+                                   jnp.float32(1))
+    assert flat.shape == (1, 32)
