@@ -138,6 +138,30 @@ def quantize_model(sym, arg_params, aux_params=None, excluded_sym_names=(),
     # 2. rewrite the DAG bottom-up
     new_args = {k: v for k, v in arg_params.items()}
     memo = {}
+    qparam_cache = {}  # var name -> (qvalues, vmin, vmax): a weight shared
+    # by two quantizable consumers is quantized once (the fp32 entry may be
+    # popped from new_args at first use, so a re-lookup would KeyError)
+
+    # variables also consumed by a node that will stay fp32 (excluded or
+    # non-quantizable): their fp32 entry must survive in new_args even when
+    # a quantized consumer shares them
+    fp32_consumed = set()
+    for node in _walk(sym):
+        if node.op is None:
+            continue
+        if node.op.name not in QUANTIZABLE or node.name in excluded:
+            for inp, _ in node.inputs:
+                if inp.op is None:
+                    fp32_consumed.add(inp.name)
+
+    def _quantize_param(pname):
+        if pname not in qparam_cache:
+            qv, vmin, vmax = _quantize_weight(new_args[pname])
+            new_args[pname + "_quantized"] = NDArray(qv)
+            if pname not in fp32_consumed:
+                new_args.pop(pname, None)
+            qparam_cache[pname] = (qv, vmin, vmax)
+        return qparam_cache[pname]
 
     def clone(node):
         if node in memo:
@@ -160,10 +184,8 @@ def quantize_model(sym, arg_params, aux_params=None, excluded_sym_names=(),
         wname = weight_node.name
         no_bias = bool(node.kwargs.get("no_bias", False))
 
-        # pre-quantize the weight (and bias) params
-        qw, wmin, wmax = _quantize_weight(new_args[wname])
-        new_args[wname + "_quantized"] = NDArray(qw)
-        new_args.pop(wname, None)
+        # pre-quantize the weight (and bias) params (cached per var name)
+        _qw, wmin, wmax = _quantize_param(wname)
         qweight = Variable(wname + "_quantized")._outputs[0]
         wmin_s = _const_var(wname + "_min", wmin, new_args)
         wmax_s = _const_var(wname + "_max", wmax, new_args)
@@ -172,9 +194,7 @@ def quantize_model(sym, arg_params, aux_params=None, excluded_sym_names=(),
         if not no_bias and len(node.inputs) > 2:
             bias_node, _ = node.inputs[2]
             bname = bias_node.name
-            qb, bmin, bmax = _quantize_weight(new_args[bname])
-            new_args[bname + "_quantized"] = NDArray(qb)
-            new_args.pop(bname, None)
+            _qb, bmin, bmax = _quantize_param(bname)
             qbias = Variable(bname + "_quantized")._outputs[0]
             bmin_s = _const_var(bname + "_min", bmin, new_args)
             bmax_s = _const_var(bname + "_max", bmax, new_args)

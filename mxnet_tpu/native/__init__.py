@@ -62,8 +62,14 @@ def _load():
     lib.EnginePush.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ctypes.POINTER(ctypes.c_void_p), ctypes.c_int,
-        ctypes.POINTER(ctypes.c_void_p), ctypes.c_int]
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_int, ctypes.c_uint64]
     lib.EngineWaitAll.argtypes = [ctypes.c_void_p]
+    lib.EngineOutstanding.restype = ctypes.c_int64
+    lib.EngineOutstanding.argtypes = [ctypes.c_void_p]
+    lib.EngineDrainDone.restype = ctypes.c_int64
+    lib.EngineDrainDone.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_uint64),
+                                    ctypes.c_int64]
 
     lib.RecWriterCreate.restype = ctypes.c_void_p
     lib.RecWriterCreate.argtypes = [ctypes.c_char_p]
@@ -108,27 +114,41 @@ class NativeEngine:
     include/mxnet/engine.h:96-295). Python callables run on C++ worker
     threads; vars serialize writers and share readers."""
 
+    _DRAIN_BUF_CAP = 1024
+
     def __init__(self, num_threads=0):
         assert AVAILABLE, "native library unavailable"
         self._h = _lib.EngineCreate(num_threads)
         self._keepalive = {}
         self._token = 0
+        self._drain_buf = (ctypes.c_uint64 * self._DRAIN_BUF_CAP)()
 
     def new_var(self):
         return _lib.EngineNewVar(self._h)
 
+    def _drain_done(self):
+        # Free ffi closures whose callbacks have fully returned. The C++
+        # side records each token strictly AFTER invoking the callback (see
+        # EnginePush in mxtpu_native.cc), so freeing the CFUNCTYPE here can
+        # never unmap a closure stub still on a worker thread's stack —
+        # unlike a trampoline popping itself, which is a use-after-free.
+        # Draining on every push also bounds memory under sustained streams
+        # that never go idle.
+        while True:
+            n = _lib.EngineDrainDone(self._h, self._drain_buf,
+                                     self._DRAIN_BUF_CAP)
+            for i in range(n):
+                self._keepalive.pop(self._drain_buf[i], None)
+            if n < self._DRAIN_BUF_CAP:
+                break
+
     def push(self, fn, read_vars=(), write_vars=()):
+        self._drain_done()
         token = self._token
         self._token += 1
 
         def trampoline(_arg):
-            try:
-                fn()
-            finally:
-                # self-release so long-running push streams don't accumulate
-                # callbacks (dict ops are GIL-protected; the object stays
-                # alive for the duration of this call)
-                self._keepalive.pop(token, None)
+            fn()
 
         cb = _ENGINE_CB(trampoline)
         self._keepalive[token] = cb
@@ -136,11 +156,12 @@ class NativeEngine:
         r = (ctypes.c_void_p * max(n_r, 1))(*read_vars)
         w = (ctypes.c_void_p * max(n_w, 1))(*write_vars)
         _lib.EnginePush(self._h, ctypes.cast(cb, ctypes.c_void_p), None,
-                        r, n_r, w, n_w)
+                        r, n_r, w, n_w, token)
 
     def wait_all(self):
         _lib.EngineWaitAll(self._h)
-        self._keepalive.clear()
+        # all ops completed => all tokens recorded; drain frees everything
+        self._drain_done()
 
     def close(self):
         if self._h:

@@ -15,6 +15,8 @@ placement is sharding, see mxnet_tpu.parallel).
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -374,12 +376,21 @@ class NDArray:
 # gets compiled-kernel dispatch instead of per-call retracing of op bodies
 # with internal control flow like the fused RNN's lax.scan).
 _JIT_CACHE = {}
-_JIT_BLACKLIST = set()
+_JIT_BLACKLIST = set()    # per (op, static-args) keys that failed to trace
+_JIT_OP_FAILS = {}        # op name -> trace-failure count
+_JIT_OP_FAIL_CAP = 8     # after this many key-level failures, demote the op:
+# an op whose kwargs vary per call would otherwise pay a doomed jax.jit
+# trace for every new combination and grow _JIT_BLACKLIST without bound
 _JIT_CACHE_CAP = 8192
 _EAGER_JIT = os.environ.get("MXNET_EAGER_JIT", "1") != "0"
 
 
 def _freeze(v):
+    """Freeze kwargs/static args into a hashable cache key. NDArray (or raw
+    device-array) values are refused — hashing them by object identity would
+    pin device buffers in _JIT_CACHE forever and mint one entry per tensor."""
+    if isinstance(v, (NDArray, jax.Array)):
+        raise TypeError("tensor-valued static arg is not cacheable")
     if isinstance(v, (list, tuple)):
         return tuple(_freeze(x) for x in v)
     if isinstance(v, dict):
@@ -431,15 +442,16 @@ def _apply_op(opdef, args, kwargs):
         rng_key = _random.next_key()
 
     jit_fn = None
+    key = None
     if _EAGER_JIT and not in_trace and not isinstance(opdef, _AdhocOp) \
-            and opdef.name not in _JIT_BLACKLIST:
+            and _JIT_OP_FAILS.get(opdef.name, 0) < _JIT_OP_FAIL_CAP:
         try:
             key = (opdef.fn, _freeze(static_args), tuple(nd_positions),
                    _freeze(kwargs), autograd.is_training())
             hash(key)
         except TypeError:
             key = None
-        if key is not None:
+        if key is not None and key not in _JIT_BLACKLIST:
             jit_fn = _jitted_op(opdef, key, lambda: closed_fn)
 
     if jit_fn is not None:
@@ -448,8 +460,12 @@ def _apply_op(opdef, args, kwargs):
                 else jit_fn(*vals)
         except (jax.errors.TracerArrayConversionError,
                 jax.errors.ConcretizationTypeError, TypeError):
-            # op body isn't traceable (host syncs etc.): run raw from now on
-            _JIT_BLACKLIST.add(opdef.name)
+            # this specialization isn't traceable (host syncs etc.): run it
+            # raw from now on — per cache key, so other kwargs of the same
+            # op keep their compiled path; repeat offenders demote the op
+            _JIT_BLACKLIST.add(key)
+            _JIT_CACHE.pop(key, None)
+            _JIT_OP_FAILS[opdef.name] = _JIT_OP_FAILS.get(opdef.name, 0) + 1
             jit_fn = None
     if jit_fn is None:
         if opdef.stochastic and rng_key is not None:

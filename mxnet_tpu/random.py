@@ -18,9 +18,15 @@ import jax
 
 
 class _RandomState(threading.local):
+    """Per-thread RNG state. ``key`` is created LAZILY on first use: building
+    a PRNGKey forces JAX backend initialization, and importing the framework
+    must do zero device work (round-1 lesson — an import-time key made bench
+    die and the multichip dryrun hang under the TPU plugin)."""
+
     def __init__(self):
         super().__init__()
-        self.key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        self.key = None  # materialized by _current_key() on first use
+        self.seed_value = None  # pending integer seed, if seed() ran first
         self.trace_key = None  # set while tracing a CachedOp
         self.trace_counter = 0
 
@@ -28,9 +34,20 @@ class _RandomState(threading.local):
 _STATE = _RandomState()
 
 
+def _current_key():
+    if _STATE.key is None:
+        if _STATE.seed_value is not None:
+            _STATE.key = jax.random.PRNGKey(_STATE.seed_value)
+        else:
+            _STATE.key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+    return _STATE.key
+
+
 def seed(seed_state, ctx="all"):
-    """Seed the global generator (parity: mx.random.seed)."""
-    _STATE.key = jax.random.PRNGKey(int(seed_state) & 0x7FFFFFFF)
+    """Seed the global generator (parity: mx.random.seed). Device-lazy: only
+    records the integer; the PRNGKey materializes on first sampling call."""
+    _STATE.seed_value = int(seed_state) & 0x7FFFFFFF
+    _STATE.key = None
     _STATE.trace_counter = 0
     np.random.seed(int(seed_state) & 0xFFFFFFFF)
 
@@ -40,7 +57,7 @@ def next_key():
     if _STATE.trace_key is not None:
         _STATE.trace_counter += 1
         return jax.random.fold_in(_STATE.trace_key, _STATE.trace_counter)
-    _STATE.key, sub = jax.random.split(_STATE.key)
+    _STATE.key, sub = jax.random.split(_current_key())
     return sub
 
 

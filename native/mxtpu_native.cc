@@ -172,6 +172,8 @@ class Engine {
     wait_cv_.wait(lk, [this] { return outstanding_.load() == 0; });
   }
 
+  int64_t Outstanding() { return outstanding_.load(); }
+
  private:
   void Grant(EngineOp *op) {
     if (op->pending.fetch_sub(1) == 1) {
@@ -223,6 +225,30 @@ class Engine {
   std::atomic<int> outstanding_{0};
   std::mutex wait_m_;
   std::condition_variable wait_cv_;
+
+ public:
+  // Completed-token ledger: the C wrapper records a caller-supplied token
+  // AFTER the callback has fully returned, so a token drained here is
+  // guaranteed to have no ffi stub frame left on any worker stack — the
+  // safe point for the Python side to free its CFUNCTYPE closure.
+  void RecordDone(uint64_t token) {
+    std::unique_lock<std::mutex> lk(done_m_);
+    done_tokens_.push_back(token);
+  }
+
+  int64_t DrainDone(uint64_t *out, int64_t cap) {
+    std::unique_lock<std::mutex> lk(done_m_);
+    int64_t n = (int64_t)done_tokens_.size() < cap
+                    ? (int64_t)done_tokens_.size()
+                    : cap;
+    for (int64_t i = 0; i < n; ++i) out[i] = done_tokens_[i];
+    done_tokens_.erase(done_tokens_.begin(), done_tokens_.begin() + n);
+    return n;
+  }
+
+ private:
+  std::mutex done_m_;
+  std::vector<uint64_t> done_tokens_;
 };
 
 }  // namespace
@@ -234,17 +260,33 @@ void *EngineNewVar(void *h) { return static_cast<Engine *>(h)->NewVar(); }
 typedef void (*engine_cb)(void *);
 
 void EnginePush(void *h, engine_cb fn, void *arg, void **read_vars,
-                int n_read, void **write_vars, int n_write) {
+                int n_read, void **write_vars, int n_write, uint64_t token) {
   std::vector<EngineVar *> reads(n_read), writes(n_write);
   for (int i = 0; i < n_read; ++i)
     reads[i] = static_cast<EngineVar *>(read_vars[i]);
   for (int i = 0; i < n_write; ++i)
     writes[i] = static_cast<EngineVar *>(write_vars[i]);
-  static_cast<Engine *>(h)->Push([fn, arg] { fn(arg); }, std::move(reads),
-                                 std::move(writes));
+  Engine *e = static_cast<Engine *>(h);
+  // RecordDone runs strictly after fn(arg) — i.e. after the ffi closure
+  // stub has returned — making the token safe to free caller-side
+  e->Push([e, fn, arg, token] { fn(arg); e->RecordDone(token); },
+          std::move(reads), std::move(writes));
+}
+
+int64_t EngineDrainDone(void *h, uint64_t *out, int64_t cap) {
+  return static_cast<Engine *>(h)->DrainDone(out, cap);
 }
 
 void EngineWaitAll(void *h) { static_cast<Engine *>(h)->WaitAll(); }
+
+// Number of pushed-but-not-completed ops. An op counts as outstanding until
+// AFTER its callback has fully returned (Run() decrements last), so
+// outstanding == 0 guarantees no ffi closure stub is still on any worker
+// thread's stack — the Python side uses this as the safe point to free
+// retired CFUNCTYPE objects.
+int64_t EngineOutstanding(void *h) {
+  return static_cast<Engine *>(h)->Outstanding();
+}
 
 // ===========================================================================
 // RecordIO (framing matches mxnet_tpu/recordio.py: <magic u32><len u32>
@@ -553,6 +595,14 @@ int ImgIterNext(void *h, float *data_out, float *label_out) {
   {
     std::unique_lock<std::mutex> lk(done_m);
     done_cv.wait(lk, [&] { return done.load() == n; });
+  }
+  // zero the padded tail of a final partial batch: otherwise consumers that
+  // ignore DataBatch.pad silently train on stale samples from the previous
+  // batch left in the caller's buffer
+  if (n < it->batch) {
+    memset(data_out + (size_t)n * it->c * it->h * it->w, 0,
+           sizeof(float) * (size_t)(it->batch - n) * it->c * it->h * it->w);
+    for (int i = n; i < it->batch; ++i) label_out[i] = -1.f;
   }
   it->cursor += n;
   return n;

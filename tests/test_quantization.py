@@ -152,3 +152,43 @@ def test_quantized_pooling_and_flatten():
     flat, _, _ = quantized_flatten(jnp.asarray(x8), jnp.float32(-1),
                                    jnp.float32(1))
     assert flat.shape == (1, 32)
+
+
+def test_quantize_model_shared_weight():
+    """A weight shared by two quantizable FCs is quantized once; a weight
+    shared between a quantized and an excluded (fp32) consumer keeps its
+    fp32 entry so the excluded layer still binds."""
+    np.random.seed(4)
+    w = rand(4, 6)
+    data = sym.Variable("data")
+    shared = sym.Variable("shared_weight")
+    fc1 = sym.FullyConnected(data, weight=shared, name="fca", num_hidden=4,
+                             no_bias=True)
+    fc2 = sym.FullyConnected(data, weight=shared, name="fcb", num_hidden=4,
+                             no_bias=True)
+    out = fc1 + fc2
+    args = {"shared_weight": nd.array(w)}
+
+    # both consumers quantized: one quantized copy, fp32 entry dropped
+    qsym, qargs, _ = quantize_model(out, args)
+    assert "shared_weight_quantized" in qargs
+    assert "shared_weight" not in qargs
+    x = rand(8, 6)
+    qexe = qsym.bind(mx.cpu(), args={**qargs, "data": nd.array(x)},
+                     grad_req="null")
+    qexe.forward()
+    ref = x @ w.T * 2
+    rel = np.abs(qexe.outputs[0].asnumpy() - ref).max() / (
+        np.abs(ref).max() + 1e-9)
+    assert rel < 0.05, rel
+
+    # one consumer excluded: the fp32 weight must survive for it
+    qsym2, qargs2, _ = quantize_model(out, args, excluded_sym_names=["fcb"])
+    assert "shared_weight_quantized" in qargs2
+    assert "shared_weight" in qargs2
+    qexe2 = qsym2.bind(mx.cpu(), args={**qargs2, "data": nd.array(x)},
+                       grad_req="null")
+    qexe2.forward()
+    rel2 = np.abs(qexe2.outputs[0].asnumpy() - ref).max() / (
+        np.abs(ref).max() + 1e-9)
+    assert rel2 < 0.05, rel2
