@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from .ndarray import NDArray
 from .ndarray.sparse import RowSparseNDArray
 from .registry import get_register_func, get_create_func
+from . import optimizer_rules as _rules
 
 
 class Optimizer:
@@ -164,6 +165,36 @@ class Optimizer:
             return grad._indices.astype(jnp.int32), g
         return None
 
+    # rule delegation: the dense math for every optimizer lives ONCE, as a
+    # pure function in optimizer_rules.py, shared with the fused TrainStep
+    rule_name = None  # subclasses set this to their optimizer_rules key
+
+    def rule_hyper(self):
+        """Static hyper-parameter dict passed to the pure rule."""
+        return {}
+
+    def _dense_update(self, index, weight, grad, states, t=None, key=None):
+        """Apply this optimizer's pure rule to a dense gradient.
+
+        `states` is the tuple of NDArray state buffers in the rule's state
+        order; they are updated in place (buffer rebinding)."""
+        _, apply_rule = _rules.get(self.rule_name)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if t is None:
+            t = self._index_update_count[index]
+        if isinstance(grad, RowSparseNDArray):
+            g = grad.todense()._data * self.rescale_grad
+            if self.clip_gradient is not None:
+                g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        else:
+            g = self._preprocess_grad(grad)
+        vals = tuple(s._data for s in states)
+        new_w, new_vals = apply_rule(weight._data, g, vals, lr, wd, t,
+                                     self.rule_hyper(), key)
+        for s, v in zip(states, new_vals):
+            s._data = v
+        _assign(weight, new_w)
+
 
 register = Optimizer.register
 create = Optimizer.create_optimizer
@@ -179,10 +210,15 @@ class SGD(Optimizer):
     """SGD with momentum, multi-precision, and lazy sparse updates
     (parity: optimizer.py:483 + optimizer_op-inl.h sgd_update/sgd_mom_update)."""
 
+    rule_name = "sgd"
+
     def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
         self.lazy_update = lazy_update
+
+    def rule_hyper(self):
+        return {"momentum": self.momentum}
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:
@@ -206,26 +242,23 @@ class SGD(Optimizer):
             else:
                 _assign(weight, weight._data.at[rows].add(-lr * g))
             return
-        g = (grad.todense()._data * self.rescale_grad
-             if isinstance(grad, RowSparseNDArray)
-             else self._preprocess_grad(grad))
-        g = g + wd * weight._data
-        if state is not None:
-            m = self.momentum * state._data - lr * g
-            state._data = m
-            _assign(weight, weight._data + m)
-        else:
-            _assign(weight, weight._data - lr * g)
+        self._dense_update(index, weight, grad,
+                           () if state is None else (state,))
 
 
 @register
 class Signum(Optimizer):
     """Parity: optimizer.py Signum (signSGD + momentum variant)."""
 
+    rule_name = "signum"
+
     def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.momentum = momentum
         self.wd_lh = wd_lh
+
+    def rule_hyper(self):
+        return {"momentum": self.momentum, "wd_lh": self.wd_lh}
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:
@@ -234,26 +267,23 @@ class Signum(Optimizer):
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        g = self._preprocess_grad(grad)
-        if state is not None:
-            m = self.momentum * state._data - (1 - self.momentum) * (
-                g + wd * weight._data)
-            state._data = m
-            new_w = (1 - lr * self.wd_lh) * weight._data + lr * jnp.sign(m)
-        else:
-            new_w = (1 - lr * (wd + self.wd_lh)) * weight._data - \
-                lr * jnp.sign(g)
-        _assign(weight, new_w)
+        self._dense_update(index, weight, grad,
+                           () if state is None else (state,))
 
 
 @register
 class FTML(Optimizer):
     """Parity: optimizer.py FTML (Follow The Moving Leader)."""
 
+    rule_name = "ftml"
+
     def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
         super().__init__(**kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def rule_hyper(self):
+        return {"beta1": self.beta1, "beta2": self.beta2,
+                "epsilon": self.epsilon}
 
     def create_state(self, index, weight):
         z = jnp.zeros(weight.shape, dtype=weight._data.dtype)
@@ -261,18 +291,7 @@ class FTML(Optimizer):
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        t = self._index_update_count[index]
-        g = self._preprocess_grad(grad) + wd * weight._data
-        d, v, z = state
-        v_t = self.beta2 * v._data + (1 - self.beta2) * jnp.square(g)
-        d_t = (1 - self.beta1 ** t) / lr * (
-            jnp.sqrt(v_t / (1 - self.beta2 ** t)) + self.epsilon)
-        sigma_t = d_t - self.beta1 * d._data
-        z_t = self.beta1 * z._data + (1 - self.beta1) * g - \
-            sigma_t * weight._data
-        v._data, d._data, z._data = v_t, d_t, z_t
-        _assign(weight, -z_t / d_t)
+        self._dense_update(index, weight, grad, state)
 
 
 @register
@@ -289,34 +308,35 @@ class LBSGD(Optimizer):
         self.batch_scale = batch_scale
         self.updates_per_epoch = updates_per_epoch
 
+    rule_name = "lbsgd"
+
+    def rule_hyper(self):
+        return {"momentum": self.momentum,
+                "warmup_epochs": self.warmup_epochs,
+                "updates_per_epoch": self.updates_per_epoch}
+
     def create_state(self, index, weight):
         return NDArray(jnp.zeros(weight.shape, dtype=weight._data.dtype))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        warm_steps = self.warmup_epochs * self.updates_per_epoch
-        if self.num_update < warm_steps:
-            lr = lr * self.num_update / max(1, warm_steps)
-        g = self._preprocess_grad(grad)
-        wnorm = jnp.linalg.norm(weight._data)
-        gnorm = jnp.linalg.norm(g)
-        phi = jnp.where((wnorm > 0) & (gnorm > 0),
-                        wnorm / (gnorm + wd * wnorm + 1e-12), 1.0)
-        g = g + wd * weight._data
-        m = self.momentum * state._data - lr * phi * g
-        state._data = m
-        _assign(weight, weight._data + m)
+        # warmup is driven by the global update count (reference semantics)
+        self._dense_update(index, weight, grad, (state,), t=self.num_update)
 
 
 @register
 class DCASGD(Optimizer):
     """Delay-compensated async SGD (parity: optimizer.py DCASGD)."""
 
+    rule_name = "dcasgd"
+
     def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
         self.lamda = lamda
+
+    def rule_hyper(self):
+        return {"momentum": self.momentum, "lamda": self.lamda}
 
     def create_state(self, index, weight):
         mom = None if self.momentum == 0.0 else \
@@ -326,28 +346,23 @@ class DCASGD(Optimizer):
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        g = self._preprocess_grad(grad)
         mom, prev = state
-        comp = g + wd * weight._data + self.lamda * g * g * (
-            weight._data - prev._data)
-        if mom is not None:
-            m = self.momentum * mom._data - lr * comp
-            mom._data = m
-            new_w = weight._data + m
-        else:
-            new_w = weight._data - lr * comp
-        prev._data = weight._data
-        _assign(weight, new_w)
+        states = (prev,) if mom is None else (mom, prev)
+        self._dense_update(index, weight, grad, states)
 
 
 @register
 class NAG(Optimizer):
     """Nesterov accelerated SGD (parity: optimizer.py NAG)."""
 
+    rule_name = "nag"
+
     def __init__(self, momentum=0.0, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
+
+    def rule_hyper(self):
+        return {"momentum": self.momentum}
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:
@@ -356,29 +371,20 @@ class NAG(Optimizer):
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        g = self._preprocess_grad(grad) + wd * weight._data
-        if state is not None:
-            m = self.momentum * state._data + g
-            state._data = m
-            _assign(weight, weight._data - lr * (g + self.momentum * m))
-        else:
-            _assign(weight, weight._data - lr * g)
+        self._dense_update(index, weight, grad,
+                           () if state is None else (state,))
 
 
 @register
 class SGLD(Optimizer):
     """Stochastic Gradient Langevin Dynamics (parity: optimizer.py SGLD)."""
 
+    rule_name = "sgld"
+
     def update(self, index, weight, grad, state):
         self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        g = self._preprocess_grad(grad) + wd * weight._data
         from . import random as _rng
-        import jax
-        noise = jax.random.normal(_rng.next_key(), weight.shape,
-                                  dtype=weight._data.dtype) * math.sqrt(lr)
-        _assign(weight, weight._data - lr / 2 * g + noise)
+        self._dense_update(index, weight, grad, (), key=_rng.next_key())
 
 
 @register
@@ -390,11 +396,17 @@ class ccSGD(SGD):
 class Adam(Optimizer):
     """Parity: optimizer.py Adam + adam_update kernels; lazy sparse update."""
 
+    rule_name = "adam"
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
         self.lazy_update = lazy_update
+
+    def rule_hyper(self):
+        return {"beta1": self.beta1, "beta2": self.beta2,
+                "epsilon": self.epsilon}
 
     def create_state(self, index, weight):
         z = jnp.zeros(weight.shape, dtype=weight._data.dtype)
@@ -402,14 +414,13 @@ class Adam(Optimizer):
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        t = self._index_update_count[index]
-        coef1 = 1.0 - self.beta1 ** t
-        coef2 = 1.0 - self.beta2 ** t
-        lr_t = lr * math.sqrt(coef2) / coef1
-        mean, var = state
         sparse = self._sparse_rows(grad)
         if sparse is not None and self.lazy_update:
+            lr, wd = self._get_lr(index), self._get_wd(index)
+            t = self._index_update_count[index]
+            lr_t = lr * math.sqrt(1.0 - self.beta2 ** t) / \
+                (1.0 - self.beta1 ** t)
+            mean, var = state
             rows, g = sparse
             g = g + wd * weight._data[rows]
             m_r = self.beta1 * mean._data[rows] + (1 - self.beta1) * g
@@ -419,32 +430,26 @@ class Adam(Optimizer):
             upd = lr_t * m_r / (jnp.sqrt(v_r) + self.epsilon)
             _assign(weight, weight._data.at[rows].add(-upd))
             return
-        g = (grad.todense()._data * self.rescale_grad
-             if isinstance(grad, RowSparseNDArray)
-             else self._preprocess_grad(grad))
-        g = g + wd * weight._data
-        mean._data = self.beta1 * mean._data + (1 - self.beta1) * g
-        var._data = self.beta2 * var._data + (1 - self.beta2) * jnp.square(g)
-        _assign(weight, weight._data -
-                lr_t * mean._data / (jnp.sqrt(var._data) + self.epsilon))
+        self._dense_update(index, weight, grad, state)
 
 
 @register
 class AdaGrad(Optimizer):
+    rule_name = "adagrad"
+
     def __init__(self, eps=1e-7, **kwargs):
         super().__init__(**kwargs)
         self.float_stable_eps = eps
+
+    def rule_hyper(self):
+        return {"eps": self.float_stable_eps}
 
     def create_state(self, index, weight):
         return NDArray(jnp.zeros(weight.shape, dtype=weight._data.dtype))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        g = self._preprocess_grad(grad) + wd * weight._data
-        state._data = state._data + jnp.square(g)
-        _assign(weight, weight._data -
-                lr * g / jnp.sqrt(state._data + self.float_stable_eps))
+        self._dense_update(index, weight, grad, (state,))
 
 
 @register
@@ -459,6 +464,13 @@ class RMSProp(Optimizer):
         self.epsilon = epsilon
         self.clip_weights = clip_weights
 
+    rule_name = "rmsprop"
+
+    def rule_hyper(self):
+        return {"gamma1": self.gamma1, "gamma2": self.gamma2,
+                "epsilon": self.epsilon, "centered": self.centered,
+                "clip_weights": self.clip_weights}
+
     def create_state(self, index, weight):
         z = jnp.zeros(weight.shape, dtype=weight._data.dtype)
         if self.centered:
@@ -467,29 +479,19 @@ class RMSProp(Optimizer):
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        g = self._preprocess_grad(grad) + wd * weight._data
-        if self.centered:
-            n, gbar, delta = state
-            n._data = (1 - self.gamma1) * jnp.square(g) + self.gamma1 * n._data
-            gbar._data = (1 - self.gamma1) * g + self.gamma1 * gbar._data
-            delta._data = self.gamma2 * delta._data - lr * g / jnp.sqrt(
-                n._data - jnp.square(gbar._data) + self.epsilon)
-            new_w = weight._data + delta._data
-        else:
-            (n,) = state
-            n._data = (1 - self.gamma1) * jnp.square(g) + self.gamma1 * n._data
-            new_w = weight._data - lr * g / jnp.sqrt(n._data + self.epsilon)
-        if self.clip_weights:
-            new_w = jnp.clip(new_w, -self.clip_weights, self.clip_weights)
-        _assign(weight, new_w)
+        self._dense_update(index, weight, grad, state)
 
 
 @register
 class AdaDelta(Optimizer):
+    rule_name = "adadelta"
+
     def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
         super().__init__(**kwargs)
         self.rho, self.epsilon = rho, epsilon
+
+    def rule_hyper(self):
+        return {"rho": self.rho, "epsilon": self.epsilon}
 
     def create_state(self, index, weight):
         z = jnp.zeros(weight.shape, dtype=weight._data.dtype)
@@ -497,22 +499,20 @@ class AdaDelta(Optimizer):
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
-        wd = self._get_wd(index)
-        g = self._preprocess_grad(grad) + wd * weight._data
-        acc_g, acc_d = state
-        acc_g._data = self.rho * acc_g._data + (1 - self.rho) * jnp.square(g)
-        delta = jnp.sqrt(acc_d._data + self.epsilon) / \
-            jnp.sqrt(acc_g._data + self.epsilon) * g
-        acc_d._data = self.rho * acc_d._data + (1 - self.rho) * jnp.square(delta)
-        _assign(weight, weight._data - delta)
+        self._dense_update(index, weight, grad, state)
 
 
 @register
 class Ftrl(Optimizer):
+    rule_name = "ftrl"
+
     def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.lamda1 = lamda1
         self.beta = beta
+
+    def rule_hyper(self):
+        return {"lamda1": self.lamda1, "beta": self.beta}
 
     def create_state(self, index, weight):
         z = jnp.zeros(weight.shape, dtype=weight._data.dtype)
@@ -520,24 +520,19 @@ class Ftrl(Optimizer):
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        g = self._preprocess_grad(grad)
-        z, n = state
-        sigma = (jnp.sqrt(n._data + jnp.square(g)) - jnp.sqrt(n._data)) / lr
-        z._data = z._data + g - sigma * weight._data
-        n._data = n._data + jnp.square(g)
-        new_w = jnp.where(
-            jnp.abs(z._data) <= self.lamda1, 0.0,
-            -(z._data - jnp.sign(z._data) * self.lamda1) /
-            ((self.beta + jnp.sqrt(n._data)) / lr + wd))
-        _assign(weight, new_w)
+        self._dense_update(index, weight, grad, state)
 
 
 @register
 class Adamax(Optimizer):
+    rule_name = "adamax"
+
     def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2 = beta1, beta2
+
+    def rule_hyper(self):
+        return {"beta1": self.beta1, "beta2": self.beta2}
 
     def create_state(self, index, weight):
         z = jnp.zeros(weight.shape, dtype=weight._data.dtype)
@@ -545,60 +540,50 @@ class Adamax(Optimizer):
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        t = self._index_update_count[index]
-        lr /= (1.0 - self.beta1 ** t)
-        g = self._preprocess_grad(grad) + wd * weight._data
-        m, u = state
-        m._data = self.beta1 * m._data + (1 - self.beta1) * g
-        u._data = jnp.maximum(self.beta2 * u._data, jnp.abs(g))
-        _assign(weight, weight._data - lr * m._data / (u._data + 1e-8))
+        self._dense_update(index, weight, grad, state)
 
 
 @register
 class Nadam(Optimizer):
+    """Nadam. Unlike the reference (which keeps one Python-float m_schedule
+    shared across ALL parameters — a cross-parameter leak), m_schedule is
+    per-parameter state, the mathematically intended form."""
+
+    rule_name = "nadam"
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, schedule_decay=0.004, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
         self.schedule_decay = schedule_decay
-        self.m_schedule = 1.0
+
+    def rule_hyper(self):
+        return {"beta1": self.beta1, "beta2": self.beta2,
+                "epsilon": self.epsilon,
+                "schedule_decay": self.schedule_decay}
 
     def create_state(self, index, weight):
         z = jnp.zeros(weight.shape, dtype=weight._data.dtype)
-        return (NDArray(z), NDArray(z))
+        return (NDArray(z), NDArray(z),
+                NDArray(jnp.ones((), dtype=weight._data.dtype)))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        t = self._index_update_count[index]
-        g = self._preprocess_grad(grad) + wd * weight._data
-        mom_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
-        mom_tp1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
-        self.m_schedule = self.m_schedule * mom_t
-        m_sched_next = self.m_schedule * mom_tp1
-        m, v = state
-        gp = g / (1.0 - self.m_schedule)
-        m._data = self.beta1 * m._data + (1 - self.beta1) * g
-        v._data = self.beta2 * v._data + (1 - self.beta2) * jnp.square(g)
-        m_hat = m._data / (1.0 - m_sched_next)
-        v_hat = v._data / (1.0 - self.beta2 ** t)
-        m_bar = (1.0 - mom_t) * gp + mom_tp1 * m_hat
-        _assign(weight, weight._data -
-                lr * m_bar / (jnp.sqrt(v_hat) + self.epsilon))
+        self._dense_update(index, weight, grad, state)
 
 
 @register
 class Test(Optimizer):
     """Parity: optimizer.py Test — trivial optimizer used by unit tests."""
 
+    rule_name = "test"
+
     def create_state(self, index, weight):
         return NDArray(jnp.zeros(weight.shape, dtype=weight._data.dtype))
 
     def update(self, index, weight, grad, state):
-        g = self._preprocess_grad(grad)  # already applies rescale_grad
-        _assign(weight, weight._data + g)
-        state._data = weight._data
+        self._update_count(index)
+        self._dense_update(index, weight, grad, (state,))
 
 
 class Updater:

@@ -1,0 +1,288 @@
+"""Pure optimizer update rules shared by every execution path.
+
+Parity: the reference implements each optimizer twice — python math
+(`python/mxnet/optimizer.py:35-1453`) and fused C++ kernels
+(`src/operator/optimizer_op-inl.h`). Here there is ONE implementation per
+optimizer: a pure (weight, grad, state) -> (new_weight, new_state) function
+in jnp. The eager classes in optimizer.py delegate their dense paths to
+these rules, and parallel.trainer.TrainStep closes them into the donated
+fused XLA step — so the fused path supports every registered optimizer and
+matches the eager path exactly (tested in tests/test_trainstep_optimizers.py).
+
+Signatures:
+    init(w, h)                          -> tuple of state arrays (may be ())
+    apply(w, g, state, lr, wd, t, h, key=None) -> (new_w, new_state)
+
+where `g` is the incoming gradient with rescale/clipping already applied
+(NOT weight decay — each rule applies wd the way its reference class does),
+`lr`/`wd`/`t` may be tracers (t is the 1-based update count), `h` is a dict
+of static hyper-parameters, and `key` is a PRNG key for stochastic rules
+(SGLD). All state is carried in the returned tuple — including Nadam's
+m_schedule, which the reference keeps as a single Python float shared by
+every parameter (a cross-parameter leak); here it is per-parameter state,
+the mathematically intended form.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _zeros(w):
+    return jnp.zeros_like(w)
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+def _sgd_init(w, h):
+    return (_zeros(w),) if h.get("momentum", 0.0) else ()
+
+
+def _sgd_apply(w, g, state, lr, wd, t, h, key=None):
+    g = g + wd * w
+    if state:
+        m = h["momentum"] * state[0] - lr * g
+        return w + m, (m,)
+    return w - lr * g, state
+
+
+def _signum_init(w, h):
+    return (_zeros(w),) if h.get("momentum", 0.0) else ()
+
+
+def _signum_apply(w, g, state, lr, wd, t, h, key=None):
+    wd_lh = h.get("wd_lh", 0.0)
+    if state:
+        m = h["momentum"] * state[0] - (1 - h["momentum"]) * (g + wd * w)
+        return (1 - lr * wd_lh) * w + lr * jnp.sign(m), (m,)
+    return (1 - lr * (wd + wd_lh)) * w - lr * jnp.sign(g), state
+
+
+def _ftml_init(w, h):
+    return (_zeros(w), _zeros(w), _zeros(w))  # d, v, z
+
+
+def _ftml_apply(w, g, state, lr, wd, t, h, key=None):
+    b1, b2, eps = h.get("beta1", 0.6), h.get("beta2", 0.999), \
+        h.get("epsilon", 1e-8)
+    g = g + wd * w
+    d, v, z = state
+    v_t = b2 * v + (1 - b2) * jnp.square(g)
+    d_t = (1 - b1 ** t) / lr * (jnp.sqrt(v_t / (1 - b2 ** t)) + eps)
+    sigma_t = d_t - b1 * d
+    z_t = b1 * z + (1 - b1) * g - sigma_t * w
+    return -z_t / d_t, (d_t, v_t, z_t)
+
+
+def _lbsgd_init(w, h):
+    return (_zeros(w),)
+
+
+def _lbsgd_apply(w, g, state, lr, wd, t, h, key=None):
+    warm_steps = h.get("warmup_epochs", 5) * h.get("updates_per_epoch", 32)
+    lr = lr * jnp.minimum(t / max(1, warm_steps), 1.0)
+    wnorm = jnp.linalg.norm(w)
+    gnorm = jnp.linalg.norm(g)
+    phi = jnp.where((wnorm > 0) & (gnorm > 0),
+                    wnorm / (gnorm + wd * wnorm + 1e-12), 1.0)
+    g = g + wd * w
+    m = h.get("momentum", 0.0) * state[0] - lr * phi * g
+    return w + m, (m,)
+
+
+def _dcasgd_init(w, h):
+    mom = (_zeros(w),) if h.get("momentum", 0.0) else ()
+    return mom + (w + 0,)  # (momentum?, prev_weight)
+
+
+def _dcasgd_apply(w, g, state, lr, wd, t, h, key=None):
+    lamda = h.get("lamda", 0.04)
+    prev = state[-1]
+    comp = g + wd * w + lamda * g * g * (w - prev)
+    if len(state) == 2:
+        m = h["momentum"] * state[0] - lr * comp
+        return w + m, (m, w)
+    return w - lr * comp, (w,)
+
+
+def _nag_init(w, h):
+    return (_zeros(w),) if h.get("momentum", 0.0) else ()
+
+
+def _nag_apply(w, g, state, lr, wd, t, h, key=None):
+    g = g + wd * w
+    if state:
+        m = h["momentum"] * state[0] + g
+        return w - lr * (g + h["momentum"] * m), (m,)
+    return w - lr * g, state
+
+
+def _sgld_init(w, h):
+    return ()
+
+
+def _sgld_apply(w, g, state, lr, wd, t, h, key=None):
+    g = g + wd * w
+    noise = jax.random.normal(key, w.shape, dtype=w.dtype) * jnp.sqrt(lr)
+    return w - lr / 2 * g + noise, state
+
+
+def _adam_init(w, h):
+    return (_zeros(w), _zeros(w))  # mean, var
+
+
+def _adam_apply(w, g, state, lr, wd, t, h, key=None):
+    b1, b2, eps = h.get("beta1", 0.9), h.get("beta2", 0.999), \
+        h.get("epsilon", 1e-8)
+    g = g + wd * w
+    m, v = state
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+    return w - lr_t * m / (jnp.sqrt(v) + eps), (m, v)
+
+
+def _adagrad_init(w, h):
+    return (_zeros(w),)
+
+
+def _adagrad_apply(w, g, state, lr, wd, t, h, key=None):
+    eps = h.get("eps", 1e-7)
+    g = g + wd * w
+    n = state[0] + jnp.square(g)
+    return w - lr * g / jnp.sqrt(n + eps), (n,)
+
+
+def _rmsprop_init(w, h):
+    if h.get("centered", False):
+        return (_zeros(w), _zeros(w), _zeros(w))  # n, g_bar, delta
+    return (_zeros(w),)
+
+
+def _rmsprop_apply(w, g, state, lr, wd, t, h, key=None):
+    g1, g2 = h.get("gamma1", 0.9), h.get("gamma2", 0.9)
+    eps = h.get("epsilon", 1e-8)
+    clip_w = h.get("clip_weights", None)
+    g = g + wd * w
+    if h.get("centered", False):
+        n, gbar, delta = state
+        n = (1 - g1) * jnp.square(g) + g1 * n
+        gbar = (1 - g1) * g + g1 * gbar
+        delta = g2 * delta - lr * g / jnp.sqrt(
+            n - jnp.square(gbar) + eps)
+        new_w, new_state = w + delta, (n, gbar, delta)
+    else:
+        n = (1 - g1) * jnp.square(g) + g1 * state[0]
+        new_w, new_state = w - lr * g / jnp.sqrt(n + eps), (n,)
+    if clip_w:
+        new_w = jnp.clip(new_w, -clip_w, clip_w)
+    return new_w, new_state
+
+
+def _adadelta_init(w, h):
+    return (_zeros(w), _zeros(w))  # acc_g, acc_delta
+
+
+def _adadelta_apply(w, g, state, lr, wd, t, h, key=None):
+    rho, eps = h.get("rho", 0.90), h.get("epsilon", 1e-5)
+    g = g + wd * w
+    acc_g, acc_d = state
+    acc_g = rho * acc_g + (1 - rho) * jnp.square(g)
+    delta = jnp.sqrt(acc_d + eps) / jnp.sqrt(acc_g + eps) * g
+    acc_d = rho * acc_d + (1 - rho) * jnp.square(delta)
+    return w - delta, (acc_g, acc_d)
+
+
+def _ftrl_init(w, h):
+    return (_zeros(w), _zeros(w))  # z, n
+
+
+def _ftrl_apply(w, g, state, lr, wd, t, h, key=None):
+    lamda1, beta = h.get("lamda1", 0.01), h.get("beta", 1)
+    z, n = state
+    sigma = (jnp.sqrt(n + jnp.square(g)) - jnp.sqrt(n)) / lr
+    z = z + g - sigma * w
+    n = n + jnp.square(g)
+    new_w = jnp.where(
+        jnp.abs(z) <= lamda1, 0.0,
+        -(z - jnp.sign(z) * lamda1) / ((beta + jnp.sqrt(n)) / lr + wd))
+    return new_w, (z, n)
+
+
+def _adamax_init(w, h):
+    return (_zeros(w), _zeros(w))  # m, u
+
+
+def _adamax_apply(w, g, state, lr, wd, t, h, key=None):
+    b1, b2 = h.get("beta1", 0.9), h.get("beta2", 0.999)
+    lr = lr / (1.0 - b1 ** t)
+    g = g + wd * w
+    m, u = state
+    m = b1 * m + (1 - b1) * g
+    u = jnp.maximum(b2 * u, jnp.abs(g))
+    return w - lr * m / (u + 1e-8), (m, u)
+
+
+def _nadam_init(w, h):
+    # per-parameter m_schedule (see module docstring re: reference quirk)
+    return (_zeros(w), _zeros(w), jnp.ones((), dtype=w.dtype))
+
+
+def _nadam_apply(w, g, state, lr, wd, t, h, key=None):
+    b1, b2, eps = h.get("beta1", 0.9), h.get("beta2", 0.999), \
+        h.get("epsilon", 1e-8)
+    sd = h.get("schedule_decay", 0.004)
+    g = g + wd * w
+    m, v, m_sched = state
+    mom_t = b1 * (1.0 - 0.5 * 0.96 ** (t * sd))
+    mom_tp1 = b1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * sd))
+    m_sched = m_sched * mom_t
+    m_sched_next = m_sched * mom_tp1
+    gp = g / (1.0 - m_sched)
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * jnp.square(g)
+    m_hat = m / (1.0 - m_sched_next)
+    v_hat = v / (1.0 - b2 ** t)
+    m_bar = (1.0 - mom_t) * gp + mom_tp1 * m_hat
+    return w - lr * m_bar / (jnp.sqrt(v_hat) + eps), (m, v, m_sched)
+
+
+def _test_init(w, h):
+    return (_zeros(w),)
+
+
+def _test_apply(w, g, state, lr, wd, t, h, key=None):
+    new_w = w + g
+    return new_w, (new_w,)
+
+
+RULES = {
+    "sgd": (_sgd_init, _sgd_apply),
+    "ccsgd": (_sgd_init, _sgd_apply),
+    "signum": (_signum_init, _signum_apply),
+    "ftml": (_ftml_init, _ftml_apply),
+    "lbsgd": (_lbsgd_init, _lbsgd_apply),
+    "dcasgd": (_dcasgd_init, _dcasgd_apply),
+    "nag": (_nag_init, _nag_apply),
+    "sgld": (_sgld_init, _sgld_apply),
+    "adam": (_adam_init, _adam_apply),
+    "adagrad": (_adagrad_init, _adagrad_apply),
+    "rmsprop": (_rmsprop_init, _rmsprop_apply),
+    "adadelta": (_adadelta_init, _adadelta_apply),
+    "ftrl": (_ftrl_init, _ftrl_apply),
+    "adamax": (_adamax_init, _adamax_apply),
+    "nadam": (_nadam_init, _nadam_apply),
+    "test": (_test_init, _test_apply),
+}
+
+STOCHASTIC = {"sgld"}
+
+
+def get(name):
+    """Return (init, apply) for a registered optimizer name."""
+    key = name.lower()
+    if key not in RULES:
+        raise ValueError("no pure update rule for optimizer %r" % name)
+    return RULES[key]

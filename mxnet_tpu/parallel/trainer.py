@@ -8,6 +8,20 @@ nothing round-trips to the host. Under a mesh, the batch shards over 'dp'
 parameters stay replicated (or sharded for tensor parallelism via
 param_shardings).
 
+Every registered optimizer fuses: the update math lives once, as pure rules
+in mxnet_tpu.optimizer_rules, shared with the eager classes — the analog of
+the reference's fused optimizer kernels (src/operator/optimizer_op-inl.h)
+covering the full optimizer list instead of a subset.
+
+Mixed precision (dtype="bfloat16"): forward/backward compute in bf16 on the
+MXU with float32 master weights and optimizer state; logits are promoted to
+f32 before the loss for a stable softmax. This is the reference's
+multi_precision fp16 capability (optimizer.py:483) in its TPU-native form.
+
+Rematerialisation (remat=True): wraps the forward in jax.checkpoint so the
+backward pass recomputes activations instead of storing them — the
+MXNET_BACKWARD_DO_MIRROR capability (docs/faq/env_var.md:93).
+
 Parity note: the reference overlapped backward with kvstore pushes via
 engine priorities (src/kvstore/comm.h:171); XLA's latency-hiding scheduler
 performs the same overlap inside this single program.
@@ -21,51 +35,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..ndarray import NDArray
 from .. import autograd
 from .. import random as _random
-
-
-# -- pure optimizer rules (lr and t arrive as tracers, so no retrace/step) --
-
-def _sgd_init(w, momentum):
-    return (jnp.zeros_like(w),) if momentum else ()
-
-
-def _sgd_apply(w, g, state, lr, t, momentum, wd, hyper):
-    g = g + wd * w
-    if state:
-        m = momentum * state[0] - lr * g
-        return w + m, (m,)
-    return w - lr * g, state
-
-
-def _nag_init(w, momentum):
-    return (jnp.zeros_like(w),)
-
-
-def _nag_apply(w, g, state, lr, t, momentum, wd, hyper):
-    g = g + wd * w
-    m = momentum * state[0] + g
-    return w - lr * (g + momentum * m), (m,)
-
-
-def _adam_init(w, momentum):
-    return (jnp.zeros_like(w), jnp.zeros_like(w))
-
-
-def _adam_apply(w, g, state, lr, t, momentum, wd, hyper):
-    beta1 = hyper.get("beta1", 0.9)
-    beta2 = hyper.get("beta2", 0.999)
-    eps = hyper.get("epsilon", 1e-8)
-    g = g + wd * w
-    m, v = state
-    m = beta1 * m + (1 - beta1) * g
-    v = beta2 * v + (1 - beta2) * jnp.square(g)
-    lr_t = lr * jnp.sqrt(1 - beta2 ** t) / (1 - beta1 ** t)
-    return w - lr_t * m / (jnp.sqrt(v) + eps), (m, v)
-
-
-_RULES = {"sgd": (_sgd_init, _sgd_apply),
-          "nag": (_nag_init, _nag_apply),
-          "adam": (_adam_init, _adam_apply)}
+from .. import optimizer_rules as _rules
 
 
 class TrainStep:
@@ -79,23 +49,25 @@ class TrainStep:
     """
 
     def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
-                 mesh=None, data_axis="dp", param_shardings=None):
+                 mesh=None, data_axis="dp", param_shardings=None,
+                 dtype="float32", remat=False):
+        from .. import optimizer as _opt_mod
         self._net = net
         self._loss = loss_fn
-        optimizer_params = dict(optimizer_params or {})
-        self._lr = float(optimizer_params.pop("learning_rate", 0.01))
-        self._momentum = float(optimizer_params.pop("momentum", 0.0))
-        self._wd = float(optimizer_params.pop("wd", 0.0))
-        self._hyper = optimizer_params
-        self._opt_name = optimizer if isinstance(optimizer, str) else \
-            type(optimizer).__name__.lower()
-        if self._opt_name not in _RULES:
-            raise ValueError(
-                "TrainStep fuses %s; use gluon.Trainer for other optimizers"
-                % sorted(_RULES))
+        if isinstance(optimizer, str):
+            optimizer = _opt_mod.create(optimizer,
+                                        **dict(optimizer_params or {}))
+        elif optimizer_params:
+            raise ValueError("pass optimizer_params only with a string name")
+        if optimizer.rule_name is None:
+            raise ValueError("optimizer %s has no pure update rule"
+                             % type(optimizer).__name__)
+        self._opt = optimizer
         self._mesh = mesh
         self._data_axis = data_axis
         self._param_shardings = param_shardings or {}
+        self._compute_dtype = jnp.dtype(dtype)
+        self._remat = remat
         self._lr_schedule = None
         self._t = 0
         self._step_fn = None
@@ -114,8 +86,28 @@ class TrainStep:
             plist.append(p)
         grad_mask = [p.grad_req != "null" for p in plist]
         net, loss_fn = self._net, self._loss
-        init_rule, apply_rule = _RULES[self._opt_name]
-        momentum, wd, hyper = self._momentum, self._wd, self._hyper
+        opt = self._opt
+        init_rule, apply_rule = _rules.get(opt.rule_name)
+        hyper = opt.rule_hyper()
+        stochastic_rule = opt.rule_name in _rules.STOCHASTIC
+        rescale, clip = opt.rescale_grad, opt.clip_gradient
+        # per-param lr/wd multipliers resolve to static floats at build time;
+        # Parameter-level attrs take priority over name dicts, matching the
+        # eager Optimizer._get_lr/_get_wd param_dict branch
+        gparams = [(n, p) for n, p, m in zip(names, plist, grad_mask) if m]
+        gnames_all = [n for n, _ in gparams]
+
+        def _mult(p, n, dct, attr):
+            v = getattr(p, attr, 1.0)
+            if v != 1.0:
+                return v
+            return dct.get(n, 1.0)
+
+        lr_mults = [_mult(p, n, opt.lr_mult, "lr_mult") for n, p in gparams]
+        wd_mults = [_mult(p, n, opt.wd_mult, "wd_mult") for n, p in gparams]
+        base_wd = opt.wd
+        cdtype = self._compute_dtype
+        mixed = cdtype != jnp.float32
 
         def forward_loss(grad_vals, nograd_vals, x, y, key):
             """Trace the eager net with tracer-backed parameter buffers.
@@ -130,23 +122,51 @@ class TrainStep:
                 else:
                     merged.append(nograd_vals[ni])
                     ni += 1
+            if mixed:
+                # bf16 compute, f32 master weights: cast the traced buffers,
+                # so grads flow back through the cast in f32
+                merged = [v.astype(cdtype)
+                          if jnp.issubdtype(v.dtype, jnp.floating) else v
+                          for v in merged]
+                x = x.astype(cdtype) if jnp.issubdtype(
+                    jnp.asarray(x).dtype, jnp.floating) else x
             from .functional import swap_param_buffers
             with swap_param_buffers(plist, merged) as injected:
                 with autograd._RecordingStateScope(False, True), \
                         _random.trace_key_scope(key):
                     out = net.forward(NDArray(x))
+                    if mixed:
+                        # f32 softmax/loss for numerical stability
+                        out = NDArray(out._data.astype(jnp.float32))
                     loss = loss_fn(out, NDArray(y))
-                loss_val = jnp.mean(loss._data)
+                loss_val = jnp.mean(loss._data.astype(jnp.float32))
                 aux_upd = {i: p._data._data for i, p in enumerate(plist)
                            if p._data._data is not injected[i]}
             return loss_val, aux_upd
 
+        if self._remat:
+            # recompute activations in backward (reference capability:
+            # MXNET_BACKWARD_DO_MIRROR) — aux outputs are tiny, so
+            # checkpointing the whole traced forward is fine
+            forward_loss = jax.checkpoint(forward_loss)
+
         def step(grad_vals, nograd_vals, opt_state, x, y, key, lr, t):
+            # independent streams: forward-trace keys (dropout masks etc.)
+            # derive from fwd_key; optimizer noise (SGLD) from noise_key —
+            # fold_in on the SAME base key would collide with the trace keys
+            fwd_key, noise_key = jax.random.split(key)
             (loss_val, aux_upd), grads = jax.value_and_grad(
-                forward_loss, has_aux=True)(grad_vals, nograd_vals, x, y, key)
+                forward_loss, has_aux=True)(grad_vals, nograd_vals, x, y,
+                                            fwd_key)
             new_grad_vals, new_state = [], []
-            for w, g, s in zip(grad_vals, grads, opt_state):
-                w2, s2 = apply_rule(w, g, s, lr, t, momentum, wd, hyper)
+            for i, (w, g, s) in enumerate(zip(grad_vals, grads, opt_state)):
+                g = g.astype(w.dtype) * rescale
+                if clip is not None:
+                    g = jnp.clip(g, -clip, clip)
+                k = jax.random.fold_in(noise_key, i) if stochastic_rule \
+                    else None
+                w2, s2 = apply_rule(w, g, s, lr * lr_mults[i],
+                                    base_wd * wd_mults[i], t, hyper, k)
                 new_grad_vals.append(w2)
                 new_state.append(s2)
             new_nograd_vals = list(nograd_vals)
@@ -154,7 +174,8 @@ class TrainStep:
             for i, has_grad in enumerate(grad_mask):
                 if not has_grad:
                     if i in aux_upd:
-                        new_nograd_vals[ni] = aux_upd[i]
+                        new_nograd_vals[ni] = aux_upd[i].astype(
+                            nograd_vals[ni].dtype)
                     ni += 1
             return (loss_val, tuple(new_grad_vals), tuple(new_nograd_vals),
                     tuple(new_state))
@@ -167,12 +188,14 @@ class TrainStep:
                           for p, m in zip(plist, grad_mask) if m)
         nograd_vals = tuple(p._data._data
                             for p, m in zip(plist, grad_mask) if not m)
-        opt_state = tuple(init_rule(w, self._momentum) for w in grad_vals)
+        opt_state = tuple(init_rule(w, hyper) for w in grad_vals)
         if self._mesh is not None:
             def place(name, v):
                 spec = self._param_shardings.get(name, P())
+                if v.ndim == 0:  # scalar state (e.g. nadam m_schedule)
+                    spec = P()
                 return jax.device_put(v, NamedSharding(self._mesh, spec))
-            gnames = [n for n, m in zip(self._names, grad_mask) if m]
+            gnames = gnames_all
             nnames = [n for n, m in zip(self._names, grad_mask) if not m]
             grad_vals = tuple(place(n, v) for n, v in zip(gnames, grad_vals))
             nograd_vals = tuple(place(n, v)
@@ -194,8 +217,12 @@ class TrainStep:
             xv = shard_batch(self._mesh, xv, self._data_axis)
             yv = shard_batch(self._mesh, yv, self._data_axis)
         self._t += 1
-        lr = self._lr if self._lr_schedule is None else \
-            self._lr_schedule(self._t)
+        if self._lr_schedule is not None:
+            lr = self._lr_schedule(self._t)
+        elif self._opt.lr_scheduler is not None:
+            lr = self._opt.lr_scheduler(self._t)
+        else:
+            lr = self._opt.lr
         key = _random.next_key()
         loss, self._grad_vals, self._nograd_vals, self._opt_state = \
             self._step_fn(self._grad_vals, self._nograd_vals,

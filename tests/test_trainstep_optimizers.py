@@ -1,0 +1,159 @@
+"""Every registered optimizer fuses into TrainStep and matches the eager
+Trainer path exactly (VERDICT r1 item 6; parity target: the reference's
+fused optimizer kernels src/operator/optimizer_op-inl.h cover its full
+optimizer list).
+
+The eager path: loss.backward() accumulates sum-grads, Trainer.step(batch)
+sets rescale_grad=1/batch -> mean grads. The fused path takes grads of the
+mean loss directly. Both then apply the SAME pure rule from
+mxnet_tpu.optimizer_rules, so final parameters must agree to fp tolerance.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn, loss as gloss
+from mxnet_tpu.optimizer import Optimizer
+from mxnet_tpu.parallel.trainer import TrainStep
+
+BATCH, DIN, DOUT, STEPS = 8, 6, 4, 3
+
+# hyper-params chosen so every rule takes a non-trivial path
+_OPT_PARAMS = {
+    "sgd": {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-3},
+    "ccsgd": {"learning_rate": 0.1, "momentum": 0.9},
+    "signum": {"learning_rate": 0.05, "momentum": 0.9, "wd_lh": 1e-3},
+    "ftml": {"learning_rate": 0.02},
+    "lbsgd": {"learning_rate": 0.1, "momentum": 0.9},
+    "dcasgd": {"learning_rate": 0.05, "momentum": 0.9},
+    "nag": {"learning_rate": 0.1, "momentum": 0.9},
+    "sgld": {"learning_rate": 0.01},
+    "adam": {"learning_rate": 0.01},
+    "adagrad": {"learning_rate": 0.1},
+    "rmsprop": {"learning_rate": 0.01, "centered": True},
+    "adadelta": {},
+    "ftrl": {"learning_rate": 0.1, "lamda1": 1e-4},
+    "adamax": {"learning_rate": 0.01},
+    "nadam": {"learning_rate": 0.01},
+    "test": {},
+}
+
+
+def _make_net(seed):
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = nn.HybridSequential(prefix="ts%d_" % seed)
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu"))
+        net.add(nn.Dense(DOUT))
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.zeros((1, DIN)))
+    return net
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    xs = [rng.uniform(-1, 1, (BATCH, DIN)).astype(np.float32)
+          for _ in range(STEPS)]
+    ys = [rng.randint(0, DOUT, (BATCH,)).astype(np.int32)
+          for _ in range(STEPS)]
+    return xs, ys
+
+
+@pytest.mark.parametrize("opt_name", sorted(Optimizer.opt_registry))
+def test_fused_matches_eager(opt_name):
+    params = dict(_OPT_PARAMS.get(opt_name, {"learning_rate": 0.05}))
+    xs, ys = _data()
+    L = gloss.SoftmaxCrossEntropyLoss()
+
+    # eager path
+    net_e = _make_net(11)
+    trainer = gluon.Trainer(net_e.collect_params(), opt_name, dict(params),
+                            kvstore=None)
+    for x, y in zip(xs, ys):
+        with autograd.record():
+            loss = L(net_e(mx.nd.array(x)), mx.nd.array(y))
+        loss.backward()
+        trainer.step(BATCH)
+
+    # fused path (same init by seed)
+    net_f = _make_net(11)
+    step = TrainStep(net_f, L, opt_name, dict(params))
+    for x, y in zip(xs, ys):
+        step(x, y)
+    step.sync_params()
+
+    pe = net_e.collect_params()
+    pf = net_f.collect_params()
+    assert sorted(pe) == sorted(pf)
+    for name in pe:
+        a, b = pe[name].data().asnumpy(), pf[name].data().asnumpy()
+        if opt_name == "sgld":
+            # stochastic rule: keys differ between paths by construction —
+            # check the update moved the weights and stayed finite
+            assert np.all(np.isfinite(b))
+            assert not np.allclose(
+                b, _make_net(11).collect_params()[name].data().asnumpy())
+        else:
+            np.testing.assert_allclose(
+                a, b, rtol=2e-4, atol=2e-5,
+                err_msg="%s diverged for %s" % (name, opt_name))
+
+
+def test_trainstep_accepts_optimizer_instance():
+    from mxnet_tpu import optimizer as opt_mod
+    net = _make_net(13)
+    opt = opt_mod.create("adam", learning_rate=0.01)
+    step = TrainStep(net, gloss.SoftmaxCrossEntropyLoss(), opt)
+    xs, ys = _data()
+    l0 = float(step(xs[0], ys[0]))
+    l1 = float(step(xs[0], ys[0]))
+    assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0
+
+
+def test_trainstep_bf16_mixed_precision():
+    """bf16 compute with f32 master weights trains and keeps params f32."""
+    net = _make_net(17)
+    step = TrainStep(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+                     {"learning_rate": 0.1, "momentum": 0.9},
+                     dtype="bfloat16")
+    xs, ys = _data()
+    losses = [float(step(x, y)) for x, y in zip(xs * 4, ys * 4)]
+    assert losses[-1] < losses[0]
+    step.sync_params()
+    for p in net.collect_params().values():
+        assert p.data().dtype == np.float32
+
+
+def test_trainstep_honors_parameter_wd_mult():
+    """Parameter-level lr_mult/wd_mult (standard no-decay-on-bias) must give
+    the same weights on the fused path as on the eager gluon.Trainer path."""
+    xs, ys = _data()
+    L = gloss.SoftmaxCrossEntropyLoss()
+    params = {"learning_rate": 0.1, "momentum": 0.9, "wd": 0.01}
+
+    def run(fused):
+        net = _make_net(23)
+        for name, p in net.collect_params().items():
+            if name.endswith("bias"):
+                p.wd_mult = 0.0
+        if fused:
+            step = TrainStep(net, L, "sgd", dict(params))
+            for x, y in zip(xs, ys):
+                step(x, y)
+            step.sync_params()
+        else:
+            tr = gluon.Trainer(net.collect_params(), "sgd", dict(params),
+                               kvstore=None)
+            for x, y in zip(xs, ys):
+                with autograd.record():
+                    loss = L(net(mx.nd.array(x)), mx.nd.array(y))
+                loss.backward()
+                tr.step(BATCH)
+        return {k: v.data().asnumpy() for k, v in net.collect_params().items()}
+
+    eager, fused = run(False), run(True)
+    for name in eager:
+        np.testing.assert_allclose(eager[name], fused[name],
+                                   rtol=2e-4, atol=2e-5, err_msg=name)
