@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from .base import MXNetError
 from .context import current_context
 from . import random as _random
+from . import profiler as _profiler
 from .ndarray import NDArray
 
 
@@ -126,7 +127,10 @@ class Executor:
         aux = {n: a._data for n, a in self.aux_dict.items()}
         key = _random.next_key()
         self._last_key = key
-        outs, new_aux = self._fwd(values, aux, key, train=bool(is_train))
+        with _profiler.scope("Executor::forward", "executor"):
+            outs, new_aux = self._fwd(values, aux, key, train=bool(is_train))
+            if _profiler.profile_sync():
+                jax.block_until_ready(outs)
         for n, v in new_aux.items():
             self.aux_dict[n]._data = v
         self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
@@ -151,7 +155,11 @@ class Executor:
         other_vals = {n: v for n, v in values.items()
                       if n not in self._grad_names}
         key = self._last_key if self._last_key is not None else _random.next_key()
-        gins = self._bwd(grad_vals, other_vals, aux, key, tuple(head_grads))
+        with _profiler.scope("Executor::backward", "executor"):
+            gins = self._bwd(grad_vals, other_vals, aux, key,
+                             tuple(head_grads))
+            if _profiler.profile_sync():
+                jax.block_until_ready(gins)
         for n, g in gins.items():
             req = self._grad_req[n]
             tgt = self.grad_dict.get(n)

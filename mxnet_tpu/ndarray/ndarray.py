@@ -16,12 +16,14 @@ placement is sharding, see mxnet_tpu.parallel).
 from __future__ import annotations
 
 import os
+import time
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
 from .. import autograd
+from .. import profiler as _profiler
 from .. import random as _random
 from ..base import MXNetError, dtype_np
 from ..context import Context, current_context
@@ -419,6 +421,8 @@ def _jitted_op(opdef, key, make_closed):
 def _apply_op(opdef, args, kwargs):
     """Unwrap NDArrays, run the pure-JAX op (XLA dispatches async), wrap
     outputs, and record on the autograd tape if inside record()."""
+    _prof_t0 = (time.perf_counter_ns() // 1000) if _profiler.is_running() \
+        else None
     out = kwargs.pop("out", None)
     ctx = kwargs.pop("ctx", None)
     if isinstance(ctx, str):
@@ -473,6 +477,13 @@ def _apply_op(opdef, args, kwargs):
                 res = closed_fn(*vals)
         else:
             res = closed_fn(*vals)
+
+    if _prof_t0 is not None:
+        if _profiler.profile_sync():
+            jax.block_until_ready(res)
+        _t1 = time.perf_counter_ns() // 1000
+        _profiler.record_event(opdef.name, "operator", _prof_t0,
+                               _t1 - _prof_t0)
 
     result_ctx = (ctx or (nd_inputs[0]._ctx if nd_inputs else current_context()))
     if isinstance(res, tuple):

@@ -22,8 +22,19 @@ from collections import defaultdict
 import jax
 
 _state = {"running": False, "config": {"filename": "profile.json",
-                                       "aggregate_stats": True},
+                                       "aggregate_stats": True,
+                                       # block on each op's outputs so the
+                                       # recorded duration is true device
+                                       # time, not async dispatch time (the
+                                       # reference's engine-execute timing,
+                                       # profiler.h:85-159, measures the
+                                       # kernel, not the push)
+                                       "profile_sync": True},
           "events": [], "lock": threading.Lock(), "jax_trace_dir": None}
+
+
+def profile_sync():
+    return _state["running"] and _state["config"].get("profile_sync", True)
 
 
 def set_config(**kwargs):
