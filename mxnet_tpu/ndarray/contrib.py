@@ -13,27 +13,15 @@ def _make(opname):
     return fn
 
 
-MultiBoxPrior = _make("_contrib_MultiBoxPrior")
-MultiBoxTarget = _make("_contrib_MultiBoxTarget")
-MultiBoxDetection = _make("_contrib_MultiBoxDetection")
-box_iou = _make("_contrib_box_iou")
-box_nms = _make("_contrib_box_nms")
-ctc_loss = _make("_contrib_ctc_loss")
-CTCLoss = ctc_loss
-count_sketch = _make("_contrib_count_sketch")
-fft = _make("_contrib_fft")
-ifft = _make("_contrib_ifft")
-Proposal = _make("_contrib_Proposal")
-BilinearResize2D = _make("_contrib_BilinearResize2D")
-AdaptiveAvgPooling2D = _make("_contrib_AdaptiveAvgPooling2D")
+# every registered `_contrib_*` op surfaces here under its public name
+# (parity: the reference code-gens this namespace from the op registry,
+# python/mxnet/ndarray/register.py:156)
+for _opname in _registry.list_ops():
+    if _opname.startswith("_contrib_"):
+        globals()[_opname[len("_contrib_"):]] = _make(_opname)
+del _opname
+CTCLoss = ctc_loss  # noqa: F821 — defined by the loop above
 quadratic = _make("quadratic")
-quantize = _make("_contrib_quantize")
-dequantize = _make("_contrib_dequantize")
-requantize = _make("_contrib_requantize")
-quantized_fully_connected = _make("_contrib_quantized_fully_connected")
-quantized_conv = _make("_contrib_quantized_conv")
-quantized_pooling = _make("_contrib_quantized_pooling")
-quantized_flatten = _make("_contrib_quantized_flatten")
 
 
 def foreach(body, data, init_states):

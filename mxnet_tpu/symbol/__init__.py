@@ -671,15 +671,10 @@ linalg = _SubNS("linalg", {
     "sumlogdiag": "linalg_sumlogdiag", "syrk": "linalg_syrk",
     "gelqf": "linalg_gelqf", "syevd": "linalg_syevd",
 })
-contrib = _SubNS("contrib", {
-    "MultiBoxPrior": "_contrib_MultiBoxPrior",
-    "MultiBoxTarget": "_contrib_MultiBoxTarget",
-    "MultiBoxDetection": "_contrib_MultiBoxDetection",
-    "box_nms": "_contrib_box_nms", "box_iou": "_contrib_box_iou",
-    "ctc_loss": "_contrib_ctc_loss", "fft": "_contrib_fft",
-    "ifft": "_contrib_ifft", "count_sketch": "_contrib_count_sketch",
-    "Proposal": "_contrib_Proposal",
-    "BilinearResize2D": "_contrib_BilinearResize2D",
-    "AdaptiveAvgPooling2D": "_contrib_AdaptiveAvgPooling2D",
-    "quadratic": "quadratic",
-})
+# every registered `_contrib_*` op surfaces under mx.sym.contrib (parity:
+# the reference code-gens this namespace from the op registry)
+contrib = _SubNS("contrib", dict(
+    {n[len("_contrib_"):]: n for n in _registry.list_ops()
+     if n.startswith("_contrib_")},
+    quadratic="quadratic",
+))

@@ -775,3 +775,237 @@ def test_shuffle():
     x = np.arange(20, dtype=np.float32)
     out = nd.random.shuffle(nd.array(x)).asnumpy()
     assert_almost_equal(np.sort(out), x)
+
+
+# ---------------- deformable / PSROI / multi-proposal / krprod ----------------
+
+def test_khatri_rao():
+    # the reference docstring example (src/operator/contrib/krprod.cc:92-105)
+    A = nd.array(np.array([[1, -1], [2, -3]], np.float32))
+    B = nd.array(np.array([[1, 4], [2, 5], [3, 6]], np.float32))
+    C = nd.khatri_rao(A, B).asnumpy()
+    exp = np.array([[1, -4], [2, -5], [3, -6], [2, -12], [4, -15], [6, -18]],
+                   np.float32)
+    assert_almost_equal(C, exp)
+    D = nd.khatri_rao(A, B, nd.array(np.ones((2, 2), np.float32)))
+    assert D.shape == (12, 2)
+
+
+def _np_psroi(data, rois, ss, od, P, gs):
+    R = rois.shape[0]
+    C, H, W = data.shape[1:]
+    out = np.zeros((R, od, P, P), np.float32)
+    for n in range(R):
+        b = int(rois[n, 0])
+        sw = np.round(rois[n, 1]) * ss
+        sh = np.round(rois[n, 2]) * ss
+        ew = (np.round(rois[n, 3]) + 1) * ss
+        eh = (np.round(rois[n, 4]) + 1) * ss
+        rw = max(ew - sw, 0.1)
+        rh = max(eh - sh, 0.1)
+        bh, bw = rh / P, rw / P
+        for ct in range(od):
+            for ph in range(P):
+                for pw in range(P):
+                    hs = int(min(max(np.floor(ph * bh + sh), 0), H))
+                    he = int(min(max(np.ceil((ph + 1) * bh + sh), 0), H))
+                    ws = int(min(max(np.floor(pw * bw + sw), 0), W))
+                    we = int(min(max(np.ceil((pw + 1) * bw + sw), 0), W))
+                    gh = min(max(int(ph * gs / P), 0), gs - 1)
+                    gw = min(max(int(pw * gs / P), 0), gs - 1)
+                    c = (ct * gs + gh) * gs + gw
+                    if he <= hs or we <= ws:
+                        continue
+                    patch = data[b, c, hs:he, ws:we]
+                    out[n, ct, ph, pw] = patch.sum() / ((he - hs) * (we - ws))
+    return out
+
+
+def test_psroi_pooling():
+    np.random.seed(7)
+    od, gs, P = 2, 2, 2
+    data = np.random.randn(2, od * gs * gs, 6, 6).astype(np.float32)
+    rois = np.array([[0, 1, 1, 4, 4], [1, 0, 2, 5, 5]], np.float32)
+    out = nd.contrib.PSROIPooling(nd.array(data), nd.array(rois),
+                                  spatial_scale=1.0, output_dim=od,
+                                  pooled_size=P, group_size=gs).asnumpy()
+    exp = _np_psroi(data, rois, 1.0, od, P, gs)
+    assert_almost_equal(out, exp, rtol=1e-4, atol=1e-5)
+    # fractional spatial_scale exercises the floor/ceil bin edges
+    rois2 = rois.copy()
+    rois2[:, 1:] *= 2
+    out2 = nd.contrib.PSROIPooling(nd.array(data), nd.array(rois2),
+                                   spatial_scale=0.4, output_dim=od,
+                                   pooled_size=P, group_size=gs).asnumpy()
+    exp2 = _np_psroi(data, rois2, 0.4, od, P, gs)
+    assert_almost_equal(out2, exp2, rtol=1e-4, atol=1e-5)
+    # gradient in data (rois are not differentiable, like the reference)
+    d = sym.Variable("data")
+    r = sym.Variable("rois")
+    s = sym.contrib.PSROIPooling(d, r, spatial_scale=1.0, output_dim=od,
+                                 pooled_size=P, group_size=gs)
+    check_numeric_gradient(
+        s, {"data": data[:1, :, :4, :4],
+            "rois": np.array([[0, 0, 0, 3, 3]], np.float32)},
+        grad_nodes=["data"], rtol=5e-2, atol=1e-3)
+
+
+def test_deformable_convolution_zero_offset():
+    np.random.seed(8)
+    x = np.random.randn(2, 4, 6, 6).astype(np.float32)
+    w = np.random.randn(3, 4, 3, 3).astype(np.float32)
+    b = np.random.randn(3).astype(np.float32)
+    off = np.zeros((2, 2 * 9, 6, 6), np.float32)
+    out = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), nd.array(b),
+        kernel=(3, 3), pad=(1, 1), num_filter=3).asnumpy()
+    ref = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                         kernel=(3, 3), pad=(1, 1), num_filter=3).asnumpy()
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_convolution_grad():
+    np.random.seed(9)
+    x = np.random.randn(1, 2, 4, 4).astype(np.float32)
+    w = np.random.randn(2, 2, 3, 3).astype(np.float32)
+    # non-lattice offsets keep the bilinear kernel away from its corners
+    off = (np.random.rand(1, 2 * 9, 2, 2).astype(np.float32) - 0.5) * 0.7 \
+        + 0.23
+    d, o, wt = sym.Variable("data"), sym.Variable("offset"), sym.Variable("w")
+    s = sym.contrib.DeformableConvolution(d, o, wt, kernel=(3, 3),
+                                          num_filter=2, no_bias=True)
+    check_numeric_gradient(s, {"data": x, "offset": off, "w": w},
+                           rtol=5e-2, atol=5e-3)
+
+
+def test_deformable_convolution_groups():
+    np.random.seed(10)
+    x = np.random.randn(1, 4, 5, 5).astype(np.float32)
+    w = np.random.randn(4, 2, 3, 3).astype(np.float32)
+    off = np.zeros((1, 2 * 2 * 9, 5, 5), np.float32)  # 2 deformable groups
+    out = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), kernel=(3, 3), pad=(1, 1),
+        num_filter=4, num_group=2, num_deformable_group=2,
+        no_bias=True).asnumpy()
+    ref = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3), pad=(1, 1),
+                         num_filter=4, num_group=2, no_bias=True).asnumpy()
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def _np_bilinear(img, y, x):
+    H, W = img.shape
+    y0, x0 = int(np.floor(y)), int(np.floor(x))
+    v = 0.0
+    for yy, wy in ((y0, 1 - (y - y0)), (y0 + 1, y - y0)):
+        for xx, wx in ((x0, 1 - (x - x0)), (x0 + 1, x - x0)):
+            if 0 <= yy < H and 0 <= xx < W:
+                v += img[yy, xx] * wy * wx
+    return v
+
+
+def _np_dpsroi(data, rois, trans, ss, od, gs, P, part, sp, tstd, no_trans):
+    R = rois.shape[0]
+    C, H, W = data.shape[1:]
+    ncls = 1 if no_trans else trans.shape[1] // 2
+    cec = od // ncls
+    out = np.zeros((R, od, P, P), np.float32)
+    cnt_out = np.zeros((R, od, P, P), np.float32)
+    for n in range(R):
+        b = int(rois[n, 0])
+        sw = np.round(rois[n, 1]) * ss - 0.5
+        sh = np.round(rois[n, 2]) * ss - 0.5
+        ew = (np.round(rois[n, 3]) + 1) * ss - 0.5
+        eh = (np.round(rois[n, 4]) + 1) * ss - 0.5
+        rw = max(ew - sw, 0.1)
+        rh = max(eh - sh, 0.1)
+        bh, bw = rh / P, rw / P
+        sbh, sbw = bh / sp, bw / sp
+        for ct in range(od):
+            cls = ct // cec
+            for ph in range(P):
+                for pw in range(P):
+                    p_h = int(np.floor(ph / P * part))
+                    p_w = int(np.floor(pw / P * part))
+                    tx = 0.0 if no_trans else \
+                        trans[n, cls * 2, p_h, p_w] * tstd
+                    ty = 0.0 if no_trans else \
+                        trans[n, cls * 2 + 1, p_h, p_w] * tstd
+                    wst = pw * bw + sw + tx * rw
+                    hst = ph * bh + sh + ty * rh
+                    gh = min(max(int(ph * gs / P), 0), gs - 1)
+                    gw = min(max(int(pw * gs / P), 0), gs - 1)
+                    c = (ct * gs + gh) * gs + gw
+                    ssum, k = 0.0, 0
+                    for ih in range(sp):
+                        for iw in range(sp):
+                            w_ = wst + iw * sbw
+                            h_ = hst + ih * sbh
+                            if w_ < -0.5 or w_ > W - 0.5 or h_ < -0.5 \
+                                    or h_ > H - 0.5:
+                                continue
+                            w_ = min(max(w_, 0.0), W - 1.0)
+                            h_ = min(max(h_, 0.0), H - 1.0)
+                            ssum += _np_bilinear(data[b, c], h_, w_)
+                            k += 1
+                    out[n, ct, ph, pw] = 0.0 if k == 0 else ssum / k
+                    cnt_out[n, ct, ph, pw] = k
+    return out, cnt_out
+
+
+def test_deformable_psroi_pooling():
+    np.random.seed(11)
+    od, gs, P, sp = 2, 2, 2, 2
+    data = np.random.randn(2, od * gs * gs, 6, 6).astype(np.float32)
+    rois = np.array([[0, 1, 1, 4, 4], [1, 0, 2, 5, 5]], np.float32)
+    ncls = 2
+    trans = (np.random.rand(2, 2 * ncls, P, P).astype(np.float32) - 0.5) * 0.4
+    out, cnt = nd.contrib.DeformablePSROIPooling(
+        nd.array(data), nd.array(rois), nd.array(trans), spatial_scale=1.0,
+        output_dim=od, group_size=gs, pooled_size=P, sample_per_part=sp,
+        trans_std=0.3)
+    exp, expc = _np_dpsroi(data, rois, trans, 1.0, od, gs, P, P, sp, 0.3,
+                           False)
+    assert_almost_equal(out.asnumpy(), exp, rtol=1e-4, atol=1e-5)
+    assert_almost_equal(cnt.asnumpy(), expc, rtol=1e-5, atol=1e-6)
+    # no_trans path
+    out2, _ = nd.contrib.DeformablePSROIPooling(
+        nd.array(data), nd.array(rois), None, spatial_scale=1.0,
+        output_dim=od, group_size=gs, pooled_size=P, sample_per_part=sp,
+        no_trans=True)
+    exp2, _ = _np_dpsroi(data, rois, None, 1.0, od, gs, P, P, sp, 0.0, True)
+    assert_almost_equal(out2.asnumpy(), exp2, rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_psroi_grad():
+    np.random.seed(12)
+    od, gs, P, sp = 1, 1, 2, 2
+    data = np.random.randn(1, 1, 5, 5).astype(np.float32)
+    rois = np.array([[0, 1, 1, 3, 3]], np.float32)
+    trans = np.full((1, 2, P, P), 0.17, np.float32)
+    d, r, t = sym.Variable("data"), sym.Variable("rois"), sym.Variable("tr")
+    s = sym.contrib.DeformablePSROIPooling(
+        d, r, t, spatial_scale=1.0, output_dim=od, group_size=gs,
+        pooled_size=P, sample_per_part=sp, trans_std=0.2)
+    check_numeric_gradient(s, {"data": data, "rois": rois, "tr": trans},
+                           grad_nodes=["data", "tr"], rtol=5e-2, atol=5e-3)
+
+
+def test_multi_proposal():
+    np.random.seed(13)
+    N, H, W = 2, 4, 4
+    A = 4 * 3  # default scales x ratios
+    cls_prob = np.random.rand(N, 2 * A, H, W).astype(np.float32)
+    bbox_pred = (np.random.randn(N, 4 * A, H, W) * 0.1).astype(np.float32)
+    im_info = np.array([[64, 64, 1.0], [64, 64, 1.0]], np.float32)
+    rois = nd.contrib.MultiProposal(
+        nd.array(cls_prob), nd.array(bbox_pred), nd.array(im_info),
+        rpn_pre_nms_top_n=50, rpn_post_nms_top_n=10,
+        feature_stride=16).asnumpy()
+    assert rois.shape == (N * 10, 5)
+    assert (rois[:10, 0] == 0).all() and (rois[10:, 0] == 1).all()
+    # per-image results match single-image Proposal
+    one = nd.contrib.Proposal(
+        nd.array(cls_prob[1:]), nd.array(bbox_pred[1:]),
+        nd.array(im_info[1:]), rpn_pre_nms_top_n=50, rpn_post_nms_top_n=10,
+        feature_stride=16).asnumpy()
+    assert_almost_equal(rois[10:, 1:], one[:, 1:], rtol=1e-4, atol=1e-4)
