@@ -494,3 +494,12 @@ class ImageIter:
 
     def __iter__(self):
         return self
+
+
+# detection pipeline (parity: reference python/mxnet/image/detection.py) —
+# imported at the tail so image_detection can import ImageIter from here
+from .image_detection import (DetAugmenter, DetBorrowAug,  # noqa: E402,F401
+                              DetRandomSelectAug, DetHorizontalFlipAug,
+                              DetRandomCropAug, DetRandomPadAug,
+                              CreateMultiRandCropAugmenter,
+                              CreateDetAugmenter, ImageDetIter)

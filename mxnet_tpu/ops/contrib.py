@@ -121,7 +121,8 @@ def MultiBoxTarget(anchor, label, cls_pred, overlap_threshold=0.5,
         th = jnp.log(gh / ah) / v[3]
         loc_t = jnp.stack([tx, ty, tw, th], axis=-1)
         loc_t = jnp.where(pos[:, None], loc_t, 0.0)
-        loc_m = jnp.where(pos[:, None], 1.0, 0.0)
+        # per-coordinate mask [A, 4] (reference loc_mask is length 4A)
+        loc_m = jnp.broadcast_to(pos[:, None], loc_t.shape).astype(loc_t.dtype)
         return loc_t.reshape(-1), loc_m.reshape(-1), cls_t, pos
 
     loc_t, loc_m, cls_t, pos = jax.vmap(one_sample)(label)

@@ -7,6 +7,8 @@ default_context, simple data generators.
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from .context import Context, cpu, current_context
@@ -321,3 +323,36 @@ def set_env_var(key, val, default_val=""):
     prev_val = os.environ.get(key, default_val)
     os.environ[key] = val
     return prev_val
+
+
+def make_synthetic_det_dataset(path, num_images=40, size=48, num_classes=2,
+                               seed=0):
+    """Write a synthetic detection dataset (JPEG files + imglist entries).
+
+    Each image is noise background with 1-2 solid rectangles; class c fills
+    channel c. Returns an imglist of [flat_det_label, filename] rows using
+    the im2rec detection label format [header_width=2, obj_width=5,
+    (cls, xmin, ymin, xmax, ymax)*] with normalized corner coords
+    (parity: the tools/im2rec.py detection packing convention).
+    """
+    import cv2
+    rng = np.random.RandomState(seed)
+    os.makedirs(path, exist_ok=True)
+    imglist = []
+    for i in range(num_images):
+        img = rng.randint(0, 60, (size, size, 3)).astype(np.uint8)
+        objs = []
+        for _ in range(rng.randint(1, 3)):
+            cls = rng.randint(num_classes)
+            w = rng.randint(size // 4, size // 2)
+            h = rng.randint(size // 4, size // 2)
+            x0 = rng.randint(0, size - w)
+            y0 = rng.randint(0, size - h)
+            img[y0:y0 + h, x0:x0 + w, cls] = 230
+            objs += [float(cls), x0 / size, y0 / size,
+                     (x0 + w) / size, (y0 + h) / size]
+        fname = "img%04d.jpg" % i
+        cv2.imwrite(os.path.join(path, fname),
+                    cv2.cvtColor(img, cv2.COLOR_RGB2BGR))
+        imglist.append([[2.0, 5.0] + objs, fname])
+    return imglist
