@@ -66,3 +66,14 @@ from . import operator
 from .operator import CustomOp, CustomOpProp
 from . import rtc
 from . import contrib
+
+# Under tools/launch.py the DMLC_* worker env is present: join the
+# distributed job NOW, before anything can initialise the XLA backend
+# (jax.distributed must come first). Parity: ps-lite workers connect to the
+# scheduler at startup. No-op outside a launched job, so importing the
+# package still does zero device work in the normal case.
+import os as _os  # noqa: E402
+if int(_os.environ.get("DMLC_NUM_WORKER", "1")) > 1 and \
+        _os.environ.get("DMLC_ROLE", "worker") == "worker":
+    from .kvstore import _init_distributed as _kv_init_distributed
+    _kv_init_distributed()

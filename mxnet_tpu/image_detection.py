@@ -361,21 +361,29 @@ class ImageDetIter(ImageIter):
             raise MXNetError("Encountered sample with no valid label")
         return out[valid]
 
+    def _labels_only(self):
+        """Yield raw labels without decoding images — imglist-backed
+        datasets keep labels in memory, so the construction-time shape scan
+        must not pay a full-dataset JPEG decode. RecordIO still reads
+        records (label and image share the record) but skips the decode."""
+        if self.imglist is not None:
+            for idx in self.seq:
+                yield self.imglist[idx][0]
+        else:
+            from . import recordio
+            for idx in self.seq:
+                header, _img = recordio.unpack(self.imgrec.read_idx(idx))
+                yield header.label
+
     def _estimate_label_shape(self):
         max_count, width = 0, 5
-        self.reset()
-        try:
-            while True:
-                label, _ = self.next_sample()
-                try:
-                    parsed = self._parse_label(label)
-                except MXNetError:
-                    continue  # bad records are skipped again in next()
-                max_count = max(max_count, parsed.shape[0])
-                width = parsed.shape[1]
-        except StopIteration:
-            pass
-        self.reset()
+        for label in self._labels_only():
+            try:
+                parsed = self._parse_label(label)
+            except MXNetError:
+                continue  # bad records are skipped again in next()
+            max_count = max(max_count, parsed.shape[0])
+            width = parsed.shape[1]
         return (max_count, width)
 
     def reshape(self, data_shape=None, label_shape=None):

@@ -25,6 +25,7 @@ residual algorithm in pure jnp (see _TwoBitCompressor).
 """
 from __future__ import annotations
 
+import os
 import pickle
 
 import numpy as np
@@ -263,10 +264,96 @@ class KVStoreTPU(KVStore):
         return value
 
 
+def _bigarray_bound():
+    """Element-count threshold above which cross-host transfers are chunked
+    (parity: MXNET_KVSTORE_BIGARRAY_BOUND sharding big keys across servers,
+    kvstore_dist.h:521 — here it bounds per-message allgather size)."""
+    return int(os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000))
+
+
 def _multihost_psum(x):
-    """All-reduce across hosts over ICI/DCN using a global mesh."""
+    """All-reduce across hosts over ICI/DCN using the global process set.
+
+    Arrays above MXNET_KVSTORE_BIGARRAY_BOUND elements are reduced in
+    bounded chunks — the TPU-native analog of the reference splitting big
+    keys across parameter servers so no single message/server sees the
+    whole tensor.
+    """
     from jax.experimental import multihost_utils
-    return multihost_utils.process_allgather(x).sum(axis=0)
+    bound = _bigarray_bound()
+    if x.size <= bound:
+        return multihost_utils.process_allgather(x).sum(axis=0)
+    flat = x.reshape(-1)
+    out = []
+    for i in range(0, flat.size, bound):
+        chunk = flat[i:i + bound]
+        out.append(multihost_utils.process_allgather(chunk).sum(axis=0))
+    return jnp.concatenate(out).reshape(x.shape)
+
+
+def _multihost_rsp_sum(rsp, shape):
+    """Cross-host sum of row-sparse values (parity: the dist kvstore's
+    row_sparse key handling, kvstore_dist.h:437-476 — workers send only
+    occupied rows; the merge scatter-adds them).
+
+    Each worker pads its (indices, values) to the global max row count
+    (one small allgather of counts first), allgathers both, and
+    scatter-adds into the dense shape. Rows no worker touched stay zero.
+    """
+    from jax.experimental import multihost_utils
+    idx = jnp.asarray(rsp._indices, dtype=jnp.int32)
+    vals = rsp._values
+    counts = multihost_utils.process_allgather(
+        jnp.asarray([idx.shape[0]], dtype=jnp.int32))
+    kmax = int(np.asarray(counts).max())
+    pad = kmax - idx.shape[0]
+    idx_p = jnp.pad(idx, (0, pad), constant_values=-1)
+    vals_p = jnp.pad(vals, [(0, pad)] + [(0, 0)] * (vals.ndim - 1))
+    gi = multihost_utils.process_allgather(idx_p).reshape(-1)
+    gv = multihost_utils.process_allgather(vals_p).reshape(
+        (-1,) + vals.shape[1:])
+    mask = (gi >= 0).astype(gv.dtype).reshape((-1,) + (1,) * (gv.ndim - 1))
+    dense = jnp.zeros(shape, dtype=gv.dtype)
+    dense = dense.at[jnp.clip(gi, 0, shape[0] - 1)].add(gv * mask)
+    return RowSparseNDArray.from_dense(NDArray(dense))
+
+
+def _init_distributed():
+    """Bring up jax.distributed from the launcher-provided environment.
+
+    Parity: the reference worker reads DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT /
+    DMLC_NUM_WORKER / DMLC_WORKER_ID set by tools/launch.py and connects to
+    the ps-lite scheduler (kvstore_dist.h:50). Here the same variables name
+    the jax.distributed coordinator: process 0 hosts it, everyone connects
+    over gRPC; collectives then ride gloo (CPU) or ICI/DCN (TPU).
+    """
+    num = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    if num <= 1:
+        return
+    # NOTE: jax.process_count() would itself initialise the XLA backend,
+    # which must not happen before jax.distributed.initialize — use the
+    # distributed-state query, which does not touch the backend
+    if jax.distributed.is_initialized():
+        return
+    uri = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+    port = os.environ.get("DMLC_PS_ROOT_PORT", "9091")
+    rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+    try:
+        # CPU multi-process collectives need gloo; harmless for TPU (the
+        # flag only affects CPU client creation)
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
+    try:
+        jax.distributed.initialize(coordinator_address="%s:%s" % (uri, port),
+                                   num_processes=num, process_id=rank)
+    except RuntimeError as e:
+        raise MXNetError(
+            "cannot join the distributed job: the XLA backend was already "
+            "initialized before the dist kvstore was created. Create the "
+            "kvstore (or import mxnet_tpu under tools/launch.py, which "
+            "self-assembles at import) before any computation. "
+            "Original error: %s" % e) from e
 
 
 class KVStoreDist(KVStoreTPU):
@@ -276,12 +363,52 @@ class KVStoreDist(KVStoreTPU):
     into symmetric collectives; sync mode reduces with a barrier semantic
     (collectives are inherently synchronizing), async skips determinism by
     applying local updates immediately and folding remote contributions in
-    at the next collective.
+    at the next collective. The server-side optimizer (set_optimizer)
+    becomes: every worker applies the optimizer to the identical global
+    gradient sum, which reproduces the server's single authoritative update
+    deterministically on all ranks.
     """
 
     def __init__(self, kv_type):
+        _init_distributed()
         super().__init__(kv_type)
         self._sync = "async" not in kv_type
+
+    def init(self, key, value):
+        """Rank-0 value wins (parity: the first worker to init a key on the
+        PS defines it; later inits are ignored)."""
+        keys, values = self._key_list(key, value)
+        if self.num_workers > 1:
+            from jax.experimental import multihost_utils
+            src = self.rank == 0
+            bcast = []
+            for v in values:
+                if isinstance(v, RowSparseNDArray):
+                    # shapes differ per rank: broadcast the rank-0 nnz
+                    # first, then same-shaped (indices, values) buffers
+                    n0 = int(multihost_utils.broadcast_one_to_all(
+                        jnp.asarray([v._indices.shape[0]], jnp.int32))[0])
+                    cols = v.shape[1:]
+                    idx = v._indices if src else jnp.zeros((n0,), jnp.int32)
+                    vals = v._values if src else \
+                        jnp.zeros((n0,) + cols, v._values.dtype)
+                    idx, vals = multihost_utils.broadcast_one_to_all(
+                        (idx, vals))
+                    bcast.append(RowSparseNDArray(idx, vals, v.shape,
+                                                  ctx=v._ctx))
+                else:
+                    bcast.append(NDArray(
+                        multihost_utils.broadcast_one_to_all(v._data),
+                        ctx=v._ctx))
+            values = bcast
+        super().init(keys, values)
+
+    def _reduce_global(self, value, priority=0):
+        if self.num_workers <= 1:
+            return value
+        if isinstance(value, RowSparseNDArray):
+            return _multihost_rsp_sum(value, value.shape)
+        return NDArray(_multihost_psum(value._data), ctx=value._ctx)
 
     def barrier(self):
         if jax.process_count() > 1:

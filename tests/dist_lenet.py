@@ -1,0 +1,59 @@
+"""Distributed data-parallel training worker (run under tools/launch.py).
+
+Parity: reference tests/nightly/dist_lenet.py — N workers train one model on
+rank-sharded data with a dist kvstore; the run must converge and every rank
+must hold identical parameters afterwards (sync semantics).
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402  (joins the dist job at import)
+from mxnet_tpu import autograd, gluon  # noqa: E402
+
+
+def main():
+    kv = mx.kvstore.create("dist_sync")
+    rank, nworker = kv.rank, kv.num_workers
+    mx.random.seed(7)  # identical init on every rank
+    np.random.seed(7)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(64, activation="relu"))
+    net.add(gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    train, _ = mx.test_utils.get_mnist_iterator(
+        batch_size=32, input_shape=(784,), num_parts=nworker, part_index=rank)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9},
+                            kvstore=kv)
+    first = last = None
+    for _epoch in range(2):
+        train.reset()
+        for batch in train:
+            with autograd.record():
+                out = net(batch.data[0])
+                L = loss_fn(out, batch.label[0])
+            L.backward()
+            trainer.step(batch.data[0].shape[0])
+            v = float(L.mean().asnumpy())
+            first = v if first is None else first
+            last = v
+    assert last < first * 0.5, (first, last)
+    # sync check: every rank must hold bit-identical parameters
+    from jax.experimental import multihost_utils
+    flat = np.concatenate([p.data().asnumpy().ravel()
+                           for p in net.collect_params().values()])
+    gathered = np.asarray(multihost_utils.process_allgather(
+        mx.nd.array(flat)._data))
+    for r in range(1, nworker):
+        np.testing.assert_allclose(gathered[r], gathered[0], rtol=0, atol=0)
+    print("DIST_LENET_OK rank=%d loss %.4f->%.4f" % (rank, first, last),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

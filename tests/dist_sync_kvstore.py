@@ -1,0 +1,102 @@
+"""Multi-worker kvstore checks, run under tools/launch.py.
+
+Parity: reference tests/nightly/dist_sync_kvstore.py:36-60 — N real worker
+processes init/push/pull dense, row_sparse, and compressed keys, with one
+shape crossing MXNET_KVSTORE_BIGARRAY_BOUND to force the chunked (big-key)
+transport, plus the server-side-optimizer path. Every worker asserts the
+globally-reduced values, then prints a per-rank OK line the spawning test
+greps for.
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import kvstore as kvs  # noqa: E402
+from mxnet_tpu.ndarray import NDArray  # noqa: E402
+from mxnet_tpu.ndarray.sparse import RowSparseNDArray  # noqa: E402
+
+
+def check_dense(kv, rank, nworker):
+    # shapes from the reference nightly, scaled; big one crosses the bound
+    shapes = {"3": (50, 50), "99": (4, 4), "big": (1200, 7)}
+    for k, shape in shapes.items():
+        kv.init(k, mx.nd.zeros(shape))
+    for it in range(3):
+        for k, shape in shapes.items():
+            kv.push(k, mx.nd.ones(shape) * (rank + 1))
+            out = mx.nd.zeros(shape)
+            kv.pull(k, out=out)
+            # sum over ranks of (rank+1), accumulated over pushes
+            expected = sum(r + 1 for r in range(nworker)) * (it + 1)
+            np.testing.assert_allclose(out.asnumpy(),
+                                       np.full(shape, expected), rtol=1e-5)
+        kv.barrier()
+
+
+def check_row_sparse(kv, rank, nworker):
+    shape = (20, 3)
+    kv.init("rsp", RowSparseNDArray.from_dense(mx.nd.zeros(shape)))
+    # each worker touches its own pair of rows
+    rows = np.array([rank, rank + nworker], dtype=np.int32)
+    vals = np.full((2, 3), rank + 1, dtype=np.float32)
+    kv.push("rsp", RowSparseNDArray(rows, vals, shape))
+    all_rows = mx.nd.array(np.arange(shape[0], dtype=np.float32))
+    ret = kv.row_sparse_pull("rsp", row_ids=all_rows)
+    dense = ret.todense().asnumpy()
+    expected = np.zeros(shape, np.float32)
+    for r in range(nworker):
+        expected[r] += r + 1
+        expected[r + nworker] += r + 1
+    np.testing.assert_allclose(dense, expected, rtol=1e-5)
+    kv.barrier()
+
+
+def check_compressed(kv, rank, nworker):
+    shape = (6, 6)
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("comp", mx.nd.zeros(shape))
+    # 2.0 quantizes to +0.5 on every worker; residual 1.5 carries over
+    kv.push("comp", mx.nd.ones(shape) * 2.0)
+    out = mx.nd.zeros(shape)
+    kv.pull("comp", out=out)
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.full(shape, 0.5 * nworker), rtol=1e-5)
+    kv._compressor = None
+    kv.barrier()
+
+
+def check_server_side_optimizer(kv, rank, nworker):
+    shape = (8, 4)
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, wd=0.0))
+    kv.init("w", mx.nd.ones(shape))
+    kv.push("w", mx.nd.ones(shape) * (rank + 1))
+    out = mx.nd.zeros(shape)
+    kv.pull("w", out=out)
+    # one authoritative update on the aggregated gradient, same on all ranks
+    grad_sum = sum(r + 1 for r in range(nworker))
+    expected = 1.0 - 0.1 * grad_sum
+    np.testing.assert_allclose(out.asnumpy(), np.full(shape, expected),
+                               rtol=1e-4)
+    kv._updater = None
+    kv._optimizer = None
+    kv.barrier()
+
+
+def main():
+    kv = kvs.create("dist_sync")
+    rank, nworker = kv.rank, kv.num_workers
+    assert nworker == int(os.environ["DMLC_NUM_WORKER"]), \
+        (nworker, os.environ["DMLC_NUM_WORKER"])
+    check_dense(kv, rank, nworker)
+    check_row_sparse(kv, rank, nworker)
+    check_compressed(kv, rank, nworker)
+    check_server_side_optimizer(kv, rank, nworker)
+    print("DIST_KVSTORE_OK rank=%d nworker=%d" % (rank, nworker), flush=True)
+
+
+if __name__ == "__main__":
+    main()

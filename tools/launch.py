@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Cluster launcher for distributed training.
+
+Parity: reference `tools/launch.py` (ssh/mpi/sge/yarn/local launchers that
+spawn N workers + S servers and set `DMLC_*` roles consumed by ps-lite).
+
+TPU-native redesign: there is no parameter-server tier — workers are
+symmetric jax.distributed processes whose collectives carry the traffic, so
+`-s/--num-servers` is accepted for CLI compatibility but ignored. Worker 0
+hosts the coordination service; every worker gets
+DMLC_PS_ROOT_URI/DMLC_PS_ROOT_PORT (coordinator address), DMLC_NUM_WORKER,
+DMLC_WORKER_ID and DMLC_ROLE=worker, which mxnet_tpu.kvstore's
+dist_sync/dist_async stores read to self-assemble the job
+(kvstore._init_distributed).
+
+Usage:
+  tools/launch.py -n 4 python train.py ...            # local processes
+  tools/launch.py -n 4 --launcher ssh -H hosts python train.py ...
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _worker_env(args, rank, coordinator):
+    env = dict(os.environ)
+    env.update({
+        "DMLC_ROLE": "worker",
+        "DMLC_NUM_WORKER": str(args.num_workers),
+        "DMLC_WORKER_ID": str(rank),
+        "DMLC_PS_ROOT_URI": coordinator[0],
+        "DMLC_PS_ROOT_PORT": str(coordinator[1]),
+        "DMLC_NUM_SERVER": str(args.num_servers),
+    })
+    # interactive TPU tunnels are single-process; a fan-out job must not
+    # have every worker grab the one tunnelled chip
+    if args.platform:
+        env["JAX_PLATFORMS"] = args.platform
+        if args.platform == "cpu":
+            env["PALLAS_AXON_POOL_IPS"] = ""
+    return env
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Launch a distributed mxnet_tpu job (parity: "
+                    "reference tools/launch.py)")
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-s", "--num-servers", type=int, default=0,
+                    help="accepted for reference CLI compatibility; "
+                         "collective workers need no servers")
+    ap.add_argument("--launcher", choices=["local", "ssh"], default="local")
+    ap.add_argument("-H", "--hostfile", default=None,
+                    help="newline-separated hosts (ssh launcher)")
+    ap.add_argument("-p", "--port", type=int, default=0,
+                    help="coordinator port (0 = pick a free one)")
+    ap.add_argument("--platform", default=None,
+                    help="force JAX_PLATFORMS for workers (e.g. cpu)")
+    ap.add_argument("--sync-dst-dir", default=None,
+                    help="rsync the working dir to this path on each ssh "
+                         "host before launching")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    if not args.command:
+        ap.error("no command given")
+    cmd = args.command[1:] if args.command[0] == "--" else args.command
+
+    if args.launcher == "local":
+        coordinator = ("127.0.0.1", args.port or _free_port())
+        procs = []
+        for rank in range(args.num_workers):
+            procs.append(subprocess.Popen(
+                cmd, env=_worker_env(args, rank, coordinator)))
+        rc = 0
+        try:
+            for p in procs:
+                rc = p.wait() or rc
+        except KeyboardInterrupt:
+            for p in procs:
+                p.send_signal(signal.SIGTERM)
+            rc = 1
+        return rc
+
+    # ssh launcher: round-robin ranks over the hostfile; worker 0's host is
+    # the coordinator (parity: dmlc-tracker ssh.py)
+    if not args.hostfile:
+        ap.error("ssh launcher requires -H/--hostfile")
+    with open(args.hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip() and not h.startswith("#")]
+    if not hosts:
+        ap.error("hostfile is empty")
+    coordinator = (hosts[0], args.port or 9091)
+    cwd = os.getcwd()
+    procs = []
+    for rank in range(args.num_workers):
+        host = hosts[rank % len(hosts)]
+        if args.sync_dst_dir:
+            subprocess.check_call(["rsync", "-a", "--delete",
+                                   cwd + "/", "%s:%s" % (host,
+                                                         args.sync_dst_dir)])
+        env = _worker_env(args, rank, coordinator)
+        envs = " ".join("%s=%s" % (k, v) for k, v in env.items()
+                        if k.startswith(("DMLC_", "JAX_", "MXNET_",
+                                         "PALLAS_")))
+        rdir = args.sync_dst_dir or cwd
+        remote = "cd %s && env %s %s" % (rdir, envs,
+                                         " ".join(map(str, cmd)))
+        procs.append(subprocess.Popen(["ssh", "-o",
+                                       "StrictHostKeyChecking=no", host,
+                                       remote]))
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
