@@ -348,12 +348,14 @@ def _init_distributed():
         jax.distributed.initialize(coordinator_address="%s:%s" % (uri, port),
                                    num_processes=num, process_id=rank)
     except RuntimeError as e:
-        raise MXNetError(
-            "cannot join the distributed job: the XLA backend was already "
-            "initialized before the dist kvstore was created. Create the "
-            "kvstore (or import mxnet_tpu under tools/launch.py, which "
-            "self-assembles at import) before any computation. "
-            "Original error: %s" % e) from e
+        if "backend" in str(e).lower():
+            raise MXNetError(
+                "cannot join the distributed job: the XLA backend was "
+                "already initialized before the dist kvstore was created. "
+                "Create the kvstore (or import mxnet_tpu under "
+                "tools/launch.py, which self-assembles at import) before "
+                "any computation. Original error: %s" % e) from e
+        raise  # connection/timeout errors keep their real cause
 
 
 class KVStoreDist(KVStoreTPU):

@@ -427,12 +427,23 @@ class Symbol:
     def tojson(self):
         order = self._topo()
         node_index = {id(n): i for i, n in enumerate(order)}
+
+        def _ser(v):
+            # numpy scalars repr as 'np.float32(0.3)' under numpy>=2, which
+            # the loader cannot eval — demote to plain Python scalars first
+            if isinstance(v, np.generic):
+                v = v.item()
+            if isinstance(v, (list, tuple)):
+                return repr(type(v)(x.item() if isinstance(x, np.generic)
+                                    else x for x in v))
+            return repr(v)
+
         nodes = []
         for n in order:
             nodes.append({
                 "op": "null" if n.op is None else n.op.name,
                 "name": n.name,
-                "attrs": {k: repr(v) for k, v in n.kwargs.items()} if n.op else {},
+                "attrs": {k: _ser(v) for k, v in n.kwargs.items()} if n.op else {},
                 "inputs": [[node_index[id(i)], oi, 0] for i, oi in n.inputs],
                 "is_aux": n.is_aux,
             })
@@ -637,16 +648,71 @@ def load(fname):
         return load_json(f.read())
 
 
+_ACCEPTED_PARAMS_CACHE = {}
+
+
+def _accepted_params(opdef):
+    """Parameter-name set the op accepts, or None when it takes **kwargs.
+    Cached per OpDef — signature reflection is too slow per graph node."""
+    key = id(opdef)
+    if key not in _ACCEPTED_PARAMS_CACHE:
+        import inspect
+        sig = inspect.signature(opdef.fn)
+        if any(p.kind == p.VAR_KEYWORD for p in sig.parameters.values()):
+            _ACCEPTED_PARAMS_CACHE[key] = None
+        else:
+            _ACCEPTED_PARAMS_CACHE[key] = frozenset(sig.parameters)
+    return _ACCEPTED_PARAMS_CACHE[key]
+
+
+def _parse_attr_value(v):
+    """Attr values from our tojson are repr()'d; reference legacy JSON
+    stores plain strings ('128', '(3, 3)', 'relu') — eval what evals,
+    keep the rest as strings (parity: legacy_json_util.cc upgrade)."""
+    if not isinstance(v, str):
+        return v
+    try:
+        # empty namespaces: bare words like 'relu' must NOT resolve to this
+        # module's generated op functions — they fall through as strings
+        return eval(v, {"__builtins__": {}}, {})  # noqa: S307
+    except Exception:
+        return v
+
+
+# pre-nnvm (2015-era) symbol JSON omits auxiliary-state inputs — nnvm later
+# made them explicit graph inputs. Synthesized on load with the reference's
+# aux naming convention (parity: legacy_json_util.cc upgrade pass).
+_LEGACY_AUX_INPUTS = {
+    "BatchNorm": ("moving_mean", "moving_var"),
+    "batch_norm_v1": ("moving_mean", "moving_var"),
+}
+
+
 def load_json(json_str):
-    data = json.loads(json_str)
+    from ..utils import legacy as _legacy
+    data = _legacy.upgrade_json(json.loads(json_str))
     nodes = []
     for spec in data["nodes"]:
         inputs = [(nodes[i], oi) for i, oi, _ in spec["inputs"]]
+        aux_names = _LEGACY_AUX_INPUTS.get(spec["op"])
+        if aux_names and len(inputs) == 5 - len(aux_names):
+            for an in aux_names:
+                aux_node = SymNode(None, "%s_%s" % (spec["name"], an), [],
+                                   {}, is_aux=True)
+                inputs.append((aux_node, 0))
         if spec["op"] == "null":
-            node = SymNode(None, spec["name"], [], {}, is_aux=spec.get("is_aux", False))
+            node = SymNode(None, spec["name"], [], {},
+                           is_aux=spec.get("is_aux", False))
         else:
-            kwargs = {k: eval(v) for k, v in spec.get("attrs", {}).items()}  # noqa: S307 — values were repr()'d by tojson
-            node = SymNode(_registry.get(spec["op"]), spec["name"], inputs, kwargs)
+            opdef = _registry.get(spec["op"])
+            kwargs = {k: _parse_attr_value(v)
+                      for k, v in spec.get("attrs", {}).items()}
+            # legacy files mix node attributes (ctx_group, lr_mult, ...)
+            # into the op params — keep only kwargs the op accepts
+            accepted = _accepted_params(opdef)
+            if accepted is not None:
+                kwargs = {k: v for k, v in kwargs.items() if k in accepted}
+            node = SymNode(opdef, spec["name"], inputs, kwargs)
         nodes.append(node)
     heads = [(nodes[i], oi) for i, oi, _ in data["heads"]]
     return Symbol(heads)

@@ -1,0 +1,220 @@
+"""Reference-format binary checkpoint import/export.
+
+Parity: the reference's legacy NDArray container
+(`src/ndarray/ndarray.cc:1583-1810` — list framing kMXAPINDArrayListMagic
+0x112 + per-array V2/V1/V0 records) and its legacy symbol JSON
+(`src/nnvm/legacy_json_util.cc` upgrade pass). This lets reference-trained
+`.params` / `-symbol.json` artifacts load into the npz-native world, and
+exports back for reference consumers.
+
+Layout (all little-endian, dmlc::Stream conventions):
+  file  := u64 magic=0x112, u64 reserved, vec<ndarray>, vec<string names>
+  vec<T>:= u64 count, T*count; string := u64 len, bytes
+  ndarray (V2, magic 0xF993FAC9 as u32):
+    u32 magic, i32 stype, [storage_shape if sparse], shape, i32 dev_type,
+    i32 dev_id, i32 type_flag, [i32 aux_type + aux_shape]*nad,
+    raw data, raw aux data*nad
+  shape := u32 ndim, i64*ndim        (V1 same; V0: magic IS ndim, u32 dims)
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+LIST_MAGIC = 0x112
+V2_MAGIC = 0xF993FAC9
+V1_MAGIC = 0xF993FAC8
+
+# mshadow type flags (mshadow/base.h kFloat32..kInt64)
+_DTYPES = {0: np.float32, 1: np.float64, 2: np.float16, 3: np.uint8,
+           4: np.int32, 5: np.int8, 6: np.int64}
+_FLAGS = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+# NDArrayStorageType (include/mxnet/ndarray.h:61-66); aux counts: row_sparse
+# carries its row-index vector, csr carries indptr + indices
+_STYPE_DEFAULT, _STYPE_ROW_SPARSE, _STYPE_CSR = 0, 1, 2
+_NUM_AUX = {_STYPE_DEFAULT: 0, _STYPE_ROW_SPARSE: 1, _STYPE_CSR: 2}
+
+
+class _Reader:
+    def __init__(self, buf):
+        self.buf = buf
+        self.pos = 0
+
+    def unpack(self, fmt):
+        vals = struct.unpack_from("<" + fmt, self.buf, self.pos)
+        self.pos += struct.calcsize("<" + fmt)
+        return vals if len(vals) > 1 else vals[0]
+
+    def unpack_many(self, fmt):
+        """Always returns a tuple (unpack() collapses single values)."""
+        vals = struct.unpack_from("<" + fmt, self.buf, self.pos)
+        self.pos += struct.calcsize("<" + fmt)
+        return vals
+
+    def raw(self, n):
+        out = self.buf[self.pos:self.pos + n]
+        if len(out) != n:
+            raise IOError("truncated legacy NDArray file")
+        self.pos += n
+        return out
+
+
+def _read_shape(r):
+    ndim = r.unpack("I")
+    return list(r.unpack_many("%dq" % ndim)) if ndim else []
+
+
+def _read_array_data(r, shape, type_flag):
+    dt = np.dtype(_DTYPES[type_flag])
+    n = int(np.prod(shape)) if shape else 1
+    return np.frombuffer(r.raw(dt.itemsize * n), dtype=dt).reshape(shape)
+
+
+def _read_one(r):
+    """One NDArray record -> numpy array (sparse records densified)."""
+    magic = r.unpack("I")
+    if magic == V2_MAGIC:
+        stype = r.unpack("i")
+        nad = _NUM_AUX.get(stype)
+        if nad is None:
+            raise IOError("unknown storage type %d" % stype)
+        sshape = _read_shape(r) if nad else None
+        shape = _read_shape(r)
+        if not shape:
+            return None
+        r.unpack("ii")  # context (dev_type, dev_id) — placement is ignored
+        type_flag = r.unpack("i")
+        aux = []
+        for _ in range(nad):
+            at = r.unpack("i")
+            ash = _read_shape(r)
+            aux.append((at, ash))
+        data = _read_array_data(r, sshape if nad else shape, type_flag)
+        aux_data = [_read_array_data(r, ash, at) for at, ash in aux]
+        if stype == _STYPE_ROW_SPARSE:
+            dense = np.zeros(shape, data.dtype)
+            dense[aux_data[0].astype(np.int64)] = data
+            return dense
+        if stype == _STYPE_CSR:
+            dense = np.zeros(shape, data.dtype)
+            indptr = aux_data[0].astype(np.int64)
+            indices = aux_data[1].astype(np.int64)
+            for row in range(shape[0]):
+                lo, hi = indptr[row], indptr[row + 1]
+                dense[row, indices[lo:hi]] = data[lo:hi]
+            return dense
+        return data
+    if magic == V1_MAGIC:
+        shape = _read_shape(r)
+    else:
+        # V0: the magic word IS ndim; dims are u32
+        ndim = magic
+        shape = list(r.unpack_many("%dI" % ndim)) if ndim else []
+    if not shape:
+        return None
+    r.unpack("ii")  # context
+    type_flag = r.unpack("i")
+    return _read_array_data(r, shape, type_flag)
+
+
+def is_legacy_ndarray_file(fname):
+    try:
+        with open(fname, "rb") as f:
+            head = f.read(8)
+        return len(head) == 8 and \
+            struct.unpack("<Q", head)[0] == LIST_MAGIC
+    except OSError:
+        return False
+
+
+def load_legacy_ndarrays(fname):
+    """Read a reference .params file -> dict[str, NDArray] (or list when the
+    file carries no names)."""
+    from ..ndarray import NDArray
+    with open(fname, "rb") as f:
+        r = _Reader(f.read())
+    header, _reserved = r.unpack("QQ")
+    if header != LIST_MAGIC:
+        raise IOError("not a legacy NDArray file (magic %#x)" % header)
+    n = r.unpack("Q")
+    arrays = [_read_one(r) for _ in range(n)]
+    n_names = r.unpack("Q")
+    names = [r.raw(r.unpack("Q")).decode() for _ in range(n_names)]
+    if names and len(names) != len(arrays):
+        raise IOError("invalid legacy NDArray file: %d names for %d arrays"
+                      % (len(names), len(arrays)))
+    wrapped = [None if a is None else NDArray(a) for a in arrays]
+    if not names:
+        return wrapped
+    return dict(zip(names, wrapped))
+
+
+def _write_shape(out, shape):
+    out.append(struct.pack("<I", len(shape)))
+    if shape:
+        out.append(struct.pack("<%dq" % len(shape), *shape))
+
+
+def save_legacy_ndarrays(fname, data):
+    """Write dict/list of NDArrays in the reference V2 container so the
+    artifacts load in the reference framework."""
+    from ..ndarray import NDArray
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+    else:
+        names, arrays = [], list(data)
+    out = [struct.pack("<QQ", LIST_MAGIC, 0), struct.pack("<Q", len(arrays))]
+    for a in arrays:
+        npd = np.asarray(a.asnumpy() if hasattr(a, "asnumpy") else a)
+        if npd.dtype not in _FLAGS:
+            npd = npd.astype(np.float32)  # bf16 etc. have no legacy flag
+        out.append(struct.pack("<Ii", V2_MAGIC, _STYPE_DEFAULT))
+        _write_shape(out, npd.shape)
+        out.append(struct.pack("<iii", 1, 0, _FLAGS[npd.dtype]))  # cpu(0)
+        out.append(np.ascontiguousarray(npd).tobytes())
+    out.append(struct.pack("<Q", len(names)))
+    for nm in names:
+        b = nm.encode()
+        out.append(struct.pack("<Q", len(b)) + b)
+    with open(fname, "wb") as f:
+        f.write(b"".join(out))
+
+
+# ---------------------------------------------------------------------------
+# legacy symbol JSON
+# ---------------------------------------------------------------------------
+
+
+def upgrade_json(data):
+    """Normalize a reference symbol-JSON dict to the modern layout (parity:
+    src/nnvm/legacy_json_util.cc): op parameters move to 'attrs', 2-element
+    inputs/heads pad to 3 elements.
+
+    Era handling: oldest files keep op params in 'param' with node
+    attributes (ctx_group, lr_mult, ...) in a separate 'attr' dict; the
+    'attr'-era mixes both in one dict; modern files use 'attrs'. 'param'
+    wins when present so node attributes never masquerade as op kwargs —
+    the symbol loader additionally drops kwargs the op doesn't accept.
+    """
+    nodes = []
+    for spec in data["nodes"]:
+        spec = dict(spec)
+        attrs = spec.pop("param", None)
+        if attrs is None:
+            attrs = spec.pop("attrs", None)
+        if attrs is None:
+            attrs = spec.pop("attr", None) or {}
+        spec.pop("attrs", None)
+        spec.pop("attr", None)
+        spec["attrs"] = dict(attrs)
+        spec["inputs"] = [list(i) + [0] * (3 - len(i))
+                          for i in spec.get("inputs", [])]
+        nodes.append(spec)
+    heads = [list(h) + [0] * (3 - len(h)) for h in data["heads"]]
+    return {"nodes": nodes, "heads": heads,
+            "arg_nodes": data.get("arg_nodes", [])}

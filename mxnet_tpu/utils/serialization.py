@@ -51,6 +51,10 @@ def save_ndarrays(fname, data):
 
 def load_ndarrays(fname):
     from ..ndarray import NDArray
+    from . import legacy
+    if legacy.is_legacy_ndarray_file(fname):
+        # reference-framework binary .params (ndarray.cc Save/Load framing)
+        return legacy.load_legacy_ndarrays(fname)
     try:
         archive = np.load(fname, allow_pickle=False)
     except (zipfile.BadZipFile, ValueError):

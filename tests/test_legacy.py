@@ -1,0 +1,175 @@
+"""Legacy (reference-format) checkpoint compatibility tests.
+
+Parity model: the reference pins backward-compat with committed fixtures
+(tests/python/unittest/legacy_ndarray.v0, save_000800.json, loaded in
+test_ndarray.py:296). Here the binary fixtures are hand-packed in-test from
+the documented format (an independent writer, so reader/writer bugs cannot
+cancel out); when the reference tree is present its real fixtures are
+loaded too.
+"""
+import json
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.utils import legacy
+
+REF_FIXDIR = "/root/reference/tests/python/unittest"
+
+
+def _pack_shape(shape):
+    return struct.pack("<I", len(shape)) + \
+        struct.pack("<%dq" % len(shape), *shape)
+
+
+def _pack_v2_dense(arr):
+    out = struct.pack("<Ii", legacy.V2_MAGIC, 0)
+    out += _pack_shape(arr.shape)
+    out += struct.pack("<iii", 1, 0, legacy._FLAGS[arr.dtype])
+    return out + arr.tobytes()
+
+
+def _pack_file(arrays, names):
+    out = struct.pack("<QQ", legacy.LIST_MAGIC, 0)
+    out += struct.pack("<Q", len(arrays)) + b"".join(arrays)
+    out += struct.pack("<Q", len(names))
+    for n in names:
+        out += struct.pack("<Q", len(n)) + n.encode()
+    return out
+
+
+def test_load_v2_dense(tmp_path):
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    b = np.ones((2, 2), np.int32)
+    f = tmp_path / "x.params"
+    f.write_bytes(_pack_file([_pack_v2_dense(a), _pack_v2_dense(b)],
+                             ["arg:w", "aux:s"]))
+    loaded = nd.load(str(f))
+    np.testing.assert_array_equal(loaded["arg:w"].asnumpy(), a)
+    np.testing.assert_array_equal(loaded["aux:s"].asnumpy(), b)
+    assert loaded["aux:s"].asnumpy().dtype == np.int32
+
+
+def test_load_v0_record(tmp_path):
+    # V0: leading u32 is ndim, dims are u32, then ctx + type_flag + data
+    a = np.arange(6, dtype=np.float32).reshape(2, 3)
+    rec = struct.pack("<I", 2) + struct.pack("<II", 2, 3) + \
+        struct.pack("<iii", 1, 0, 0) + a.tobytes()
+    f = tmp_path / "v0.params"
+    f.write_bytes(_pack_file([rec], []))
+    loaded = nd.load(str(f))
+    assert isinstance(loaded, list)
+    np.testing.assert_array_equal(loaded[0].asnumpy(), a)
+
+
+def test_load_v2_row_sparse(tmp_path):
+    # row_sparse record: stype=1, storage_shape [2,3], rows [0,4] of (5,3)
+    vals = np.array([[1, 2, 3], [4, 5, 6]], np.float32)
+    idx = np.array([0, 4], np.int64)
+    rec = struct.pack("<Ii", legacy.V2_MAGIC, 1)
+    rec += _pack_shape(vals.shape)          # storage shape
+    rec += _pack_shape((5, 3))              # logical shape
+    rec += struct.pack("<iii", 1, 0, 0)     # ctx + float32
+    rec += struct.pack("<i", 6) + _pack_shape(idx.shape)  # aux int64
+    rec += vals.tobytes() + idx.tobytes()
+    f = tmp_path / "rsp.params"
+    f.write_bytes(_pack_file([rec], ["w"]))
+    dense = nd.load(str(f))["w"].asnumpy()
+    expected = np.zeros((5, 3), np.float32)
+    expected[[0, 4]] = vals
+    np.testing.assert_array_equal(dense, expected)
+
+
+def test_save_legacy_round_trip(tmp_path):
+    data = {"arg:a": mx.nd.array(np.random.randn(4, 5).astype(np.float32)),
+            "arg:b": mx.nd.array(np.arange(3, dtype=np.int64))}
+    f = str(tmp_path / "rt.params")
+    legacy.save_legacy_ndarrays(f, data)
+    assert legacy.is_legacy_ndarray_file(f)
+    loaded = nd.load(f)
+    for k in data:
+        np.testing.assert_array_equal(loaded[k].asnumpy(),
+                                      data[k].asnumpy())
+    # list (unnamed) round trip
+    f2 = str(tmp_path / "rt2.params")
+    legacy.save_legacy_ndarrays(f2, [mx.nd.ones((2, 2))])
+    out = nd.load(f2)
+    assert isinstance(out, list) and out[0].shape == (2, 2)
+
+
+def test_legacy_symbol_json(tmp_path):
+    # oldest era: op params in 'param', node attrs in 'attr', 2-elem inputs
+    graph = {
+        "nodes": [
+            {"op": "null", "name": "data", "param": {}, "inputs": [],
+             "attr": {"ctx_group": "stage1"}},
+            {"op": "null", "name": "fc_weight", "param": {}, "inputs": []},
+            {"op": "null", "name": "fc_bias", "param": {}, "inputs": []},
+            {"op": "FullyConnected", "name": "fc",
+             "param": {"num_hidden": "4", "no_bias": "False"},
+             "attr": {"lr_mult": "0.2"},
+             "inputs": [[0, 0], [1, 0], [2, 0]]},
+            {"op": "Activation", "name": "act",
+             "param": {"act_type": "relu"}, "inputs": [[3, 0]]},
+        ],
+        "arg_nodes": [0, 1, 2],
+        "heads": [[4, 0]],
+    }
+    s = mx.sym.load_json(json.dumps(graph))
+    assert s.list_arguments() == ["data", "fc_weight", "fc_bias"]
+    ex = s.simple_bind(ctx=mx.cpu(), data=(2, 3))
+    ex.arg_dict["fc_weight"][:] = 0.5
+    ex.arg_dict["fc_bias"][:] = -1.0
+    ex.arg_dict["data"][:] = 1.0
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, np.full((2, 4), 0.5), rtol=1e-6)
+
+
+def test_legacy_checkpoint_end_to_end(tmp_path):
+    """A reference-style checkpoint (legacy binary params + legacy JSON)
+    loads through mx.model.load_checkpoint and runs."""
+    graph = {
+        "nodes": [
+            {"op": "null", "name": "data", "param": {}, "inputs": []},
+            {"op": "null", "name": "w", "param": {}, "inputs": []},
+            {"op": "FullyConnected", "name": "fc",
+             "param": {"num_hidden": "2", "no_bias": "True"},
+             "inputs": [[0, 0], [1, 0]]},
+        ],
+        "arg_nodes": [0, 1],
+        "heads": [[2, 0]],
+    }
+    (tmp_path / "m-symbol.json").write_text(json.dumps(graph))
+    w = np.random.randn(2, 3).astype(np.float32)
+    legacy.save_legacy_ndarrays(str(tmp_path / "m-0003.params"),
+                                {"arg:w": mx.nd.array(w)})
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        str(tmp_path / "m"), 3)
+    assert "w" in arg_params and not aux_params
+    ex = sym.bind(mx.cpu(), args={"data": mx.nd.ones((1, 3)),
+                                  "w": arg_params["w"]})
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, w.sum(axis=1)[None], rtol=1e-5)
+
+
+@pytest.mark.skipif(not os.path.exists(REF_FIXDIR),
+                    reason="reference fixtures not present")
+def test_reference_fixtures_load():
+    """The reference's own committed artifacts load: the v0 binary file and
+    the 2015-era save_000800.json multi-layer perceptron."""
+    arrays = nd.load(os.path.join(REF_FIXDIR, "legacy_ndarray.v0"))
+    vals = arrays if isinstance(arrays, list) else list(arrays.values())
+    assert len(vals) >= 1
+    for v in vals:
+        assert v.asnumpy().size > 0
+    sym = mx.sym.load(os.path.join(REF_FIXDIR, "save_000800.json"))
+    args = sym.list_arguments()
+    assert "data" in args and len(args) > 3
+    shapes = dict.fromkeys(args)
+    ex = sym.simple_bind(ctx=mx.cpu(), data=(1, 784))
+    out = ex.forward()[0]
+    assert out.shape[0] == 1

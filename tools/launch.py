@@ -21,10 +21,12 @@ from __future__ import annotations
 
 import argparse
 import os
+import shlex
 import signal
 import socket
 import subprocess
 import sys
+import time
 
 
 def _free_port():
@@ -84,14 +86,28 @@ def main(argv=None):
         for rank in range(args.num_workers):
             procs.append(subprocess.Popen(
                 cmd, env=_worker_env(args, rank, coordinator)))
+        # fail-fast: one dead worker deadlocks the rest in collectives, so
+        # the first nonzero exit kills the whole job (parity: dmlc-tracker)
         rc = 0
         try:
-            for p in procs:
-                rc = p.wait() or rc
+            live = list(procs)
+            while live:
+                time.sleep(0.2)
+                for p in list(live):
+                    ret = p.poll()
+                    if ret is None:
+                        continue
+                    live.remove(p)
+                    if ret != 0:
+                        rc = ret
+                        for q in live:
+                            q.send_signal(signal.SIGTERM)
         except KeyboardInterrupt:
-            for p in procs:
-                p.send_signal(signal.SIGTERM)
             rc = 1
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
         return rc
 
     # ssh launcher: round-robin ranks over the hostfile; worker 0's host is
@@ -104,20 +120,24 @@ def main(argv=None):
         ap.error("hostfile is empty")
     coordinator = (hosts[0], args.port or 9091)
     cwd = os.getcwd()
+    if args.sync_dst_dir:
+        # each unique host syncs exactly once, before any worker launches —
+        # a per-rank sync would rewrite files under a running worker
+        for host in dict.fromkeys(hosts[:args.num_workers] or hosts):
+            subprocess.check_call(["rsync", "-a", "--delete", cwd + "/",
+                                   "%s:%s" % (host, args.sync_dst_dir)])
     procs = []
     for rank in range(args.num_workers):
         host = hosts[rank % len(hosts)]
-        if args.sync_dst_dir:
-            subprocess.check_call(["rsync", "-a", "--delete",
-                                   cwd + "/", "%s:%s" % (host,
-                                                         args.sync_dst_dir)])
         env = _worker_env(args, rank, coordinator)
-        envs = " ".join("%s=%s" % (k, v) for k, v in env.items()
+        envs = " ".join("%s=%s" % (k, shlex.quote(str(v)))
+                        for k, v in env.items()
                         if k.startswith(("DMLC_", "JAX_", "MXNET_",
                                          "PALLAS_")))
         rdir = args.sync_dst_dir or cwd
-        remote = "cd %s && env %s %s" % (rdir, envs,
-                                         " ".join(map(str, cmd)))
+        remote = "cd %s && env %s %s" % (
+            shlex.quote(rdir), envs,
+            " ".join(shlex.quote(str(c)) for c in cmd))
         procs.append(subprocess.Popen(["ssh", "-o",
                                        "StrictHostKeyChecking=no", host,
                                        remote]))
