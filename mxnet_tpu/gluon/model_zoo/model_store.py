@@ -13,13 +13,20 @@ _DEFAULT_ROOT = os.path.join("~", ".mxnet", "models")
 
 def get_model_file(name, root=_DEFAULT_ROOT):
     root = os.path.expanduser(root or _DEFAULT_ROOT)
-    file_path = os.path.join(root, name + ".params")
-    if os.path.exists(file_path):
-        return file_path
+    search = [root]
+    # parity: MXNET_GLUON_REPO overrides the model source; with no network
+    # egress it is honored as an extra local directory to resolve from
+    extra = os.environ.get("MXNET_GLUON_REPO")
+    if extra and not extra.startswith(("http://", "https://")):
+        search.append(os.path.expanduser(extra))
+    for base in search:
+        file_path = os.path.join(base, name + ".params")
+        if os.path.exists(file_path):
+            return file_path
     raise IOError(
         "Pretrained weights %s.params not found under %s and cannot be "
         "downloaded (no network egress). Train from scratch or place the "
-        "file there." % (name, root))
+        "file there." % (name, " or ".join(search)))
 
 
 def purge(root=_DEFAULT_ROOT):

@@ -109,6 +109,23 @@ _load()
 _ENGINE_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
 
 
+def _env_nthreads(num_threads):
+    """Explicit count wins; otherwise MXNET_CPU_WORKER_NTHREADS (parity:
+    docs/faq/env_var.md) sizes the pool; 0 falls through to
+    hardware_concurrency in C++. Bad values are ignored with the variable
+    named, not a bare ValueError from deep inside a constructor."""
+    if num_threads > 0:
+        return num_threads
+    raw = os.environ.get("MXNET_CPU_WORKER_NTHREADS", "0")
+    try:
+        return int(raw)
+    except ValueError:
+        import warnings
+        warnings.warn("ignoring non-integer MXNET_CPU_WORKER_NTHREADS=%r"
+                      % raw)
+        return 0
+
+
 class NativeEngine:
     """Threaded dependency engine (parity: Engine::PushAsync semantics —
     include/mxnet/engine.h:96-295). Python callables run on C++ worker
@@ -118,7 +135,7 @@ class NativeEngine:
 
     def __init__(self, num_threads=0):
         assert AVAILABLE, "native library unavailable"
-        self._h = _lib.EngineCreate(num_threads)
+        self._h = _lib.EngineCreate(_env_nthreads(num_threads))
         self._keepalive = {}
         self._token = 0
         self._drain_buf = (ctypes.c_uint64 * self._DRAIN_BUF_CAP)()
@@ -243,6 +260,7 @@ class NativeImageIter:
         c, h, w = data_shape
         self.batch_size = batch_size
         self.data_shape = data_shape
+        num_threads = _env_nthreads(num_threads)
         self._h = _lib.ImgIterCreate(rec_path.encode(), batch_size, h, w, c,
                                      int(shuffle), num_threads,
                                      int(rand_crop), int(rand_mirror),

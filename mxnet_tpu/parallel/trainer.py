@@ -50,8 +50,13 @@ class TrainStep:
 
     def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
                  mesh=None, data_axis="dp", param_shardings=None,
-                 dtype="float32", remat=False):
+                 dtype="float32", remat=None):
+        import os
         from .. import optimizer as _opt_mod
+        if remat is None:
+            # parity: MXNET_BACKWARD_DO_MIRROR (docs/faq/env_var.md:93) —
+            # trade recompute for activation memory by default when set
+            remat = os.environ.get("MXNET_BACKWARD_DO_MIRROR", "0") == "1"
         self._net = net
         self._loss = loss_fn
         if isinstance(optimizer, str):

@@ -180,6 +180,9 @@ class Counter:
         self.set_value(self.value - delta)
 
 
-# env autostart (parity: MXNET_PROFILER_AUTOSTART, docs/faq/env_var.md:105)
+# env autostart (parity: MXNET_PROFILER_AUTOSTART / MXNET_PROFILER_MODE,
+# docs/faq/env_var.md:105-109)
+if os.environ.get("MXNET_PROFILER_MODE"):
+    _state["config"]["mode"] = os.environ["MXNET_PROFILER_MODE"]
 if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":
     set_state("run")

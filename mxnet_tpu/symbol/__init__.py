@@ -700,19 +700,24 @@ def load_json(json_str):
                 aux_node = SymNode(None, "%s_%s" % (spec["name"], an), [],
                                    {}, is_aux=True)
                 inputs.append((aux_node, 0))
+        node_attr = dict(spec.get("attr") or {})
         if spec["op"] == "null":
-            node = SymNode(None, spec["name"], [], {},
+            node = SymNode(None, spec["name"], [], {}, attr=node_attr,
                            is_aux=spec.get("is_aux", False))
         else:
             opdef = _registry.get(spec["op"])
             kwargs = {k: _parse_attr_value(v)
                       for k, v in spec.get("attrs", {}).items()}
             # legacy files mix node attributes (ctx_group, lr_mult, ...)
-            # into the op params — keep only kwargs the op accepts
+            # into the op params — keep only kwargs the op accepts; the
+            # rejects are node attributes, preserved on SymNode.attr
             accepted = _accepted_params(opdef)
             if accepted is not None:
+                node_attr.update({k: v for k, v in kwargs.items()
+                                  if k not in accepted})
                 kwargs = {k: v for k, v in kwargs.items() if k in accepted}
-            node = SymNode(opdef, spec["name"], inputs, kwargs)
+            node = SymNode(opdef, spec["name"], inputs, kwargs,
+                           attr=node_attr)
         nodes.append(node)
     heads = [(nodes[i], oi) for i, oi, _ in data["heads"]]
     return Symbol(heads)

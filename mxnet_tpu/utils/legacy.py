@@ -109,8 +109,12 @@ def _read_one(r):
     if magic == V1_MAGIC:
         shape = _read_shape(r)
     else:
-        # V0: the magic word IS ndim; dims are u32
+        # V0: the magic word IS ndim; dims are u32. A plausible ndim bounds
+        # the interpretation — anything larger is an unknown future format,
+        # not a 4-billion-dimensional array
         ndim = magic
+        if ndim > 32:
+            raise IOError("unsupported NDArray record magic %#x" % magic)
         shape = list(r.unpack_many("%dI" % ndim)) if ndim else []
     if not shape:
         return None
@@ -205,6 +209,11 @@ def upgrade_json(data):
     for spec in data["nodes"]:
         spec = dict(spec)
         attrs = spec.pop("param", None)
+        node_attr = {}
+        if attrs is not None:
+            # oldest era: 'attr' holds node attributes (ctx_group, lr_mult)
+            # alongside the 'param' op kwargs — keep them as node attrs
+            node_attr = dict(spec.pop("attr", None) or {})
         if attrs is None:
             attrs = spec.pop("attrs", None)
         if attrs is None:
@@ -212,6 +221,7 @@ def upgrade_json(data):
         spec.pop("attrs", None)
         spec.pop("attr", None)
         spec["attrs"] = dict(attrs)
+        spec["attr"] = node_attr
         spec["inputs"] = [list(i) + [0] * (3 - len(i))
                           for i in spec.get("inputs", [])]
         nodes.append(spec)

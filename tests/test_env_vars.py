@@ -1,0 +1,86 @@
+"""Env-knob surface tests (parity model: docs/faq/env_var.md contract —
+documented variables must actually change behavior)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def test_bigarray_bound_read_at_call_time(monkeypatch):
+    from mxnet_tpu import kvstore as kvs
+    monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "1234")
+    assert kvs._bigarray_bound() == 1234
+    monkeypatch.delenv("MXNET_KVSTORE_BIGARRAY_BOUND")
+    assert kvs._bigarray_bound() == 1000000
+
+
+def test_backward_do_mirror_default(monkeypatch):
+    from mxnet_tpu.parallel.trainer import TrainStep
+    from mxnet_tpu import gluon
+    net = gluon.nn.Dense(2, in_units=3)
+    net.initialize()
+    loss = gluon.loss.L2Loss()
+    monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
+    assert TrainStep(net, loss)._remat is True
+    monkeypatch.delenv("MXNET_BACKWARD_DO_MIRROR")
+    assert TrainStep(net, loss)._remat is False
+    # explicit argument wins over the env default
+    monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
+    assert TrainStep(net, loss, remat=False)._remat is False
+    # the remat step still trains correctly
+    step = TrainStep(net, loss, "sgd", {"learning_rate": 0.1})
+    assert step._remat is True
+    l0 = float(step(mx.nd.ones((4, 3)), mx.nd.zeros((4, 2))))
+    for _ in range(10):
+        l1 = float(step(mx.nd.ones((4, 3)), mx.nd.zeros((4, 2))))
+    assert l1 < l0
+
+
+def test_profiler_autostart_subprocess():
+    code = (
+        "import os; os.environ['JAX_PLATFORMS']='cpu'; "
+        "os.environ.pop('PALLAS_AXON_POOL_IPS', None); "
+        "os.environ['MXNET_PROFILER_AUTOSTART']='1'; "
+        "os.environ['MXNET_PROFILER_MODE']='imperative'; "
+        "import mxnet_tpu as mx; "
+        "from mxnet_tpu import profiler; "
+        "assert profiler.is_running(); "
+        "assert profiler._state['config']['mode'] == 'imperative'; "
+        "a = mx.nd.ones((4, 4)); (a + a).wait_to_read(); "
+        "assert profiler._state['events']; print('AUTOSTART_OK')"
+    )
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=180,
+                         env={**os.environ, "PYTHONPATH": REPO})
+    assert "AUTOSTART_OK" in out.stdout, (out.stdout, out.stderr)
+
+
+def test_gluon_repo_local_dir(monkeypatch, tmp_path):
+    from mxnet_tpu.gluon.model_zoo import model_store
+    (tmp_path / "toy.params").write_bytes(b"x")
+    monkeypatch.setenv("MXNET_GLUON_REPO", str(tmp_path))
+    assert model_store.get_model_file("toy") == str(tmp_path / "toy.params")
+    monkeypatch.delenv("MXNET_GLUON_REPO")
+    with pytest.raises(IOError):
+        model_store.get_model_file("toy")
+
+
+def test_cpu_worker_nthreads(monkeypatch):
+    from mxnet_tpu import native
+    if not native.AVAILABLE:
+        pytest.skip("native library unavailable")
+    monkeypatch.setenv("MXNET_CPU_WORKER_NTHREADS", "2")
+    eng = native.NativeEngine()
+    # engine functions with the env-sized pool
+    token = {"done": False}
+    v = eng.new_var()
+    eng.push(lambda: token.__setitem__("done", True), read_vars=(),
+             write_vars=(v,))
+    eng.wait_all()
+    assert token["done"]

@@ -121,6 +121,10 @@ def test_legacy_symbol_json(tmp_path):
     }
     s = mx.sym.load_json(json.dumps(graph))
     assert s.list_arguments() == ["data", "fc_weight", "fc_bias"]
+    # node attributes from the legacy 'attr' dicts survive the upgrade
+    attrs = s.attr_dict()
+    assert attrs.get("data", {}).get("ctx_group") == "stage1"
+    assert str(attrs.get("fc", {}).get("lr_mult")) == "0.2"
     ex = s.simple_bind(ctx=mx.cpu(), data=(2, 3))
     ex.arg_dict["fc_weight"][:] = 0.5
     ex.arg_dict["fc_bias"][:] = -1.0
@@ -169,7 +173,6 @@ def test_reference_fixtures_load():
     sym = mx.sym.load(os.path.join(REF_FIXDIR, "save_000800.json"))
     args = sym.list_arguments()
     assert "data" in args and len(args) > 3
-    shapes = dict.fromkeys(args)
     ex = sym.simple_bind(ctx=mx.cpu(), data=(1, 784))
     out = ex.forward()[0]
     assert out.shape[0] == 1

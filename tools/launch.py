@@ -37,6 +37,37 @@ def _free_port():
     return port
 
 
+def _wait_fail_fast(procs):
+    """Wait on a worker fleet; the FIRST nonzero exit kills the rest — one
+    dead worker deadlocks the survivors in collectives (parity:
+    dmlc-tracker killing the job on any worker failure). The original
+    failure code is preserved (not the -SIGTERM of the peers it killed)."""
+    rc = 0
+    signalled = False
+    try:
+        live = list(procs)
+        while live:
+            time.sleep(0.2)
+            for p in list(live):
+                ret = p.poll()
+                if ret is None:
+                    continue
+                live.remove(p)
+                if ret != 0 and rc == 0:
+                    rc = ret
+                if rc != 0 and not signalled:
+                    signalled = True
+                    for q in live:
+                        q.send_signal(signal.SIGTERM)
+    except KeyboardInterrupt:
+        rc = 1
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return rc
+
+
 def _worker_env(args, rank, coordinator):
     env = dict(os.environ)
     env.update({
@@ -86,29 +117,7 @@ def main(argv=None):
         for rank in range(args.num_workers):
             procs.append(subprocess.Popen(
                 cmd, env=_worker_env(args, rank, coordinator)))
-        # fail-fast: one dead worker deadlocks the rest in collectives, so
-        # the first nonzero exit kills the whole job (parity: dmlc-tracker)
-        rc = 0
-        try:
-            live = list(procs)
-            while live:
-                time.sleep(0.2)
-                for p in list(live):
-                    ret = p.poll()
-                    if ret is None:
-                        continue
-                    live.remove(p)
-                    if ret != 0:
-                        rc = ret
-                        for q in live:
-                            q.send_signal(signal.SIGTERM)
-        except KeyboardInterrupt:
-            rc = 1
-        finally:
-            for p in procs:
-                if p.poll() is None:
-                    p.kill()
-        return rc
+        return _wait_fail_fast(procs)
 
     # ssh launcher: round-robin ranks over the hostfile; worker 0's host is
     # the coordinator (parity: dmlc-tracker ssh.py)
@@ -141,10 +150,7 @@ def main(argv=None):
         procs.append(subprocess.Popen(["ssh", "-o",
                                        "StrictHostKeyChecking=no", host,
                                        remote]))
-    rc = 0
-    for p in procs:
-        rc = p.wait() or rc
-    return rc
+    return _wait_fail_fast(procs)
 
 
 if __name__ == "__main__":
