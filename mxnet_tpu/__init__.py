@@ -66,6 +66,8 @@ from . import operator
 from .operator import CustomOp, CustomOpProp
 from . import rtc
 from . import contrib
+from . import predict
+from .predict import Predictor
 
 # Under tools/launch.py the DMLC_* worker env is present: join the
 # distributed job NOW, before anything can initialise the XLA backend

@@ -123,22 +123,29 @@ def _read_one(r):
     return _read_array_data(r, shape, type_flag)
 
 
-def is_legacy_ndarray_file(fname):
-    try:
-        with open(fname, "rb") as f:
-            head = f.read(8)
-        return len(head) == 8 and \
-            struct.unpack("<Q", head)[0] == LIST_MAGIC
-    except OSError:
-        return False
+def is_legacy_ndarray_file(src):
+    """True when `src` (a path or a byte buffer) starts with the reference
+    list magic."""
+    if isinstance(src, (bytes, bytearray)):
+        head = bytes(src[:8])
+    else:
+        try:
+            with open(src, "rb") as f:
+                head = f.read(8)
+        except OSError:
+            return False
+    return len(head) == 8 and struct.unpack("<Q", head)[0] == LIST_MAGIC
 
 
-def load_legacy_ndarrays(fname):
-    """Read a reference .params file -> dict[str, NDArray] (or list when the
-    file carries no names)."""
+def load_legacy_ndarrays(src):
+    """Read a reference .params file (path or byte buffer) ->
+    dict[str, NDArray] (or list when the file carries no names)."""
     from ..ndarray import NDArray
-    with open(fname, "rb") as f:
-        r = _Reader(f.read())
+    if isinstance(src, (bytes, bytearray)):
+        r = _Reader(bytes(src))
+    else:
+        with open(src, "rb") as f:
+            r = _Reader(f.read())
     header, _reserved = r.unpack("QQ")
     if header != LIST_MAGIC:
         raise IOError("not a legacy NDArray file (magic %#x)" % header)

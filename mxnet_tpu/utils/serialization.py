@@ -50,15 +50,21 @@ def save_ndarrays(fname, data):
 
 
 def load_ndarrays(fname):
+    """Load a .params container from a path or raw byte buffer (the
+    c_predict_api contract passes param bytes). Auto-detects the
+    reference-framework binary format."""
     from ..ndarray import NDArray
     from . import legacy
     if legacy.is_legacy_ndarray_file(fname):
         # reference-framework binary .params (ndarray.cc Save/Load framing)
         return legacy.load_legacy_ndarrays(fname)
+    src = io.BytesIO(bytes(fname)) if isinstance(fname, (bytes, bytearray)) \
+        else fname
     try:
-        archive = np.load(fname, allow_pickle=False)
+        archive = np.load(src, allow_pickle=False)
     except (zipfile.BadZipFile, ValueError):
-        raise IOError("not an mxnet_tpu .params/.npz archive: %s" % fname)
+        raise IOError("not an mxnet_tpu .params/.npz archive: %s"
+                      % (fname if isinstance(fname, str) else "<bytes>"))
     items = {}
     is_list = False
     for key in archive.files:
