@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import weakref
 
 import jax
 
@@ -42,10 +43,32 @@ def maybe_sync(data):
     return data
 
 
+# Buffers the framework dispatched since the last wait_all. Weak values:
+# collected buffers need no sync and drop out automatically. jax.Array is
+# unhashable, so a WeakSet can't hold it — key by id instead.
+_PENDING = weakref.WeakValueDictionary()
+
+
+def note(data):
+    """Record a dispatched device buffer (called from NDArray creation) so
+    wait_all syncs exactly the framework's outstanding work."""
+    try:
+        _PENDING[id(data)] = data
+    except TypeError:
+        pass  # non-weakref-able host value: nothing async to wait on
+
+
 def wait_all():
-    """Parity: Engine::WaitForAll / mx.nd.waitall."""
-    for d in jax.live_arrays():
-        d.block_until_ready()
+    """Parity: Engine::WaitForAll / mx.nd.waitall.
+
+    Blocks on the buffers this framework dispatched (deterministic scope),
+    not on every live array in the process — another library's arrays are
+    not this engine's business."""
+    pending = list(_PENDING.values())
+    _PENDING.clear()
+    for d in pending:
+        if hasattr(d, "block_until_ready"):
+            d.block_until_ready()
 
 
 @contextlib.contextmanager

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from .base import MXNetError
 from .context import current_context
+from . import engine as _engine
 from . import random as _random
 from . import profiler as _profiler
 from .ndarray import NDArray
@@ -133,6 +134,7 @@ class Executor:
                 jax.block_until_ready(outs)
         for n, v in new_aux.items():
             self.aux_dict[n]._data = v
+            _engine.note(v)
         self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
         if self._monitor_callback is not None:
             for name, out in zip(self._symbol.list_outputs(), self.outputs):

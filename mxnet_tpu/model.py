@@ -27,14 +27,7 @@ def load_checkpoint(prefix, epoch):
     """Parity: model.py:395."""
     symbol = sym_mod.load("%s-symbol.json" % prefix)
     save_dict = serialization.load_ndarrays("%s-%04d.params" % (prefix, epoch))
-    arg_params = {}
-    aux_params = {}
-    for k, v in save_dict.items():
-        tp, name = k.split(":", 1)
-        if tp == "arg":
-            arg_params[name] = v
-        if tp == "aux":
-            aux_params[name] = v
+    arg_params, aux_params = serialization.split_arg_aux(save_dict)
     return (symbol, arg_params, aux_params)
 
 

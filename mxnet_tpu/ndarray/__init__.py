@@ -97,8 +97,8 @@ def split_v2(ary, indices_or_sections, axis=0, squeeze_axis=False):
 
 def waitall():
     """Block until all launched work completes (parity: mx.nd.waitall)."""
-    import jax
-    (jax.device_put(0.0) + 0).block_until_ready()
+    from .. import engine
+    engine.wait_all()
 
 
 def load(fname):

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import autograd
+from .. import engine as _engine
 from .. import profiler as _profiler
 from .. import random as _random
 from ..base import MXNetError, dtype_np
@@ -64,6 +65,7 @@ class NDArray:
         self._version = 0
         self._written = False
         self._stype = "default"
+        _engine.note(data)  # wait_all() syncs exactly what we dispatched
 
     # -- basic properties ---------------------------------------------------
     @property
@@ -202,6 +204,7 @@ class NDArray:
             value = value._data
         value = jnp.asarray(value, dtype=self._data.dtype)
         self._data = self._data.at[idx].set(value)
+        _engine.note(self._data)  # rebind: a fresh buffer wait_all must see
         self._version += 1
 
     def __len__(self):

@@ -15,3 +15,4 @@ from .ring_attention import ring_attention, ring_attention_sharded
 from . import collectives
 from .pipeline import gpipe_apply
 from .functional import functionalize, swap_param_buffers
+from .embedding import row_sharded_spec, shard_embedding_params

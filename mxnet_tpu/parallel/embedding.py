@@ -1,0 +1,39 @@
+"""Sharded embedding flow over the device mesh.
+
+Parity: the reference shards large (row-sparse) embeddings across parameter
+servers and pulls only the needed rows per step
+(`src/kvstore/kvstore_dist.h:437-476`, `python/mxnet/kvstore.py:307`,
+`example/sparse/*`).
+
+TPU-native redesign: the table is a mesh-sharded parameter — rows split
+over an axis via `PartitionSpec(axis, None)` — and the lookup is a plain
+gather inside the jitted step. GSPMD partitions the gather (each shard
+serves its rows, a psum combines) and keeps the backward scatter-add
+sharded, so only touched-row gradients move over ICI: the row_sparse_pull
+capability without a parameter server. Use `row_sharded_spec()` in
+`TrainStep(param_shardings=...)` or any pjit sharding map.
+"""
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+
+def row_sharded_spec(axis="tp"):
+    """PartitionSpec sharding an embedding table's vocabulary rows over a
+    mesh axis (the PS key-sharding analog)."""
+    return P(axis, None)
+
+
+def shard_embedding_params(net, mesh_axis="tp", pattern="embedding"):
+    """Build a TrainStep `param_shardings` dict that row-shards every
+    embedding weight of `net` (matched by name) over `mesh_axis`, e.g.:
+
+        shardings = shard_embedding_params(net, "tp")
+        step = TrainStep(net, loss, mesh=mesh, param_shardings=shardings)
+    """
+    out = {}
+    for name, p in net.collect_params().items():
+        if pattern in name and name.endswith("weight") and \
+                p.shape is not None and len(p.shape) == 2:
+            out[name] = row_sharded_spec(mesh_axis)
+    return out

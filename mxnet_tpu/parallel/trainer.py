@@ -28,6 +28,8 @@ performs the same overlap inside this single program.
 """
 from __future__ import annotations
 
+import contextlib
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -36,6 +38,53 @@ from ..ndarray import NDArray
 from .. import autograd
 from .. import random as _random
 from .. import optimizer_rules as _rules
+
+
+def _remat_eligible_children(net):
+    """Top-level children safe to checkpoint as remat segments: blocks whose
+    forward mutates auxiliary state (grad_req 'null' params — BatchNorm
+    running stats) are excluded, because their buffer rebinds inside a
+    checkpointed trace would leak tracers into the outer aux collection."""
+    children = list(getattr(net, "_children", {}).values())
+    return [c for c in children
+            if all(p.grad_req != "null"
+                   for p in c.collect_params().values())]
+
+
+@contextlib.contextmanager
+def _segment_remat(blocks):
+    """Wrap each block's forward in jax.checkpoint for the duration of the
+    step trace. Whole-function checkpoint saves nothing at peak (the
+    backward's recompute carries the same live set); per-segment checkpoint
+    keeps only segment boundaries alive — the real
+    MXNET_BACKWARD_DO_MIRROR/memonger trade."""
+    saved = []
+    for block in blocks:
+        orig = block.forward
+
+        def wrapped(*args, _orig=orig):
+            if len(args) == 1 and isinstance(args[0], NDArray):
+                # single trace through checkpoint — no retry path, so the
+                # stateful trace-key counter advances exactly once and
+                # remat numerics match the non-remat step bit for bit
+                def pure(xv):
+                    out = _orig(NDArray(xv))
+                    if isinstance(out, NDArray):
+                        return out._data
+                    return tuple(o._data for o in out)
+                res = jax.checkpoint(pure)(args[0]._data)
+                if isinstance(res, tuple):
+                    return tuple(NDArray(r) for r in res)
+                return NDArray(res)
+            return _orig(*args)
+
+        saved.append((block, orig))
+        block.forward = wrapped
+    try:
+        yield
+    finally:
+        for block, orig in saved:
+            block.forward = orig
 
 
 class TrainStep:
@@ -113,6 +162,7 @@ class TrainStep:
         base_wd = opt.wd
         cdtype = self._compute_dtype
         mixed = cdtype != jnp.float32
+        remat_blocks = _remat_eligible_children(net) if self._remat else []
 
         def forward_loss(grad_vals, nograd_vals, x, y, key):
             """Trace the eager net with tracer-backed parameter buffers.
@@ -136,9 +186,11 @@ class TrainStep:
                 x = x.astype(cdtype) if jnp.issubdtype(
                     jnp.asarray(x).dtype, jnp.floating) else x
             from .functional import swap_param_buffers
+            remat_ctx = _segment_remat(remat_blocks) if remat_blocks \
+                else contextlib.nullcontext()
             with swap_param_buffers(plist, merged) as injected:
                 with autograd._RecordingStateScope(False, True), \
-                        _random.trace_key_scope(key):
+                        _random.trace_key_scope(key), remat_ctx:
                     out = net.forward(NDArray(x))
                     if mixed:
                         # f32 softmax/loss for numerical stability
@@ -149,10 +201,9 @@ class TrainStep:
                            if p._data._data is not injected[i]}
             return loss_val, aux_upd
 
-        if self._remat:
-            # recompute activations in backward (reference capability:
-            # MXNET_BACKWARD_DO_MIRROR) — aux outputs are tiny, so
-            # checkpointing the whole traced forward is fine
+        if self._remat and not remat_blocks:
+            # no segmentable children: whole-forward checkpoint (weaker —
+            # peak is unchanged, but recompute semantics are preserved)
             forward_loss = jax.checkpoint(forward_loss)
 
         def step(grad_vals, nograd_vals, opt_state, x, y, key, lr, t):
@@ -231,6 +282,12 @@ class TrainStep:
         else:
             lr = self._opt.lr
         key = _random.next_key()
+        if first_call:
+            self._example_args = jax.tree.map(
+                lambda v: jax.ShapeDtypeStruct(jnp.shape(v),
+                                               jnp.asarray(v).dtype),
+                (self._grad_vals, self._nograd_vals, self._opt_state, xv,
+                 yv, key, jnp.float32(0.0), jnp.int32(0)))
         # compile vs run split in the profiler table: the first dispatch pays
         # XLA compilation, later ones are cached executions (parity with the
         # reference's symbolic bind-vs-run accounting)
@@ -242,7 +299,32 @@ class TrainStep:
                               jnp.float32(lr), jnp.int32(self._t))
             if _profiler.profile_sync():
                 jax.block_until_ready(loss)
+        # register the step's output buffers so mx.nd.waitall() blocks on
+        # in-flight optimizer updates (the benchmark timing pattern)
+        from .. import engine as _engine
+        jax.tree.map(_engine.note, (loss, self._grad_vals,
+                                    self._nograd_vals, self._opt_state))
         return loss
+
+    def memory_analysis(self):
+        """XLA memory accounting of the compiled step (requires one prior
+        call). `temp_size_in_bytes` is the live-activation footprint — the
+        number the MXNET_BACKWARD_DO_MIRROR/remat trade shrinks on TPU
+        (reference: memonger's measurement, docs/faq/env_var.md:93). Note
+        XLA:CPU CSEs rematerialization away, so the difference shows on
+        device backends; `lowered_stablehlo()` shows the program-level
+        recompute on any backend."""
+        if self._step_fn is None or not hasattr(self, "_example_args"):
+            raise RuntimeError("run at least one step first")
+        return self._step_fn.lower(*self._example_args).compile() \
+            .memory_analysis()
+
+    def lowered_stablehlo(self):
+        """Pre-optimization StableHLO of the step (requires one prior
+        call) — e.g. for auditing remat recompute + optimization barriers."""
+        if self._step_fn is None or not hasattr(self, "_example_args"):
+            raise RuntimeError("run at least one step first")
+        return self._step_fn.lower(*self._example_args).as_text()
 
     def sync_params(self):
         """Write device buffers back into the Parameters (for eval/save)."""

@@ -42,15 +42,9 @@ def _load_param_payload(params):
 
 
 def _split_arg_aux(payload):
-    arg_params, aux_params = {}, {}
-    for k, v in payload.items():
-        if k.startswith("arg:"):
-            arg_params[k[4:]] = v
-        elif k.startswith("aux:"):
-            aux_params[k[4:]] = v
-        else:
-            arg_params[k] = v
-    return arg_params, aux_params
+    from .utils.serialization import split_arg_aux
+    # bare keys (plain npz saves) serve as arg params at predict time
+    return split_arg_aux(payload, unprefixed="arg")
 
 
 class Predictor:

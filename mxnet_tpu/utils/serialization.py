@@ -81,3 +81,22 @@ def load_ndarrays(fname):
     if is_list:
         return [items[i] for i in sorted(items)]
     return items
+
+
+def split_arg_aux(payload, unprefixed=None):
+    """Split a checkpoint dict on the reference 'arg:'/'aux:' key prefixes
+    (one implementation of the format contract — model.load_checkpoint and
+    the predict path both call this).
+
+    unprefixed: 'arg' treats bare keys as arg params (plain npz saves);
+    None drops them (the reference load_checkpoint behavior).
+    """
+    arg_params, aux_params = {}, {}
+    for k, v in payload.items():
+        if k.startswith("arg:"):
+            arg_params[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux_params[k[4:]] = v
+        elif unprefixed == "arg":
+            arg_params[k] = v
+    return arg_params, aux_params
