@@ -67,8 +67,12 @@ def wait_all():
     pending = list(_PENDING.values())
     _PENDING.clear()
     for d in pending:
-        if hasattr(d, "block_until_ready"):
-            d.block_until_ready()
+        try:
+            if hasattr(d, "block_until_ready"):
+                d.block_until_ready()
+        except RuntimeError:
+            # donated/deleted buffer: its consumer already completed it
+            pass
 
 
 @contextlib.contextmanager

@@ -134,7 +134,6 @@ class Executor:
                 jax.block_until_ready(outs)
         for n, v in new_aux.items():
             self.aux_dict[n]._data = v
-            _engine.note(v)
         self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
         if self._monitor_callback is not None:
             for name, out in zip(self._symbol.list_outputs(), self.outputs):

@@ -107,13 +107,11 @@ class Trainer:
         # single logical buffer per param: nothing to reduce locally.
         # multi-host data parallelism: psum grads over the process mesh.
         if self._kvstore is not None and self._kvstore.num_workers > 1:
-            from .. import engine as _engine
             for param in self._params:
                 if param.grad_req != "null":
                     g = param.grad()
                     g._data = kvs._multihost_psum(g._data) / \
                         self._kvstore.num_workers
-                    _engine.note(g._data)
 
     def _update(self, ignore_stale_grad=False):
         updater = self._updaters[0]

@@ -45,8 +45,20 @@ class _AdhocOp:
 
 
 class NDArray:
-    __slots__ = ("_data", "_ctx", "_grad", "_entry", "_version", "_written",
-                 "_stype", "__weakref__")
+    __slots__ = ("_data_buf", "_ctx", "_grad", "_entry", "_version",
+                 "_written", "_stype", "__weakref__")
+
+    @property
+    def _data(self):
+        return self._data_buf
+
+    @_data.setter
+    def _data(self, value):
+        # the ONE rebind chokepoint: every fresh buffer (op result, setitem
+        # scatter, optimizer update, executor aux write, copyto...) lands
+        # here, so wait_all's pending registry can't miss a dispatch site
+        self._data_buf = value
+        _engine.note(value)
 
     def __init__(self, data, ctx=None, dtype=None):
         if isinstance(data, NDArray):
@@ -58,14 +70,13 @@ class NDArray:
             if arr.dtype == np.float64:
                 arr = arr.astype(np.float32)
             data = jnp.asarray(arr)
-        self._data = data
+        self._data = data  # property setter registers it for wait_all
         self._ctx = ctx if ctx is not None else current_context()
         self._grad = None
         self._entry = None
         self._version = 0
         self._written = False
         self._stype = "default"
-        _engine.note(data)  # wait_all() syncs exactly what we dispatched
 
     # -- basic properties ---------------------------------------------------
     @property
@@ -204,7 +215,6 @@ class NDArray:
             value = value._data
         value = jnp.asarray(value, dtype=self._data.dtype)
         self._data = self._data.at[idx].set(value)
-        _engine.note(self._data)  # rebind: a fresh buffer wait_all must see
         self._version += 1
 
     def __len__(self):

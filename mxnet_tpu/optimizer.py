@@ -201,9 +201,7 @@ create = Optimizer.create_optimizer
 
 
 def _assign(weight, new):
-    from . import engine as _engine
     weight._data = new.astype(weight._data.dtype)
-    _engine.note(weight._data)  # rebind: wait_all must see the update
     weight._version += 1
 
 

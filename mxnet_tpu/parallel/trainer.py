@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import contextlib
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -59,7 +60,14 @@ def _segment_remat(blocks):
     keeps only segment boundaries alive — the real
     MXNET_BACKWARD_DO_MIRROR/memonger trade."""
     saved = []
+    active = []
     for block in blocks:
+        # hybridized blocks route through their CachedOp and would bypass
+        # the wrapped forward — deactivate for this trace (inside the step
+        # everything is jitted anyway, the CachedOp adds nothing)
+        if getattr(block, "_active", False):
+            active.append(block)
+            block._active = False
         orig = block.forward
 
         def wrapped(*args, _orig=orig):
@@ -85,6 +93,8 @@ def _segment_remat(blocks):
     finally:
         for block, orig in saved:
             block.forward = orig
+        for block in active:
+            block._active = True
 
 
 class TrainStep:
@@ -125,6 +135,7 @@ class TrainStep:
         self._lr_schedule = None
         self._t = 0
         self._step_fn = None
+        self._compiled = False
 
     def set_lr_schedule(self, fn):
         self._lr_schedule = fn
@@ -267,9 +278,11 @@ class TrainStep:
         from .. import profiler as _profiler
         xv = x._data if isinstance(x, NDArray) else jnp.asarray(x)
         yv = y._data if isinstance(y, NDArray) else jnp.asarray(y)
-        first_call = self._step_fn is None
-        if first_call:
+        if self._step_fn is None:
             self._build()
+        # first DISPATCH (not first build — load_state_dict also builds)
+        # pays XLA compilation and captures the example specs
+        first_call = not self._compiled
         if self._mesh is not None:
             from .mesh import shard_batch
             xv = shard_batch(self._mesh, xv, self._data_axis)
@@ -299,6 +312,7 @@ class TrainStep:
                               jnp.float32(lr), jnp.int32(self._t))
             if _profiler.profile_sync():
                 jax.block_until_ready(loss)
+        self._compiled = True
         # register the step's output buffers so mx.nd.waitall() blocks on
         # in-flight optimizer updates (the benchmark timing pattern)
         from .. import engine as _engine
@@ -325,6 +339,40 @@ class TrainStep:
         if self._step_fn is None or not hasattr(self, "_example_args"):
             raise RuntimeError("run at least one step first")
         return self._step_fn.lower(*self._example_args).as_text()
+
+    def state_dict(self):
+        """Full resumable training state (params + optimizer state + step
+        counter) for utils.recovery.CheckpointManager. Materialized to host
+        arrays — the live device buffers get donated by the next step, so
+        handing out references would leave the caller with deleted arrays."""
+        if self._step_fn is None:
+            self._build()
+        host = jax.tree.map(np.asarray,
+                            (tuple(self._grad_vals),
+                             tuple(self._nograd_vals),
+                             tuple(self._opt_state)))
+        return {"t": np.int64(self._t), "grad_vals": host[0],
+                "nograd_vals": host[1], "opt_state": host[2]}
+
+    def load_state_dict(self, state):
+        if self._step_fn is None:
+            self._build()
+        self._t = int(state["t"])
+
+        def place(tmpl, v):
+            arr = jnp.asarray(np.asarray(v), dtype=jnp.asarray(tmpl).dtype)
+            if self._mesh is not None:
+                arr = jax.device_put(arr, tmpl.sharding)
+            return arr
+
+        self._grad_vals = tuple(
+            place(t, v) for t, v in zip(self._grad_vals,
+                                        state["grad_vals"]))
+        self._nograd_vals = tuple(
+            place(t, v) for t, v in zip(self._nograd_vals,
+                                        state["nograd_vals"]))
+        self._opt_state = jax.tree.map(place, tuple(self._opt_state),
+                                       tuple(state["opt_state"]))
 
     def sync_params(self):
         """Write device buffers back into the Parameters (for eval/save)."""

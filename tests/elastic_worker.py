@@ -1,0 +1,67 @@
+"""Crash-and-resume training worker (driven by test_recovery.py).
+
+Trains a deterministic TrainStep run with periodic async checkpoints; with
+MXTPU_CRASH_AT set, simulates a preemption by hard-exiting (os._exit, no
+cleanup — the async save machinery must cope). On relaunch it auto-resumes
+from the newest intact checkpoint. Prints the final step + a param hash so
+the test can compare against an uninterrupted run.
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon  # noqa: E402
+from mxnet_tpu.parallel.trainer import TrainStep  # noqa: E402
+from mxnet_tpu.utils.recovery import CheckpointManager  # noqa: E402
+
+TOTAL_STEPS = 30
+SAVE_EVERY = 5
+
+
+def batch_for(step):
+    rng = np.random.RandomState(1000 + step)
+    x = rng.randn(8, 6).astype(np.float32)
+    y = rng.randint(0, 3, (8,)).astype(np.float32)
+    return x, y
+
+
+def main():
+    ckpt_dir = sys.argv[1]
+    crash_at = int(os.environ.get("MXTPU_CRASH_AT", "-1"))
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, in_units=6, activation="relu"))
+    net.add(gluon.nn.Dense(3, in_units=16))
+    net.initialize(mx.init.Xavier())
+    step_fn = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                        "adam", {"learning_rate": 0.01})
+    mgr = CheckpointManager(ckpt_dir, keep=2)
+    start = 0
+    state = mgr.restore_latest()
+    if state is not None:
+        start, tree = state
+        step_fn.load_state_dict(tree)
+        print("resumed from step %d" % start, flush=True)
+    for step in range(start, TOTAL_STEPS):
+        x, y = batch_for(step)
+        step_fn(x, y)
+        done = step + 1
+        if done % SAVE_EVERY == 0:
+            mgr.save(done, step_fn.state_dict())
+        if crash_at == done:
+            os._exit(17)  # simulated preemption: no flush, no cleanup
+    mgr.wait()
+    step_fn.sync_params()
+    flat = np.concatenate([p.data().asnumpy().ravel()
+                           for p in net.collect_params().values()])
+    print("FINAL step=%d hash=%.8f" % (TOTAL_STEPS, float(np.sum(flat * flat))),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

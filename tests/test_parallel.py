@@ -408,3 +408,73 @@ def test_wait_all_scoped_to_framework_buffers():
     mx.nd.waitall()
     assert len(engine._PENDING) == 0
     assert b.asnumpy()[0, 0] == 64.0
+
+
+def test_waitall_after_trainstep_with_donation():
+    """The benchmark pattern: steps then waitall — donated (deleted)
+    buffers in the pending registry must not raise."""
+    from mxnet_tpu.gluon import nn, loss as gloss
+    from mxnet_tpu.parallel.trainer import TrainStep
+    net = nn.Dense(4, in_units=6)
+    net.initialize(mx.init.Xavier())
+    step = TrainStep(net, gloss.L2Loss(), "sgd", {"learning_rate": 0.1})
+    for _ in range(3):
+        step(rand(8, 6), rand(8, 4))
+    mx.nd.waitall()  # must not raise on donated param buffers
+
+
+def test_state_dict_survives_next_step():
+    """state_dict is host-materialized: the next (donating) step must not
+    invalidate a held checkpoint."""
+    from mxnet_tpu.gluon import nn, loss as gloss
+    from mxnet_tpu.parallel.trainer import TrainStep
+    net = nn.Dense(4, in_units=6)
+    net.initialize(mx.init.Xavier())
+    step = TrainStep(net, gloss.L2Loss(), "sgd", {"learning_rate": 0.1})
+    step(rand(8, 6), rand(8, 4))
+    state = step.state_dict()
+    step(rand(8, 6), rand(8, 4))  # donates the buffers state snapshotted
+    w = np.asarray(state["grad_vals"][0])  # still readable
+    assert np.isfinite(w).all()
+    # and restoring rewinds to the snapshot
+    step.load_state_dict(state)
+    assert step._t == int(state["t"])
+
+
+def test_remat_applies_to_hybridized_children():
+    """Segmented remat must not be bypassed by hybridize()'s CachedOp."""
+    from mxnet_tpu.gluon import nn, loss as gloss
+    from mxnet_tpu.parallel.trainer import TrainStep
+    np.random.seed(6)
+    net = nn.HybridSequential(prefix="h_")
+    with net.name_scope():
+        for _ in range(3):
+            net.add(nn.Dense(64, activation="relu"))
+        net.add(nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net(nd.zeros((1, 16)))
+    net.hybridize()
+    step = TrainStep(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+                     {"learning_rate": 0.1}, remat=True)
+    step(rand(8, 16), np.zeros((8,), np.float32))
+    txt = step.lowered_stablehlo()
+    assert txt.count("optimization_barrier") > 0, "remat bypassed"
+
+
+def test_memory_analysis_after_resume():
+    """load_state_dict builds the step early; the analysis APIs must still
+    work after the first real dispatch."""
+    from mxnet_tpu.gluon import nn, loss as gloss
+    from mxnet_tpu.parallel.trainer import TrainStep
+    net = nn.Dense(4, in_units=6)
+    net.initialize(mx.init.Xavier())
+    step = TrainStep(net, gloss.L2Loss(), "sgd", {"learning_rate": 0.1})
+    step(rand(8, 6), rand(8, 4))
+    state = step.state_dict()
+
+    net2 = nn.Dense(4, in_units=6)
+    net2.initialize(mx.init.Xavier())
+    step2 = TrainStep(net2, gloss.L2Loss(), "sgd", {"learning_rate": 0.1})
+    step2.load_state_dict(state)  # builds before any dispatch
+    step2(rand(8, 6), rand(8, 4))
+    assert step2.memory_analysis().temp_size_in_bytes >= 0
