@@ -68,18 +68,33 @@ def _probe_backend(timeout):
 def main():
     smoke = os.environ.get("BENCH_SMOKE", "") == "1"
     probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
+    inner = os.environ.get("BENCH_INNER", "") == "1"
 
-    if not smoke:
+    if not smoke and not inner:
         platform, kind = _probe_backend(probe_timeout)
         if platform is None:  # retry once — first contact can be slow
             platform, kind = _probe_backend(probe_timeout)
-        if platform is None or platform == "cpu":
-            # accelerator unreachable: fall back to CPU smoke so the driver
-            # always gets a JSON line instead of a hang/timeout
-            smoke = True
-            os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-            os.environ["JAX_PLATFORMS"] = "cpu"
-    else:
+        if platform is not None and platform != "cpu":
+            # run the REAL benchmark in a subprocess with a hard timeout: a
+            # tunnel that wedges after a healthy probe still cannot hang
+            # the bench — we fall back to the CPU smoke below
+            total = int(os.environ.get("BENCH_TOTAL_TIMEOUT", "1500"))
+            env = dict(os.environ, BENCH_INNER="1")
+            try:
+                out = subprocess.run([sys.executable, __file__], env=env,
+                                     timeout=total, capture_output=True)
+                if out.returncode == 0:
+                    lines = [ln for ln in out.stdout.decode().splitlines()
+                             if ln.startswith("{")]
+                    if lines:
+                        print(lines[-1])
+                        return
+            except (subprocess.TimeoutExpired, OSError):
+                pass
+        # accelerator unreachable or died mid-run: CPU smoke so the driver
+        # always gets a JSON line instead of a hang/timeout
+        smoke = True
+    if smoke:
         os.environ.pop("PALLAS_AXON_POOL_IPS", None)
         os.environ["JAX_PLATFORMS"] = "cpu"
 

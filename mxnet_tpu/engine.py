@@ -67,12 +67,12 @@ def wait_all():
     pending = list(_PENDING.values())
     _PENDING.clear()
     for d in pending:
-        try:
-            if hasattr(d, "block_until_ready"):
-                d.block_until_ready()
-        except RuntimeError:
-            # donated/deleted buffer: its consumer already completed it
-            pass
+        if getattr(d, "is_deleted", lambda: False)():
+            continue  # donated buffer: its consumer already completed it
+        if hasattr(d, "block_until_ready"):
+            # real async failures (OOM, collective errors) surface here,
+            # as the module contract promises — never swallowed
+            d.block_until_ready()
 
 
 @contextlib.contextmanager

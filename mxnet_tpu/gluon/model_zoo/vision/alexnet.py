@@ -1,4 +1,10 @@
-"""AlexNet (parity: python/mxnet/gluon/model_zoo/vision/alexnet.py)."""
+"""AlexNet (parity: python/mxnet/gluon/model_zoo/vision/alexnet.py).
+
+Architecture definitions adapted from the reference Gluon model zoo
+(python/mxnet/gluon/model_zoo/vision/alexnet.py) — these are fixed published
+architectures expressed against the parity API; the layer implementations
+underneath (mxnet_tpu.gluon.nn) are original TPU-native code.
+"""
 from ...block import HybridBlock
 from ... import nn
 

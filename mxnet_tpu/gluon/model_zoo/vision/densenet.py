@@ -1,4 +1,10 @@
-"""DenseNet 121/161/169/201 (parity: model_zoo/vision/densenet.py)."""
+"""DenseNet 121/161/169/201 (parity: model_zoo/vision/densenet.py).
+
+Architecture definitions adapted from the reference Gluon model zoo
+(python/mxnet/gluon/model_zoo/vision/densenet.py) — these are fixed published
+architectures expressed against the parity API; the layer implementations
+underneath (mxnet_tpu.gluon.nn) are original TPU-native code.
+"""
 from ...block import HybridBlock
 from ... import nn
 

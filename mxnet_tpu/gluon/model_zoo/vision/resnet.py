@@ -2,6 +2,11 @@
 BasicBlock/Bottleneck x V1/V2, depths 18/34/50/101/152).
 
 The flagship benchmark model (BASELINE: ResNet-50 ImageNet throughput).
+
+Architecture definitions adapted from the reference Gluon model zoo
+(python/mxnet/gluon/model_zoo/vision/resnet.py) — these are fixed published
+architectures expressed against the parity API; the layer implementations
+underneath (mxnet_tpu.gluon.nn) are original TPU-native code.
 """
 from __future__ import annotations
 

@@ -1,4 +1,10 @@
-"""SqueezeNet 1.0/1.1 (parity: model_zoo/vision/squeezenet.py)."""
+"""SqueezeNet 1.0/1.1 (parity: model_zoo/vision/squeezenet.py).
+
+Architecture definitions adapted from the reference Gluon model zoo
+(python/mxnet/gluon/model_zoo/vision/squeezenet.py) — these are fixed published
+architectures expressed against the parity API; the layer implementations
+underneath (mxnet_tpu.gluon.nn) are original TPU-native code.
+"""
 from ...block import HybridBlock
 from ... import nn
 

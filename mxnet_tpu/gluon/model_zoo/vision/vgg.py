@@ -1,4 +1,10 @@
-"""VGG 11/13/16/19 ±BN (parity: model_zoo/vision/vgg.py)."""
+"""VGG 11/13/16/19 ±BN (parity: model_zoo/vision/vgg.py).
+
+Architecture definitions adapted from the reference Gluon model zoo
+(python/mxnet/gluon/model_zoo/vision/vgg.py) — these are fixed published
+architectures expressed against the parity API; the layer implementations
+underneath (mxnet_tpu.gluon.nn) are original TPU-native code.
+"""
 from ...block import HybridBlock
 from ... import nn
 from ....initializer import Xavier

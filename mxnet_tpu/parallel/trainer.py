@@ -352,12 +352,25 @@ class TrainStep:
                              tuple(self._nograd_vals),
                              tuple(self._opt_state)))
         return {"t": np.int64(self._t), "grad_vals": host[0],
-                "nograd_vals": host[1], "opt_state": host[2]}
+                "nograd_vals": host[1], "opt_state": host[2],
+                # the global key stream feeds per-step dropout masks / SGLD
+                # noise — without it a resume would replay early-step keys
+                "rng_key": _random.get_state()}
 
     def load_state_dict(self, state):
         if self._step_fn is None:
             self._build()
+        for name, tmpl in (("grad_vals", self._grad_vals),
+                           ("nograd_vals", self._nograd_vals),
+                           ("opt_state", self._opt_state)):
+            if len(state[name]) != len(tmpl):
+                raise ValueError(
+                    "checkpoint %s has %d entries but the model expects %d "
+                    "— wrong or since-modified model" %
+                    (name, len(state[name]), len(tmpl)))
         self._t = int(state["t"])
+        if "rng_key" in state:
+            _random.set_state(state["rng_key"])
 
         def place(tmpl, v):
             arr = jnp.asarray(np.asarray(v), dtype=jnp.asarray(tmpl).dtype)

@@ -80,3 +80,16 @@ class trace_key_scope:
 
 # Imperative sampling API (mx.random.*) is populated by mxnet_tpu.ndarray at
 # import time (uniform/normal/randint/...) — see ndarray/__init__.py.
+
+
+def get_state():
+    """Snapshot the global PRNG key as a host array (for checkpoint/resume —
+    the reference's RandomGenerator state save)."""
+    import numpy as _np
+    return _np.asarray(_current_key())
+
+
+def set_state(key_data):
+    """Restore a key snapshot taken by get_state()."""
+    _STATE.key = jax.numpy.asarray(key_data)
+    _STATE.seed_value = None

@@ -66,8 +66,8 @@ class CheckpointManager:
         """
         self._raise_pending()
         host = {k: np.asarray(v) for k, v in _flatten(tree).items()}
+        self.wait()  # one save at a time: bounded memory, no write races
         if self.async_save and not block:
-            self.wait()  # one outstanding save at a time: bounded memory
             self._worker = threading.Thread(
                 target=self._write, args=(step, host), daemon=True)
             self._worker.start()
@@ -76,10 +76,20 @@ class CheckpointManager:
 
     def _write(self, step, host):
         try:
+            import io
+            import zipfile
             final = os.path.join(self.directory, "ckpt-%d.npz" % step)
             tmp = final + ".tmp-%d" % os.getpid()
             with open(tmp, "wb") as f:
-                np.savez(f, **host)
+                # npz written by hand: np.savez(**host) would collide with
+                # its own 'file'/'allow_pickle' parameter names for user
+                # keys, and we need the fd for fsync anyway
+                with zipfile.ZipFile(f, "w", zipfile.ZIP_STORED) as z:
+                    for k, v in host.items():
+                        buf = io.BytesIO()
+                        np.lib.format.write_array(buf, np.asarray(v),
+                                                  allow_pickle=False)
+                        z.writestr(k + ".npy", buf.getvalue())
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, final)  # atomic publication
@@ -128,13 +138,18 @@ class CheckpointManager:
         return _unflatten({k: archive[k] for k in archive.files})
 
     def restore_latest(self):
-        """(step, tree) of the newest intact checkpoint, or None. A torn
-        file (crash mid-publish is impossible, but disk corruption isn't)
-        falls back to the previous one."""
+        """(step, tree) of the newest intact checkpoint, or None. A
+        corrupt file falls back (with a warning) to the previous one —
+        only corruption-shaped errors are treated as fallback-able, so a
+        systematic restore bug cannot silently become a cold start."""
+        import warnings
+        import zipfile
         for step in reversed(self.all_steps()):
             try:
                 return step, self.restore(step)
-            except Exception:
+            except (OSError, ValueError, zipfile.BadZipFile, EOFError) as e:
+                warnings.warn("skipping corrupt checkpoint ckpt-%d.npz: %s"
+                              % (step, e))
                 continue
         return None
 

@@ -36,6 +36,9 @@ def main():
     np.random.seed(0)
     net = gluon.nn.HybridSequential()
     net.add(gluon.nn.Dense(16, in_units=6, activation="relu"))
+    # dropout consumes the per-step RNG stream: resume must restore the
+    # key state or the masks (and final params) diverge from a clean run
+    net.add(gluon.nn.Dropout(0.3))
     net.add(gluon.nn.Dense(3, in_units=16))
     net.initialize(mx.init.Xavier())
     step_fn = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
