@@ -89,8 +89,17 @@ def main():
                     if lines:
                         print(lines[-1])
                         return
-            except (subprocess.TimeoutExpired, OSError):
-                pass
+                # preserve the diagnostic: broken benchmark code must not
+                # masquerade as an unreachable accelerator
+                sys.stderr.write("bench inner run failed (rc=%s); stderr "
+                                 "tail:\n%s\n" % (
+                                     out.returncode,
+                                     out.stderr.decode()[-2000:]))
+            except subprocess.TimeoutExpired:
+                sys.stderr.write("bench inner run timed out after %ds\n"
+                                 % total)
+            except OSError as e:
+                sys.stderr.write("bench inner spawn failed: %s\n" % e)
         # accelerator unreachable or died mid-run: CPU smoke so the driver
         # always gets a JSON line instead of a hang/timeout
         smoke = True

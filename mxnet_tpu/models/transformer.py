@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 
 import numpy as np
 import jax
@@ -115,6 +116,16 @@ def _attention(x, wqkv, wo, cfg, mesh=None, sp_axis="sp", causal=True):
         from ..parallel.ring_attention import ring_attention_sharded
         out = ring_attention_sharded(mesh, q, k, v, axis_name=sp_axis,
                                      causal=causal)
+    elif mesh is None and \
+            os.environ.get("MXNET_FLASH_ATTENTION", "1") == "1":
+        # the Pallas hot-op path: VMEM-streamed online-softmax kernel
+        # (falls back to the XLA reference internally when shapes don't
+        # tile into the attention blocks). Single-device only: a
+        # pallas_call has no GSPMD partitioning rule, so under a dp/tp
+        # mesh it would force replication — the sharded paths go through
+        # ring attention / the partitionable XLA reference instead
+        from ..ops.pallas_attention import flash_attention
+        out = flash_attention(q, k, v, causal=causal)
     else:
         from ..parallel.ring_attention import attention_reference
         out = attention_reference(q, k, v, causal=causal)
