@@ -83,18 +83,21 @@ def main():
             try:
                 out = subprocess.run([sys.executable, __file__], env=env,
                                      timeout=total, capture_output=True)
-                if out.returncode == 0:
-                    lines = [ln for ln in out.stdout.decode().splitlines()
-                             if ln.startswith("{")]
-                    if lines:
-                        print(lines[-1])
-                        return
+                lines = [ln for ln in out.stdout.decode().splitlines()
+                         if ln.startswith("{")]
+                if out.returncode == 0 and lines:
+                    print(lines[-1])
+                    return
                 # preserve the diagnostic: broken benchmark code must not
                 # masquerade as an unreachable accelerator
-                sys.stderr.write("bench inner run failed (rc=%s); stderr "
-                                 "tail:\n%s\n" % (
-                                     out.returncode,
-                                     out.stderr.decode()[-2000:]))
+                if out.returncode == 0:
+                    sys.stderr.write("bench inner run exited 0 but "
+                                     "produced no JSON result line\n")
+                else:
+                    sys.stderr.write("bench inner run failed (rc=%s); "
+                                     "stderr tail:\n%s\n" % (
+                                         out.returncode,
+                                         out.stderr.decode()[-2000:]))
             except subprocess.TimeoutExpired:
                 sys.stderr.write("bench inner run timed out after %ds\n"
                                  % total)

@@ -5,3 +5,7 @@ text embeddings, onnx import, tensorboard glue). INT8 quantization is the
 load-bearing member here; the others are thin or gated.
 """
 from . import quantization
+from . import autograd
+from . import onnx
+from . import tensorboard
+from . import text

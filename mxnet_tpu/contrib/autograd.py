@@ -1,0 +1,58 @@
+"""Legacy contrib autograd surface (parity:
+python/mxnet/contrib/autograd.py — the pre-mx.autograd API: train/test
+sections, mark_variables, backward, grad_and_loss, grad). Thin adapters
+over mxnet_tpu.autograd, kept so reference user code ports unchanged."""
+from __future__ import annotations
+
+from .. import autograd as _ag
+
+
+def set_is_training(is_train):
+    prev = _ag.is_training()
+    _ag.set_training(is_train)
+    return prev
+
+
+train_section = _ag.record
+test_section = _ag.pause
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    return _ag.mark_variables(variables, gradients, grad_reqs)
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    return _ag.backward(outputs, head_grads=out_grads,
+                        retain_graph=retain_graph)
+
+
+def compute_gradient(outputs):
+    backward(outputs)
+
+
+def grad_and_loss(func, argnum=None):
+    """Return a function computing both gradient and loss (parity:
+    contrib/autograd.py:163)."""
+    def wrapped(*args):
+        variables = list(args) if argnum is None else \
+            [args[i] for i in ([argnum] if isinstance(argnum, int)
+                               else argnum)]
+        from ..ndarray import NDArray, zeros_like
+        grads = [zeros_like(v) for v in variables]
+        mark_variables(variables, grads)
+        with train_section():
+            out = func(*args)
+        compute_gradient([out] if isinstance(out, NDArray) else out)
+        return grads, out
+
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """Gradient-only variant (parity: contrib/autograd.py:195)."""
+    g_and_l = grad_and_loss(func, argnum)
+
+    def wrapped(*args):
+        return g_and_l(*args)[0]
+
+    return wrapped

@@ -1,0 +1,26 @@
+"""ONNX import (parity surface: python/mxnet/contrib/onnx — import_model).
+
+Gated: this environment ships no `onnx` package (and no network egress to
+fetch one), so the graph translation cannot be implemented against the real
+protobuf schema here. The entry point exists with the reference signature
+and fails with an actionable error; with `onnx` installed it raises
+NotImplementedError until the translation table lands.
+"""
+from __future__ import annotations
+
+
+def import_model(model_file):
+    """Parity: onnx.import_model -> (sym, arg_params, aux_params)."""
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "ONNX import requires the `onnx` package, which is not "
+            "available in this environment. Convert the model to the "
+            "legacy .params/symbol-json format (mxnet_tpu.utils.legacy "
+            "reads the reference's artifacts) or export from the source "
+            "framework via StableHLO (mxnet_tpu.predict).") from e
+    raise NotImplementedError(
+        "onnx graph translation is not implemented; use "
+        "mxnet_tpu.utils.legacy (reference checkpoints) or "
+        "mxnet_tpu.predict (StableHLO artifacts) as the interchange path")

@@ -1,0 +1,61 @@
+"""TensorBoard glue (parity: python/mxnet/contrib/tensorboard.py
+LogMetricsCallback — stream EvalMetric values to an event log).
+
+The reference depends on the `tensorboard` pypi writer; here the writer is
+resolved lazily (torch's SummaryWriter, present in this environment) and a
+plain JSONL fallback keeps the callback usable without any writer — the
+metrics stream is the capability, the sink is pluggable.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+class _JsonlWriter:
+    """Fallback sink: one {'tag', 'value', 'step', 'wall_time'} per line."""
+
+    def __init__(self, logging_dir):
+        os.makedirs(logging_dir, exist_ok=True)
+        self._f = open(os.path.join(logging_dir, "metrics.jsonl"), "a")
+
+    def add_scalar(self, tag, value, step):
+        self._f.write(json.dumps({"tag": tag, "value": float(value),
+                                  "step": int(step),
+                                  "wall_time": time.time()}) + "\n")
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+
+def _make_writer(logging_dir):
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+        return SummaryWriter(logging_dir)
+    except Exception:
+        return _JsonlWriter(logging_dir)
+
+
+class LogMetricsCallback:
+    """Batch-end callback logging every metric of the param's eval_metric
+    (parity: contrib/tensorboard.py:25). Use:
+
+        mod.fit(..., batch_end_callback=[
+            mx.contrib.tensorboard.LogMetricsCallback('logs/train')])
+    """
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.step = 0
+        self.summary_writer = _make_writer(logging_dir)
+
+    def __call__(self, param):
+        self.step += 1
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            self.summary_writer.add_scalar(name, value, self.step)

@@ -1,0 +1,191 @@
+"""Token embeddings (parity: python/mxnet/contrib/text/embedding.py —
+registry + GloVe/FastText file formats + CustomEmbedding +
+CompositeEmbedding).
+
+Zero-egress note: the reference downloads pretrained archives; here the
+pretrained classes load the same text formats from local files
+(`pretrained_file_path` or files under the reference's layout in `root`).
+"""
+from __future__ import annotations
+
+import io
+import os
+
+import numpy as np
+
+from ...ndarray import NDArray
+from . import vocab as _vocab
+
+_REGISTRY = {}
+
+
+def register(embedding_cls):
+    """Parity: text.embedding.register decorator."""
+    _REGISTRY[embedding_cls.__name__.lower()] = embedding_cls
+    return embedding_cls
+
+
+def create(embedding_name, **kwargs):
+    """Parity: text.embedding.create('glove', pretrained_file_name=...)."""
+    name = embedding_name.lower()
+    if name not in _REGISTRY:
+        raise KeyError("unknown embedding %s (registered: %s)"
+                       % (embedding_name, sorted(_REGISTRY)))
+    return _REGISTRY[name](**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    if embedding_name is not None:
+        cls = _REGISTRY[embedding_name.lower()]
+        return list(cls.pretrained_file_names)
+    return {n: list(c.pretrained_file_names) for n, c in _REGISTRY.items()}
+
+
+class _TokenEmbedding(_vocab.Vocabulary):
+    """Vocabulary + vectors; index 0 (unknown) gets init_unknown_vec."""
+
+    pretrained_file_names = ()
+
+    def __init__(self, unknown_token="<unk>",
+                 init_unknown_vec=np.zeros):
+        super().__init__(counter=None, unknown_token=unknown_token)
+        self._init_unknown_vec = init_unknown_vec
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return NDArray(self._idx_to_vec)
+
+    def _load_embedding_txt(self, fobj, elem_delim=" "):
+        tokens, vecs = [], []
+        seen = set(self._token_to_idx)
+        for line_num, line in enumerate(fobj):
+            parts = line.rstrip().split(elem_delim)
+            if line_num == 0 and len(parts) == 2 and \
+                    all(p.isdigit() for p in parts):
+                continue  # fastText header: "<count> <dim>"
+            token, elems = parts[0], parts[1:]
+            if len(elems) == 1:
+                continue  # malformed/meta line, like the reference skips
+            if self._vec_len and len(elems) != self._vec_len:
+                raise ValueError(
+                    "inconsistent vector length at line %d for token %r"
+                    % (line_num + 1, token))
+            self._vec_len = self._vec_len or len(elems)
+            if token in seen:
+                continue  # first occurrence wins (real GloVe files repeat)
+            seen.add(token)
+            tokens.append(token)
+            vecs.append(np.asarray(elems, dtype=np.float32))
+        mat = np.zeros((1 + len(tokens), self._vec_len), np.float32)
+        mat[0] = self._init_unknown_vec(self._vec_len)
+        for i, (t, v) in enumerate(zip(tokens, vecs), start=1):
+            self._token_to_idx[t] = i
+            self._idx_to_token.append(t)
+            mat[i] = v
+        self._idx_to_vec = mat
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        single = not isinstance(tokens, (list, tuple))
+        toks = [tokens] if single else tokens
+        idxs = []
+        for t in toks:
+            i = self._token_to_idx.get(t, 0)
+            if i == 0 and lower_case_backup:
+                i = self._token_to_idx.get(str(t).lower(), 0)
+            idxs.append(i)
+        out = self._idx_to_vec[np.asarray(idxs)]
+        return NDArray(out[0] if single else out)
+
+    def update_token_vectors(self, tokens, new_vectors):
+        toks = [tokens] if not isinstance(tokens, (list, tuple)) else tokens
+        vals = np.asarray(new_vectors.asnumpy()
+                          if isinstance(new_vectors, NDArray)
+                          else new_vectors, dtype=np.float32)
+        vals = vals.reshape(len(toks), self._vec_len)
+        for t, v in zip(toks, vals):
+            if t not in self._token_to_idx:
+                raise ValueError("token %r not indexed" % (t,))
+            self._idx_to_vec[self._token_to_idx[t]] = v
+
+
+class _PretrainedFileEmbedding(_TokenEmbedding):
+    """Common loader for txt-format pretrained files resolved locally."""
+
+    def __init__(self, pretrained_file_name=None,
+                 pretrained_file_path=None,
+                 embedding_root=os.path.join("~", ".mxnet", "embeddings"),
+                 **kwargs):
+        super().__init__(**kwargs)
+        path = pretrained_file_path
+        if path is None:
+            if pretrained_file_name is None:
+                raise ValueError("pass pretrained_file_name or "
+                                 "pretrained_file_path")
+            path = os.path.join(os.path.expanduser(embedding_root),
+                                type(self).__name__.lower(),
+                                pretrained_file_name)
+        if not os.path.exists(path):
+            raise IOError(
+                "pretrained embedding file %s not found and cannot be "
+                "downloaded (no network egress); place the file there or "
+                "pass pretrained_file_path" % path)
+        with io.open(path, encoding="utf-8") as f:
+            self._load_embedding_txt(f)
+
+
+@register
+class GloVe(_PretrainedFileEmbedding):
+    """Parity: embedding.py:468 — glove.*.txt word-vector files."""
+
+    pretrained_file_names = (
+        "glove.42B.300d.txt", "glove.6B.50d.txt", "glove.6B.100d.txt",
+        "glove.6B.200d.txt", "glove.6B.300d.txt", "glove.840B.300d.txt",
+        "glove.twitter.27B.25d.txt", "glove.twitter.27B.50d.txt",
+        "glove.twitter.27B.100d.txt", "glove.twitter.27B.200d.txt")
+
+
+@register
+class FastText(_PretrainedFileEmbedding):
+    """Parity: embedding.py:558 — wiki.*.vec files (count/dim header)."""
+
+    pretrained_file_names = ("wiki.en.vec", "wiki.simple.vec",
+                             "wiki.zh.vec")
+
+
+@register
+class CustomEmbedding(_TokenEmbedding):
+    """Parity: embedding.py:658 — user-supplied token-vector txt file."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf-8", **kwargs):
+        super().__init__(**kwargs)
+        with io.open(pretrained_file_path, encoding=encoding) as f:
+            self._load_embedding_txt(f, elem_delim=elem_delim)
+
+
+@register
+class CompositeEmbedding(_TokenEmbedding):
+    """Parity: embedding.py:719 — index a vocabulary against one or more
+    token embeddings; vectors concatenate along the embedding dim."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        if not isinstance(token_embeddings, list):
+            token_embeddings = [token_embeddings]
+        super().__init__(unknown_token=vocabulary.unknown_token)
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._vec_len = sum(e.vec_len for e in token_embeddings)
+        mat = np.zeros((len(self._idx_to_token), self._vec_len), np.float32)
+        for row, token in enumerate(self._idx_to_token):
+            col = 0
+            for emb in token_embeddings:
+                mat[row, col:col + emb.vec_len] = \
+                    emb.get_vecs_by_tokens(token).asnumpy()
+                col += emb.vec_len
+        self._idx_to_vec = mat

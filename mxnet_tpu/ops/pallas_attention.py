@@ -151,28 +151,39 @@ def _flash_bwd_blockwise(q, k, v, o, m, l, g, scale, causal, bq, bk):
         dd = db[:, qi][..., None]
 
         def inner(carry, ki):
-            dq_blk, dk_acc, dv_acc = carry
-            kk = kb[:, ki].astype(f32)
-            vv = vb[:, ki].astype(f32)
-            s = jnp.einsum("bqd,bkd->bqk", qq, kk,
-                           preferred_element_type=f32) * scale
+            def live_block(carry):
+                dq_blk, dk_acc, dv_acc = carry
+                kk = kb[:, ki].astype(f32)
+                vv = vb[:, ki].astype(f32)
+                s = jnp.einsum("bqd,bkd->bqk", qq, kk,
+                               preferred_element_type=f32) * scale
+                if causal:
+                    tq = qi * bq + jnp.arange(bq)[:, None]
+                    tk_ = ki * bk + jnp.arange(bk)[None, :]
+                    s = jnp.where((tk_ <= tq)[None], s, -jnp.inf)
+                p = jnp.where(jnp.isfinite(s), jnp.exp(s - m_safe) / ll,
+                              0.0)
+                dv_acc = dv_acc.at[:, ki].add(
+                    jnp.einsum("bqk,bqd->bkd", p, gg,
+                               preferred_element_type=f32))
+                dp = jnp.einsum("bqd,bkd->bqk", gg, vv,
+                                preferred_element_type=f32)
+                ds = p * (dp - dd) * scale
+                dq_blk = dq_blk + jnp.einsum("bqk,bkd->bqd", ds, kk,
+                                             preferred_element_type=f32)
+                dk_acc = dk_acc.at[:, ki].add(
+                    jnp.einsum("bqk,bqd->bkd", ds, qq,
+                               preferred_element_type=f32))
+                return dq_blk, dk_acc, dv_acc
+
             if causal:
-                tq = qi * bq + jnp.arange(bq)[:, None]
-                tk_ = ki * bk + jnp.arange(bk)[None, :]
-                s = jnp.where((tk_ <= tq)[None], s, -jnp.inf)
-            p = jnp.where(jnp.isfinite(s), jnp.exp(s - m_safe) / ll, 0.0)
-            dv_acc = dv_acc.at[:, ki].add(
-                jnp.einsum("bqk,bqd->bkd", p, gg,
-                           preferred_element_type=f32))
-            dp = jnp.einsum("bqd,bkd->bqk", gg, vv,
-                            preferred_element_type=f32)
-            ds = p * (dp - dd) * scale
-            dq_blk = dq_blk + jnp.einsum("bqk,bkd->bqd", ds, kk,
-                                         preferred_element_type=f32)
-            dk_acc = dk_acc.at[:, ki].add(
-                jnp.einsum("bqk,bqd->bkd", ds, qq,
-                           preferred_element_type=f32))
-            return (dq_blk, dk_acc, dv_acc), None
+                # skip fully-masked above-diagonal tiles, mirroring the
+                # forward's `live` predicate (~2x fewer backward FLOPs)
+                live = ki * bk <= qi * bq + bq - 1
+                carry = lax.cond(live, live_block, lambda c: c, carry)
+            else:
+                carry = live_block(carry)
+            return carry, None
 
         (dq_blk, dk_acc, dv_acc), _ = lax.scan(
             inner, (jnp.zeros((BH, bq, D), f32), dk_acc, dv_acc),
@@ -250,9 +261,11 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
     if interpret is None:
         interpret = default_interpret()
     use_kernel = not (T % bq or Tk % bk or (causal and bq != bk))
-    if use_kernel and not interpret and D % 128 != 0:
-        # conservative on real hardware: head dims off the (8,128) VMEM
-        # tiling grid go through XLA (which pads) instead of the kernel
+    if use_kernel and not interpret and \
+            (D % 128 != 0 or bq % 8 != 0 or bk % 8 != 0):
+        # conservative on real hardware: blocks off the (8,128) VMEM tiling
+        # grid (head dim or sublane-unaligned block sizes from short
+        # sequences) go through XLA (which pads) instead of the kernel
         use_kernel = False
     if not use_kernel:
         out3 = _reference(q3, k3, v3, scale, causal)

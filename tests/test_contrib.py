@@ -1,0 +1,116 @@
+"""mx.contrib tests: text (vocab + embeddings), legacy autograd surface,
+tensorboard glue (parity model: reference tests/python/unittest/
+test_contrib_text.py and contrib module docs)."""
+import json
+import os
+from collections import Counter
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.contrib import text
+
+
+def test_vocabulary_indexing():
+    counter = Counter(["a", "b", "b", "c", "c", "c", "rare"])
+    v = text.Vocabulary(counter, most_freq_count=None, min_freq=2,
+                        unknown_token="<unk>", reserved_tokens=["<pad>"])
+    assert v.idx_to_token[0] == "<unk>"
+    assert v.idx_to_token[1] == "<pad>"
+    # by descending frequency: c(3), b(2); 'a'/'rare' fall below min_freq
+    assert v.idx_to_token[2:] == ["c", "b"]
+    assert v.to_indices(["c", "nope"]) == [2, 0]
+    assert v.to_tokens([1, 3]) == ["<pad>", "b"]
+    assert len(v) == 4
+
+
+def test_vocabulary_most_freq_count():
+    counter = Counter({"w%d" % i: 10 - i for i in range(8)})
+    v = text.Vocabulary(counter, most_freq_count=3)
+    assert len(v) == 4  # unk + 3
+    assert v.idx_to_token[1:] == ["w0", "w1", "w2"]
+
+
+def test_count_tokens_from_str():
+    c = text.utils.count_tokens_from_str("Life is great!\nlife is good.",
+                                         to_lower=True)
+    assert c["life"] == 2 and c["is"] == 2 and c["great!"] == 1
+
+
+def test_custom_embedding_and_composite(tmp_path):
+    p = tmp_path / "emb.txt"
+    p.write_text("hello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+    emb = text.embedding.create("customembedding",
+                                pretrained_file_path=str(p))
+    assert emb.vec_len == 3
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("world").asnumpy(), [4, 5, 6])
+    # unknown -> zeros at index 0
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("nope").asnumpy(), [0, 0, 0])
+    emb.update_token_vectors("hello", nd.array(np.array([9., 9., 9.])))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [9, 9, 9])
+    # composite: vocabulary indexed against the embedding
+    vocab = text.Vocabulary(Counter(["hello", "hello", "world"]))
+    comp = text.embedding.CompositeEmbedding(vocab, emb)
+    assert comp.vec_len == 3
+    np.testing.assert_allclose(
+        comp.idx_to_vec.asnumpy()[vocab.to_indices("world")], [4, 5, 6])
+
+
+def test_embedding_duplicate_tokens_first_wins(tmp_path):
+    # real GloVe releases contain duplicate tokens: first occurrence wins
+    p = tmp_path / "dup.txt"
+    p.write_text("foo 1 2 3\nbar 4 5 6\nfoo 7 8 9\n")
+    e = text.embedding.CustomEmbedding(str(p))
+    assert len(e) == 3 and e.token_to_idx["foo"] == 1
+    np.testing.assert_allclose(e.get_vecs_by_tokens("foo").asnumpy(),
+                               [1, 2, 3])
+
+
+def test_glove_requires_local_file(tmp_path):
+    with pytest.raises(IOError):
+        text.embedding.create("glove", pretrained_file_name="glove.6B.50d.txt",
+                              embedding_root=str(tmp_path))
+    # fastText header line is skipped
+    p = tmp_path / "wiki.mini.vec"
+    p.write_text("2 3\nfoo 1 1 1\nbar 2 2 2\n")
+    ft = text.embedding.FastText(pretrained_file_path=str(p))
+    assert ft.vec_len == 3 and len(ft) == 3
+
+
+def test_contrib_autograd_grad_and_loss():
+    from mxnet_tpu.contrib import autograd as cag
+
+    def f(x):
+        return (x * x).sum()
+
+    x = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    grads, loss = cag.grad_and_loss(f)(x)
+    np.testing.assert_allclose(grads[0].asnumpy(), [2, 4, 6], rtol=1e-5)
+    assert abs(float(loss.asnumpy()) - 14.0) < 1e-5
+    g_only = cag.grad(f)(x)
+    np.testing.assert_allclose(g_only[0].asnumpy(), [2, 4, 6], rtol=1e-5)
+
+
+def test_tensorboard_callback(tmp_path):
+    from mxnet_tpu.contrib.tensorboard import (LogMetricsCallback,
+                                               _JsonlWriter)
+    cb = LogMetricsCallback(str(tmp_path / "logs"), prefix="train")
+    # force the dependency-free sink for a deterministic assertion
+    cb.summary_writer = _JsonlWriter(str(tmp_path / "logs"))
+    metric = mx.metric.Accuracy()
+    metric.update([nd.array(np.array([0, 1], np.float32))],
+                  [nd.array(np.array([[0.9, 0.1], [0.2, 0.8]],
+                                     np.float32))])
+
+    class P:
+        eval_metric = metric
+    cb(P())
+    lines = [json.loads(l) for l in
+             (tmp_path / "logs" / "metrics.jsonl").read_text().splitlines()]
+    assert lines and lines[0]["tag"] == "train-accuracy"
+    assert lines[0]["value"] == 1.0

@@ -62,8 +62,8 @@ def test_flash_unaligned_falls_back():
                                np.asarray(ref), rtol=1e-5, atol=1e-5)
 
 
-def test_default_interpret_on_cpu():
-    assert default_interpret() is True  # tests run on the CPU backend
+def test_default_interpret_matches_backend():
+    assert default_interpret() == (jax.default_backend() != "tpu")
 
 
 def test_transformer_uses_flash(monkeypatch):
