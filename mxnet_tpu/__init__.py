@@ -68,6 +68,7 @@ from . import rtc
 from . import contrib
 from . import predict
 from .predict import Predictor
+from . import rnn
 
 # Under tools/launch.py the DMLC_* worker env is present: join the
 # distributed job NOW, before anything can initialise the XLA backend

@@ -63,6 +63,7 @@ class _TokenEmbedding(_vocab.Vocabulary):
 
     def _load_embedding_txt(self, fobj, elem_delim=" "):
         tokens, vecs = [], []
+        unk_vec = None
         seen = set(self._token_to_idx)
         for line_num, line in enumerate(fobj):
             parts = line.rstrip().split(elem_delim)
@@ -77,13 +78,19 @@ class _TokenEmbedding(_vocab.Vocabulary):
                     "inconsistent vector length at line %d for token %r"
                     % (line_num + 1, token))
             self._vec_len = self._vec_len or len(elems)
+            if token == self._unknown_token:
+                # the file's own unknown vector takes row 0 (reference
+                # behavior) instead of init_unknown_vec
+                unk_vec = np.asarray(elems, dtype=np.float32)
+                continue
             if token in seen:
                 continue  # first occurrence wins (real GloVe files repeat)
             seen.add(token)
             tokens.append(token)
             vecs.append(np.asarray(elems, dtype=np.float32))
         mat = np.zeros((1 + len(tokens), self._vec_len), np.float32)
-        mat[0] = self._init_unknown_vec(self._vec_len)
+        mat[0] = unk_vec if unk_vec is not None \
+            else self._init_unknown_vec(self._vec_len)
         for i, (t, v) in enumerate(zip(tokens, vecs), start=1):
             self._token_to_idx[t] = i
             self._idx_to_token.append(t)
@@ -181,11 +188,8 @@ class CompositeEmbedding(_TokenEmbedding):
         self._idx_to_token = list(vocabulary.idx_to_token)
         self._token_to_idx = dict(vocabulary.token_to_idx)
         self._vec_len = sum(e.vec_len for e in token_embeddings)
-        mat = np.zeros((len(self._idx_to_token), self._vec_len), np.float32)
-        for row, token in enumerate(self._idx_to_token):
-            col = 0
-            for emb in token_embeddings:
-                mat[row, col:col + emb.vec_len] = \
-                    emb.get_vecs_by_tokens(token).asnumpy()
-                col += emb.vec_len
-        self._idx_to_vec = mat
+        # one batched lookup per embedding, concatenated along the vector
+        # dim — not a per-token python loop
+        self._idx_to_vec = np.concatenate(
+            [emb.get_vecs_by_tokens(list(self._idx_to_token)).asnumpy()
+             for emb in token_embeddings], axis=1)

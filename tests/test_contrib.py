@@ -114,3 +114,14 @@ def test_tensorboard_callback(tmp_path):
              (tmp_path / "logs" / "metrics.jsonl").read_text().splitlines()]
     assert lines and lines[0]["tag"] == "train-accuracy"
     assert lines[0]["value"] == 1.0
+
+
+def test_embedding_unknown_token_row_from_file(tmp_path):
+    # a file row for the unknown token populates index 0 (reference
+    # behavior), so OOV lookups return the pretrained unknown vector
+    p = tmp_path / "unk.txt"
+    p.write_text("<unk> 7 7 7\nhello 1 2 3\n")
+    e = text.embedding.CustomEmbedding(str(p))
+    assert len(e) == 2  # <unk> + hello
+    np.testing.assert_allclose(e.get_vecs_by_tokens("oov").asnumpy(),
+                               [7, 7, 7])
