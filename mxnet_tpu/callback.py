@@ -88,3 +88,14 @@ class ProgressBar:
         percents = math.ceil(100.0 * count / float(self.total))
         prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
         sys.stdout.write("[%s] %s%s\r" % (prog_bar, percents, "%"))
+
+
+class LogValidationMetricsCallback:
+    """Log eval metrics at the end of an epoch (parity: callback.py:206)."""
+
+    def __call__(self, param):
+        if not param.eval_metric:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name,
+                         value)

@@ -67,9 +67,37 @@ class FeedForward:
                 num_epoch=self.num_epoch)
         self.arg_params, self.aux_params = mod.get_params()
 
+    def _ensure_module(self, X):
+        """Bind a Module on demand so predict/score work on
+        checkpoint-loaded models that never called fit (reference
+        model.py:724 builds the predictor from arg_params)."""
+        if self._module is not None:
+            return self._module
+        from .module import Module
+        mod = Module(self.symbol, context=self.ctx)
+        mod.bind(data_shapes=X.provide_data,
+                 label_shapes=getattr(X, "provide_label", None),
+                 for_training=False)
+        assert self.arg_params is not None, \
+            "no parameters: call fit() or load() first"
+        mod.set_params(self.arg_params, self.aux_params or {},
+                       allow_missing=False)
+        self._module = mod
+        return mod
+
     def predict(self, X, num_batch=None, return_data=False, reset=True):
-        assert self._module is not None, "call fit first"
-        return self._module.predict(X, num_batch=num_batch, reset=reset)
+        return self._ensure_module(X).predict(X, num_batch=num_batch,
+                                              reset=reset)
+
+    def score(self, X, eval_metric="acc", num_batch=None):
+        """Parity: model.py FeedForward.score — returns the metric value
+        list (all values for composite metrics, reference model.py:773)."""
+        from . import metric as metric_mod
+        mod = self._ensure_module(X)
+        if isinstance(eval_metric, str):
+            eval_metric = metric_mod.create(eval_metric)
+        mod.score(X, eval_metric, num_batch=num_batch)
+        return eval_metric.get()[1]
 
     def save(self, prefix, epoch=None):
         if epoch is None:

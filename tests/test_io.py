@@ -155,3 +155,23 @@ def test_mnist_synthetic_iterator():
     b = next(iter(train))
     assert b.data[0].shape == (32, 1, 28, 28)
     assert b.label[0].shape == (32,)
+
+
+def test_device_prefetch_iter():
+    import numpy as np
+    from mxnet_tpu import io as mio
+    x = np.arange(48, dtype=np.float32).reshape(12, 4)
+    y = np.arange(12, dtype=np.float32)
+    base = mio.NDArrayIter(x, y, batch_size=4)
+    pre = mio.DevicePrefetchIter(mio.NDArrayIter(x, y, batch_size=4),
+                                 depth=2)
+    for _epoch in range(2):
+        base.reset()
+        pre.reset()
+        got = [b.data[0].asnumpy() for b in pre]
+        exp = [b.data[0].asnumpy() for b in base]
+        assert len(got) == len(exp) == 3
+        for g, e in zip(got, exp):
+            np.testing.assert_array_equal(g, e)
+    # provide_data passes through
+    assert pre.provide_data[0].shape == (4, 4)

@@ -263,3 +263,27 @@ def test_feedforward_legacy():
     model.fit(X=train)
     preds = model.predict(_toy_iter())
     assert preds.shape == (64, 4)
+
+
+def test_feedforward_load_then_score(tmp_path):
+    """FeedForward loaded from a checkpoint predicts and scores without
+    fit() (reference model.py:724 contract)."""
+    import os
+    sym_net = _mlp()
+    mod = mx.mod.Module(sym_net, context=mx.cpu())
+    it = mx.io.NDArrayIter(np.random.rand(32, 6).astype(np.float32),
+                           np.random.randint(0, 4, (32,)).astype(np.float32),
+                           batch_size=8)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    arg_params, aux_params = mod.get_params()
+    prefix = os.path.join(str(tmp_path), "ff")
+    mx.model.save_checkpoint(prefix, 1, sym_net, arg_params, aux_params)
+
+    ff = mx.model.FeedForward.load(prefix, 1, ctx=mx.cpu())
+    it.reset()
+    out = ff.predict(it)
+    assert out.shape == (32, 4)
+    it.reset()
+    val = ff.score(it, eval_metric="acc")
+    assert 0.0 <= float(val) <= 1.0
