@@ -627,6 +627,7 @@ class DevicePrefetchIter(DataIter):
         return DataBatch(data=[put(d) for d in batch.data],
                          label=[put(l) for l in (batch.label or [])],
                          pad=getattr(batch, "pad", 0),
+                         index=getattr(batch, "index", None),
                          bucket_key=getattr(batch, "bucket_key", None),
                          provide_data=getattr(batch, "provide_data", None),
                          provide_label=getattr(batch, "provide_label",

@@ -24,6 +24,64 @@ from .sparse import RowSparseNDArray, CSRNDArray, BaseSparseNDArray
 
 
 # ---------------------------------------------------------------------------
+# optimizer update ops: reference call-style writes states in place and
+# honors out= (`nd.sgd_mom_update(w, g, mom, out=w, lr=...)`,
+# src/operator/optimizer_op.cc). The registered ops are pure and return
+# (new_weight, new_states...); these wrappers rebind the state buffers.
+# ---------------------------------------------------------------------------
+_UPDATE_OP_STATES = {
+    "sgd_mom_update": (2,), "mp_sgd_update": (2,),
+    "mp_sgd_mom_update": (2, 3), "signum_update": (2,),
+    "adam_update": (2, 3), "rmsprop_update": (2,),
+    "rmspropalex_update": (2, 3, 4), "ftml_update": (2, 3, 4),
+    "ftrl_update": (2, 3), "_sparse_adagrad_update": (2,),
+    "adagrad_update": (2,),
+}
+
+
+def _make_update_op(opname, state_pos):
+    opdef = _registry.get(opname)
+
+    def update_op(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        res = _apply_op(opdef, args, kwargs)
+        new_w = res[0]
+        for pos, new_state in zip(state_pos, res[1:]):
+            st = args[pos]
+            if isinstance(st, NDArray):
+                st._data = new_state._data
+                st._entry = new_state._entry
+                st._version += 1
+        if out is not None:
+            out._data = new_w._data
+            out._entry = new_w._entry
+            out._version += 1
+            return out
+        return new_w
+
+    update_op.__name__ = opname
+    update_op.__doc__ = opdef.doc
+    return update_op
+
+
+for _uname, _upos in _UPDATE_OP_STATES.items():
+    globals()[_uname] = _make_update_op(_uname, _upos)
+
+
+def Custom(*data, **kwargs):
+    """Run a registered CustomOp (parity: mx.nd.Custom, custom-inl.h)."""
+    op_type = kwargs.pop("op_type")
+    from .. import operator as _operator
+    return _operator.invoke(op_type, *data, **kwargs)
+
+
+def cast_storage(data, stype="default"):
+    """Convert between dense/row_sparse/csr storage (parity: cast_storage,
+    src/operator/tensor/cast_storage-inl.h)."""
+    return data.tostype(stype)
+
+
+# ---------------------------------------------------------------------------
 # creation functions (parity: python/mxnet/ndarray/utils.py + ndarray.py)
 # ---------------------------------------------------------------------------
 

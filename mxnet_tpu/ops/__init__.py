@@ -17,3 +17,4 @@ from . import random_ops
 from . import contrib
 from . import sparse
 from . import quantization
+from . import optimizer_ops

@@ -317,7 +317,8 @@ def ctc_loss_ref(logits, labels, input_lengths, label_lengths, blank=0):
     return -jnp.logaddexp(a_last, a_prev)
 
 
-@register("_contrib_ctc_loss", aliases=("ctc_loss", "CTCLoss"))
+@register("_contrib_ctc_loss", aliases=("ctc_loss", "CTCLoss",
+                                        "_contrib_CTCLoss"))
 def ctc_loss(data, label, data_lengths=None, label_lengths=None,
              use_data_lengths=False, use_label_lengths=False,
              blank_label="first"):
@@ -710,6 +711,57 @@ def DeformablePSROIPooling(data, rois, trans=None, spatial_scale=1.0,
     else:
         out, cnt = jax.vmap(one)(rois, trans)
     return out, cnt
+
+
+@register("_contrib_div_sqrt_dim")
+def div_sqrt_dim(data):
+    """data / sqrt(last-dim size) — the transformer attention scaler
+    (parity: src/operator/contrib/transformer-inl.h _contrib_div_sqrt_dim)."""
+    return data / jnp.sqrt(jnp.asarray(data.shape[-1], dtype=data.dtype))
+
+
+@register("_contrib_bipartite_matching", num_outputs=2,
+          differentiable=False)
+def bipartite_matching(dist, is_ascend=False, threshold=0.5, topk=-1):
+    """Greedy bipartite matching over pairwise scores (parity:
+    src/operator/contrib/bounding_box.cc `_contrib_bipartite_matching`).
+
+    dist: [..., N, M] score matrix. Repeatedly takes the globally best
+    still-unmatched (row, col) pair whose score beats `threshold`
+    (better = larger unless is_ascend), marking both as used; at most
+    `topk` matches per matrix when topk > 0. Returns (row_match[..., N]
+    giving the matched col or -1, col_match[..., M] giving the matched
+    row or -1). Data-dependent greedy loop expressed as lax.fori_loop so
+    the whole op stays jittable on TPU.
+    """
+    batch_shape = dist.shape[:-2]
+    n, m = dist.shape[-2], dist.shape[-1]
+    flat = dist.reshape((-1, n, m)).astype(jnp.float32)
+    sign = -1.0 if is_ascend else 1.0
+    thr = jnp.float32(threshold) * sign
+    iters = min(n, m) if topk is None or topk <= 0 else min(topk, min(n, m))
+
+    def one(d):
+        d = d * sign  # larger-is-better canonical form
+
+        def body(_, st):
+            dd, rmatch, cmatch = st
+            best = jnp.argmax(dd)
+            r, c = best // m, best % m
+            ok = dd[r, c] >= thr
+            rmatch = jnp.where(ok, rmatch.at[r].set(c), rmatch)
+            cmatch = jnp.where(ok, cmatch.at[c].set(r), cmatch)
+            dd = jnp.where(ok, dd.at[r, :].set(-jnp.inf), dd)
+            dd = jnp.where(ok, dd.at[:, c].set(-jnp.inf), dd)
+            return dd, rmatch, cmatch
+
+        init = (d, jnp.full((n,), -1, jnp.float32),
+                jnp.full((m,), -1, jnp.float32))
+        _, rmatch, cmatch = lax.fori_loop(0, iters, body, init)
+        return rmatch, cmatch
+
+    rm, cm = jax.vmap(one)(flat)
+    return rm.reshape(batch_shape + (n,)), cm.reshape(batch_shape + (m,))
 
 
 @register("khatri_rao")

@@ -783,3 +783,78 @@ def Correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
     if pad_size:
         out = out[:, :, pad_size:-pad_size, pad_size:-pad_size]
     return out[:, :, ::stride1, ::stride1]
+
+
+# ---------------------------------------------------------------------------
+# legacy Crop + sparse-regularization identity + image_random ops
+# ---------------------------------------------------------------------------
+
+
+@register("Crop")
+def Crop(*inputs, offset=(0, 0), h_w=(0, 0), center_crop=False,
+         num_args=1):
+    """Legacy spatial crop (parity: src/operator/crop.cc). With two inputs
+    the second (crop_like) donates the target H,W; otherwise h_w does.
+    offset is (y, x); center_crop centers the window instead."""
+    data = inputs[0]
+    if len(inputs) > 1:
+        th, tw = inputs[1].shape[2], inputs[1].shape[3]
+    else:
+        th, tw = int(h_w[0]), int(h_w[1])
+    H, W = data.shape[2], data.shape[3]
+    if center_crop:
+        y0, x0 = (H - th) // 2, (W - tw) // 2
+    else:
+        y0, x0 = int(offset[0]), int(offset[1])
+    return data[:, :, y0:y0 + th, x0:x0 + tw]
+
+
+@jax.custom_vjp
+def _kl_sparse_identity(data, sparseness_target, penalty):
+    return data
+
+
+def _kl_sparse_fwd(data, sparseness_target, penalty):
+    return data, (data, sparseness_target, penalty)
+
+
+def _kl_sparse_bwd(res, g):
+    data, target, penalty = res
+    # rho_hat: mean activation per hidden unit over the batch (the
+    # reference keeps a momentum moving average in an aux state; the
+    # batch estimate is its momentum=0 case)
+    rho = jnp.clip(jnp.mean(data, axis=0, keepdims=True), 1e-6, 1 - 1e-6)
+    kl_grad = penalty * (-target / rho + (1.0 - target) / (1.0 - rho))
+    return (g + jnp.broadcast_to(kl_grad, g.shape), None, None)
+
+
+_kl_sparse_identity.defvjp(_kl_sparse_fwd, _kl_sparse_bwd)
+
+
+@register("IdentityAttachKLSparseReg")
+def IdentityAttachKLSparseReg(data, sparseness_target=0.1, penalty=0.001,
+                              momentum=0.9):
+    """Identity forward; backward adds the KL sparsity-penalty gradient
+    (parity: src/operator/identity_attach_KL_sparse_reg.cc — sparse
+    autoencoder regularization on sigmoid activations)."""
+    return _kl_sparse_identity(data, float(sparseness_target), float(penalty))
+
+
+@register("_image_to_tensor")
+def _image_to_tensor(data):
+    """HWC (or NHWC) uint8 [0,255] -> CHW (NCHW) float32 [0,1]
+    (parity: src/operator/image/image_random.cc ToTensor)."""
+    out = data.astype(jnp.float32) / 255.0
+    if data.ndim == 3:
+        return jnp.transpose(out, (2, 0, 1))
+    return jnp.transpose(out, (0, 3, 1, 2))
+
+
+@register("_image_normalize")
+def _image_normalize(data, mean=(0.0,), std=(1.0,)):
+    """Channel-wise (x - mean) / std on CHW/NCHW float images
+    (parity: src/operator/image/image_random.cc Normalize)."""
+    mean = jnp.asarray(mean, dtype=data.dtype)
+    std = jnp.asarray(std, dtype=data.dtype)
+    shape = (-1, 1, 1) if data.ndim == 3 else (1, -1, 1, 1)
+    return (data - mean.reshape(shape)) / std.reshape(shape)

@@ -1,0 +1,167 @@
+"""Optimizer updates as registered operators.
+
+Parity: the reference exposes its fused optimizer kernels as first-class ops
+(`src/operator/optimizer_op.cc` — sgd_update, sgd_mom_update, adam_update,
+rmsprop_update, rmspropalex_update, ftml_update, ftrl_update, signsgd_update,
+signum_update, mp_sgd_update, mp_sgd_mom_update, _sparse_adagrad_update) so
+frontends and the KVStore server can run updates without a Python optimizer
+object.
+
+TPU-native redesign: each op is a pure jnp function returning
+``(new_weight, new_state...)``; the `mx.nd` layer rebinds the state NDArray
+buffers in place and honors ``out=`` (see ndarray/__init__.py), which gives
+the reference's call-style — ``nd.sgd_mom_update(w, g, mom, out=w, lr=...)``
+— on immutable XLA buffers. Note the op-level contract differs from the
+Python optimizer classes the same way it does in the reference: e.g.
+``adam_update`` applies NO bias correction (the Adam class pre-scales lr),
+so these ops deliberately do not reuse optimizer_rules.py verbatim.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _prep(grad, wd, weight, rescale_grad, clip_gradient):
+    """rescale -> clip -> weight-decay fold, the shared kernel preamble
+    (optimizer_op-inl.h SGDKernel et al.)."""
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * weight
+
+
+@register("sgd_update")
+def sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=True):
+    g = _prep(grad, wd, weight, rescale_grad, clip_gradient)
+    return weight - lr * g
+
+
+@register("sgd_mom_update", num_outputs=2)
+def sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _prep(grad, wd, weight, rescale_grad, clip_gradient)
+    mom = momentum * mom - lr * g
+    return weight + mom, mom
+
+
+@register("mp_sgd_update", num_outputs=2)
+def mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, lazy_update=True):
+    """Multi-precision SGD: update runs on the f32 master copy; the visible
+    weight is the cast-back (mixed-precision fp16/bf16 training)."""
+    g = _prep(grad.astype(jnp.float32), wd, weight32, rescale_grad,
+              clip_gradient)
+    w32 = weight32 - lr * g
+    return w32.astype(weight.dtype), w32
+
+
+@register("mp_sgd_mom_update", num_outputs=3)
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                      lazy_update=True):
+    g = _prep(grad.astype(jnp.float32), wd, weight32, rescale_grad,
+              clip_gradient)
+    mom = momentum * mom - lr * g
+    w32 = weight32 + mom
+    return w32.astype(weight.dtype), mom, w32
+
+
+@register("signsgd_update")
+def signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return (1 - lr * wd) * weight - lr * jnp.sign(g)
+
+
+@register("signum_update", num_outputs=2)
+def signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    g = _prep(grad, wd, weight, rescale_grad, clip_gradient)
+    mom = momentum * mom - (1 - momentum) * g
+    return (1 - lr * wd_lh) * weight + lr * jnp.sign(mom), mom
+
+
+@register("adam_update", num_outputs=3)
+def adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True):
+    g = _prep(grad, wd, weight, rescale_grad, clip_gradient)
+    mean = beta1 * mean + (1 - beta1) * g
+    var = beta2 * var + (1 - beta2) * jnp.square(g)
+    return weight - lr * mean / (jnp.sqrt(var) + epsilon), mean, var
+
+
+@register("rmsprop_update", num_outputs=2)
+def rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.95, epsilon=1e-8,
+                   wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                   clip_weights=-1.0):
+    g = _prep(grad, wd, weight, rescale_grad, clip_gradient)
+    n = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    w = weight - lr * g / jnp.sqrt(n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, n
+
+
+@register("rmspropalex_update", num_outputs=4)
+def rmspropalex_update(weight, grad, n, g, delta, lr=0.001, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0):
+    """Centered RMSProp (Graves 2013) — the reference's rmspropalex kernel."""
+    gr = _prep(grad, wd, weight, rescale_grad, clip_gradient)
+    n = (1 - gamma1) * jnp.square(gr) + gamma1 * n
+    g = (1 - gamma1) * gr + gamma1 * g
+    delta = gamma2 * delta - lr * gr / jnp.sqrt(n - jnp.square(g) + epsilon)
+    w = weight + delta
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, n, g, delta
+
+
+@register("ftml_update", num_outputs=4)
+def ftml_update(weight, grad, d, v, z, lr=0.001, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0,
+                clip_grad=-1.0):
+    g = grad * rescale_grad + wd * weight
+    if clip_grad is not None and clip_grad >= 0:
+        g = jnp.clip(g, -clip_grad, clip_grad)
+    v = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_t = (1 - beta1 ** t) / lr * (jnp.sqrt(v / (1 - beta2 ** t)) + epsilon)
+    sigma = d_t - beta1 * d
+    z = beta1 * z + (1 - beta1) * g - sigma * weight
+    return -z / d_t, d_t, v, z
+
+
+@register("ftrl_update", num_outputs=3)
+def ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    sigma = (jnp.sqrt(n + jnp.square(g)) - jnp.sqrt(n)) / lr
+    z = z + g - sigma * weight
+    n = n + jnp.square(g)
+    w = jnp.where(jnp.abs(z) <= lamda1, 0.0,
+                  -(z - jnp.sign(z) * lamda1)
+                  / ((beta + jnp.sqrt(n)) / lr + wd))
+    return w.astype(weight.dtype), z, n
+
+
+@register("_sparse_adagrad_update", num_outputs=2,
+          aliases=("adagrad_update",))
+def _sparse_adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7,
+                           wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """AdaGrad update (reference registers only the row_sparse form; dense
+    rows with zero grad are unchanged either way, so one dense kernel serves
+    both — the sparse frontend masks to stored rows)."""
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    g = g + wd * weight
+    history = history + jnp.square(g)
+    return weight - lr * g / (jnp.sqrt(history) + epsilon), history

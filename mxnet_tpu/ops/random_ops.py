@@ -27,13 +27,15 @@ def _shp(shape):
     return tuple(int(s) for s in shape)
 
 
-@register("_random_uniform", differentiable=False, stochastic=True)
+@register("_random_uniform", differentiable=False, stochastic=True,
+          aliases=("uniform",))
 def _random_uniform(low=0.0, high=1.0, shape=None, dtype="float32"):
     return jax.random.uniform(next_key(), _shp(shape), dtype=dtype_np(dtype),
                               minval=low, maxval=high)
 
 
-@register("_random_normal", differentiable=False, stochastic=True)
+@register("_random_normal", differentiable=False, stochastic=True,
+          aliases=("normal",))
 def _random_normal(loc=0.0, scale=1.0, shape=None, dtype="float32"):
     return loc + scale * jax.random.normal(next_key(), _shp(shape),
                                            dtype=dtype_np(dtype))
@@ -45,25 +47,28 @@ def _random_gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32"):
                                    dtype=dtype_np(dtype))
 
 
-@register("_random_exponential", differentiable=False, stochastic=True)
+@register("_random_exponential", differentiable=False, stochastic=True,
+          aliases=("exponential",))
 def _random_exponential(lam=1.0, shape=None, dtype="float32"):
     return jax.random.exponential(next_key(), _shp(shape),
                                   dtype=dtype_np(dtype)) / lam
 
 
-@register("_random_poisson", differentiable=False, stochastic=True)
+@register("_random_poisson", differentiable=False, stochastic=True,
+          aliases=("poisson",))
 def _random_poisson(lam=1.0, shape=None, dtype="float32"):
     return jax.random.poisson(next_key(), lam, _shp(shape)).astype(dtype_np(dtype))
 
 
-@register("_random_negative_binomial", differentiable=False, stochastic=True)
+@register("_random_negative_binomial", differentiable=False, stochastic=True,
+          aliases=("negative_binomial",))
 def _random_negative_binomial(k=1, p=1.0, shape=None, dtype="float32"):
     lam = jax.random.gamma(next_key(), float(k), _shp(shape)) * (1 - p) / p
     return jax.random.poisson(next_key(), lam, _shp(shape)).astype(dtype_np(dtype))
 
 
 @register("_random_generalized_negative_binomial", differentiable=False,
-          stochastic=True)
+          stochastic=True, aliases=("generalized_negative_binomial",))
 def _random_generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None,
                                           dtype="float32"):
     if alpha == 0.0:

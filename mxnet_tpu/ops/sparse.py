@@ -47,7 +47,7 @@ def csr_to_dense(indptr, indices, values, num_rows=0, num_cols=0):
     return out.at[rows, indices.astype(jnp.int32)].add(values)
 
 
-@register("sparse_retain", num_outputs=2)
+@register("sparse_retain", num_outputs=2, aliases=("_sparse_retain",))
 def sparse_retain(indices, values, new_idx):
     """Retain only rows listed in new_idx (parity: sparse_retain op).
 

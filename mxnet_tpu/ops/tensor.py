@@ -142,6 +142,48 @@ def _maximum_scalar(data, scalar=0.0):
     return jnp.maximum(data, jnp.asarray(scalar, dtype=data.dtype))
 
 
+@register("_hypot_scalar")
+def _hypot_scalar(data, scalar=0.0):
+    return jnp.hypot(data, jnp.asarray(scalar, dtype=data.dtype))
+
+
+# scalar logical ops (parity: elemwise_binary_scalar_op_logic.cc)
+for _lname, _lfn in [("_logical_and_scalar", jnp.logical_and),
+                     ("_logical_or_scalar", jnp.logical_or),
+                     ("_logical_xor_scalar", jnp.logical_xor)]:
+    def _mkl(fn):
+        def logical_scalar(data, scalar=0.0):
+            return fn(data != 0, bool(scalar)).astype(data.dtype)
+        return logical_scalar
+    register(_lname, differentiable=False)(_mkl(_lfn))
+
+
+# _scatter_* ops: in the reference these write only the stored rows of a
+# row_sparse output (elemwise_scatter_op.cc); dense storage makes them the
+# plain elementwise op, and the sparse frontend routes stored-values-only
+# updates through the same kernels.
+@register("_scatter_plus_scalar")
+def _scatter_plus_scalar(data, scalar=0.0):
+    return data + jnp.asarray(scalar, dtype=data.dtype)
+
+
+@register("_scatter_minus_scalar")
+def _scatter_minus_scalar(data, scalar=0.0):
+    return data - jnp.asarray(scalar, dtype=data.dtype)
+
+
+@register("_scatter_elemwise_div")
+def _scatter_elemwise_div(lhs, rhs):
+    return lhs / rhs
+
+
+@register("_identity_with_attr_like_rhs")
+def _identity_with_attr_like_rhs(lhs, rhs):
+    """Identity on lhs; rhs only donates shape/stype attrs in the reference's
+    graph passes (elemwise_op_common.h) — returned value is lhs."""
+    return lhs
+
+
 @register("_minimum_scalar")
 def _minimum_scalar(data, scalar=0.0):
     return jnp.minimum(data, jnp.asarray(scalar, dtype=data.dtype))
@@ -165,7 +207,11 @@ for _name, _fn in [
 # ---------------------------------------------------------------------------
 
 _UNARY = {
-    "abs": jnp.abs, "sign": jnp.sign, "rint": jnp.rint, "ceil": jnp.ceil,
+    "abs": jnp.abs, "sign": jnp.sign, "rint": jnp.rint,
+    # mxnet round = half away from zero (mshadow_op.h round), NOT
+    # banker's rounding — keeps it distinct from rint
+    "round": lambda x: jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5),
+    "ceil": jnp.ceil,
     "floor": jnp.floor, "trunc": jnp.trunc, "fix": jnp.trunc,
     "square": jnp.square, "sqrt": jnp.sqrt,
     "cbrt": jnp.cbrt, "exp": jnp.exp, "log": jnp.log, "log10": jnp.log10,
@@ -375,7 +421,7 @@ def L2Normalization(data, eps=1e-10, mode="instance"):
     return data / nrm
 
 
-@register("square_sum")
+@register("square_sum", aliases=("_square_sum",))
 def square_sum(data, axis=None, keepdims=False):
     """Parity: src/operator/tensor/square_sum-inl.h (sparse fused square+sum)."""
     return jnp.sum(jnp.square(data), axis=_norm_axis(axis), keepdims=bool(keepdims))
@@ -412,33 +458,33 @@ def khatri_rao(*mats):
     return out
 
 
-@register("linalg_gemm")
+@register("linalg_gemm", aliases=("_linalg_gemm",))
 def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0):
     a = jnp.swapaxes(A, -1, -2) if transpose_a else A
     b = jnp.swapaxes(B, -1, -2) if transpose_b else B
     return alpha * jnp.matmul(a, b) + beta * C
 
 
-@register("linalg_gemm2")
+@register("linalg_gemm2", aliases=("_linalg_gemm2",))
 def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0):
     a = jnp.swapaxes(A, -1, -2) if transpose_a else A
     b = jnp.swapaxes(B, -1, -2) if transpose_b else B
     return alpha * jnp.matmul(a, b)
 
 
-@register("linalg_potrf")
+@register("linalg_potrf", aliases=("_linalg_potrf",))
 def linalg_potrf(A):
     return jnp.linalg.cholesky(A)
 
 
-@register("linalg_potri")
+@register("linalg_potri", aliases=("_linalg_potri",))
 def linalg_potri(A):
     L = A
     inv = jnp.linalg.inv(jnp.matmul(L, jnp.swapaxes(L, -1, -2)))
     return inv
 
 
-@register("linalg_trsm")
+@register("linalg_trsm", aliases=("_linalg_trsm",))
 def linalg_trsm(A, B, transpose=False, rightside=False, alpha=1.0, lower=True):
     a = jnp.swapaxes(A, -1, -2) if transpose else A
     low = bool(lower) != bool(transpose)
@@ -450,30 +496,30 @@ def linalg_trsm(A, B, transpose=False, rightside=False, alpha=1.0, lower=True):
     return jax.scipy.linalg.solve_triangular(a, alpha * B, lower=low)
 
 
-@register("linalg_trmm")
+@register("linalg_trmm", aliases=("_linalg_trmm",))
 def linalg_trmm(A, B, transpose=False, rightside=False, alpha=1.0):
     a = jnp.swapaxes(A, -1, -2) if transpose else A
     return alpha * (jnp.matmul(B, a) if rightside else jnp.matmul(a, B))
 
 
-@register("linalg_sumlogdiag")
+@register("linalg_sumlogdiag", aliases=("_linalg_sumlogdiag",))
 def linalg_sumlogdiag(A):
     return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
 
 
-@register("linalg_syrk")
+@register("linalg_syrk", aliases=("_linalg_syrk",))
 def linalg_syrk(A, transpose=False, alpha=1.0):
     at = jnp.swapaxes(A, -1, -2)
     return alpha * (jnp.matmul(at, A) if transpose else jnp.matmul(A, at))
 
 
-@register("linalg_gelqf", num_outputs=2)
+@register("linalg_gelqf", num_outputs=2, aliases=("_linalg_gelqf",))
 def linalg_gelqf(A):
     q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2))
     return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
 
 
-@register("linalg_syevd", num_outputs=2)
+@register("linalg_syevd", num_outputs=2, aliases=("_linalg_syevd",))
 def linalg_syevd(A):
     w, v = jnp.linalg.eigh(A)
     return jnp.swapaxes(v, -1, -2), w
@@ -556,11 +602,7 @@ def squeeze(data, axis=None):
 
 @register("slice", aliases=("crop",))
 def slice_op(data, begin=(), end=(), step=()):
-    idx = []
-    step = tuple(step) if step else (None,) * len(begin)
-    for b, e, s in zip(begin, end, step):
-        idx.append(builtins_slice(b, e, s))
-    return data[tuple(idx)]
+    return data[_slice_index(begin, end, step)]
 
 
 def builtins_slice(b, e, s):
@@ -568,6 +610,27 @@ def builtins_slice(b, e, s):
     e = None if e is None else int(e)
     s = None if s is None else int(s)
     return slice(b, e, s)
+
+
+def _slice_index(begin, end, step):
+    """begin/end/step attr triple -> an indexing tuple (shared by slice,
+    _slice_assign, _slice_assign_scalar)."""
+    step = tuple(step) if step else (None,) * len(begin)
+    return tuple(builtins_slice(b, e, s)
+                 for b, e, s in zip(begin, end, step))
+
+
+@register("_slice_assign", aliases=("_crop_assign",))
+def _slice_assign(lhs, rhs, begin=(), end=(), step=()):
+    """Write rhs into lhs[begin:end:step] (parity: _slice_assign /
+    _crop_assign, matrix_op.cc) — functional: returns the updated array."""
+    return lhs.at[_slice_index(begin, end, step)].set(rhs)
+
+
+@register("_slice_assign_scalar", aliases=("_crop_assign_scalar",))
+def _slice_assign_scalar(data, scalar=0.0, begin=(), end=(), step=()):
+    return data.at[_slice_index(begin, end, step)].set(
+        jnp.asarray(scalar, dtype=data.dtype))
 
 
 @register("slice_axis")
@@ -688,7 +751,7 @@ def size_array(data):
 # ---------------------------------------------------------------------------
 
 
-@register("Embedding")
+@register("Embedding", aliases=("_contrib_SparseEmbedding",))
 def Embedding(data, weight, input_dim=0, output_dim=0, dtype="float32",
               sparse_grad=False):
     """Parity: src/operator/tensor/indexing_op.h Embedding.
@@ -899,6 +962,23 @@ def smooth_l1(data, scalar=1.0):
 def quadratic(data, a=0.0, b=0.0, c=0.0):
     """Parity: src/operator/contrib/quadratic_op-inl.h (the tutorial op)."""
     return a * jnp.square(data) + b * data + c
+
+
+@register("softmax_cross_entropy")
+def softmax_cross_entropy(data, label):
+    """Fused softmax + CE against integer labels, summed over the batch
+    (parity: softmax_cross_entropy, loss_binary_op.cc) — output shape (1,)."""
+    logz = jax.scipy.special.logsumexp(data, axis=1)
+    picked = jnp.take_along_axis(
+        data, label.astype(jnp.int32)[:, None], axis=1)[:, 0]
+    return jnp.sum(logz - picked).reshape(1)
+
+
+@register("_grad_add")
+def _grad_add(lhs, rhs):
+    """Gradient aggregation add (parity: _grad_add — elemwise add that never
+    runs in place; XLA owns buffers so it IS plain add here)."""
+    return lhs + rhs
 
 
 @register("add_n", aliases=("ElementWiseSum", "_sum"))

@@ -1,0 +1,208 @@
+"""Op-registry parity additions (round 2): optimizer-as-op family, legacy
+aliases, slice-assign, image_random ops, bipartite matching.
+
+Reference: src/operator/optimizer_op.cc (update ops), matrix_op.cc
+(_slice_assign), bounding_box.cc (_contrib_bipartite_matching), crop.cc,
+image/image_random.cc, sample_op.cc (legacy sampler aliases).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_round_and_scalar_logicals():
+    a = nd.array([[1.4, -1.6], [0.0, 2.5]])
+    # mxnet round is half-away-from-zero (mshadow_op.h), not banker's
+    np.testing.assert_allclose(nd.round(a).asnumpy(),
+                               [[1.0, -2.0], [0.0, 3.0]])
+    np.testing.assert_allclose(
+        nd.round(nd.array([0.5, -0.5, 1.5, -1.5])).asnumpy(),
+        [1.0, -1.0, 2.0, -2.0])
+    np.testing.assert_allclose(
+        nd._logical_and_scalar(a, scalar=1.0).asnumpy(),
+        np.logical_and(a.asnumpy() != 0, True).astype(np.float32))
+    np.testing.assert_allclose(
+        nd._logical_or_scalar(a, scalar=0.0).asnumpy(),
+        (a.asnumpy() != 0).astype(np.float32))
+    np.testing.assert_allclose(
+        nd._hypot_scalar(nd.array([3.0]), scalar=4.0).asnumpy(), [5.0])
+
+
+def test_slice_assign():
+    x = nd.zeros((4, 4))
+    y = nd.ones((2, 2))
+    out = nd._slice_assign(x, y, begin=(1, 1), end=(3, 3))
+    expect = np.zeros((4, 4), np.float32)
+    expect[1:3, 1:3] = 1
+    np.testing.assert_allclose(out.asnumpy(), expect)
+    out2 = nd._slice_assign_scalar(x, scalar=5.0, begin=(0, 0), end=(1, 4))
+    assert out2.asnumpy()[0].sum() == 20.0 and out2.asnumpy()[1:].sum() == 0
+
+
+def test_softmax_cross_entropy():
+    rng = np.random.RandomState(0)
+    d = rng.randn(8, 10).astype(np.float32)
+    lab = rng.randint(0, 10, (8,)).astype(np.float32)
+    got = nd.softmax_cross_entropy(nd.array(d), nd.array(lab)).asnumpy()
+    p = np.exp(d) / np.exp(d).sum(1, keepdims=True)
+    ref = -np.log(p[np.arange(8), lab.astype(int)]).sum()
+    np.testing.assert_allclose(got, [ref], rtol=1e-5)
+
+
+# --- optimizer update ops -------------------------------------------------
+
+def test_sgd_update_ops_match_manual():
+    w = nd.array([1.0, 2.0]); g = nd.array([0.2, -0.4])
+    out = nd.sgd_update(w, g, lr=0.1, wd=0.01, rescale_grad=1.0)
+    expect = w.asnumpy() - 0.1 * (g.asnumpy() + 0.01 * w.asnumpy())
+    np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-6)
+
+    w = nd.array([1.0, 2.0]); m = nd.zeros((2,))
+    nd.sgd_mom_update(w, g, m, out=w, lr=0.1, momentum=0.9)
+    np.testing.assert_allclose(m.asnumpy(), -0.1 * g.asnumpy(), rtol=1e-6)
+    np.testing.assert_allclose(w.asnumpy(),
+                               [1.0, 2.0] + m.asnumpy(), rtol=1e-6)
+
+
+def test_mp_sgd_update_keeps_f32_master():
+    w32 = nd.array([1.0, -1.0])
+    w16 = nd.Cast(w32, dtype="float16")
+    g16 = nd.Cast(nd.array([0.5, 0.5]), dtype="float16")
+    out = nd.mp_sgd_update(w16, g16, w32, out=w16, lr=0.1)
+    assert out.dtype == np.float16
+    np.testing.assert_allclose(w32.asnumpy(), [0.95, -1.05], rtol=1e-6)
+
+
+def test_adam_update_no_bias_correction():
+    # op-level adam applies NO bias correction (the Adam class pre-scales lr)
+    w = nd.array([1.0]); g = nd.array([0.5])
+    mean = nd.zeros((1,)); var = nd.zeros((1,))
+    nd.adam_update(w, g, mean, var, out=w, lr=0.01, beta1=0.9, beta2=0.999,
+                   epsilon=1e-8)
+    m = 0.1 * 0.5
+    v = 0.001 * 0.25
+    np.testing.assert_allclose(w.asnumpy(),
+                               [1.0 - 0.01 * m / (np.sqrt(v) + 1e-8)],
+                               rtol=1e-5)
+    np.testing.assert_allclose(mean.asnumpy(), [m], rtol=1e-6)
+    np.testing.assert_allclose(var.asnumpy(), [v], rtol=1e-6)
+
+
+def test_rmsprop_and_centered_updates():
+    w = nd.array([1.0]); g = nd.array([0.3]); n = nd.zeros((1,))
+    nd.rmsprop_update(w, g, n, out=w, lr=0.1, gamma1=0.9, epsilon=1e-8)
+    n_ref = 0.1 * 0.09
+    np.testing.assert_allclose(
+        w.asnumpy(), [1.0 - 0.1 * 0.3 / np.sqrt(n_ref + 1e-8)], rtol=1e-5)
+
+    w = nd.array([1.0]); n = nd.zeros((1,)); gbar = nd.zeros((1,))
+    delta = nd.zeros((1,))
+    nd.rmspropalex_update(w, g, n, gbar, delta, out=w, lr=0.1)
+    assert abs(w.asnumpy()[0]) < 1.0  # moved toward minimum
+
+
+def test_ftrl_signsgd_signum_adagrad():
+    g = nd.array([0.4])
+    w = nd.array([1.0]); z = nd.zeros((1,)); n = nd.zeros((1,))
+    nd.ftrl_update(w, g, z, n, out=w, lr=0.1, lamda1=0.01, beta=1.0)
+    assert n.asnumpy()[0] == pytest.approx(0.16)
+
+    w = nd.array([1.0])
+    out = nd.signsgd_update(w, g, lr=0.1, wd=0.0)
+    np.testing.assert_allclose(out.asnumpy(), [0.9], rtol=1e-6)
+
+    w = nd.array([1.0]); m = nd.zeros((1,))
+    nd.signum_update(w, g, m, out=w, lr=0.1, momentum=0.9)
+    np.testing.assert_allclose(m.asnumpy(), [-0.04], rtol=1e-5)
+
+    w = nd.array([1.0]); h = nd.zeros((1,))
+    nd._sparse_adagrad_update(w, g, h, out=w, lr=0.1, epsilon=1e-7)
+    np.testing.assert_allclose(h.asnumpy(), [0.16], rtol=1e-6)
+    np.testing.assert_allclose(w.asnumpy(),
+                               [1.0 - 0.1 * 0.4 / (0.4 + 1e-7)], rtol=1e-5)
+
+
+def test_ftml_update_runs():
+    w = nd.array([1.0]); g = nd.array([0.5])
+    d = nd.zeros((1,)); v = nd.zeros((1,)); z = nd.zeros((1,))
+    nd.ftml_update(w, g, d, v, z, out=w, lr=0.1, t=1)
+    assert np.isfinite(w.asnumpy()).all()
+    assert v.asnumpy()[0] > 0
+
+
+# --- misc new surface -----------------------------------------------------
+
+def test_bipartite_matching_greedy():
+    dist = nd.array([[0.9, 0.1, 0.2], [0.8, 0.7, 0.3]])
+    rm, cm = nd._contrib_bipartite_matching(dist, threshold=0.5)
+    np.testing.assert_allclose(rm.asnumpy(), [0, 1])  # r0->c0 .9, r1->c1 .7
+    np.testing.assert_allclose(cm.asnumpy(), [0, 1, -1])
+    # ascending: smaller is better
+    rm2, cm2 = nd._contrib_bipartite_matching(dist, is_ascend=True,
+                                              threshold=0.5)
+    assert rm2.asnumpy()[0] == 1  # r0 takes c1 (0.1)
+
+
+def test_crop_and_image_ops():
+    img = nd.array(np.arange(32, dtype=np.float32).reshape(1, 2, 4, 4))
+    c = nd.Crop(img, h_w=(2, 2), center_crop=True)
+    assert c.shape == (1, 2, 2, 2)
+    np.testing.assert_allclose(c.asnumpy()[0, 0],
+                               img.asnumpy()[0, 0, 1:3, 1:3])
+    like = nd.zeros((1, 2, 3, 3))
+    c2 = nd.Crop(img, like, offset=(1, 1))
+    assert c2.shape == (1, 2, 3, 3)
+
+    hwc = nd.array(np.full((4, 5, 3), 255, np.uint8))
+    t = nd._image_to_tensor(hwc)
+    assert t.shape == (3, 4, 5) and t.asnumpy().max() == pytest.approx(1.0)
+    norm = nd._image_normalize(t, mean=(0.5, 0.5, 0.5), std=(0.25, 0.5, 1.0))
+    np.testing.assert_allclose(norm.asnumpy()[0], np.full((4, 5), 2.0),
+                               rtol=1e-6)
+
+
+def test_kl_sparse_reg_gradient():
+    data = nd.array(np.full((4, 3), 0.2, np.float32))
+    data.attach_grad()
+    with autograd.record():
+        out = nd.IdentityAttachKLSparseReg(data, sparseness_target=0.1,
+                                           penalty=0.001)
+        s = nd.sum(out)
+    s.backward()
+    expect = 1.0 + 0.001 * (-0.1 / 0.2 + 0.9 / 0.8)
+    np.testing.assert_allclose(data.grad.asnumpy(),
+                               np.full((4, 3), expect), rtol=1e-5)
+    np.testing.assert_allclose(out.asnumpy(), data.asnumpy())  # identity fwd
+
+
+def test_legacy_aliases_present():
+    for name in ["_linalg_gemm", "_linalg_gemm2", "_linalg_potrf",
+                 "_linalg_syevd", "_linalg_gelqf", "uniform", "normal",
+                 "poisson", "exponential", "negative_binomial",
+                 "generalized_negative_binomial", "_square_sum",
+                 "_sparse_retain", "_contrib_CTCLoss",
+                 "_contrib_SparseEmbedding", "_contrib_div_sqrt_dim",
+                 "_grad_add", "_identity_with_attr_like_rhs",
+                 "_scatter_plus_scalar", "_scatter_minus_scalar",
+                 "_scatter_elemwise_div", "Custom", "cast_storage",
+                 "round", "Crop"]:
+        assert hasattr(nd, name), name
+    # sampling aliases actually sample
+    u = nd.uniform(low=0.0, high=1.0, shape=(100,))
+    assert 0 <= u.asnumpy().min() and u.asnumpy().max() <= 1
+    z = nd.normal(loc=0.0, scale=1.0, shape=(100,))
+    assert abs(z.asnumpy().mean()) < 1.0
+
+
+def test_scatter_and_identity_attr_ops():
+    a = nd.array([2.0, 4.0])
+    np.testing.assert_allclose(
+        nd._scatter_plus_scalar(a, scalar=1.0).asnumpy(), [3.0, 5.0])
+    np.testing.assert_allclose(
+        nd._scatter_elemwise_div(a, nd.array([2.0, 2.0])).asnumpy(),
+        [1.0, 2.0])
+    np.testing.assert_allclose(
+        nd._identity_with_attr_like_rhs(a, nd.zeros((2,))).asnumpy(),
+        a.asnumpy())
