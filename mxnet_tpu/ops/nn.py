@@ -303,7 +303,7 @@ def _tup(v, nd, default):
     return tuple(int(x) for x in v)
 
 
-@register("Convolution")
+@register("Convolution", aliases=("Convolution_v1",))
 def Convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
                 pad=(), num_filter=0, num_group=1, workspace=1024,
                 no_bias=False, cudnn_tune=None, cudnn_off=False, layout=None):
@@ -360,7 +360,7 @@ def Deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
 # ---------------------------------------------------------------------------
 
 
-@register("Pooling")
+@register("Pooling", aliases=("Pooling_v1",))
 def Pooling(data, kernel=(), pool_type="max", global_pool=False,
             cudnn_off=False, pooling_convention="valid", stride=(), pad=(),
             p_value=2, count_include_pad=True, layout=None):
@@ -422,7 +422,7 @@ def Pooling(data, kernel=(), pool_type="max", global_pool=False,
 # ---------------------------------------------------------------------------
 
 
-@register("BatchNorm", num_outputs=3)
+@register("BatchNorm", num_outputs=3, aliases=("BatchNorm_v1",))
 def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
               momentum=0.9, fix_gamma=True, use_global_stats=False,
               output_mean_var=False, axis=1, cudnn_off=False):
@@ -436,15 +436,24 @@ def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     training = autograd.is_training() and not use_global_stats
     if training:
-        mean = jnp.mean(data, axis=red_ax)
-        var = jnp.var(data, axis=red_ax)
+        # one-pass statistics, f32 accumulation: E[x] and E[x^2] reduce in a
+        # single fused read of the activation (jnp.var would re-read it after
+        # the mean lands — an extra full HBM pass per BN under bf16 training)
+        xf = data.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=red_ax)
+        var = jnp.maximum(
+            jnp.mean(jnp.square(xf), axis=red_ax) - jnp.square(mean), 0.0)
     else:
         mean, var = moving_mean, moving_var
     mean_b = lax.stop_gradient(mean) if not training else mean
     var_b = lax.stop_gradient(var) if not training else var
-    inv = lax.rsqrt(var_b.reshape(shape) + eps)
-    out = (data - mean_b.reshape(shape)) * inv * g.reshape(shape) + beta.reshape(shape)
-    return out, mean, var
+    # fold into one per-channel affine in f32, apply in the data's dtype
+    inv = lax.rsqrt(var_b.astype(jnp.float32) + eps)
+    scale = g.astype(jnp.float32) * inv
+    offset = beta.astype(jnp.float32) - mean_b.astype(jnp.float32) * scale
+    out = (data * scale.reshape(shape).astype(data.dtype)
+           + offset.reshape(shape).astype(data.dtype))
+    return out, mean.astype(gamma.dtype), var.astype(gamma.dtype)
 
 
 @register("LayerNorm")
@@ -806,6 +815,10 @@ def Crop(*inputs, offset=(0, 0), h_w=(0, 0), center_crop=False,
         y0, x0 = (H - th) // 2, (W - tw) // 2
     else:
         y0, x0 = int(offset[0]), int(offset[1])
+    if y0 < 0 or x0 < 0 or y0 + th > H or x0 + tw > W:
+        raise ValueError(
+            "Crop window [%d:%d, %d:%d] exceeds input %dx%d"
+            % (y0, y0 + th, x0, x0 + tw, H, W))
     return data[:, :, y0:y0 + th, x0:x0 + tw]
 
 

@@ -24,12 +24,22 @@ from .registry import register
 
 
 def _prep(grad, wd, weight, rescale_grad, clip_gradient):
-    """rescale -> clip -> weight-decay fold, the shared kernel preamble
-    (optimizer_op-inl.h SGDKernel et al.)."""
+    """rescale -> clip -> weight-decay fold — the SGD-family kernel preamble
+    (optimizer_op-inl.h SGDKernel: wd is applied AFTER clipping)."""
     g = grad * rescale_grad
     if clip_gradient is not None and clip_gradient >= 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
     return g + wd * weight
+
+
+def _prep_wd_first(grad, wd, weight, rescale_grad, clip_gradient):
+    """rescale -> weight-decay fold -> clip — the Adam/RMSProp kernel
+    preamble (optimizer_op-inl.h AdamUpdateKernel / RMSPropUpdate fold
+    wd*weight into the gradient BEFORE clipping)."""
+    g = grad * rescale_grad + wd * weight
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
 
 
 @register("sgd_update")
@@ -90,7 +100,7 @@ def signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
 def adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                 lazy_update=True):
-    g = _prep(grad, wd, weight, rescale_grad, clip_gradient)
+    g = _prep_wd_first(grad, wd, weight, rescale_grad, clip_gradient)
     mean = beta1 * mean + (1 - beta1) * g
     var = beta2 * var + (1 - beta2) * jnp.square(g)
     return weight - lr * mean / (jnp.sqrt(var) + epsilon), mean, var
@@ -100,7 +110,7 @@ def adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
 def rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.95, epsilon=1e-8,
                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                    clip_weights=-1.0):
-    g = _prep(grad, wd, weight, rescale_grad, clip_gradient)
+    g = _prep_wd_first(grad, wd, weight, rescale_grad, clip_gradient)
     n = (1 - gamma1) * jnp.square(g) + gamma1 * n
     w = weight - lr * g / jnp.sqrt(n + epsilon)
     if clip_weights is not None and clip_weights > 0:
@@ -113,7 +123,7 @@ def rmspropalex_update(weight, grad, n, g, delta, lr=0.001, gamma1=0.95,
                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
                        clip_gradient=-1.0, clip_weights=-1.0):
     """Centered RMSProp (Graves 2013) — the reference's rmspropalex kernel."""
-    gr = _prep(grad, wd, weight, rescale_grad, clip_gradient)
+    gr = _prep_wd_first(grad, wd, weight, rescale_grad, clip_gradient)
     n = (1 - gamma1) * jnp.square(gr) + gamma1 * n
     g = (1 - gamma1) * gr + gamma1 * g
     delta = gamma2 * delta - lr * gr / jnp.sqrt(n - jnp.square(g) + epsilon)

@@ -958,7 +958,7 @@ def smooth_l1(data, scalar=1.0):
                      jnp.abs(data) - 0.5 / s2)
 
 
-@register("quadratic")
+@register("quadratic", aliases=("_contrib_quadratic",))
 def quadratic(data, a=0.0, b=0.0, c=0.0):
     """Parity: src/operator/contrib/quadratic_op-inl.h (the tutorial op)."""
     return a * jnp.square(data) + b * data + c
