@@ -8,10 +8,14 @@ the script is hermetic and its perplexity drop is assertable.
 Run: python examples/lstm_bucketing.py [--epochs 5]
 """
 import argparse
+import os
+import sys
 
 import numpy as np
 
-import mxnet_tpu as mx
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
 
 
 VOCAB, EMBED, HIDDEN = 32, 16, 32

@@ -9,13 +9,16 @@ Run: python examples/train_ssd.py [--epochs 12]
 """
 import argparse
 import os
+import sys
 import tempfile
 
 import numpy as np
 
-import mxnet_tpu as mx
-from mxnet_tpu import autograd, gluon
-from mxnet_tpu import ndarray as nd
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon  # noqa: E402
+from mxnet_tpu import ndarray as nd  # noqa: E402
 from mxnet_tpu.models.ssd import SSDLite
 from mxnet_tpu.test_utils import make_synthetic_det_dataset
 

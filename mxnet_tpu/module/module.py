@@ -29,13 +29,21 @@ class Module(BaseModule):
     def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
                  logger=logging, context=None, work_load_list=None,
                  fixed_param_names=None, state_names=None, group2ctxs=None,
-                 compression_params=None):
+                 compression_params=None, mesh=None, data_axis="dp"):
+        """mesh/data_axis: multi-chip data parallelism for the symbolic path.
+        The reference sliced each batch across N per-GPU executors
+        (DataParallelExecutorGroup, executor_group.py:129); here pass a
+        `jax.sharding.Mesh` and the ONE executor's inputs are sharded over
+        `data_axis` — GSPMD partitions compute and inserts the gradient
+        all-reduce, playing the role of kvstore type 'device'."""
         super().__init__(logger=logger)
         if context is None:
             context = cpu()
         if isinstance(context, (list, tuple)):
             context = context[0]  # devices = sharding, one logical executor
         self._context = context
+        self._mesh = mesh
+        self._data_axis = data_axis
         self._symbol = symbol
         data_names = list(data_names) if data_names is not None else []
         label_names = list(label_names) if label_names is not None else []
@@ -159,6 +167,9 @@ class Module(BaseModule):
                 arr._data = aux_params[name]._data.reshape(arr.shape)
             elif self._aux_params is not None and name in self._aux_params:
                 arr._data = self._aux_params[name]._data.reshape(arr.shape)
+        if self._mesh is not None:
+            # freshly-assigned buffers are single-device; restore replication
+            self._replicate_params_on_mesh()
         self.params_initialized = True
         self._params_dirty = False
 
@@ -206,12 +217,29 @@ class Module(BaseModule):
         if shared_module is not None and shared_module.params_initialized:
             arg, aux = shared_module.get_params()
             self._exec.copy_params_from(arg, aux)
+            if self._mesh is not None:
+                self._replicate_params_on_mesh()
             self.params_initialized = True
         elif self.params_initialized:
             # Module.load flow: loaded _arg/_aux_params predate this bind —
             # re-sync them into the fresh executor (parity: module.py:364
             # exec_group.set_params after bind)
             self.init_params(force_init=True)
+
+    def _replicate_params_on_mesh(self):
+        """Place every param/aux buffer replicated on the mesh so sharded
+        data feeds partition the compiled program instead of forcing a
+        cross-device transfer."""
+        from ..parallel.mesh import replicate
+        for d in (self._exec.arg_dict, self._exec.aux_dict):
+            for name, arr in d.items():
+                if name not in self._data_names + self._label_names:
+                    arr._data = replicate(self._mesh, arr._data)
+
+    def _shard_feed(self, arr):
+        from ..parallel.mesh import shard_batch
+        v = arr._data if isinstance(arr, NDArray) else arr
+        return NDArray(shard_batch(self._mesh, v, self._data_axis))
 
     # -- compute ------------------------------------------------------------
     def forward(self, data_batch, is_train=None):
@@ -225,6 +253,8 @@ class Module(BaseModule):
             for name, arr in zip(self._label_names, data_batch.label):
                 if name in self._exec.arg_dict:
                     feeds[name] = arr
+        if self._mesh is not None:
+            feeds = {n: self._shard_feed(a) for n, a in feeds.items()}
         self._exec.forward(is_train=is_train, **feeds)
 
     def backward(self, out_grads=None):

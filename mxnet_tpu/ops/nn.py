@@ -436,10 +436,11 @@ def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     training = autograd.is_training() and not use_global_stats
     if training:
-        # one-pass statistics, f32 accumulation: E[x] and E[x^2] reduce in a
-        # single fused read of the activation (jnp.var would re-read it after
-        # the mean lands — an extra full HBM pass per BN under bf16 training)
-        xf = data.astype(jnp.float32)
+        # one-pass statistics, >=f32 accumulation: E[x] and E[x^2] reduce in
+        # a single fused read of the activation (jnp.var would re-read it
+        # after the mean lands — an extra full HBM pass per BN under bf16
+        # training); f64 inputs keep f64 stats
+        xf = data.astype(jnp.promote_types(data.dtype, jnp.float32))
         mean = jnp.mean(xf, axis=red_ax)
         var = jnp.maximum(
             jnp.mean(jnp.square(xf), axis=red_ax) - jnp.square(mean), 0.0)
@@ -447,10 +448,11 @@ def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
         mean, var = moving_mean, moving_var
     mean_b = lax.stop_gradient(mean) if not training else mean
     var_b = lax.stop_gradient(var) if not training else var
-    # fold into one per-channel affine in f32, apply in the data's dtype
-    inv = lax.rsqrt(var_b.astype(jnp.float32) + eps)
-    scale = g.astype(jnp.float32) * inv
-    offset = beta.astype(jnp.float32) - mean_b.astype(jnp.float32) * scale
+    # fold into one per-channel affine in >=f32, apply in the data's dtype
+    sdt = jnp.promote_types(data.dtype, jnp.float32)
+    inv = lax.rsqrt(var_b.astype(sdt) + eps)
+    scale = g.astype(sdt) * inv
+    offset = beta.astype(sdt) - mean_b.astype(sdt) * scale
     out = (data * scale.reshape(shape).astype(data.dtype)
            + offset.reshape(shape).astype(data.dtype))
     return out, mean.astype(gamma.dtype), var.astype(gamma.dtype)

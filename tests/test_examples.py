@@ -1,0 +1,47 @@
+"""Smoke-run every example end-to-end as a subprocess (the reference's
+tests/python/train pattern: small configs, convergence asserted by the
+examples themselves where applicable).
+
+Each example is hermetic (synthetic data) and must exit 0 with a tiny
+config on the CPU backend.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_EX = os.path.join(_REPO, "examples")
+
+_CASES = [
+    ("train_mnist.py", ["--network", "mlp", "--num-epochs", "1",
+                        "--batch-size", "96"]),
+    ("image_classification_gluon.py", ["--model", "resnet18_v1",
+                                       "--batch-size", "8",
+                                       "--image-size", "32",
+                                       "--num-batches", "4"]),
+    ("word_lm.py", ["--epochs", "1", "--vocab", "50", "--emsize", "16",
+                    "--nhid", "32", "--nlayers", "1", "--bptt", "8",
+                    "--batch-size", "4"]),
+    ("lstm_bucketing.py", ["--epochs", "1", "--batch-size", "8"]),
+    ("sparse_linear_classification.py", ["--num-features", "100",
+                                         "--batch-size", "16",
+                                         "--num-batches", "8"]),
+    ("train_ssd.py", ["--epochs", "1", "--batch-size", "4"]),
+    ("benchmark_score.py", ["--models", "resnet18_v1", "--image-size", "32",
+                            "--batch-sizes", "2"]),
+]
+
+
+@pytest.mark.parametrize("script,args", _CASES,
+                         ids=[c[0] for c in _CASES])
+def test_example_runs(script, args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_EX, script)] + args,
+        capture_output=True, text=True, timeout=900, env=env, cwd=_REPO)
+    assert proc.returncode == 0, (
+        "%s failed:\nstdout: %s\nstderr: %s"
+        % (script, proc.stdout[-2000:], proc.stderr[-2000:]))
