@@ -53,6 +53,7 @@ from . import callback
 from . import monitor
 from . import profiler
 from . import visualization
+from . import visualization as viz  # parity: mx.viz
 from .visualization import print_summary
 from . import parallel
 from . import models

@@ -14,7 +14,8 @@ from ..base import MXNetError
 class BucketingModule(BaseModule):
     def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
                  context=None, work_load_list=None, fixed_param_names=None,
-                 state_names=None, group2ctxs=None, compression_params=None):
+                 state_names=None, group2ctxs=None, compression_params=None,
+                 mesh=None, data_axis="dp"):
         super().__init__(logger=logger)
         assert default_bucket_key is not None
         self._default_bucket_key = default_bucket_key
@@ -23,11 +24,14 @@ class BucketingModule(BaseModule):
         self._fixed_param_names = fixed_param_names
         self._state_names = state_names
         self._compression_params = compression_params
+        self._mesh = mesh
+        self._data_axis = data_axis
         self._buckets = {}
         self._curr_module = None
         self._curr_bucket_key = None
         self._params_dirty = False
         self._opt_config = None
+        self._opt_owner = None  # the Module whose optimizer all buckets share
 
     def _call_sym_gen(self, bucket_key):
         return self._sym_gen(bucket_key)
@@ -99,7 +103,8 @@ class BucketingModule(BaseModule):
                         context=self._context,
                         fixed_param_names=self._fixed_param_names,
                         state_names=self._state_names,
-                        compression_params=self._compression_params)
+                        compression_params=self._compression_params,
+                        mesh=self._mesh, data_axis=self._data_axis)
         module.bind(data_shapes, label_shapes, for_training,
                     inputs_need_grad, force_rebind=False, grad_req=grad_req)
         self._curr_module = module
@@ -114,7 +119,8 @@ class BucketingModule(BaseModule):
                             logger=self.logger, context=self._context,
                             fixed_param_names=self._fixed_param_names,
                             state_names=self._state_names,
-                            compression_params=self._compression_params)
+                            compression_params=self._compression_params,
+                            mesh=self._mesh, data_axis=self._data_axis)
             module.bind(data_shapes, label_shapes, self.for_training,
                         self.inputs_need_grad, force_rebind=False)
             # share parameters with the master bucket
@@ -128,7 +134,17 @@ class BucketingModule(BaseModule):
         self._curr_bucket_key = bucket_key
         if self._opt_config is not None and \
                 not self._curr_module.optimizer_initialized:
-            self._curr_module.init_optimizer(**self._opt_config)
+            # every bucket advances ONE optimizer (reference
+            # borrow_optimizer): fresh per-bucket moments would make e.g.
+            # Adam diverge when batches alternate between buckets. Borrow
+            # from whichever module actually owns the initialized optimizer
+            # (init_optimizer may have run while a non-default bucket was
+            # current).
+            if self._opt_owner is not None:
+                self._curr_module.borrow_optimizer(self._opt_owner)
+            else:
+                self._curr_module.init_optimizer(**self._opt_config)
+                self._opt_owner = self._curr_module
 
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
@@ -172,6 +188,11 @@ class BucketingModule(BaseModule):
         self._curr_module.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                                          optimizer_params=optimizer_params,
                                          force_init=force_init)
+        self._opt_owner = self._curr_module
+        # buckets bound before init_optimizer must share this optimizer too
+        for mod in self._buckets.values():
+            if mod is not self._curr_module:
+                mod.borrow_optimizer(self._opt_owner)
         self.optimizer_initialized = True
 
     @property

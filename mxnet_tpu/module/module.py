@@ -303,6 +303,23 @@ class Module(BaseModule):
             self._updater = opt.get_updater(self._optimizer)
         self.optimizer_initialized = True
 
+    def borrow_optimizer(self, shared_module):
+        """Share the optimizer AND its state (updater/kvstore) with another
+        module — all BucketingModule buckets must advance one set of
+        optimizer moments (parity: module.py borrow_optimizer; without this
+        each bucket's Adam/momentum state sees only its own subset of the
+        updates and training diverges under bucket switching)."""
+        assert shared_module.optimizer_initialized
+        # updater/kvstore state is keyed by param INDEX — orderings must
+        # match or moments silently cross-apply between parameters
+        assert shared_module._param_names == self._param_names, \
+            "borrow_optimizer requires identical parameter orderings"
+        self._optimizer = shared_module._optimizer
+        self._kvstore = shared_module._kvstore
+        self._update_on_kvstore = shared_module._update_on_kvstore
+        self._updater = shared_module._updater
+        self.optimizer_initialized = True
+
     def update(self):
         """Push grads / apply optimizer (parity: module.py:631 + model.py:126).
 

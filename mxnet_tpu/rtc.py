@@ -40,9 +40,11 @@ class PallasModule:
         out_shape = self._out_shape or jax.ShapeDtypeStruct(
             vals[0].shape, vals[0].dtype)
         interpret = jax.default_backend() == "cpu"
+        kw = dict(self._kwargs)
+        if self._grid is not None:  # grid=None breaks pallas_call's spec
+            kw["grid"] = self._grid
         fn = pl.pallas_call(self._body, out_shape=out_shape,
-                            grid=self._grid, interpret=interpret,
-                            **self._kwargs)
+                            interpret=interpret, **kw)
         out = fn(*vals)
         return NDArray(out)
 

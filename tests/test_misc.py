@@ -1,0 +1,121 @@
+"""Coverage for the small parity modules: monitor, visualization, callback,
+rtc (Pallas mapping of CudaModule), attribute scopes.
+
+Reference: python/mxnet/monitor.py, visualization.py, callback.py, rtc.py,
+attribute.py.
+"""
+import io
+import logging
+import os
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _bound_mlp(batch=32):
+    mod = mx.mod.Module(mx.models.get_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (batch, 784))],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params(mx.init.Xavier())
+    return mod
+
+
+def test_monitor_collects_stats():
+    mod = _bound_mlp()
+    mon = mx.monitor.Monitor(interval=1, pattern=".*")
+    mod._exec.set_monitor_callback(mon.stat_helper)
+    mon.install(mod._exec)
+    mon.tic()
+    batch = mx.io.DataBatch(data=[nd.ones((32, 784))],
+                            label=[nd.zeros((32,))])
+    mod.forward(batch, is_train=False)
+    rows = mon.toc()
+    assert rows, "monitor must collect per-output stats"
+    names = [r[1] for r in rows]
+    assert any("fc" in n.lower() or "output" in n.lower() or
+               "softmax" in n.lower() for n in names), names
+    for _, _, val in rows:
+        assert np.isfinite(float(val.asnumpy() if hasattr(val, "asnumpy")
+                                 else val))
+
+
+def test_print_summary_and_plot(capsys):
+    sym = mx.models.get_mlp()
+    mx.viz.print_summary(sym, shape={"data": (1, 784)})
+    out = capsys.readouterr().out
+    assert "Total params" in out or "params" in out.lower()
+    assert "fullyconnected" in out.lower() or "fc" in out.lower()
+    # plot_network needs the graphviz binaries only at render time; the
+    # call itself must succeed (or raise the documented ImportError when
+    # the python package is absent)
+    try:
+        g = mx.viz.plot_network(sym, shape={"data": (1, 784)})
+        assert g is not None
+    except ImportError:
+        pass
+
+
+def test_speedometer_and_log_metric():
+    logging.getLogger().setLevel(logging.INFO)
+    metric = mx.metric.create("acc")
+    metric.update([nd.array([0, 1])], [nd.array([[0.9, 0.1], [0.2, 0.8]])])
+
+    class P:
+        pass
+
+    p = P()
+    p.epoch, p.nbatch, p.eval_metric, p.locals = 0, 1, metric, None
+    sp = mx.callback.Speedometer(batch_size=32, frequent=1)
+    sp(p)  # must not raise
+    cb = mx.callback.log_train_metric(period=1)
+    cb(p)
+    bar = mx.callback.ProgressBar(total=2)
+    bar(p)
+
+
+def test_do_checkpoint_callback(tmp_path):
+    mod = _bound_mlp()
+    prefix = os.path.join(str(tmp_path), "chk")
+    cb = mx.callback.do_checkpoint(prefix, period=1)
+    arg, aux = mod.get_params()
+    cb(0, mod._symbol, arg, aux)
+    assert os.path.exists(prefix + "-symbol.json")
+    assert os.path.exists(prefix + "-0001.params")
+    sym, arg2, aux2 = mx.model.load_checkpoint(prefix, 1)
+    for k in arg:
+        np.testing.assert_allclose(arg[k].asnumpy(), arg2[k].asnumpy())
+
+
+def test_rtc_pallas_module():
+    """CudaModule -> PallasModule mapping (rtc.py): a user-defined kernel
+    runs through pallas_call on CPU interpret mode / TPU compiled."""
+    import jax.numpy as jnp
+
+    def body(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0
+
+    mod = mx.rtc.PallasModule(body, out_shape=None)
+    x = nd.array(np.arange(8, dtype=np.float32))
+    y = mod(x)
+    np.testing.assert_allclose(y.asnumpy(), np.arange(8) * 2.0)
+
+
+def test_cuda_module_raises_helpfully():
+    with pytest.raises(Exception) as e:
+        mx.rtc.CudaModule("__global__ void k(float*x){}")
+    assert "pallas" in str(e.value).lower() or "cuda" in str(e.value).lower()
+
+
+def test_attr_scope_applies_to_symbols():
+    import mxnet_tpu.symbol as S
+    with mx.AttrScope(ctx_group="dev1", mood="x"):
+        v = S.Variable("data")
+    attrs = v.attr_dict().get("data", {})
+    assert attrs.get("ctx_group") == "dev1"
+    v2 = S.Variable("plain")
+    assert v2.attr_dict().get("plain", {}).get("ctx_group") is None
