@@ -27,7 +27,10 @@ class VariationalDropoutCell(ModifierCell):
         self._output_mask = None
 
     def _mask(self, F, p, like):
-        return F.Dropout(F.ones_like(like), p=p, mode="always")
+        # standard (training-gated) Dropout of ones: random keep/scale mask
+        # while training, identity at inference — reference
+        # VariationalDropoutCell builds its masks the same way
+        return F.Dropout(F.ones_like(like), p=p)
 
     def hybrid_forward(self, F, inputs, states):
         cell = self.base_cell

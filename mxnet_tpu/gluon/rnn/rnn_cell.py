@@ -10,7 +10,7 @@ parameters transliterate.
 from __future__ import annotations
 
 from ..block import HybridBlock
-from ..parameter import Parameter
+from ..parameter import Parameter, DeferredInitializationError
 from ... import ndarray as nd_mod
 
 
@@ -53,7 +53,9 @@ class RecurrentCell(HybridBlock):
         self.reset()
         inputs, axis, batch_size = _format_sequence(length, inputs, layout)
         if begin_state is None:
-            begin_state = self.begin_state(batch_size)
+            # keyword, not positional: ModifierCell.begin_state's first
+            # parameter is `func` (reference signature), not batch_size
+            begin_state = self.begin_state(batch_size=batch_size)
         states = begin_state
         outputs = []
         for i in range(length):
@@ -79,7 +81,13 @@ class RecurrentCell(HybridBlock):
         return super().__call__(inputs, states)
 
     def forward(self, inputs, states):
-        params = {name: p.data() for name, p in self._reg_params.items()}
+        try:
+            params = {name: p.data() for name, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            # first call with deferred input_size: probe shapes from the
+            # input like HybridBlock.forward does (cells define _shape_probe)
+            self._finish_deferred_init(inputs, states)
+            params = {name: p.data() for name, p in self._reg_params.items()}
         return self.hybrid_forward(nd_mod, inputs, states, **params)
 
 

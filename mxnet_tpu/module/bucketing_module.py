@@ -136,15 +136,9 @@ class BucketingModule(BaseModule):
                 not self._curr_module.optimizer_initialized:
             # every bucket advances ONE optimizer (reference
             # borrow_optimizer): fresh per-bucket moments would make e.g.
-            # Adam diverge when batches alternate between buckets. Borrow
-            # from whichever module actually owns the initialized optimizer
-            # (init_optimizer may have run while a non-default bucket was
-            # current).
-            if self._opt_owner is not None:
-                self._curr_module.borrow_optimizer(self._opt_owner)
-            else:
-                self._curr_module.init_optimizer(**self._opt_config)
-                self._opt_owner = self._curr_module
+            # Adam diverge when batches alternate between buckets
+            assert self._opt_owner is not None  # set with _opt_config
+            self._curr_module.borrow_optimizer(self._opt_owner)
 
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized

@@ -4,11 +4,8 @@ rtc (Pallas mapping of CudaModule), attribute scopes.
 Reference: python/mxnet/monitor.py, visualization.py, callback.py, rtc.py,
 attribute.py.
 """
-import io
 import logging
 import os
-import tempfile
-import time
 
 import numpy as np
 import pytest
@@ -28,7 +25,6 @@ def _bound_mlp(batch=32):
 def test_monitor_collects_stats():
     mod = _bound_mlp()
     mon = mx.monitor.Monitor(interval=1, pattern=".*")
-    mod._exec.set_monitor_callback(mon.stat_helper)
     mon.install(mod._exec)
     mon.tic()
     batch = mx.io.DataBatch(data=[nd.ones((32, 784))],
