@@ -415,22 +415,80 @@ class SymbolBlock(HybridBlock):
         self._input_names = [i.name for i in inputs]
         arg_names = outputs.list_arguments()
         aux_names = outputs.list_auxiliary_states()
+        # params carry the block prefix; the symbol wants its raw arg names
+        self._sym_name_of = {}
         for name in arg_names + aux_names:
             if name not in self._input_names:
-                self.params.get(name, allow_deferred_init=True,
-                                grad_req="null" if name in aux_names
-                                else "write")
+                p = self.params.get(name, allow_deferred_init=True,
+                                    grad_req="null" if name in aux_names
+                                    else "write")
+                self._sym_name_of[p.name] = name
 
     def forward(self, *args):
-        values = {}
-        for name, a in zip(self._input_names, args):
-            values[name] = a._data
+        if len(args) != len(self._input_names):
+            raise MXNetError(
+                "SymbolBlock expects %d inputs (%s), got %d"
+                % (len(self._input_names), self._input_names, len(args)))
+        if any(p._data is None and p._deferred_init
+               for p in self.params.values()):
+            self._finish_symbol_deferred_init(args)
+        names = list(self._input_names)
+        tensors = [a if isinstance(a, NDArray) else NDArray(a)
+                   for a in args]
+        aux_params = []
         for name, p in self.params.items():
             if p._data is not None:
-                values[name] = p.data()._data
-        outs, _ = self._symbol._eval(values, train=autograd.is_training())
-        outs = [NDArray(o) for o in outs]
-        return outs[0] if len(outs) == 1 else outs
+                names.append(self._sym_name_of.get(p.name, name))
+                tensors.append(p.data())
+        train = autograd.is_training()
+        symbol = self._symbol
+        aux_names = symbol.list_auxiliary_states() if train else []
+        if aux_names:
+            by_sym = {s: p for p, s in self._sym_name_of.items()}
+            pd = self.params
+            aux_params = [pd[by_sym[n]] if by_sym.get(n) in pd else None
+                          for n in aux_names]
+
+        def eval_fn(*vals):
+            d = dict(zip(names, vals))
+            outs, aux_upd = symbol._eval(d, train=train)
+            # thread updated aux states (BatchNorm moving stats) out as
+            # extra outputs so the block can write them back — fixed arity:
+            # unchanged aux pass through
+            outs = tuple(outs) + tuple(aux_upd.get(n, d[n])
+                                       for n in aux_names)
+            return outs if len(outs) > 1 else outs[0]
+
+        # route through the op machinery so the evaluation lands on the
+        # autograd tape (gradients flow to params like any gluon block);
+        # stochastic=True threads ONE rng key through forward AND its vjp
+        # replay, keeping dropout masks consistent with the forward pass
+        from ..ndarray import _apply_op, _AdhocOp
+        n_out = len(symbol.list_outputs())
+        res = _apply_op(_AdhocOp(eval_fn, "symbol_block", stochastic=True,
+                                 num_outputs=n_out + len(aux_names)),
+                        tuple(tensors), {})
+        if not isinstance(res, tuple):
+            return res
+        outs, aux_new = res[:n_out], res[n_out:]
+        for p, v in zip(aux_params, aux_new):
+            if p is not None:
+                p.set_data(v)
+        return outs[0] if n_out == 1 else list(outs)
+
+    def _finish_symbol_deferred_init(self, args):
+        """Infer deferred param shapes from input shapes via the symbol's
+        shape inference (parity: SymbolBlock's deferred init through
+        infer_shape, gluon/block.py:653 area)."""
+        in_shapes = {n: a.shape for n, a in zip(self._input_names, args)}
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**in_shapes)
+        shape_of = dict(zip(self._symbol.list_arguments(), arg_shapes))
+        shape_of.update(zip(self._symbol.list_auxiliary_states(),
+                            aux_shapes))
+        for name, p in self.params.items():
+            sname = self._sym_name_of.get(p.name, name)
+            if p._data is None and p._deferred_init and sname in shape_of:
+                p._finish_deferred_init(shape_of[sname])
 
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None):
@@ -441,6 +499,19 @@ class SymbolBlock(HybridBlock):
                    else input_names)]
         block = SymbolBlock(s, inputs)
         if param_file:
-            block.collect_params().load(param_file, ctx=ctx,
-                                        ignore_extra=True, allow_missing=True)
+            from ..utils import serialization
+            raw = serialization.load_ndarrays(param_file)
+            # accept both Module-style 'arg:/aux:' keys and plain names;
+            # map the file's raw symbol names onto the block's prefixed
+            # params (see _sym_name_of)
+            raw = {k.split(":", 1)[-1]: v for k, v in raw.items()}
+            by_sym = {s: p for p, s in block._sym_name_of.items()}
+            params = block.collect_params()
+            for sname, arr in raw.items():
+                pname = by_sym.get(sname)
+                if pname is not None and pname in params:
+                    if ctx is not None:
+                        arr = arr.as_in_context(
+                            ctx[0] if isinstance(ctx, (list, tuple)) else ctx)
+                    params[pname].set_data(arr)
         return block

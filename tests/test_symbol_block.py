@@ -1,0 +1,147 @@
+"""SymbolBlock: import a symbolic graph into Gluon (parity:
+gluon/block.py:653 SymbolBlock + SymbolBlock.imports) — deferred shape
+inference, autograd through the symbol evaluation, file import with weight
+fidelity, and frozen fine-tuning.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+import mxnet_tpu.symbol as S
+
+
+def _mlp_symbol():
+    data = S.Variable("data")
+    h = S.Activation(S.FullyConnected(data, num_hidden=8, name="fc1"),
+                     act_type="relu")
+    return data, S.FullyConnected(h, num_hidden=4, name="fc2")
+
+
+def test_symbol_block_deferred_init_and_forward():
+    data, sym = _mlp_symbol()
+    sb = gluon.SymbolBlock(outputs=sym, inputs=data)
+    sb.initialize(mx.init.Xavier())
+    y = sb(nd.ones((2, 6)))
+    assert y.shape == (2, 4)
+    # input dim was inferred from the first batch
+    wname = [n for n in sb.collect_params() if "fc1_weight" in n][0]
+    assert sb.collect_params()[wname].shape == (8, 6)
+
+
+def test_symbol_block_trains_with_autograd():
+    data, sym = _mlp_symbol()
+    sb = gluon.SymbolBlock(outputs=sym, inputs=data)
+    sb.initialize(mx.init.Xavier())
+    y0 = sb(nd.ones((2, 6))).asnumpy()
+    tr = gluon.Trainer(sb.collect_params(), "sgd", {"learning_rate": 0.5})
+    losses = []
+    for _ in range(10):
+        with autograd.record():
+            L = nd.mean(nd.square(sb(nd.ones((2, 6)))))
+        L.backward()
+        tr.step(1)
+        losses.append(float(L.asnumpy()))
+    assert losses[-1] < losses[0] * 0.5, losses
+    assert not np.allclose(y0, sb(nd.ones((2, 6))).asnumpy())
+
+
+def test_symbol_block_imports_weight_fidelity(tmp_path):
+    data, sym = _mlp_symbol()
+    sym.save(os.path.join(str(tmp_path), "m-symbol.json"))
+    arg_shapes, _, _ = sym.infer_shape(data=(1, 6))
+    rng = np.random.RandomState(0)
+    save = {n: nd.array(rng.uniform(-0.1, 0.1, s).astype(np.float32))
+            for n, s in zip(sym.list_arguments(), arg_shapes) if n != "data"}
+    nd.save(os.path.join(str(tmp_path), "m.params"), save)
+
+    blk = gluon.SymbolBlock.imports(
+        os.path.join(str(tmp_path), "m-symbol.json"), "data",
+        os.path.join(str(tmp_path), "m.params"))
+    out = blk(nd.ones((3, 6)))
+    w1, b1 = save["fc1_weight"].asnumpy(), save["fc1_bias"].asnumpy()
+    w2, b2 = save["fc2_weight"].asnumpy(), save["fc2_bias"].asnumpy()
+    h = np.maximum(np.ones((3, 6)) @ w1.T + b1, 0)
+    np.testing.assert_allclose(out.asnumpy(), h @ w2.T + b2, rtol=1e-5)
+
+
+def test_symbol_block_frozen_finetune(tmp_path):
+    data, sym = _mlp_symbol()
+    sb = gluon.SymbolBlock(outputs=sym, inputs=data)
+    sb.initialize(mx.init.Xavier())
+    sb(nd.ones((3, 6)))
+    params = sb.collect_params()
+    for name, p in params.items():
+        if "fc1" in name:
+            p.grad_req = "null"
+    w1name = [n for n in params if "fc1_weight" in n][0]
+    w2name = [n for n in params if "fc2_weight" in n][0]
+    w1_before = params[w1name].data().asnumpy().copy()
+    w2_before = params[w2name].data().asnumpy().copy()
+    tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.5})
+    for _ in range(3):
+        with autograd.record():
+            L = nd.mean(nd.square(sb(nd.ones((3, 6)))))
+        L.backward()
+        tr.step(1)
+    np.testing.assert_allclose(w1_before, params[w1name].data().asnumpy())
+    assert not np.allclose(w2_before, params[w2name].data().asnumpy())
+
+
+def test_grad_req_add_accumulates():
+    w = nd.array([1.0, 2.0])
+    w.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            L = nd.sum(w * w)
+        L.backward()
+    np.testing.assert_allclose(w.grad.asnumpy(), [6.0, 12.0])
+
+
+def test_symbol_block_batchnorm_aux_updates():
+    data = S.Variable("data")
+    sym = S.BatchNorm(S.FullyConnected(data, num_hidden=4, name="fc"),
+                      name="bn", fix_gamma=False)
+    sb = gluon.SymbolBlock(outputs=sym, inputs=data)
+    sb.initialize(mx.init.Xavier())
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.uniform(1.0, 2.0, (16, 6)).astype(np.float32))
+    mm_name = [n for n in sb.collect_params() if "moving_mean" in n][0]
+    sb(x)  # eval forward: moving stats must NOT move
+    before = sb.collect_params()[mm_name].data().asnumpy().copy()
+    with autograd.record():
+        out = sb(x)
+        L = nd.sum(out)
+    L.backward()
+    after = sb.collect_params()[mm_name].data().asnumpy()
+    assert not np.allclose(before, after), "BN moving stats must update"
+
+
+def test_symbol_block_dropout_grad_consistency():
+    # dropout mask must be IDENTICAL between forward and the vjp replay:
+    # where the output was dropped, the input grad must be zero
+    data = S.Variable("data")
+    sym = S.Dropout(data, p=0.5)
+    sb = gluon.SymbolBlock(outputs=sym, inputs=data)
+    sb.initialize()
+    x = nd.ones((8, 8))
+    x.attach_grad()
+    with autograd.record():
+        out = sb(x)
+        L = nd.sum(out)
+    L.backward()
+    o = out.asnumpy()
+    g = x.grad.asnumpy()
+    np.testing.assert_allclose((o == 0), (g == 0),
+                               err_msg="fwd mask and grad mask differ")
+
+
+def test_symbol_block_input_arity_error():
+    a, b = S.Variable("a"), S.Variable("b")
+    sym = S.elemwise_add(a, b)
+    sb = gluon.SymbolBlock(outputs=sym, inputs=[a, b])
+    sb.initialize()
+    with pytest.raises(mx.MXNetError, match="expects 2 inputs"):
+        sb(nd.ones((2, 2)))
