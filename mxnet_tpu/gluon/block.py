@@ -423,6 +423,10 @@ class SymbolBlock(HybridBlock):
                                     grad_req="null" if name in aux_names
                                     else "write")
                 self._sym_name_of[p.name] = name
+        # static per-block metadata used on every forward (hot path)
+        self._param_of_sym = {s: p for p, s in self._sym_name_of.items()}
+        self._aux_names = aux_names
+        self._n_out = len(outputs.list_outputs())
 
     def forward(self, *args):
         if len(args) != len(self._input_names):
@@ -442,11 +446,11 @@ class SymbolBlock(HybridBlock):
                 tensors.append(p.data())
         train = autograd.is_training()
         symbol = self._symbol
-        aux_names = symbol.list_auxiliary_states() if train else []
+        aux_names = self._aux_names if train else []
         if aux_names:
-            by_sym = {s: p for p, s in self._sym_name_of.items()}
             pd = self.params
-            aux_params = [pd[by_sym[n]] if by_sym.get(n) in pd else None
+            aux_params = [pd[self._param_of_sym[n]]
+                          if self._param_of_sym.get(n) in pd else None
                           for n in aux_names]
 
         def eval_fn(*vals):
@@ -463,11 +467,11 @@ class SymbolBlock(HybridBlock):
         # autograd tape (gradients flow to params like any gluon block);
         # stochastic=True threads ONE rng key through forward AND its vjp
         # replay, keeping dropout masks consistent with the forward pass
-        from ..ndarray import _apply_op, _AdhocOp
-        n_out = len(symbol.list_outputs())
-        res = _apply_op(_AdhocOp(eval_fn, "symbol_block", stochastic=True,
-                                 num_outputs=n_out + len(aux_names)),
-                        tuple(tensors), {})
+        n_out = self._n_out
+        res = nd_mod._apply_op(
+            nd_mod._AdhocOp(eval_fn, "symbol_block", stochastic=True,
+                            num_outputs=n_out + len(aux_names)),
+            tuple(tensors), {})
         if not isinstance(res, tuple):
             return res
         outs, aux_new = res[:n_out], res[n_out:]
@@ -505,7 +509,7 @@ class SymbolBlock(HybridBlock):
             # map the file's raw symbol names onto the block's prefixed
             # params (see _sym_name_of)
             raw = {k.split(":", 1)[-1]: v for k, v in raw.items()}
-            by_sym = {s: p for p, s in block._sym_name_of.items()}
+            by_sym = block._param_of_sym
             params = block.collect_params()
             for sname, arr in raw.items():
                 pname = by_sym.get(sname)

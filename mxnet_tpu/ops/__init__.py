@@ -18,3 +18,4 @@ from . import contrib
 from . import sparse
 from . import quantization
 from . import optimizer_ops
+from . import custom

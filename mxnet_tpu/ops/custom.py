@@ -1,0 +1,114 @@
+"""The `Custom` operator — user-defined Python ops callable from the
+SYMBOLIC path (mx.sym.Custom) and inside jitted graphs.
+
+Parity: reference `src/operator/custom/custom.cc` registers op "Custom"
+whose forward/backward call back into frontend CustomOp code on a dedicated
+worker thread (custom-inl.h:50-170), so symbols/CachedOps can embed Python
+ops. TPU-native redesign: under tracing the Python callbacks escape via
+`jax.pure_callback` (SURVEY §7 hard part (f)); gradients route through
+`jax.custom_vjp`, whose backward re-enters the host to run
+CustomOp.backward. The imperative `mx.nd.Custom` keeps the direct in-line
+path (mxnet_tpu/operator.py) — this registered op is the traced/symbolic
+seam.
+
+Note: a fresh CustomOp instance is created per forward and per backward
+call (the reference reuses one instance per executor binding); custom ops
+that rely on instance state across forward->backward must carry it through
+out_data instead.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from .registry import register
+
+
+def _prop_for(op_type, params):
+    from .. import operator as _operator
+    return _operator.get(op_type)(**params)
+
+
+def _shapes_types(prop, ins):
+    in_shapes = [tuple(x.shape) for x in ins]
+    _, out_shapes, aux_shapes = prop.infer_shape(list(in_shapes))
+    try:
+        _, out_types, _ = prop.infer_type([x.dtype for x in ins])
+    except (NotImplementedError, TypeError, ValueError):
+        out_types = [ins[0].dtype if ins else np.float32] * len(out_shapes)
+    return in_shapes, out_shapes, aux_shapes, out_types
+
+
+@register("Custom", num_outputs=-1)
+def Custom(*inputs, op_type=None, **params):
+    """Traced custom-op dispatch: host callbacks via pure_callback with a
+    custom_vjp whose backward runs CustomOp.backward host-side."""
+    import jax
+    import jax.numpy as jnp
+    from .. import autograd
+    from ..ndarray import NDArray
+
+    assert op_type is not None, "op_type is required"
+    prop = _prop_for(op_type, params)
+    ins = list(inputs)
+    in_shapes, out_shapes, aux_shapes, out_types = _shapes_types(prop, ins)
+    if aux_shapes:
+        # persistent aux state would need executor-level threading (only
+        # BatchNorm gets that in symbol._eval); zero-filled aux every call
+        # would be silently wrong, so fail loudly instead
+        raise MXNetError(
+            "symbolic Custom op %r declares auxiliary states, which the "
+            "traced path does not persist — carry state through out_data "
+            "or use the imperative nd.Custom" % op_type)
+    train = autograd.is_training()  # trace-time mode, like Dropout/BatchNorm
+    n_in, n_out = len(ins), len(out_shapes)
+    out_struct = tuple(jax.ShapeDtypeStruct(tuple(s), np.dtype(t))
+                       for s, t in zip(out_shapes, out_types))
+    in_struct = tuple(jax.ShapeDtypeStruct(tuple(s), np.dtype(v.dtype))
+                      for s, v in zip(in_shapes, ins))
+
+    def _nd(v):
+        return NDArray(np.asarray(v))
+
+    def host_forward(*vals):
+        op = prop.create_operator(None, in_shapes,
+                                  [v.dtype for v in vals])
+        ins_nd = [_nd(v) for v in vals]
+        outs = [_nd(np.zeros(s, t)) for s, t in zip(out_shapes, out_types)]
+        with autograd.pause():
+            op.forward(train, ["write"] * n_out, ins_nd, outs, [])
+        return tuple(np.asarray(o.asnumpy(), dtype=t)
+                     for o, t in zip(outs, out_types))
+
+    def host_backward(*vals):
+        gouts, vins, vouts = (vals[:n_out], vals[n_out:n_out + n_in],
+                              vals[n_out + n_in:])
+        op = prop.create_operator(None, in_shapes,
+                                  [v.dtype for v in vins])
+        ins_nd = [_nd(v) for v in vins]
+        outs_nd = [_nd(v) for v in vouts]
+        gouts_nd = [_nd(g) for g in gouts]
+        gins = [_nd(np.zeros_like(np.asarray(v))) for v in vins]
+        with autograd.pause():
+            op.backward(["write"] * n_in, gouts_nd, ins_nd, outs_nd,
+                        gins, [])
+        return tuple(np.asarray(g.asnumpy(), dtype=v.dtype)
+                     for g, v in zip(gins, vins))
+
+    @jax.custom_vjp
+    def run(*vals):
+        return jax.pure_callback(host_forward, out_struct, *vals)
+
+    def run_fwd(*vals):
+        outs = jax.pure_callback(host_forward, out_struct, *vals)
+        return outs, (vals, outs)
+
+    def run_bwd(res, gouts):
+        vals, outs = res
+        return jax.pure_callback(host_backward, in_struct,
+                                 *(tuple(gouts) + tuple(vals) +
+                                   tuple(outs)))
+
+    run.defvjp(run_fwd, run_bwd)
+    outs = run(*ins)
+    return outs if n_out > 1 else outs[0]

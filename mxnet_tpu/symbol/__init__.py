@@ -69,6 +69,11 @@ def _static_num_outputs(opdef, kwargs):
         return 2
     if name == "_sample_multinomial":
         return 2 if kwargs.get("get_prob") else 1
+    if name == "Custom":
+        # output count comes from the registered CustomOpProp
+        from .. import operator as _operator
+        p = {k: v for k, v in kwargs.items() if k != "op_type"}
+        return len(_operator.get(kwargs["op_type"])(**p).list_outputs())
     # NB: don't call bare builtins shadowable by generated op names (max/min/
     # sum/abs are all registered ops injected into this module's globals)
     return opdef.num_outputs if opdef.num_outputs > 1 else 1

@@ -1,0 +1,141 @@
+"""Symbolic Custom ops (mx.sym.Custom) — the reference's custom-op tutorial
+pattern: a Python-defined op embedded in a symbolic graph, executed inside
+the jitted executor via pure_callback with host-side backward.
+
+Reference: src/operator/custom/custom.cc, docs 'how to create new
+operators', example/numpy-ops/custom_softmax.py.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+import mxnet_tpu.operator as operator
+import mxnet_tpu.symbol as S
+
+
+class _MySoftmax(operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        y = np.exp(x - x.max(axis=1, keepdims=True))
+        y /= y.sum(axis=1, keepdims=True)
+        self.assign(out_data[0], req[0], mx.nd.array(y))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0].asnumpy()
+        gy = out_grad[0].asnumpy()
+        gx = y * (gy - (gy * y).sum(axis=1, keepdims=True))
+        self.assign(in_grad[0], req[0], mx.nd.array(gx))
+
+
+@operator.register("_test_sym_softmax")
+class _MySoftmaxProp(operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _MySoftmax()
+
+
+def _np_softmax(x):
+    y = np.exp(x - x.max(1, keepdims=True))
+    return y / y.sum(1, keepdims=True)
+
+
+def test_symbolic_custom_forward_backward():
+    data = S.Variable("data")
+    sym = S.Custom(data, op_type="_test_sym_softmax")
+    exe = sym.simple_bind(mx.cpu(), data=(4, 5))
+    x = np.random.RandomState(0).uniform(-1, 1, (4, 5)).astype(np.float32)
+    out = exe.forward(is_train=True, data=mx.nd.array(x))[0].asnumpy()
+    ref = _np_softmax(x)
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+    gy = np.ones((4, 5), np.float32)
+    exe.backward(out_grads=mx.nd.array(gy))
+    gref = ref * (gy - (gy * ref).sum(1, keepdims=True))
+    np.testing.assert_allclose(exe.grad_arrays[0].asnumpy(), gref,
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_custom_op_trains_inside_module():
+    # MLP whose softmax head is the Python CustomOp, trained via Module.fit
+    data = S.Variable("data")
+    label = S.Variable("softmax_label")
+    fc = S.FullyConnected(data, num_hidden=10, name="fc")
+    probs = S.Custom(fc, op_type="_test_sym_softmax")
+    # cross-entropy via make_loss on the custom-op output
+    pick = S.pick(probs, label, axis=1)
+    loss = S.make_loss(S.negative(S.log(pick + 1e-8)))
+    group = S.Group([S.BlockGrad(probs), loss])
+
+    train, _ = mx.test_utils.get_mnist_iterator(batch_size=50,
+                                                input_shape=(784,))
+    mod = mx.mod.Module(group, context=mx.cpu(),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (50, 784))],
+             label_shapes=[("softmax_label", (50,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    first = last = None
+    for ep in range(2):
+        train.reset()
+        for batch in train:
+            mod.forward(batch, is_train=True)
+            loss_val = float(mod.get_outputs()[1].asnumpy().mean())
+            mod.backward()
+            mod.update()
+            first = first if first is not None else loss_val
+            last = loss_val
+    assert last < first * 0.3, (first, last)
+
+
+def test_eager_custom_matches_symbolic():
+    x = np.random.RandomState(1).uniform(-1, 1, (3, 7)).astype(np.float32)
+    y = nd.Custom(mx.nd.array(x), op_type="_test_sym_softmax")
+    np.testing.assert_allclose(y.asnumpy(), _np_softmax(x), rtol=1e-5)
+
+
+class _TrainFlagOp(operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        # output = input + 1 in train mode, input - 1 at inference
+        delta = 1.0 if is_train else -1.0
+        self.assign(out_data[0], req[0],
+                    mx.nd.array(in_data[0].asnumpy() + delta))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], out_grad[0])
+
+
+@operator.register("_test_train_flag")
+class _TrainFlagProp(operator.CustomOpProp):
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _TrainFlagOp()
+
+
+def test_symbolic_custom_sees_train_flag():
+    data = S.Variable("data")
+    sym = S.Custom(data, op_type="_test_train_flag")
+    exe = sym.simple_bind(mx.cpu(), data=(2, 2))
+    x = mx.nd.zeros((2, 2))
+    out_train = exe.forward(is_train=True, data=x)[0].asnumpy()
+    out_eval = exe.forward(is_train=False, data=x)[0].asnumpy()
+    np.testing.assert_allclose(out_train, np.ones((2, 2)))
+    np.testing.assert_allclose(out_eval, -np.ones((2, 2)))
