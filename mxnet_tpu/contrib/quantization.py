@@ -184,9 +184,11 @@ def quantize_model(sym, arg_params, aux_params=None, excluded_sym_names=(),
         wname = weight_node.name
         no_bias = bool(node.kwargs.get("no_bias", False))
 
-        # pre-quantize the weight (and bias) params (cached per var name)
+        # pre-quantize the weight (and bias) params (cached per var name);
+        # shape hints let simple_bind infer the quantized vars (Module flow)
         _qw, wmin, wmax = _quantize_param(wname)
-        qweight = Variable(wname + "_quantized")._outputs[0]
+        qweight = Variable(wname + "_quantized",
+                           shape=tuple(_qw.shape))._outputs[0]
         wmin_s = _const_var(wname + "_min", wmin, new_args)
         wmax_s = _const_var(wname + "_max", wmax, new_args)
 
@@ -195,7 +197,8 @@ def quantize_model(sym, arg_params, aux_params=None, excluded_sym_names=(),
             bias_node, _ = node.inputs[2]
             bname = bias_node.name
             _qb, bmin, bmax = _quantize_param(bname)
-            qbias = Variable(bname + "_quantized")._outputs[0]
+            qbias = Variable(bname + "_quantized",
+                             shape=tuple(_qb.shape))._outputs[0]
             bmin_s = _const_var(bname + "_min", bmin, new_args)
             bmax_s = _const_var(bname + "_max", bmax, new_args)
             bias_inputs = [qbias, bmin_s, bmax_s]
@@ -237,9 +240,10 @@ def quantize_model(sym, arg_params, aux_params=None, excluded_sym_names=(),
 
 
 def _const_var(name, value, new_args):
-    """A scalar parameter variable carrying a calibrated range."""
+    """A scalar parameter variable carrying a calibrated range. shape=()
+    lets simple_bind infer it (Module flow) without an explicit args dict."""
     new_args[name] = NDArray(np.float32(value).reshape(()))
-    return Variable(name)._outputs[0]
+    return Variable(name, shape=())._outputs[0]
 
 
 def _output_name(node, idx):

@@ -34,7 +34,9 @@ def _shapes_types(prop, ins):
     _, out_shapes, aux_shapes = prop.infer_shape(list(in_shapes))
     try:
         _, out_types, _ = prop.infer_type([x.dtype for x in ins])
-    except (NotImplementedError, TypeError, ValueError):
+    except NotImplementedError:
+        # only the base-class "not implemented" signal falls back; genuine
+        # errors in user infer_type overrides must surface
         out_types = [ins[0].dtype if ins else np.float32] * len(out_shapes)
     return in_shapes, out_shapes, aux_shapes, out_types
 

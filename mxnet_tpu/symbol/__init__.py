@@ -611,6 +611,22 @@ def create(op_name, *args, name=None, attr=None, **kwargs):
                 v = Variable("%s_%s" % (name, nm))
                 v._outputs[0][0].is_aux = True
                 inputs.append(v._outputs[0])
+    elif opdef.name == "Custom":
+        # bind keyword tensor inputs by the prop's declared argument order
+        # (reference custom.cc maps kwargs onto list_arguments()) — kwargs
+        # call order must NOT determine input order
+        from .. import operator as _operator
+        p = {k: v for k, v in kwargs.items()
+             if k != "op_type" and not isinstance(v, Symbol)}
+        arg_list = _operator.get(kwargs["op_type"])(**p).list_arguments()
+        for i, nm in enumerate(arg_list):
+            if i < len(inputs):
+                continue
+            if nm in kwargs and isinstance(kwargs[nm], Symbol):
+                inputs.append(kwargs.pop(nm)._outputs[0])
+            else:
+                v = Variable("%s_%s" % (name, nm))
+                inputs.append(v._outputs[0])
     else:
         # tensor kwargs for list-less ops
         for k in list(kwargs):

@@ -139,3 +139,40 @@ def test_symbolic_custom_sees_train_flag():
     out_eval = exe.forward(is_train=False, data=x)[0].asnumpy()
     np.testing.assert_allclose(out_train, np.ones((2, 2)))
     np.testing.assert_allclose(out_eval, -np.ones((2, 2)))
+
+
+class _Sub2(operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0],
+                    mx.nd.array(in_data[0].asnumpy() - in_data[1].asnumpy()))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], out_grad[0])
+        self.assign(in_grad[1], req[0],
+                    mx.nd.array(-out_grad[0].asnumpy()))
+
+
+@operator.register("_test_sub2")
+class _Sub2Prop(operator.CustomOpProp):
+    def list_arguments(self):
+        return ["lhs", "rhs"]
+
+    def list_outputs(self):
+        return ["out"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _Sub2()
+
+
+def test_symbolic_custom_keyword_inputs_bind_by_name():
+    # kwargs call order must not determine input order: inputs bind to the
+    # prop's list_arguments() declaration (reference custom.cc semantics)
+    a, b = S.Variable("a"), S.Variable("b")
+    sym = S.Custom(rhs=b, lhs=a, op_type="_test_sub2")
+    exe = sym.simple_bind(mx.cpu(), a=(2,), b=(2,))
+    out = exe.forward(is_train=False, a=mx.nd.array([5.0, 5.0]),
+                      b=mx.nd.array([1.0, 1.0]))[0].asnumpy()
+    np.testing.assert_allclose(out, [4.0, 4.0])

@@ -192,3 +192,33 @@ def test_quantize_model_shared_weight():
     rel2 = np.abs(qexe2.outputs[0].asnumpy() - ref).max() / (
         np.abs(ref).max() + 1e-9)
     assert rel2 < 0.05, rel2
+
+
+def test_quantized_model_binds_via_module():
+    """simple_bind over a quantized graph (the Module deployment flow):
+    quantized-weight and calib-range variables must carry shape hints so
+    inference binding needs no explicit args dict."""
+    import mxnet_tpu as mx
+
+    train, val = mx.test_utils.get_mnist_iterator(batch_size=100,
+                                                  input_shape=(784,))
+    mod = mx.mod.Module(mx.models.get_mlp(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd", initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.1}, num_epoch=1)
+    arg, aux = mod.get_params()
+    qsym, qarg, qaux = quantize_model(sym=mod._symbol, arg_params=arg,
+                                      aux_params=aux, calib_mode="naive",
+                                      calib_data=val,
+                                      num_calib_examples=200)
+    qmod = mx.mod.Module(qsym, context=mx.cpu())
+    qmod.bind(data_shapes=[("data", (100, 784))],
+              label_shapes=[("softmax_label", (100,))], for_training=False)
+    qmod.init_params(arg_params=qarg, aux_params=qaux, force_init=True)
+    val.reset()
+    correct = total = 0
+    for b in val:
+        qmod.forward(b, is_train=False)
+        p = qmod.get_outputs()[0].asnumpy().argmax(1)
+        correct += (p == b.label[0].asnumpy()).sum()
+        total += len(p)
+    assert correct / total > 0.85, correct / total
