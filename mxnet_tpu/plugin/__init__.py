@@ -1,0 +1,7 @@
+"""Optional plugin bridges (parity: reference `plugin/` — caffe/torch op
+bridges, `plugin/torch/torch_module.cc`). Only the torch bridge is provided
+(PyTorch is the one plugin framework present in this environment); it is
+import-gated so the core framework never requires torch.
+"""
+from . import torch_module  # noqa: F401
+from .torch_module import TorchBlock  # noqa: F401

@@ -1,0 +1,132 @@
+"""Run a torch.nn.Module as a Gluon block.
+
+Parity: reference `plugin/torch/torch_module.cc` + `torch_function.cc` —
+the TorchModule op adapts Torch modules into MXNet graphs, mapping the
+module's weights into framework-visible parameter arrays so the MXNet
+optimizer trains them.
+
+TPU-native redesign: the torch module runs host-side (CPU) inside the
+eager path; forward copies the framework's parameter values into the torch
+module, runs torch with grad tracking, and backward replays torch
+autograd to produce gradients for BOTH the inputs and the parameters —
+so `gluon.Trainer` updates torch-defined layers exactly like native ones.
+Host-bound by design (like the reference plugin, which was CPU/GPU-kernel
+bound): not traceable into jit graphs; use it in eager training or wrap
+the surrounding (non-torch) subgraph with hybridize.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..gluon.block import Block
+from ..gluon.parameter import Parameter
+from ..ndarray import NDArray
+from .. import autograd
+
+
+def _require_torch():
+    try:
+        import torch
+        return torch
+    except ImportError as e:  # pragma: no cover - torch is in this env
+        raise ImportError(
+            "mxnet_tpu.plugin.TorchBlock needs pytorch installed") from e
+
+
+class TorchBlock(Block):
+    """Wrap a ``torch.nn.Module``; its parameters become Gluon Parameters.
+
+    Example::
+
+        tb = TorchBlock(torch.nn.Linear(4, 2))
+        tb(x)                       # forward
+        gluon.Trainer(tb.collect_params(), "sgd", ...)  # trains torch weights
+    """
+
+    def __init__(self, torch_module, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        torch = _require_torch()
+        assert isinstance(torch_module, torch.nn.Module)
+        self._torch = torch
+        self._module = torch_module
+        self._tparam_names = []
+        for tname, tp in torch_module.named_parameters():
+            pname = tname.replace(".", "_")
+            p = self.params.get(pname, shape=tuple(tp.shape),
+                                allow_deferred_init=False, init="zeros")
+            p._data = NDArray(np.ascontiguousarray(
+                tp.detach().cpu().numpy()))
+            if p._grad_req != "null":
+                p._init_grad()
+            self._reg_params[pname] = p
+            self._tparam_names.append((pname, tname))
+
+    def _sync_into_torch(self, param_nds):
+        """Copy framework param values into the torch module — but only when
+        they changed (NDArray._version stamps). Skipping the no-op copy
+        matters for correctness, not just speed: an in-place copy_ between
+        two recorded forwards bumps torch's version counters and
+        invalidates the autograd graph the first forward saved (shared
+        torch encoder called twice per loss)."""
+        torch = self._torch
+        stamps = tuple(p._version for p in param_nds)
+        if stamps == getattr(self, "_sync_stamps", None):
+            return
+        tparams = dict(self._module.named_parameters())
+        for (pname, tname), p in zip(self._tparam_names, param_nds):
+            with torch.no_grad():
+                # copy: jax-backed buffers surface as read-only numpy views
+                tparams[tname].copy_(
+                    torch.from_numpy(np.array(p.asnumpy(), copy=True)))
+        self._sync_stamps = stamps
+
+    def forward(self, *inputs):
+        torch = self._torch
+        param_nds = [self._reg_params[p].data()
+                     for p, _ in self._tparam_names]
+        self._sync_into_torch(param_nds)
+
+        def _tin(a):
+            t = torch.from_numpy(np.array(a.asnumpy(), copy=True))
+            # integer inputs (embedding indices etc.) cannot require grad
+            return t.requires_grad_(True) if t.is_floating_point() else t
+        tin = [_tin(a) for a in inputs]
+        self._module.train(autograd.is_training())
+        tout = self._module(*tin)
+        multi = isinstance(tout, (tuple, list))
+        touts = list(tout) if multi else [tout]
+        outs = [NDArray(o.detach().cpu().numpy()) for o in touts]
+
+        if autograd.is_recording():
+            module = self._module
+
+            def torch_backward(out_grads, input_vals, kwargs):
+                gouts = [torch.from_numpy(np.asarray(g)) for g in out_grads]
+                tps = [dict(module.named_parameters())[tn]
+                       for _, tn in self._tparam_names]
+                # integer inputs can't require grad — exclude them from the
+                # grad call and give them zero cotangents
+                diff = [t for t in tin if t.requires_grad] + tps
+                grads = iter(torch.autograd.grad(
+                    touts, diff, grad_outputs=gouts,
+                    retain_graph=True, allow_unused=True))
+                out = []
+                for t, v in zip(tin, input_vals):
+                    g = next(grads) if t.requires_grad else None
+                    out.append(np.zeros(np.asarray(v).shape, np.float32)
+                               if g is None else g.detach().cpu().numpy())
+                for v in input_vals[len(tin):]:
+                    g = next(grads)
+                    out.append(np.zeros(np.asarray(v).shape, np.float32)
+                               if g is None else g.detach().cpu().numpy())
+                return out
+
+            class _OpDef:
+                fn = None
+                differentiable = True
+
+            ins = list(inputs) + param_nds
+            autograd.record_op(_OpDef, ins,
+                               [np.asarray(i.asnumpy()) for i in ins],
+                               outs, {}, custom_backward=torch_backward)
+        return outs[0] if len(outs) == 1 else outs

@@ -587,6 +587,7 @@ def create(op_name, *args, name=None, attr=None, **kwargs):
         inputs.append(a._outputs[0])
 
     names = _OP_INPUT_NAMES.get(opdef.name)
+    want = aux_names = None
     if names is not None:
         input_names, aux_names = names
         want = list(input_names)
@@ -595,7 +596,17 @@ def create(op_name, *args, name=None, attr=None, **kwargs):
             want.remove("bias")
         if opdef.name == "RNN" and kwargs.get("mode", "lstm") != "lstm":
             want.remove("state_cell")
-        # pull tensor kwargs (e.g. weight=some_sym)
+    elif opdef.name == "Custom":
+        # the prop's declared argument order defines input binding
+        # (reference custom.cc maps kwargs onto list_arguments()) — kwargs
+        # call order must NOT determine input order
+        from .. import operator as _operator
+        p = {k: v for k, v in kwargs.items()
+             if k != "op_type" and not isinstance(v, Symbol)}
+        want, aux_names = \
+            _operator.get(kwargs["op_type"])(**p).list_arguments(), ()
+    if want is not None:
+        # pull tensor kwargs by declared name (e.g. weight=some_sym)
         for i, nm in enumerate(want):
             if i < len(inputs):
                 continue
@@ -611,22 +622,12 @@ def create(op_name, *args, name=None, attr=None, **kwargs):
                 v = Variable("%s_%s" % (name, nm))
                 v._outputs[0][0].is_aux = True
                 inputs.append(v._outputs[0])
-    elif opdef.name == "Custom":
-        # bind keyword tensor inputs by the prop's declared argument order
-        # (reference custom.cc maps kwargs onto list_arguments()) — kwargs
-        # call order must NOT determine input order
-        from .. import operator as _operator
-        p = {k: v for k, v in kwargs.items()
-             if k != "op_type" and not isinstance(v, Symbol)}
-        arg_list = _operator.get(kwargs["op_type"])(**p).list_arguments()
-        for i, nm in enumerate(arg_list):
-            if i < len(inputs):
-                continue
-            if nm in kwargs and isinstance(kwargs[nm], Symbol):
-                inputs.append(kwargs.pop(nm)._outputs[0])
-            else:
-                v = Variable("%s_%s" % (name, nm))
-                inputs.append(v._outputs[0])
+        leftover = [k for k, v in kwargs.items() if isinstance(v, Symbol)]
+        if leftover:
+            raise MXNetError(
+                "op %s got unexpected tensor keyword(s) %s — declared "
+                "inputs are %s" % (opdef.name, leftover,
+                                   list(want) + list(aux_names)))
     else:
         # tensor kwargs for list-less ops
         for k in list(kwargs):

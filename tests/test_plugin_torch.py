@@ -1,0 +1,107 @@
+"""Torch plugin bridge (parity: reference plugin/torch/torch_module.cc —
+foreign-framework modules adapted into the training loop with their weights
+exposed as framework parameters)."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.plugin import TorchBlock
+
+
+def _mk():
+    torch.manual_seed(0)
+    tmod = torch.nn.Sequential(torch.nn.Linear(4, 8), torch.nn.Tanh(),
+                               torch.nn.Linear(8, 2))
+    return tmod, TorchBlock(tmod)
+
+
+def test_torch_block_forward_parity():
+    tmod, tb = _mk()
+    x = nd.array(np.random.RandomState(0).uniform(-1, 1, (6, 4))
+                 .astype(np.float32))
+    out = tb(x).asnumpy()
+    ref = tmod(torch.from_numpy(x.asnumpy())).detach().numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def test_torch_block_grad_matches_torch_autograd():
+    tmod, tb = _mk()
+    rng = np.random.RandomState(1)
+    x = nd.array(rng.uniform(-1, 1, (6, 4)).astype(np.float32))
+    y = nd.array(rng.uniform(-1, 1, (6, 2)).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        L = nd.mean(nd.square(tb(x) - y))
+    L.backward()
+    xt = torch.from_numpy(x.asnumpy()).requires_grad_(True)
+    Lt = ((tmod(xt) - torch.from_numpy(y.asnumpy())) ** 2).mean()
+    Lt.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), xt.grad.numpy(),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_torch_block_trains_with_gluon_trainer():
+    _, tb = _mk()
+    rng = np.random.RandomState(2)
+    x = nd.array(rng.uniform(-1, 1, (6, 4)).astype(np.float32))
+    y = nd.array(rng.uniform(-1, 1, (6, 2)).astype(np.float32))
+    tr = gluon.Trainer(tb.collect_params(), "sgd", {"learning_rate": 0.5})
+    losses = []
+    for _ in range(40):
+        with autograd.record():
+            L = nd.mean(nd.square(tb(x) - y))
+        L.backward()
+        tr.step(1)
+        losses.append(float(L.asnumpy()))
+    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+
+
+def test_torch_block_composes_with_gluon_layers():
+    # torch feature extractor under a gluon head, trained end to end
+    _, tb = _mk()
+    head = gluon.nn.Dense(1)
+    head.initialize(mx.init.Xavier())
+    rng = np.random.RandomState(3)
+    x = nd.array(rng.uniform(-1, 1, (8, 4)).astype(np.float32))
+    y = nd.array(rng.uniform(-1, 1, (8, 1)).astype(np.float32))
+    params = gluon.ParameterDict()
+    params.update(tb.collect_params())
+    params.update(head.collect_params())
+    head(tb(x))  # finish deferred init of the head
+    tr = gluon.Trainer(params, "sgd", {"learning_rate": 0.3})
+    losses = []
+    for _ in range(40):
+        with autograd.record():
+            L = nd.mean(nd.square(head(tb(x)) - y))
+        L.backward()
+        tr.step(1)
+        losses.append(float(L.asnumpy()))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_torch_block_shared_encoder_double_call():
+    # siamese pattern: one TorchBlock called twice inside one record();
+    # param sync must not invalidate the first call's autograd graph
+    tb = TorchBlock(torch.nn.Linear(4, 2))
+    rng = np.random.RandomState(0)
+    x1 = nd.array(rng.uniform(-1, 1, (3, 4)).astype(np.float32))
+    x2 = nd.array(rng.uniform(-1, 1, (3, 4)).astype(np.float32))
+    with autograd.record():
+        L = nd.mean(tb(x1) + tb(x2))
+    L.backward()  # must not raise
+
+
+def test_torch_block_integer_inputs():
+    te = TorchBlock(torch.nn.Embedding(10, 4))
+    idx = nd.array(np.array([1, 2, 3], np.int64))
+    out = te(idx)
+    assert out.shape == (3, 4)
+    with autograd.record():
+        L = nd.sum(te(idx))
+    L.backward()
+    wname = list(te.collect_params().keys())[0]
+    g = te.collect_params()[wname].grad().asnumpy()
+    assert g[1].sum() != 0 and g[5].sum() == 0  # only looked-up rows
