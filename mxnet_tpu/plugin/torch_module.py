@@ -10,6 +10,8 @@ eager path; forward copies the framework's parameter values into the torch
 module, runs torch with grad tracking, and backward replays torch
 autograd to produce gradients for BOTH the inputs and the parameters —
 so `gluon.Trainer` updates torch-defined layers exactly like native ones.
+Torch buffers (BatchNorm running stats etc.) are exposed as grad_req='null'
+parameters and synced back after every forward, so checkpoints keep them.
 Host-bound by design (like the reference plugin, which was CPU/GPU-kernel
 bound): not traceable into jit graphs; use it in eager training or wrap
 the surrounding (non-torch) subgraph with hybridize.
@@ -19,7 +21,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..gluon.block import Block
-from ..gluon.parameter import Parameter
+from ..initializer import Zero
 from ..ndarray import NDArray
 from .. import autograd
 
@@ -49,63 +51,107 @@ class TorchBlock(Block):
         assert isinstance(torch_module, torch.nn.Module)
         self._torch = torch
         self._module = torch_module
-        self._tparam_names = []
-        for tname, tp in torch_module.named_parameters():
+        self._tparam_names = []   # trainable (torch requires_grad) params
+        self._tbuffer_names = []  # frozen params + buffers (grad_req null)
+
+        def _register(tname, tensor, trainable):
             pname = tname.replace(".", "_")
-            p = self.params.get(pname, shape=tuple(tp.shape),
-                                allow_deferred_init=False, init="zeros")
+            p = self.params.get(pname, shape=tuple(tensor.shape),
+                                allow_deferred_init=False, init=Zero(),
+                                grad_req="write" if trainable else "null")
             p._data = NDArray(np.ascontiguousarray(
-                tp.detach().cpu().numpy()))
+                tensor.detach().cpu().numpy()))
             if p._grad_req != "null":
                 p._init_grad()
             self._reg_params[pname] = p
-            self._tparam_names.append((pname, tname))
+            return pname
 
-    def _sync_into_torch(self, param_nds):
-        """Copy framework param values into the torch module — but only when
-        they changed (NDArray._version stamps). Skipping the no-op copy
-        matters for correctness, not just speed: an in-place copy_ between
-        two recorded forwards bumps torch's version counters and
-        invalidates the autograd graph the first forward saved (shared
-        torch encoder called twice per loss)."""
+        for tname, tp in torch_module.named_parameters():
+            if tp.requires_grad:
+                self._tparam_names.append(
+                    (_register(tname, tp, True), tname))
+            else:
+                self._tbuffer_names.append(
+                    (_register(tname, tp, False), tname))
+        for tname, tb in torch_module.named_buffers():
+            if tb.is_floating_point():
+                self._tbuffer_names.append(
+                    (_register(tname, tb, False), tname))
+
+    def _torch_state(self):
+        d = dict(self._module.named_parameters())
+        d.update(self._module.named_buffers())
+        return d
+
+    def _sync_into_torch(self, param_nds, buffer_nds):
+        """Copy framework values into the torch module — but only when they
+        changed (NDArray._version stamps). Skipping the no-op copy matters
+        for correctness, not just speed: an in-place copy_ between two
+        recorded forwards bumps torch's version counters and invalidates
+        the autograd graph the first forward saved (shared torch encoder
+        called twice per loss)."""
         torch = self._torch
-        stamps = tuple(p._version for p in param_nds)
+        stamps = tuple(p._version for p in param_nds + buffer_nds)
         if stamps == getattr(self, "_sync_stamps", None):
             return
-        tparams = dict(self._module.named_parameters())
-        for (pname, tname), p in zip(self._tparam_names, param_nds):
+        state = self._torch_state()
+        pairs = list(zip(self._tparam_names, param_nds)) + \
+            list(zip(self._tbuffer_names, buffer_nds))
+        for (pname, tname), p in pairs:
             with torch.no_grad():
                 # copy: jax-backed buffers surface as read-only numpy views
-                tparams[tname].copy_(
+                state[tname].copy_(
                     torch.from_numpy(np.array(p.asnumpy(), copy=True)))
         self._sync_stamps = stamps
+
+    def _sync_buffers_back(self, buffer_nds):
+        """After a training forward, pull mutated torch buffers (BatchNorm
+        running stats) back into the framework parameters."""
+        state = self._torch_state()
+        for (pname, tname), buf in zip(self._tbuffer_names, buffer_nds):
+            # buf is the parameter's NDArray: rebind its raw buffer
+            import jax.numpy as jnp
+            buf._data = jnp.asarray(np.ascontiguousarray(
+                state[tname].detach().cpu().numpy()))
+            buf._version += 1
+        if buffer_nds:
+            # the write above changes versions; refresh the sync stamp so
+            # the next forward doesn't re-copy identical values into torch
+            params = [self._reg_params[n].data()
+                      for n, _ in self._tparam_names]
+            self._sync_stamps = tuple(
+                x._version for x in params + buffer_nds)
 
     def forward(self, *inputs):
         torch = self._torch
         param_nds = [self._reg_params[p].data()
                      for p, _ in self._tparam_names]
-        self._sync_into_torch(param_nds)
+        buffer_nds = [self._reg_params[p].data()
+                      for p, _ in self._tbuffer_names]
+        self._sync_into_torch(param_nds, buffer_nds)
 
         def _tin(a):
             t = torch.from_numpy(np.array(a.asnumpy(), copy=True))
             # integer inputs (embedding indices etc.) cannot require grad
             return t.requires_grad_(True) if t.is_floating_point() else t
         tin = [_tin(a) for a in inputs]
-        self._module.train(autograd.is_training())
+        train = autograd.is_training()
+        self._module.train(train)
         tout = self._module(*tin)
         multi = isinstance(tout, (tuple, list))
         touts = list(tout) if multi else [tout]
         outs = [NDArray(o.detach().cpu().numpy()) for o in touts]
+        if train:
+            self._sync_buffers_back(buffer_nds)
 
         if autograd.is_recording():
-            module = self._module
+            tstate = self._torch_state()
+            tps = [tstate[tn] for _, tn in self._tparam_names]
 
             def torch_backward(out_grads, input_vals, kwargs):
                 gouts = [torch.from_numpy(np.asarray(g)) for g in out_grads]
-                tps = [dict(module.named_parameters())[tn]
-                       for _, tn in self._tparam_names]
-                # integer inputs can't require grad — exclude them from the
-                # grad call and give them zero cotangents
+                # frozen/int tensors can't join the grad call — they get
+                # zero cotangents below
                 diff = [t for t in tin if t.requires_grad] + tps
                 grads = iter(torch.autograd.grad(
                     touts, diff, grad_outputs=gouts,
@@ -113,11 +159,11 @@ class TorchBlock(Block):
                 out = []
                 for t, v in zip(tin, input_vals):
                     g = next(grads) if t.requires_grad else None
-                    out.append(np.zeros(np.asarray(v).shape, np.float32)
+                    out.append(np.zeros(np.shape(v), np.float32)
                                if g is None else g.detach().cpu().numpy())
                 for v in input_vals[len(tin):]:
                     g = next(grads)
-                    out.append(np.zeros(np.asarray(v).shape, np.float32)
+                    out.append(np.zeros(np.shape(v), np.float32)
                                if g is None else g.detach().cpu().numpy())
                 return out
 
@@ -126,7 +172,8 @@ class TorchBlock(Block):
                 differentiable = True
 
             ins = list(inputs) + param_nds
-            autograd.record_op(_OpDef, ins,
-                               [np.asarray(i.asnumpy()) for i in ins],
+            # tape carries the buffer references (no copies): the backward
+            # only reads shapes from these values
+            autograd.record_op(_OpDef, ins, [i._data for i in ins],
                                outs, {}, custom_backward=torch_backward)
         return outs[0] if len(outs) == 1 else outs

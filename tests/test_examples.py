@@ -31,6 +31,7 @@ _CASES = [
     ("train_ssd.py", ["--epochs", "1", "--batch-size", "4"]),
     ("benchmark_score.py", ["--models", "resnet18_v1", "--image-size", "32",
                             "--batch-sizes", "2"]),
+    ("model_parallel_lstm.py", ["--steps", "50", "--batch-size", "8"]),
 ]
 
 

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Kill a distributed training job launched by tools/launch.py.
+
+Parity: reference `tools/kill-mxnet.py` (ssh'es each host and pkills the
+training program). Local mode kills every process whose command line
+matches the given program; ssh mode does the same on each host in the
+hostfile.
+
+Usage:
+  tools/kill_jobs.py python train.py          # local
+  tools/kill_jobs.py -H hosts python train.py # every host in hostfile
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import signal
+import subprocess
+import sys
+
+
+def _kill_local(pattern):
+    """pgrep+kill, excluding this process and its shell ancestry — a bare
+    `pkill -f` would match our own command line (which contains the
+    pattern) and kill the invoking shell."""
+    r = subprocess.run(["pgrep", "-f", pattern], capture_output=True,
+                       text=True)
+    me = {os.getpid(), os.getppid()}
+    killed = 0
+    for line in r.stdout.split():
+        pid = int(line)
+        if pid in me:
+            continue
+        try:
+            os.kill(pid, signal.SIGTERM)
+            killed += 1
+        except ProcessLookupError:
+            pass
+    return killed
+
+
+def _pkill_cmd(prog):
+    # remote form: exclude the ssh-spawned shell by matching and excluding
+    # the pkill process itself is handled by pkill's own-process exemption;
+    # the pattern is the training command, not our CLI
+    return "pkill -f %s" % shlex.quote(prog)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-H", "--hostfile", default=None,
+                    help="kill on every host listed (ssh), else locally")
+    ap.add_argument("prog", nargs=argparse.REMAINDER,
+                    help="program command line to match")
+    args = ap.parse_args()
+    if not args.prog:
+        ap.error("give the training program command line to match")
+    pattern = " ".join(args.prog)
+
+    if args.hostfile:
+        hosts = [h.strip() for h in open(args.hostfile)
+                 if h.strip() and not h.startswith("#")]
+        rc = 0
+        for h in hosts:
+            r = subprocess.run(["ssh", "-o", "StrictHostKeyChecking=no", h,
+                                _pkill_cmd(pattern)])
+            print("%s: %s" % (h, "killed" if r.returncode == 0
+                              else "nothing matched"))
+            rc |= 0  # pkill rc 1 (no match) is not an error for us
+        sys.exit(rc)
+    n = _kill_local(pattern)
+    print("local: %s" % ("killed %d" % n if n else "nothing matched"))
+
+
+if __name__ == "__main__":
+    main()
