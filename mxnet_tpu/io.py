@@ -146,16 +146,30 @@ class NDArrayIter(DataIter):
         self._cache_label = None
 
     def reset(self):
+        if self.last_batch_handle == "roll_over" and \
+                0 <= self.cursor < self.num_data and \
+                self.num_data - self.cursor < self.batch_size:
+            # unemitted tail rolls into the next epoch's first batch:
+            # gather it NOW (a reshuffle below would reorder idx)
+            tail = self.idx[self.cursor:self.num_data]
+            self._cache_data = [self._take(arr, tail)
+                                for _, arr in self.data]
+            self._cache_label = [self._take(arr, tail)
+                                 for _, arr in self.label]
+        else:
+            self._cache_data = None
+            self._cache_label = None
         if self.shuffle:
             np.random.shuffle(self.idx)
-        if self.last_batch_handle == "roll_over" and \
-                self.num_data - self.batch_size < self.cursor < self.num_data:
-            self.cursor = self.cursor - self.num_data
-        else:
-            self.cursor = -self.batch_size
+        ncache = len(self._cache_data[0]) if self._cache_data else 0
+        # first batch of the new epoch consumes the cache + the head
+        self.cursor = -self.batch_size - ncache
 
     def iter_next(self):
         self.cursor += self.batch_size
+        if self.last_batch_handle == "roll_over":
+            # only full batches: the tail is deferred to the next epoch
+            return self.cursor + self.batch_size <= self.num_data
         return self.cursor < self.num_data
 
     def next(self):
@@ -164,22 +178,32 @@ class NDArrayIter(DataIter):
         if self.last_batch_handle == "discard" and \
                 self.cursor + self.batch_size > self.num_data:
             raise StopIteration
-        return DataBatch(data=self.getdata(), label=self.getlabel(),
-                         pad=self.getpad(), index=None)
+        batch = DataBatch(data=self.getdata(), label=self.getlabel(),
+                          pad=self.getpad(), index=None)
+        if self.cursor < 0:  # the rolled-over cache is consumed once
+            self._cache_data = None
+            self._cache_label = None
+        return batch
 
-    def _getdata(self, data_source):
+    @staticmethod
+    def _take(arr, s):
+        if isinstance(arr, CSRNDArray):
+            return np.stack([arr[int(i):int(i) + 1].todense().asnumpy()[0]
+                             for i in s])
+        if isinstance(arr, NDArray):
+            return arr.asnumpy()[s]
+        return np.asarray(arr)[s]
+
+    def _getdata(self, data_source, cache):
+        start = max(self.cursor, 0)
         end = min(self.cursor + self.batch_size, self.num_data)
-        s = self.idx[self.cursor:end]
+        s = self.idx[start:end]
         out = []
-        for _, arr in data_source:
-            if isinstance(arr, CSRNDArray):
-                rows = [arr[int(i):int(i) + 1].todense().asnumpy()[0]
-                        for i in s]
-                batch = np.stack(rows)
-            elif isinstance(arr, NDArray):
-                batch = arr.asnumpy()[s]
-            else:
-                batch = np.asarray(arr)[s]
+        for i, (_, arr) in enumerate(data_source):
+            batch = self._take(arr, s)
+            if self.cursor < 0 and cache:
+                # rolled-over samples from the previous epoch lead the batch
+                batch = np.concatenate([cache[i], batch])
             pad = self.getpad()
             if pad and self.last_batch_handle == "pad":
                 extra = self.idx[:pad]
@@ -190,10 +214,10 @@ class NDArrayIter(DataIter):
         return out
 
     def getdata(self):
-        return self._getdata(self.data)
+        return self._getdata(self.data, self._cache_data)
 
     def getlabel(self):
-        return self._getdata(self.label)
+        return self._getdata(self.label, self._cache_label)
 
     def getpad(self):
         if self.last_batch_handle == "pad" and \

@@ -19,6 +19,7 @@ the surrounding (non-torch) subgraph with hybridize.
 from __future__ import annotations
 
 import numpy as np
+import jax.numpy as jnp
 
 from ..gluon.block import Block
 from ..initializer import Zero
@@ -74,9 +75,12 @@ class TorchBlock(Block):
                 self._tbuffer_names.append(
                     (_register(tname, tp, False), tname))
         for tname, tb in torch_module.named_buffers():
-            if tb.is_floating_point():
-                self._tbuffer_names.append(
-                    (_register(tname, tb, False), tname))
+            # integer buffers (num_batches_tracked) checkpoint as float32
+            # and cast back on sync-in, so BatchNorm(momentum=None)'s
+            # cumulative averaging survives save/load
+            self._tbuffer_names.append(
+                (_register(tname, tb.float() if not tb.is_floating_point()
+                           else tb, False), tname))
 
     def _torch_state(self):
         d = dict(self._module.named_parameters())
@@ -99,9 +103,12 @@ class TorchBlock(Block):
             list(zip(self._tbuffer_names, buffer_nds))
         for (pname, tname), p in pairs:
             with torch.no_grad():
-                # copy: jax-backed buffers surface as read-only numpy views
-                state[tname].copy_(
-                    torch.from_numpy(np.array(p.asnumpy(), copy=True)))
+                # copy: jax-backed buffers surface as read-only numpy views;
+                # torch casts to the destination dtype (int buffers restore
+                # from their float32 checkpoint form); reshape covers 0-d
+                # scalars the framework stores as shape-(1,)
+                t = torch.from_numpy(np.array(p.asnumpy(), copy=True))
+                state[tname].copy_(t.reshape(state[tname].shape))
         self._sync_stamps = stamps
 
     def _sync_buffers_back(self, buffer_nds):
@@ -110,9 +117,8 @@ class TorchBlock(Block):
         state = self._torch_state()
         for (pname, tname), buf in zip(self._tbuffer_names, buffer_nds):
             # buf is the parameter's NDArray: rebind its raw buffer
-            import jax.numpy as jnp
             buf._data = jnp.asarray(np.ascontiguousarray(
-                state[tname].detach().cpu().numpy()))
+                state[tname].detach().cpu().numpy().astype(np.float32)))
             buf._version += 1
         if buffer_nds:
             # the write above changes versions; refresh the sync stamp so
@@ -149,10 +155,14 @@ class TorchBlock(Block):
             tps = [tstate[tn] for _, tn in self._tparam_names]
 
             def torch_backward(out_grads, input_vals, kwargs):
-                gouts = [torch.from_numpy(np.asarray(g)) for g in out_grads]
+                gouts = [torch.from_numpy(np.array(g, copy=True))
+                         for g in out_grads]
                 # frozen/int tensors can't join the grad call — they get
                 # zero cotangents below
                 diff = [t for t in tin if t.requires_grad] + tps
+                if not diff:  # fully frozen module on integer inputs
+                    return [np.zeros(np.shape(v), np.float32)
+                            for v in input_vals]
                 grads = iter(torch.autograd.grad(
                     touts, diff, grad_outputs=gouts,
                     retain_graph=True, allow_unused=True))

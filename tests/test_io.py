@@ -175,3 +175,20 @@ def test_device_prefetch_iter():
             np.testing.assert_array_equal(g, e)
     # provide_data passes through
     assert pre.provide_data[0].shape == (4, 4)
+
+
+def test_ndarrayiter_roll_over_rolls_into_next_epoch():
+    """roll_over must NOT emit the partial batch; the tail leads the next
+    epoch's first batch (reference io.py NDArrayIter roll_over)."""
+    X = np.arange(25, dtype=np.float32).reshape(25, 1)
+    it = mx.io.NDArrayIter(X, np.zeros(25), batch_size=10,
+                           last_batch_handle="roll_over")
+    e1 = [b.data[0].asnumpy().ravel() for b in it]
+    assert [len(b) for b in e1] == [10, 10]
+    it.reset()
+    e2 = [b.data[0].asnumpy().ravel() for b in it]
+    assert [len(b) for b in e2] == [10, 10, 10]
+    np.testing.assert_allclose(e2[0],
+                               [20, 21, 22, 23, 24, 0, 1, 2, 3, 4])
+    it.reset()  # epoch 2 left no remainder
+    assert [b.data[0].shape[0] for b in it] == [10, 10]

@@ -65,9 +65,13 @@ def main():
         for h in hosts:
             r = subprocess.run(["ssh", "-o", "StrictHostKeyChecking=no", h,
                                 _pkill_cmd(pattern)])
-            print("%s: %s" % (h, "killed" if r.returncode == 0
-                              else "nothing matched"))
-            rc |= 0  # pkill rc 1 (no match) is not an error for us
+            if r.returncode == 0:
+                print("%s: killed" % h)
+            elif r.returncode == 1:  # pkill: pattern matched nothing
+                print("%s: nothing matched" % h)
+            else:  # ssh/connection failure — the job may still be running
+                print("%s: ERROR (ssh rc=%d)" % (h, r.returncode))
+                rc = 1
         sys.exit(rc)
     n = _kill_local(pattern)
     print("local: %s" % ("killed %d" % n if n else "nothing matched"))
