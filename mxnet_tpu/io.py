@@ -123,6 +123,13 @@ class NDArrayIter(DataIter):
         self.shuffle = shuffle
         self.last_batch_handle = last_batch_handle
         self.batch_size = batch_size
+        if last_batch_handle == "roll_over" and \
+                batch_size > self.idx.shape[0]:
+            # a full batch can never fill: the roll-over cache would
+            # duplicate samples within one batch — reject loudly
+            raise ValueError(
+                "roll_over needs batch_size (%d) <= num_data (%d)"
+                % (batch_size, self.idx.shape[0]))
         self.cursor = -batch_size
         self.num_data = self.idx.shape[0]
         self._cache_data = None

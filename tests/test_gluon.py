@@ -333,3 +333,19 @@ def test_model_zoo_get_model():
     net = get_model("resnet18_v1", classes=10)
     net.initialize()
     assert net(nd.zeros((1, 3, 32, 32))).shape == (1, 10)
+
+
+@pytest.mark.parametrize("name,size", [
+    ("alexnet", 224), ("densenet121", 64), ("inceptionv3", 299),
+    ("mobilenet0.25", 32), ("mobilenetv2_0.25", 32), ("resnet18_v1", 32),
+    ("resnet18_v2", 32), ("squeezenet1.0", 64), ("squeezenet1.1", 64),
+    ("vgg11", 32), ("vgg11_bn", 32)])
+def test_model_zoo_all_families_forward(name, size):
+    """Every reference model-zoo family constructs and forwards (parity:
+    gluon/model_zoo/vision — alexnet/densenet/inception/mobilenet v1+v2/
+    resnet v1+v2/squeezenet/vgg)."""
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+    net = get_model(name, classes=10)
+    net.initialize(mx.init.Xavier())
+    out = net(mx.nd.zeros((1, 3, size, size)))
+    assert out.shape == (1, 10)

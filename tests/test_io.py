@@ -192,3 +192,10 @@ def test_ndarrayiter_roll_over_rolls_into_next_epoch():
                                [20, 21, 22, 23, 24, 0, 1, 2, 3, 4])
     it.reset()  # epoch 2 left no remainder
     assert [b.data[0].shape[0] for b in it] == [10, 10]
+
+
+def test_ndarrayiter_roll_over_rejects_oversized_batch():
+    with pytest.raises(ValueError, match="roll_over"):
+        mx.io.NDArrayIter(np.arange(5, dtype=np.float32).reshape(5, 1),
+                          np.zeros(5), batch_size=10,
+                          last_batch_handle="roll_over")
