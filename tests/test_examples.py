@@ -32,6 +32,8 @@ _CASES = [
     ("benchmark_score.py", ["--models", "resnet18_v1", "--image-size", "32",
                             "--batch-sizes", "2"]),
     ("model_parallel_lstm.py", ["--steps", "50", "--batch-size", "8"]),
+    ("train_transformer_lm.py", ["--steps", "40", "--d-model", "32",
+                                 "--seq-len", "16"]),
 ]
 
 
