@@ -57,7 +57,15 @@ def main():
         "need %d devices (dp*tp*sp) but jax sees %d — run with "
         "XLA_FLAGS=--xla_force_host_platform_device_count=%d "
         "JAX_PLATFORMS=cpu" % (need, have, need))
-    third = ("ep", args.sp) if args.experts else ("sp", args.sp)
+    if args.experts:
+        # the third mesh axis becomes 'ep' (expert-sharded FFN) INSTEAD of
+        # 'sp' ring attention — expert count must tile it
+        assert args.experts % args.sp == 0, (
+            "--experts (%d) must be divisible by the axis size --sp (%d)"
+            % (args.experts, args.sp))
+        third = ("ep", args.sp)
+    else:
+        third = ("sp", args.sp)
     mesh = build_mesh({"dp": args.dp, "tp": args.tp, third[0]: third[1]},
                       jax.devices()[:need])
     cfg = TransformerConfig(vocab=args.vocab, d_model=args.d_model,

@@ -17,7 +17,7 @@ Public surface mirrors the reference (`python/mxnet/__init__.py`):
   mx.context: cpu()/gpu()/tpu() device handles (gpu aliases tpu)
 """
 
-__version__ = "0.1.0"
+from .libinfo import __version__  # single-sourced version
 
 from . import base
 from .base import MXNetError

@@ -1,0 +1,34 @@
+"""KVStore server entry point (parity: python/mxnet/kvstore_server.py —
+the REPL that server-role processes run, applying the controller-sent
+optimizer to stored weights, kvstore_server.py:28-75).
+
+TPU-native redesign: there is no parameter-server tier — distributed
+KVStore traffic rides symmetric jax.distributed collectives, and the
+"server-side optimizer" capability lives in the stores themselves
+(kvstore.py set_optimizer + update_on_kvstore). This module keeps the
+reference's launch contract: a process started with DMLC_ROLE=server (an
+old-style launcher script) parks in `_init_kvstore_server_module` instead
+of crashing, logging that servers are not needed.
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+
+class KVStoreServer:
+    """Accepted for API compatibility; commands are applied locally by the
+    stores (kvstore.py), so the server loop has nothing to run."""
+
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+
+    def run(self):
+        logging.info("mxnet_tpu has no parameter-server tier; server role "
+                     "is a no-op (collectives carry the traffic)")
+
+
+def _init_kvstore_server_module():
+    is_worker = int(os.environ.get("DMLC_ROLE", "worker") == "worker")
+    if not is_worker:
+        KVStoreServer(None).run()

@@ -1,0 +1,26 @@
+"""Library/version info (parity: python/mxnet/libinfo.py — find_lib_path +
+__version__). The "library" here is the native runtime shared object built
+from native/mxtpu_native.cc."""
+from __future__ import annotations
+
+import os
+
+# single source of truth for the version string; mxnet_tpu/__init__ imports
+# it from here (the reference's layout: __init__ imports libinfo.__version__)
+__version__ = "0.1.0"
+
+
+def find_lib_path():
+    """Return candidate paths of the native runtime library.
+
+    Parity: libinfo.py find_lib_path (raises if the library is absent in a
+    non-dev install; here the native lib is optional — pure-JAX paths work
+    without it — so an empty list is allowed).
+    """
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    candidates = [
+        os.path.join(repo_root, "native", "libmxtpu_native.so"),
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "native", "libmxtpu_native.so"),
+    ]
+    return [p for p in candidates if os.path.exists(p)]

@@ -1,0 +1,21 @@
+"""Torch interop surface (parity: python/mxnet/torch.py, which exposed the
+torch plugin's ops). Here the bridge is `plugin.TorchBlock` (run a
+torch.nn.Module inside Gluon) plus array converters."""
+from __future__ import annotations
+
+import numpy as np
+
+from .ndarray import NDArray
+from .plugin import TorchBlock  # noqa: F401 — re-export
+
+
+def to_torch(arr):
+    """NDArray -> torch.Tensor (copies via host)."""
+    import torch
+    a = arr.asnumpy() if isinstance(arr, NDArray) else np.asarray(arr)
+    return torch.from_numpy(np.array(a, copy=True))
+
+
+def from_torch(tensor):
+    """torch.Tensor -> NDArray (copies via host)."""
+    return NDArray(np.ascontiguousarray(tensor.detach().cpu().numpy()))

@@ -115,3 +115,29 @@ def test_attr_scope_applies_to_symbols():
     assert attrs.get("ctx_group") == "dev1"
     v2 = S.Variable("plain")
     assert v2.attr_dict().get("plain", {}).get("ctx_group") is None
+
+
+def test_log_libinfo_kvstore_server_torch_modules():
+    """Small parity modules: log.get_logger, libinfo, kvstore_server shim,
+    torch converters (reference python/mxnet/{log,libinfo,kvstore_server,
+    torch}.py)."""
+    import mxnet_tpu.log as mlog
+    lg = mlog.get_logger("mxtest", level=logging.INFO)
+    lg.info("hello")  # must not raise
+    assert mlog.get_logger("mxtest") is lg
+
+    import mxnet_tpu.libinfo as libinfo
+    assert libinfo.__version__
+    paths = libinfo.find_lib_path()
+    assert all(p.endswith(".so") for p in paths)
+
+    import mxnet_tpu.kvstore_server as kvs_srv
+    kvs_srv._init_kvstore_server_module()  # worker role: no-op
+
+    torch = pytest.importorskip("torch")
+    import mxnet_tpu.torch as mxt
+    t = mxt.to_torch(nd.array([1.0, 2.0]))
+    assert t.shape == (2,)
+    back = mxt.from_torch(t * 2)
+    np.testing.assert_allclose(back.asnumpy(), [2.0, 4.0])
+    assert mxt.TorchBlock is not None
