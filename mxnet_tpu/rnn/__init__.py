@@ -1,9 +1,9 @@
-"""mx.rnn — bucketed sequence IO for the symbolic RNN workflow.
+"""mx.rnn — the symbolic RNN workflow: bucketed sequence IO + the
+pre-Gluon symbolic cell zoo.
 
-Parity: reference `python/mxnet/rnn/io.py` BucketSentenceIter (the data
-side of `example/rnn/bucketing`). The symbolic RNN cell zoo is covered by
-`mxnet_tpu.gluon.rnn` cells and the fused `RNN` operator; this module
-carries the bucketing data pipeline those workflows need.
+Parity: reference `python/mxnet/rnn/` — io.py BucketSentenceIter (the data
+side of `example/rnn/bucketing`), rnn_cell.py symbolic cells, rnn.py
+checkpoint helpers.
 """
 from __future__ import annotations
 
@@ -97,3 +97,11 @@ class BucketSentenceIter:
             bucket_key=T,
             provide_data=[DataDesc(self.data_name, self._shape(T))],
             provide_label=[DataDesc(self.label_name, self._shape(T))])
+
+
+from .rnn_cell import (BaseRNNCell, RNNCell, LSTMCell, GRUCell,  # noqa: E402,F401
+                       FusedRNNCell, SequentialRNNCell, DropoutCell,
+                       ModifierCell, ZoneoutCell, ResidualCell,
+                       BidirectionalCell, RNNParams,
+                       save_rnn_checkpoint, load_rnn_checkpoint,
+                       do_rnn_checkpoint, rnn_unroll)

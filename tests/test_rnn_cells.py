@@ -1,0 +1,150 @@
+"""Symbolic mx.rnn cell API (parity: python/mxnet/rnn/rnn_cell.py — the
+pre-Gluon cell zoo the reference's bucketing examples are written against).
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+import mxnet_tpu.symbol as S
+
+
+def _arith_batch(rng, B, T, V):
+    start = rng.randint(0, V, (B, 1))
+    x = (start + np.arange(T)) % V
+    return x, (x + 1) % V
+
+
+def test_symbolic_cell_stack_trains_via_module():
+    B, T, V = 8, 10, 20
+    cell = mx.rnn.SequentialRNNCell()
+    cell.add(mx.rnn.LSTMCell(16, prefix="l0_"))
+    cell.add(mx.rnn.ResidualCell(mx.rnn.GRUCell(16, prefix="l1_")))
+    data = S.Variable("data")
+    label = S.Variable("softmax_label")
+    emb = S.Embedding(data, input_dim=V, output_dim=16, name="emb")
+    out, _ = cell.unroll(T, emb, layout="NTC", merge_outputs=True,
+                         batch_size=B)
+    pred = S.FullyConnected(S.Reshape(out, shape=(-1, 16)), num_hidden=V,
+                            name="pred")
+    sm = S.SoftmaxOutput(pred, S.Reshape(label, shape=(-1,)),
+                         name="softmax")
+
+    mod = mx.mod.Module(sm, context=mx.cpu(),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (B, T))],
+             label_shapes=[("softmax_label", (B, T))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 0.03})
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(80):
+        x, y = _arith_batch(rng, B, T, V)
+        b = mx.io.DataBatch(data=[mx.nd.array(x)],
+                            label=[mx.nd.array(y)])
+        mod.forward(b, is_train=True)
+        out = mod.get_outputs()[0].asnumpy()
+        lab = y.reshape(-1)
+        losses.append(-np.log(out[np.arange(len(lab)), lab] + 1e-8).mean())
+        mod.backward()
+        mod.update()
+    assert losses[-1] < losses[0] * 0.4, (losses[0], losses[-1])
+
+
+def test_vanilla_rnn_and_dropout_cells_bind():
+    cell = mx.rnn.SequentialRNNCell()
+    cell.add(mx.rnn.RNNCell(8, prefix="r_"))
+    cell.add(mx.rnn.DropoutCell(0.3))
+    emb = S.Embedding(S.Variable("data"), input_dim=10, output_dim=8)
+    outs, states = cell.unroll(5, emb, batch_size=4, merge_outputs=True)
+    exe = outs.simple_bind(mx.cpu(), data=(4, 5))
+    o = exe.forward(is_train=False,
+                    data=mx.nd.array(np.zeros((4, 5))))[0]
+    assert o.shape == (4, 5, 8)
+
+
+def test_bidirectional_cell_concats_directions():
+    bi = mx.rnn.BidirectionalCell(mx.rnn.LSTMCell(8, prefix="f_"),
+                                  mx.rnn.LSTMCell(8, prefix="b_"))
+    emb = S.Embedding(S.Variable("data"), input_dim=10, output_dim=8)
+    outs, states = bi.unroll(6, emb, batch_size=4, merge_outputs=True)
+    exe = outs.simple_bind(mx.cpu(), data=(4, 6))
+    o = exe.forward(is_train=False,
+                    data=mx.nd.array(np.zeros((4, 6))))[0]
+    assert o.shape == (4, 6, 16)  # fwd + bwd concat
+    assert len(states) == 4  # two LSTM state pairs
+
+
+def test_fused_cell_matches_rnn_op():
+    rng = np.random.RandomState(0)
+    fc = mx.rnn.FusedRNNCell(12, num_layers=2, mode="lstm")
+    emb = S.Embedding(S.Variable("data"), input_dim=10, output_dim=8,
+                      name="emb")
+    out, _ = fc.unroll(6, emb, batch_size=4)
+    exe = out.simple_bind(mx.cpu(), data=(4, 6))
+    o = exe.forward(is_train=False,
+                    data=mx.nd.array(rng.randint(0, 10, (4, 6))))[0]
+    assert o.shape == (4, 6, 12)
+
+
+def test_zoneout_cell_eval_deterministic():
+    z = mx.rnn.ZoneoutCell(mx.rnn.RNNCell(8, prefix="z_"),
+                           zoneout_states=0.3)
+    emb = S.Embedding(S.Variable("data"), input_dim=10, output_dim=8)
+    outs, _ = z.unroll(4, emb, batch_size=2, merge_outputs=True)
+    exe = outs.simple_bind(mx.cpu(), data=(2, 4))
+    x = mx.nd.array(np.ones((2, 4)))
+    o1 = exe.forward(is_train=False, data=x)[0].asnumpy()
+    o2 = exe.forward(is_train=False, data=x)[0].asnumpy()
+    np.testing.assert_allclose(o1, o2)
+
+
+def test_sequential_reset_propagates_to_children():
+    # bucketing workflow: one unroll per bucket; stale Zoneout state must
+    # not leak the first graph's inputs into the second graph
+    cell = mx.rnn.SequentialRNNCell()
+    cell.add(mx.rnn.ZoneoutCell(mx.rnn.RNNCell(4, prefix="z_"),
+                                zoneout_outputs=0.3))
+    def build(T):
+        emb = S.Embedding(S.Variable("data"), input_dim=10, output_dim=4,
+                          name="emb")
+        outs, _ = cell.unroll(T, emb, batch_size=2, merge_outputs=True)
+        return outs
+    g12 = build(12)
+    g8 = build(8)
+    args = g8.list_arguments()
+    assert len(args) == len(set(args))
+    exe = g8.simple_bind(mx.cpu(), data=(2, 8))  # must bind cleanly
+    assert exe is not None
+
+
+def test_fused_cell_returns_real_states_when_requested():
+    rng = np.random.RandomState(0)
+    fc = mx.rnn.FusedRNNCell(6, num_layers=1, mode="lstm",
+                             get_next_state=True)
+    emb = S.Embedding(S.Variable("data"), input_dim=10, output_dim=4)
+    out, states = fc.unroll(5, emb, batch_size=3)
+    assert len(states) == 2  # h, c
+    group = S.Group([out] + states)
+    exe = group.simple_bind(mx.cpu(), data=(3, 5))
+    for name, arr in exe.arg_dict.items():
+        if name != "data":  # nonzero weights so states are informative
+            arr[:] = mx.nd.array(rng.uniform(-0.5, 0.5, arr.shape)
+                                 .astype(np.float32))
+    res = exe.forward(is_train=False,
+                      data=mx.nd.array(rng.randint(0, 10, (3, 5))))
+    h = res[1].asnumpy()
+    assert h.shape == (1, 3, 6) and np.abs(h).sum() > 0  # real, not zeros
+    # without the flag: parity with reference — empty states list
+    fc2 = mx.rnn.FusedRNNCell(6, num_layers=1, mode="lstm")
+    _, states2 = fc2.unroll(5, emb, batch_size=3)
+    assert states2 == []
+
+
+def test_fused_cell_merge_outputs_false_splits_steps():
+    fc = mx.rnn.FusedRNNCell(6, num_layers=1, mode="lstm")
+    emb = S.Embedding(S.Variable("data"), input_dim=10, output_dim=4)
+    outs, _ = fc.unroll(5, emb, batch_size=3, merge_outputs=False)
+    assert isinstance(outs, list) and len(outs) == 5
+    exe = outs[2].simple_bind(mx.cpu(), data=(3, 5))
+    o = exe.forward(is_train=False, data=mx.nd.zeros((3, 5)))[0]
+    assert o.shape == (3, 6)
