@@ -109,7 +109,7 @@ class TrainStep:
 
     def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
                  mesh=None, data_axis="dp", param_shardings=None,
-                 dtype="float32", remat=None):
+                 dtype="float32", remat=None, shard_optimizer_states=False):
         import os
         from .. import optimizer as _opt_mod
         if remat is None:
@@ -132,6 +132,10 @@ class TrainStep:
         self._param_shardings = param_shardings or {}
         self._compute_dtype = jnp.dtype(dtype)
         self._remat = remat
+        # ZeRO-style weight-update sharding (arXiv:2004.13336): optimizer
+        # state shards over the data axis, GSPMD turning the grad all-reduce
+        # into reduce-scatter + the post-update all-gather automatically
+        self._shard_opt = bool(shard_optimizer_states)
         self._lr_schedule = None
         self._t = 0
         self._step_fn = None
@@ -262,13 +266,34 @@ class TrainStep:
                 if v.ndim == 0:  # scalar state (e.g. nadam m_schedule)
                     spec = P()
                 return jax.device_put(v, NamedSharding(self._mesh, spec))
+
+            dp = self._data_axis
+            dp_size = self._mesh.shape.get(dp, 0) if dp else 0
+
+            def place_state(name, s):
+                """Optimizer state placement: with weight-update sharding
+                on, a state whose weight is replicated shards its first
+                divisible axis over the data axis (ZeRO-1)."""
+                spec = self._param_shardings.get(name, P())
+                replicated = all(ax is None for ax in spec)  # P() or P(None,)
+                if self._shard_opt and dp_size > 1 and replicated \
+                        and s.ndim > 0:
+                    for axis in range(s.ndim):
+                        if s.shape[axis] % dp_size == 0:
+                            zspec = P(*([None] * axis + [dp]))
+                            return jax.device_put(
+                                s, NamedSharding(self._mesh, zspec))
+                if s.ndim == 0:
+                    spec = P()
+                return jax.device_put(s, NamedSharding(self._mesh, spec))
+
             gnames = gnames_all
             nnames = [n for n, m in zip(self._names, grad_mask) if not m]
             grad_vals = tuple(place(n, v) for n, v in zip(gnames, grad_vals))
             nograd_vals = tuple(place(n, v)
                                 for n, v in zip(nnames, nograd_vals))
             opt_state = tuple(
-                tuple(place(n, s) for s in st)
+                tuple(place_state(n, s) for s in st)
                 for n, st in zip(gnames, opt_state))
         self._grad_vals = grad_vals
         self._nograd_vals = nograd_vals
