@@ -254,7 +254,20 @@ class HybridBlock(Block):
             raise
 
     def forward(self, x, *args):
-        """Dispatch to hybrid_forward with the nd namespace + param arrays."""
+        """Dispatch to hybrid_forward with the nd namespace + param arrays.
+        A Symbol input instead traces the block into a symbolic graph
+        (parity: reference HybridBlock's F-dispatch — this is what makes
+        `export` and symbol-level composition work)."""
+        from ..symbol import Symbol as _Symbol
+        if isinstance(x, _Symbol):
+            from .. import symbol as S
+            params = {}
+            for name, p in self._reg_params.items():
+                v = S.Variable(p.name)
+                if getattr(p, "_is_aux", False):  # layer-mutated states
+                    v._outputs[0][0].is_aux = True
+                params[name] = v
+            return self.hybrid_forward(S, x, *args, **params)
         try:
             params = {name: p.data() for name, p in self._reg_params.items()}
         except DeferredInitializationError:
@@ -394,11 +407,34 @@ class HybridBlock(Block):
             return outs[0]
         return tuple(outs)
 
-    def export(self, path, epoch=0):
-        """Save params for deployment (parity: HybridBlock.export). The graph
-        itself is recompiled from code at load; params use the standard
-        container."""
-        self.collect_params().save("%s-%04d.params" % (path, epoch))
+    def export(self, path, epoch=0, inputs=("data",)):
+        """Write `path-symbol.json` + `path-%04d.params` (parity:
+        HybridBlock.export) — the train-in-Gluon, deploy-symbolically flow.
+        The graph comes from tracing this block with Symbol inputs (pass
+        `inputs` names for multi-input blocks); params save under
+        'arg:'/'aux:' keys with their raw names, so
+        `mx.model.load_checkpoint` + Module (or SymbolBlock.imports) load
+        the artifact directly."""
+        from .. import symbol as S
+        from ..utils import serialization
+        if isinstance(inputs, str):
+            inputs = (inputs,)
+        try:
+            out = self(*[S.Variable(n) for n in inputs])
+        except TypeError as e:
+            raise TypeError(
+                "export could not trace %s with inputs %s — pass the "
+                "block's input names via export(..., inputs=(...)): %s"
+                % (self.name, list(inputs), e)) from None
+        if isinstance(out, (list, tuple)):
+            out = S.Group(list(out))
+        out.save("%s-symbol.json" % path)
+        save_dict = {}
+        for name, p in self.collect_params().items():
+            kind = "aux" if getattr(p, "_is_aux", False) else "arg"
+            save_dict["%s:%s" % (kind, name)] = p.data()
+        serialization.save_ndarrays("%s-%04d.params" % (path, epoch),
+                                    save_dict)
 
 
 class SymbolBlock(HybridBlock):

@@ -171,6 +171,10 @@ class BatchNorm(HybridBlock):
                 "running_var", grad_req="null", shape=(in_channels,),
                 init=_init(running_variance_initializer),
                 allow_deferred_init=True, differentiable=False)
+            # auxiliary STATES (layer-mutated), distinct from merely-frozen
+            # params — export/symbol tracing classifies by this flag
+            self.running_mean._is_aux = True
+            self.running_var._is_aux = True
 
     def _shape_probe(self, x, *args):
         c = x.shape[self._axis]
@@ -181,11 +185,16 @@ class BatchNorm(HybridBlock):
 
     def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
         from ... import autograd
-        out, mean, var = F.BatchNorm(
+        res = F.BatchNorm(
             x, gamma, beta, running_mean, running_var,
             eps=self._epsilon, momentum=self._momentum,
             fix_gamma=not self._scale,
             use_global_stats=self._use_global_stats, axis=self._axis)
+        if not isinstance(res, tuple):
+            # symbolic trace: BN exposes the normalized output; moving-stat
+            # threading is the executor's job (symbol._eval aux_updates)
+            return res
+        out, mean, var = res
         if autograd.is_training() and not self._use_global_stats:
             m = self._momentum
             self.running_mean.set_data(

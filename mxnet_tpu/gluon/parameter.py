@@ -336,6 +336,10 @@ class ParameterDict:
              ignore_extra=False, restore_prefix=""):
         from ..utils import serialization
         arg_dict = serialization.load_ndarrays(filename)
+        # accept export/Module artifacts: 'arg:'/'aux:' key prefixes strip
+        # (parity: reference load_parameters legacy handling)
+        arg_dict = {(k.split(":", 1)[1] if k.startswith(("arg:", "aux:"))
+                     else k): v for k, v in arg_dict.items()}
         arg_dict = {restore_prefix + k: v for k, v in arg_dict.items()}
         if not allow_missing:
             for name in self.keys():

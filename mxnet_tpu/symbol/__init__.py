@@ -615,9 +615,21 @@ def create(op_name, *args, name=None, attr=None, **kwargs):
             else:
                 v = Variable("%s_%s" % (name, nm))
                 inputs.append(v._outputs[0])
-        for nm in aux_names:
+        base = len(want)
+        for j, nm in enumerate(aux_names):
+            # aux-ness is positional (reference FMutateInputs): whether the
+            # state var was auto-created, passed positionally, or passed by
+            # keyword, the slot marks it — Module must not train it
+            if base + j < len(inputs):
+                node, _ = inputs[base + j]
+                if node.op is None:
+                    node.is_aux = True
+                continue
             if nm in kwargs and isinstance(kwargs[nm], Symbol):
-                inputs.append(kwargs.pop(nm)._outputs[0])
+                out = kwargs.pop(nm)._outputs[0]
+                if out[0].op is None:
+                    out[0].is_aux = True
+                inputs.append(out)
             else:
                 v = Variable("%s_%s" % (name, nm))
                 v._outputs[0][0].is_aux = True

@@ -1,0 +1,112 @@
+"""HybridBlock.export — train in Gluon, deploy symbolically (parity:
+gluon/block.py HybridBlock.export + the Module/SymbolBlock reload flows).
+"""
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+
+
+def _bn_net():
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Conv2D(4, 3, padding=1), gluon.nn.BatchNorm(),
+                gluon.nn.Activation("relu"), gluon.nn.Dropout(0.5),
+                gluon.nn.Flatten(), gluon.nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def test_export_reloads_via_module(tmp_path):
+    net = _bn_net()
+    x = nd.array(np.random.RandomState(0).uniform(-1, 1, (2, 3, 8, 8))
+                 .astype(np.float32))
+    eager = net(x).asnumpy()
+    prefix = os.path.join(str(tmp_path), "m")
+    net.export(prefix, epoch=0)
+    assert os.path.exists(prefix + "-symbol.json")
+    assert os.path.exists(prefix + "-0000.params")
+
+    sym, arg, aux = mx.model.load_checkpoint(prefix, 0)
+    # BN moving stats land as aux states, not trainable args
+    assert any("running_mean" in n for n in sym.list_auxiliary_states())
+    mod = mx.mod.Module(sym, context=mx.cpu(), label_names=())
+    mod.bind(data_shapes=[("data", (2, 3, 8, 8))], for_training=False)
+    mod.init_params(arg_params=arg, aux_params=aux)
+    mod.forward(mx.io.DataBatch(data=[x]), is_train=False)
+    np.testing.assert_allclose(mod.get_outputs()[0].asnumpy(), eager,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_export_reimports_via_symbol_block(tmp_path):
+    net = _bn_net()
+    x = nd.array(np.random.RandomState(1).uniform(-1, 1, (2, 3, 8, 8))
+                 .astype(np.float32))
+    eager = net(x).asnumpy()
+    prefix = os.path.join(str(tmp_path), "m")
+    net.export(prefix, epoch=0)
+    blk = gluon.SymbolBlock.imports(prefix + "-symbol.json", "data",
+                                    prefix + "-0000.params")
+    np.testing.assert_allclose(blk(x).asnumpy(), eager,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_export_model_zoo_resnet(tmp_path):
+    z = gluon.model_zoo.vision.resnet18_v1(classes=10)
+    z.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(2).uniform(-1, 1, (1, 3, 32, 32))
+                 .astype(np.float32))
+    eager = z(x).asnumpy()
+    prefix = os.path.join(str(tmp_path), "rn")
+    z.export(prefix, epoch=0)
+    sym, arg, aux = mx.model.load_checkpoint(prefix, 0)
+    mod = mx.mod.Module(sym, context=mx.cpu(), label_names=())
+    mod.bind(data_shapes=[("data", (1, 3, 32, 32))], for_training=False)
+    mod.init_params(arg_params=arg, aux_params=aux)
+    mod.forward(mx.io.DataBatch(data=[x]), is_train=False)
+    np.testing.assert_allclose(mod.get_outputs()[0].asnumpy(), eager,
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_positional_aux_symbols_not_duplicated():
+    # regression: S.BatchNorm(x, g, b, mm, mv) given ALL inputs positionally
+    # must not invent extra auto-aux variables (it used to append duplicate
+    # moving-stat vars, breaking the op call with extra positional args) —
+    # and the supplied moving stats ARE aux states (positional aux-ness,
+    # reference FMutateInputs), so Module never trains them
+    import mxnet_tpu.symbol as S
+    args = [S.Variable(n) for n in ("x", "g", "b", "mm", "mv")]
+    bn = S.BatchNorm(*args, fix_gamma=False)
+    assert bn.list_arguments() == ["x", "g", "b"]
+    assert bn.list_auxiliary_states() == ["mm", "mv"]
+
+
+def test_export_frozen_params_stay_args(tmp_path):
+    # frozen (grad_req null) params are NOT aux: BatchNorm(scale=False)'s
+    # gamma must export under arg:, with only moving stats as aux
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Conv2D(4, 3), gluon.nn.BatchNorm(scale=False))
+    net.initialize(mx.init.Xavier())
+    net(nd.zeros((1, 3, 8, 8)))
+    prefix = os.path.join(str(tmp_path), "f")
+    net.export(prefix, epoch=0)
+    sym, arg, aux = mx.model.load_checkpoint(prefix, 0)
+    assert any("gamma" in n for n in arg), list(arg)
+    assert all("gamma" not in n for n in aux), list(aux)
+    assert any("running_mean" in n for n in aux)
+
+
+def test_export_multi_input_block(tmp_path):
+    class TwoIn(gluon.HybridBlock):
+        def hybrid_forward(self, F, a, b):
+            return F.broadcast_add(a, b)
+
+    blk = TwoIn()
+    blk.initialize()
+    prefix = os.path.join(str(tmp_path), "two")
+    blk.export(prefix, epoch=0, inputs=("a", "b"))
+    sym, arg, aux = mx.model.load_checkpoint(prefix, 0)
+    assert set(sym.list_arguments()) == {"a", "b"}
