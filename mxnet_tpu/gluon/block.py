@@ -261,13 +261,8 @@ class HybridBlock(Block):
         from ..symbol import Symbol as _Symbol
         if isinstance(x, _Symbol):
             from .. import symbol as S
-            params = {}
-            for name, p in self._reg_params.items():
-                v = S.Variable(p.name)
-                if getattr(p, "_is_aux", False):  # layer-mutated states
-                    v._outputs[0][0].is_aux = True
-                params[name] = v
-            return self.hybrid_forward(S, x, *args, **params)
+            return self.hybrid_forward(S, x, *args,
+                                       **self._trace_param_symbols())
         try:
             params = {name: p.data() for name, p in self._reg_params.items()}
         except DeferredInitializationError:
@@ -406,6 +401,22 @@ class HybridBlock(Block):
         if len(outs) == 1:
             return outs[0]
         return tuple(outs)
+
+    def _trace_param_symbols(self):
+        """Parameter Variables for a symbolic trace: known shapes travel as
+        hints (only when fully concrete — deferred shapes contain 0 and
+        must leave bind-time inference in charge), layer-mutated states
+        carry the aux flag."""
+        from .. import symbol as S
+        params = {}
+        for name, p in self._reg_params.items():
+            shape = p.shape if p.shape and all(d > 0 for d in p.shape) \
+                else None
+            v = S.Variable(p.name, shape=shape)
+            if getattr(p, "_is_aux", False):
+                v._outputs[0][0].is_aux = True
+            params[name] = v
+        return params
 
     def export(self, path, epoch=0, inputs=("data",)):
         """Write `path-symbol.json` + `path-%04d.params` (parity:

@@ -84,19 +84,59 @@ class _RNNLayer(HybridBlock):
                                   if k != "__layout__"}))
         return states
 
-    def _pack_params(self, params):
+    def _pack_params(self, params, F=None):
         """Flatten per-gate params into the fused-op vector (layout documented
-        in ops/nn.py _unpack_rnn_params)."""
+        in ops/nn.py _unpack_rnn_params). F picks the namespace: nd (default)
+        or symbol for export tracing."""
+        if F is None:
+            from ... import ndarray as F
         chunks = []
         for i in range(self._num_layers):
             for j in ["l", "r"][:self._dir]:
                 for part in ("i2h_weight", "h2h_weight", "i2h_bias",
                              "h2h_bias"):
-                    chunks.append(params["%s%d_%s" % (j, i, part)].reshape(-1))
-        from ... import ndarray as F
+                    chunks.append(F.Reshape(
+                        params["%s%d_%s" % (j, i, part)], shape=(-1,)))
         return F.Concat(*chunks, dim=0)
 
+    def _symbolic_forward(self, inputs, in_states=None):
+        """Trace into a Symbol graph (export path)."""
+        from ... import symbol as S
+        params = self._trace_param_symbols()
+        x = S.swapaxes(inputs, dim1=0, dim2=1) if self._layout == "NTC" \
+            else inputs
+        if in_states is None:
+            # begin states as AUX variables: the executor allocates them as
+            # zeros and init_params never touches them (a free arg variable
+            # would get randomly initialized by Module.init_params, silently
+            # perturbing the exported model's outputs)
+            n_states = 2 if self._mode == "lstm" else 1
+            states = []
+            for nm in ("state", "state_cell")[:n_states]:
+                v = S.Variable("%s%s" % (self.prefix, nm))
+                v._outputs[0][0].is_aux = True
+                states.append(v)
+        else:
+            states = list(in_states)
+        rnn = S.RNN(x, self._pack_params(params, F=S), *states,
+                    state_size=self._hidden_size,
+                    num_layers=self._num_layers, mode=self._mode,
+                    bidirectional=self._dir == 2, p=self._dropout,
+                    state_outputs=in_states is not None)
+        if in_states is not None:
+            out = rnn[0]
+            out_states = [rnn[i] for i in range(1, len(states) + 1)]
+        else:
+            out, out_states = rnn, None
+        if self._layout == "NTC":
+            out = S.swapaxes(out, dim1=0, dim2=1)
+        # shape parity with the eager path: states passed -> both returned
+        return out if out_states is None else (out, out_states)
+
     def forward(self, inputs, states=None):
+        from ...symbol import Symbol as _Symbol
+        if isinstance(inputs, _Symbol):
+            return self._symbolic_forward(inputs, states)
         try:
             params = {name: p.data() for name, p in self._reg_params.items()}
         except Exception:

@@ -445,13 +445,18 @@ class Symbol:
 
         nodes = []
         for n in order:
-            nodes.append({
+            spec = {
                 "op": "null" if n.op is None else n.op.name,
                 "name": n.name,
                 "attrs": {k: _ser(v) for k, v in n.kwargs.items()} if n.op else {},
                 "inputs": [[node_index[id(i)], oi, 0] for i, oi in n.inputs],
                 "is_aux": n.is_aux,
-            })
+            }
+            if n.op is None and n.shape_hint is not None:
+                # variables carry known shapes (the reference's __shape__
+                # attr) so a loaded graph binds without inference rules
+                spec["shape"] = list(n.shape_hint)
+            nodes.append(spec)
         heads = [[node_index[id(n)], i, 0] for n, i in self._outputs]
         return json.dumps({"nodes": nodes, "heads": heads,
                            "mxnet_tpu_version": 1}, indent=2)
@@ -736,8 +741,11 @@ def load_json(json_str):
                 inputs.append((aux_node, 0))
         node_attr = dict(spec.get("attr") or {})
         if spec["op"] == "null":
+            shp = spec.get("shape")
             node = SymNode(None, spec["name"], [], {}, attr=node_attr,
-                           is_aux=spec.get("is_aux", False))
+                           is_aux=spec.get("is_aux", False),
+                           shape_hint=tuple(shp) if shp is not None
+                           else None)
         else:
             opdef = _registry.get(spec["op"])
             kwargs = {k: _parse_attr_value(v)

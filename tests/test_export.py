@@ -110,3 +110,27 @@ def test_export_multi_input_block(tmp_path):
     blk.export(prefix, epoch=0, inputs=("a", "b"))
     sym, arg, aux = mx.model.load_checkpoint(prefix, 0)
     assert set(sym.list_arguments()) == {"a", "b"}
+
+
+def test_export_rnn_net_exact(tmp_path):
+    # word-LM shape: Embedding -> fused LSTM -> Dense; export must be
+    # numerically EXACT (begin states emit as zero-allocated aux vars —
+    # free state args would get randomly initialized by init_params)
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Embedding(20, 8))
+        net.add(gluon.rnn.LSTM(16, layout="NTC"))
+        net.add(gluon.nn.Dense(20, flatten=False))
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.RandomState(0).randint(0, 20, (2, 6)))
+    eager = net(x).asnumpy()
+    prefix = os.path.join(str(tmp_path), "lm")
+    net.export(prefix, epoch=0)
+    sym, arg, aux = mx.model.load_checkpoint(prefix, 0)
+    assert any("state" in n for n in sym.list_auxiliary_states())
+    mod = mx.mod.Module(sym, context=mx.cpu(), label_names=())
+    mod.bind(data_shapes=[("data", (2, 6))], for_training=False)
+    mod.init_params(arg_params=arg, aux_params=aux)
+    mod.forward(mx.io.DataBatch(data=[x]), is_train=False)
+    np.testing.assert_allclose(mod.get_outputs()[0].asnumpy(), eager,
+                               rtol=1e-5, atol=1e-6)
