@@ -46,9 +46,11 @@ class DataBatch:
     def __init__(self, data, label=None, pad=None, index=None,
                  bucket_key=None, provide_data=None, provide_label=None):
         if data is not None:
-            assert isinstance(data, (list, tuple)), "Data must be list of NDArrays"
+            assert isinstance(data, (list, tuple)), \
+                "DataBatch.data takes a list/tuple of arrays"
         if label is not None:
-            assert isinstance(label, (list, tuple)), "Label must be list of NDArrays"
+            assert isinstance(label, (list, tuple)), \
+                "DataBatch.label takes a list/tuple of arrays"
         self.data = data
         self.label = label
         self.pad = pad
@@ -115,8 +117,8 @@ class NDArrayIter(DataIter):
         if ((_has_sparse(self.data) or _has_sparse(self.label)) and
                 last_batch_handle != "discard"):
             raise NotImplementedError(
-                "`NDArrayIter` only supports ``CSRNDArray`` with "
-                "`last_batch_handle` set to `discard`.")
+                "sparse (CSR) inputs cannot be padded or rolled over; "
+                "construct NDArrayIter with last_batch_handle='discard'")
         self.idx = np.arange(self.data[0][1].shape[0])
         if shuffle:
             np.random.shuffle(self.idx)
@@ -249,8 +251,9 @@ def _init_data(data, allow_empty, default_name):
                 [("_%d_%s" % (i, default_name), d)
                  for i, d in enumerate(data)])
     if not isinstance(data, dict):
-        raise TypeError("Input must be NDArray, numpy.ndarray, a list of "
-                        "them or dict with them as values")
+        raise TypeError(
+            "cannot build a data source from %r: expected an array, a "
+            "list of arrays, or a {name: array} dict" % type(data).__name__)
     out = []
     for k, v in data.items():
         if isinstance(v, (NDArray, CSRNDArray)):

@@ -1,9 +1,18 @@
-"""Evaluation metrics.
+"""Evaluation metrics, accumulated on host in numpy.
 
-Parity: reference `python/mxnet/metric.py:68-1190` — EvalMetric base,
-CompositeEvalMetric, Accuracy, TopKAccuracy, F1, Perplexity, MAE, MSE, RMSE,
-CrossEntropy, NegativeLogLikelihood, PearsonCorrelation, Loss, Torch, Caffe,
-CustomMetric + np() helper and the registry/create path.
+Design: metrics are cheap streaming reducers that run on the host — they
+never enter the jit'd training step (the fused TrainStep returns loss and
+outputs; metrics consume those after readback). Each metric keeps two
+accumulators (``sum_metric``, ``num_inst``) whose ratio is the reported
+value; metrics with a different aggregation (Perplexity, F1 micro) override
+``get``/``update`` accordingly.
+
+API parity: reference ``python/mxnet/metric.py`` — EvalMetric,
+CompositeEvalMetric, Accuracy, TopKAccuracy, F1, Perplexity, MAE, MSE,
+RMSE, CrossEntropy, NegativeLogLikelihood, PearsonCorrelation, Loss,
+Torch, Caffe, CustomMetric, ``np()`` and the registry/create path.
+(The reference's ``CompositeEvalMetric.get_metric`` bug — returning the
+ValueError instead of raising it, reference metric.py:292 — is fixed here.)
 """
 from __future__ import annotations
 
@@ -11,21 +20,36 @@ import math
 
 import numpy
 
-from .base import MXNetError
 from .registry import get_register_func, get_create_func, get_alias_func
 
 
+def _as_numpy(x):
+    """Coerce an NDArray / jax array / sequence to a host numpy array."""
+    if hasattr(x, "asnumpy"):
+        return x.asnumpy()
+    return numpy.asarray(x)
+
+
 def check_label_shapes(labels, preds, shape=False):
-    if shape:
-        label_shape, pred_shape = len(labels), len(preds)
-    else:
-        label_shape, pred_shape = labels.shape[0], preds.shape[0]
-    if label_shape != pred_shape:
-        raise ValueError("Shape of labels {} does not match shape of "
-                         "predictions {}".format(label_shape, pred_shape))
+    """Raise if the label/pred batch sizes (or list lengths) disagree."""
+    n_lab = len(labels) if shape else labels.shape[0]
+    n_pred = len(preds) if shape else preds.shape[0]
+    if n_lab != n_pred:
+        raise ValueError(
+            "Shape of labels {} does not match shape of predictions {}"
+            .format(n_lab, n_pred))
+
+
+def _pairs(labels, preds):
+    """Yield (label, pred) numpy pairs after validating list lengths."""
+    check_label_shapes(labels, preds, shape=True)
+    for label, pred in zip(labels, preds):
+        yield _as_numpy(label), _as_numpy(pred)
 
 
 class EvalMetric:
+    """Base streaming metric: value = sum_metric / num_inst."""
+
     def __init__(self, name, output_names=None, label_names=None, **kwargs):
         self.name = str(name)
         self.output_names = output_names
@@ -37,22 +61,23 @@ class EvalMetric:
         return "EvalMetric: {}".format(dict(self.get_name_value()))
 
     def get_config(self):
-        config = self._kwargs.copy()
-        config.update({"metric": self.__class__.__name__, "name": self.name,
-                       "output_names": self.output_names,
-                       "label_names": self.label_names})
+        config = dict(self._kwargs)
+        config.update(metric=self.__class__.__name__, name=self.name,
+                      output_names=self.output_names,
+                      label_names=self.label_names)
         return config
 
     def update_dict(self, label, pred):
+        """Update from name->array dicts, honoring output/label_names."""
         if self.output_names is not None:
-            pred = [pred[name] for name in self.output_names]
+            preds = [pred[name] for name in self.output_names]
         else:
-            pred = list(pred.values())
+            preds = list(pred.values())
         if self.label_names is not None:
-            label = [label[name] for name in self.label_names]
+            labels = [label[name] for name in self.label_names]
         else:
-            label = list(label.values())
-        self.update(label, pred)
+            labels = list(label.values())
+        self.update(labels, preds)
 
     def update(self, labels, preds):
         raise NotImplementedError()
@@ -81,6 +106,7 @@ _create = get_create_func(EvalMetric, "metric")
 
 
 def create(metric, *args, **kwargs):
+    """Create a metric from a name, callable, instance, or list of those."""
     if callable(metric):
         return CustomMetric(metric, *args, **kwargs)
     if isinstance(metric, list):
@@ -94,10 +120,12 @@ def create(metric, *args, **kwargs):
 @register
 @alias("composite")
 class CompositeEvalMetric(EvalMetric):
+    """Fan an update out to several child metrics; get() concatenates."""
+
     def __init__(self, metrics=None, name="composite", output_names=None,
                  label_names=None):
         super().__init__(name, output_names, label_names)
-        self.metrics = metrics if metrics is not None else []
+        self.metrics = list(metrics) if metrics is not None else []
 
     def add(self, metric):
         self.metrics.append(create(metric))
@@ -106,8 +134,9 @@ class CompositeEvalMetric(EvalMetric):
         try:
             return self.metrics[index]
         except IndexError:
-            return ValueError("Metric index {} is out of range 0 and {}".format(
-                index, len(self.metrics)))
+            raise ValueError(
+                "Metric index {} is out of range [0, {})".format(
+                    index, len(self.metrics))) from None
 
     def update_dict(self, labels, preds):
         for metric in self.metrics:
@@ -118,55 +147,51 @@ class CompositeEvalMetric(EvalMetric):
             metric.update(labels, preds)
 
     def reset(self):
-        try:
-            for metric in self.metrics:
-                metric.reset()
-        except AttributeError:
-            pass
+        # Called once from EvalMetric.__init__ before self.metrics exists.
+        for metric in getattr(self, "metrics", []):
+            metric.reset()
 
     def get(self):
         names, values = [], []
         for metric in self.metrics:
             name, value = metric.get()
-            if isinstance(name, str):
-                name = [name]
-            if isinstance(value, (float, int, numpy.generic)):
-                value = [value]
-            names.extend(name)
-            values.extend(value)
+            names.extend(name if isinstance(name, list) else [name])
+            values.extend(value if isinstance(value, (list, tuple))
+                          else [value])
         return (names, values)
 
 
-def _np(x):
-    return x.asnumpy() if hasattr(x, "asnumpy") else numpy.asarray(x)
+def _hard_labels(pred, axis):
+    """Collapse class scores to hard label ids (argmax along axis)."""
+    if pred.ndim > 1 and pred.shape[axis] > 1:
+        pred = numpy.argmax(pred, axis=axis)
+    return pred.astype("int64").ravel()
 
 
 @register
 @alias("acc")
 class Accuracy(EvalMetric):
+    """Fraction of predictions whose argmax equals the label."""
+
     def __init__(self, axis=1, name="accuracy", output_names=None,
                  label_names=None):
         super().__init__(name, output_names, label_names, axis=axis)
         self.axis = axis
 
     def update(self, labels, preds):
-        check_label_shapes(labels, preds, True)
-        for label, pred_label in zip(labels, preds):
-            pl = _np(pred_label)
-            if pl.ndim > 1 and pl.shape[-1] > 1 and pl.ndim != _np(label).ndim:
-                pl = numpy.argmax(pl, axis=self.axis)
-            elif pl.ndim > 1 and pl.shape[self.axis] > 1:
-                pl = numpy.argmax(pl, axis=self.axis)
-            pl = pl.astype("int32").ravel()
-            lab = _np(label).astype("int32").ravel()
-            check_label_shapes(lab, pl)
-            self.sum_metric += (pl == lab).sum()
-            self.num_inst += len(pl)
+        for label, pred in _pairs(labels, preds):
+            hard = _hard_labels(pred, self.axis)
+            want = label.astype("int64").ravel()
+            check_label_shapes(want, hard)
+            self.sum_metric += int((hard == want).sum())
+            self.num_inst += hard.size
 
 
 @register
 @alias("top_k_accuracy", "top_k_acc")
 class TopKAccuracy(EvalMetric):
+    """Fraction of rows whose label appears in the k highest scores."""
+
     def __init__(self, top_k=1, name="top_k_accuracy", output_names=None,
                  label_names=None):
         super().__init__(name, output_names, label_names, top_k=top_k)
@@ -175,30 +200,74 @@ class TopKAccuracy(EvalMetric):
         self.name += "_%d" % self.top_k
 
     def update(self, labels, preds):
-        check_label_shapes(labels, preds, True)
-        for label, pred_label in zip(labels, preds):
-            pred = numpy.argsort(_np(pred_label).astype("float32"), axis=1)
-            lab = _np(label).astype("int32")
-            num_samples = pred.shape[0]
-            num_classes = pred.shape[1]
-            top_k = min(num_classes, self.top_k)
-            for j in range(top_k):
-                self.sum_metric += (
-                    pred[:, num_classes - 1 - j].ravel() == lab.ravel()).sum()
-            self.num_inst += num_samples
+        for label, pred in _pairs(labels, preds):
+            if pred.ndim < 2:
+                raise ValueError(
+                    "TopKAccuracy needs per-class scores of shape "
+                    "(batch, num_classes); got shape %s" % (pred.shape,))
+            scores = pred.reshape(pred.shape[0], -1).astype("float64")
+            k = min(scores.shape[1], self.top_k)
+            # Indices of the k best classes per row, any order.
+            top = numpy.argpartition(scores, -k, axis=1)[:, -k:]
+            want = label.astype("int64").ravel()[:, None]
+            self.sum_metric += int((top == want).any(axis=1).sum())
+            self.num_inst += scores.shape[0]
+
+
+class _ConfusionCounts:
+    """Streaming binary-classification confusion counts."""
+
+    __slots__ = ("tp", "fp", "fn", "tn")
+
+    def __init__(self):
+        self.reset_stats()
+
+    def reset_stats(self):
+        self.tp = self.fp = self.fn = self.tn = 0
+
+    def update_binary_stats(self, label, pred):
+        decided = numpy.argmax(pred, axis=1)
+        truth = label.astype("int64").ravel()
+        if numpy.unique(truth).size > 2:
+            raise ValueError(
+                "F1 currently only supports binary classification.")
+        self.tp += int(((decided == 1) & (truth == 1)).sum())
+        self.fp += int(((decided == 1) & (truth == 0)).sum())
+        self.fn += int(((decided == 0) & (truth == 1)).sum())
+        self.tn += int(((decided == 0) & (truth == 0)).sum())
+
+    @property
+    def precision(self):
+        return self.tp / (self.tp + self.fp) if self.tp + self.fp else 0.0
+
+    @property
+    def recall(self):
+        return self.tp / (self.tp + self.fn) if self.tp + self.fn else 0.0
+
+    @property
+    def fscore(self):
+        pr = self.precision + self.recall
+        return 2 * self.precision * self.recall / pr if pr else 0.0
+
+    @property
+    def total_examples(self):
+        return self.tp + self.fp + self.fn + self.tn
 
 
 @register
 class F1(EvalMetric):
+    """Binary F1. average='macro' averages per-update F1 scores;
+    'micro' pools confusion counts across all updates."""
+
     def __init__(self, name="f1", output_names=None, label_names=None,
                  average="macro"):
         self.average = average
-        self.metrics = _BinaryClassificationMetrics()
+        self.metrics = _ConfusionCounts()
         super().__init__(name, output_names, label_names)
 
     def update(self, labels, preds):
-        for label, pred in zip(labels, preds):
-            self.metrics.update_binary_stats(_np(label), _np(pred))
+        for label, pred in _pairs(labels, preds):
+            self.metrics.update_binary_stats(label, pred)
         if self.average == "macro":
             self.sum_metric += self.metrics.fscore
             self.num_inst += 1
@@ -214,52 +283,10 @@ class F1(EvalMetric):
             self.metrics.reset_stats()
 
 
-class _BinaryClassificationMetrics:
-    def __init__(self):
-        self.reset_stats()
-
-    def update_binary_stats(self, label, pred):
-        pred_label = numpy.argmax(pred, axis=1)
-        label = label.astype("int32").ravel()
-        if len(numpy.unique(label)) > 2:
-            raise ValueError("%s currently only supports binary "
-                             "classification." % self.__class__.__name__)
-        self.true_positives += ((pred_label == 1) & (label == 1)).sum()
-        self.false_positives += ((pred_label == 1) & (label == 0)).sum()
-        self.false_negatives += ((pred_label == 0) & (label == 1)).sum()
-        self.true_negatives += ((pred_label == 0) & (label == 0)).sum()
-
-    @property
-    def precision(self):
-        denom = self.true_positives + self.false_positives
-        return self.true_positives / denom if denom > 0 else 0.0
-
-    @property
-    def recall(self):
-        denom = self.true_positives + self.false_negatives
-        return self.true_positives / denom if denom > 0 else 0.0
-
-    @property
-    def fscore(self):
-        if self.precision + self.recall > 0:
-            return 2 * self.precision * self.recall / (
-                self.precision + self.recall)
-        return 0.0
-
-    @property
-    def total_examples(self):
-        return (self.false_negatives + self.false_positives +
-                self.true_negatives + self.true_positives)
-
-    def reset_stats(self):
-        self.false_positives = 0
-        self.false_negatives = 0
-        self.true_positives = 0
-        self.true_negatives = 0
-
-
 @register
 class Perplexity(EvalMetric):
+    """exp(mean negative log prob of the target), skipping ignore_label."""
+
     def __init__(self, ignore_label, axis=-1, name="perplexity",
                  output_names=None, label_names=None):
         super().__init__(name, output_names, label_names,
@@ -268,21 +295,18 @@ class Perplexity(EvalMetric):
         self.axis = axis
 
     def update(self, labels, preds):
-        assert len(labels) == len(preds)
-        loss = 0.0
-        num = 0
-        for label, pred in zip(labels, preds):
-            lab = _np(label).astype("int32").ravel()
-            p = _np(pred).reshape(-1, _np(pred).shape[-1])
-            probs = p[numpy.arange(lab.shape[0]), lab]
+        for label, pred in _pairs(labels, preds):
+            want = label.astype("int64").ravel()
+            scores = pred.reshape(-1, pred.shape[-1])
+            target_prob = scores[numpy.arange(want.size), want]
+            n = want.size
             if self.ignore_label is not None:
-                ignore = (lab == self.ignore_label)
-                probs = numpy.where(ignore, 1.0, probs)
-                num -= ignore.sum()
-            loss -= numpy.sum(numpy.log(numpy.maximum(1e-10, probs)))
-            num += lab.shape[0]
-        self.sum_metric += loss
-        self.num_inst += num
+                keep = want != self.ignore_label
+                target_prob = numpy.where(keep, target_prob, 1.0)
+                n = int(keep.sum())
+            self.sum_metric += float(
+                -numpy.log(numpy.maximum(target_prob, 1e-10)).sum())
+            self.num_inst += n
 
     def get(self):
         if self.num_inst == 0:
@@ -290,119 +314,115 @@ class Perplexity(EvalMetric):
         return (self.name, math.exp(self.sum_metric / self.num_inst))
 
 
+class _Regression(EvalMetric):
+    """Shared shape-normalisation for per-batch regression metrics."""
+
+    def _score(self, label, pred):
+        raise NotImplementedError()
+
+    def update(self, labels, preds):
+        for label, pred in _pairs(labels, preds):
+            if label.ndim == 1:
+                label = label[:, None]
+            if pred.ndim == 1:
+                pred = pred[:, None]
+            self.sum_metric += float(self._score(label, pred))
+            self.num_inst += 1
+
+
 @register
-class MAE(EvalMetric):
+class MAE(_Regression):
+    """Mean absolute error, averaged per update() call."""
+
     def __init__(self, name="mae", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            lab, p = _np(label), _np(pred)
-            if lab.ndim == 1:
-                lab = lab.reshape(lab.shape[0], 1)
-            if p.ndim == 1:
-                p = p.reshape(p.shape[0], 1)
-            self.sum_metric += numpy.abs(lab - p).mean()
-            self.num_inst += 1
+    def _score(self, label, pred):
+        return numpy.abs(label - pred).mean()
 
 
 @register
-class MSE(EvalMetric):
+class MSE(_Regression):
+    """Mean squared error, averaged per update() call."""
+
     def __init__(self, name="mse", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            lab, p = _np(label), _np(pred)
-            if lab.ndim == 1:
-                lab = lab.reshape(lab.shape[0], 1)
-            if p.ndim == 1:
-                p = p.reshape(p.shape[0], 1)
-            self.sum_metric += ((lab - p) ** 2.0).mean()
-            self.num_inst += 1
+    def _score(self, label, pred):
+        return ((label - pred) ** 2.0).mean()
 
 
 @register
-class RMSE(EvalMetric):
+class RMSE(_Regression):
+    """Root mean squared error, averaged per update() call."""
+
     def __init__(self, name="rmse", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
 
+    def _score(self, label, pred):
+        return math.sqrt(((label - pred) ** 2.0).mean())
+
+
+class _TargetNLL(EvalMetric):
+    """Mean -log(prob assigned to the integer target), plus eps."""
+
+    def __init__(self, eps, name, output_names, label_names):
+        super().__init__(name, output_names, label_names, eps=eps)
+        self.eps = eps
+
     def update(self, labels, preds):
-        check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            lab, p = _np(label), _np(pred)
-            if lab.ndim == 1:
-                lab = lab.reshape(lab.shape[0], 1)
-            if p.ndim == 1:
-                p = p.reshape(p.shape[0], 1)
-            self.sum_metric += numpy.sqrt(((lab - p) ** 2.0).mean())
-            self.num_inst += 1
+        for label, pred in _pairs(labels, preds):
+            want = label.astype("int64").ravel()
+            assert want.size == pred.shape[0]
+            target_prob = pred[numpy.arange(want.size), want]
+            self.sum_metric += float(
+                -numpy.log(target_prob + self.eps).sum())
+            self.num_inst += want.size
 
 
 @register
 @alias("ce")
-class CrossEntropy(EvalMetric):
+class CrossEntropy(_TargetNLL):
     def __init__(self, eps=1e-12, name="cross-entropy", output_names=None,
                  label_names=None):
-        super().__init__(name, output_names, label_names, eps=eps)
-        self.eps = eps
-
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            lab = _np(label).ravel()
-            p = _np(pred)
-            assert lab.shape[0] == p.shape[0]
-            prob = p[numpy.arange(lab.shape[0]), numpy.int64(lab)]
-            self.sum_metric += (-numpy.log(prob + self.eps)).sum()
-            self.num_inst += lab.shape[0]
+        super().__init__(eps, name, output_names, label_names)
 
 
 @register
 @alias("nll_loss")
-class NegativeLogLikelihood(EvalMetric):
+class NegativeLogLikelihood(_TargetNLL):
     def __init__(self, eps=1e-12, name="nll-loss", output_names=None,
                  label_names=None):
-        super().__init__(name, output_names, label_names, eps=eps)
-        self.eps = eps
-
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            lab = _np(label).ravel()
-            p = _np(pred)
-            num_examples = p.shape[0]
-            prob = p[numpy.arange(num_examples, dtype=numpy.int64), numpy.int64(lab)]
-            self.sum_metric += (-numpy.log(prob + self.eps)).sum()
-            self.num_inst += num_examples
+        super().__init__(eps, name, output_names, label_names)
 
 
 @register
 @alias("pearsonr")
 class PearsonCorrelation(EvalMetric):
+    """Pearson correlation between flattened label and prediction."""
+
     def __init__(self, name="pearsonr", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
 
     def update(self, labels, preds):
-        check_label_shapes(labels, preds, True)
-        for label, pred in zip(labels, preds):
-            lab, p = _np(label).ravel(), _np(pred).ravel()
-            self.sum_metric += numpy.corrcoef(p, lab)[0, 1]
+        for label, pred in _pairs(labels, preds):
+            self.sum_metric += float(
+                numpy.corrcoef(pred.ravel(), label.ravel())[0, 1])
             self.num_inst += 1
 
 
 @register
 class Loss(EvalMetric):
+    """Mean of the raw prediction values (for monitoring loss outputs)."""
+
     def __init__(self, name="loss", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
 
     def update(self, _, preds):
         for pred in preds:
-            loss = _np(pred).sum()
-            self.sum_metric += loss
-            self.num_inst += _np(pred).size
+            values = _as_numpy(pred)
+            self.sum_metric += float(values.sum())
+            self.num_inst += values.size
 
 
 @register
@@ -419,11 +439,13 @@ class Caffe(Loss):
 
 @register
 class CustomMetric(EvalMetric):
+    """Wrap feval(label, pred) -> value or (sum, count)."""
+
     def __init__(self, feval, name=None, allow_extra_outputs=False,
                  output_names=None, label_names=None):
         if name is None:
             name = feval.__name__
-            if name.find("<") != -1:
+            if "<" in name:
                 name = "custom(%s)" % name
         super().__init__(name, output_names, label_names,
                          feval=feval, allow_extra_outputs=allow_extra_outputs)
@@ -432,19 +454,20 @@ class CustomMetric(EvalMetric):
 
     def update(self, labels, preds):
         if not self._allow_extra_outputs:
-            check_label_shapes(labels, preds, True)
+            check_label_shapes(labels, preds, shape=True)
         for pred, label in zip(preds, labels):
-            reval = self._feval(_np(label), _np(pred))
-            if isinstance(reval, tuple):
-                (sum_metric, num_inst) = reval
-                self.sum_metric += sum_metric
-                self.num_inst += num_inst
+            result = self._feval(_as_numpy(label), _as_numpy(pred))
+            if isinstance(result, tuple):
+                part_sum, part_count = result
+                self.sum_metric += part_sum
+                self.num_inst += part_count
             else:
-                self.sum_metric += reval
+                self.sum_metric += result
                 self.num_inst += 1
 
 
 def np(numpy_feval, name=None, allow_extra_outputs=False):
+    """Lift a plain numpy_feval(label, pred) into a CustomMetric."""
     def feval(label, pred):
         return numpy_feval(label, pred)
     feval.__name__ = numpy_feval.__name__

@@ -199,3 +199,24 @@ def test_ndarrayiter_roll_over_rejects_oversized_batch():
         mx.io.NDArrayIter(np.arange(5, dtype=np.float32).reshape(5, 1),
                           np.zeros(5), batch_size=10,
                           last_batch_handle="roll_over")
+
+
+def test_ndarrayiter_pad_content_wraps_from_head():
+    """Padded tail batch must be filled with samples wrapped from the
+    epoch's head order, and getpad() reports exactly the fill count."""
+    X = np.arange(10, dtype=np.float32).reshape(5, 2)
+    it = mx.io.NDArrayIter(X, batch_size=4, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 2
+    last = batches[-1].data[0].asnumpy()
+    assert batches[-1].pad == 3
+    # 5 samples, batch 4: second batch = [sample4, sample0, sample1, sample2]
+    np.testing.assert_array_equal(last, X[[4, 0, 1, 2]])
+
+
+def test_ndarrayiter_discard_drops_tail():
+    X = np.arange(10, dtype=np.float32).reshape(5, 2)
+    it = mx.io.NDArrayIter(X, batch_size=4, last_batch_handle="discard")
+    assert len(list(it)) == 1
+    it.reset()
+    assert len(list(it)) == 1

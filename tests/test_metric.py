@@ -99,3 +99,69 @@ def test_loss_metric_and_reset():
     assert abs(m.get()[1] - 3.0) < 1e-6
     m.reset()
     assert np.isnan(m.get()[1])
+
+
+def test_f1_macro_vs_micro():
+    # Two updates with very different batch sizes: macro averages the two
+    # per-update F1 scores; micro pools the confusion counts.
+    p1 = np.array([[0.2, 0.8]] * 4, np.float32)          # predict 1 x4
+    l1 = np.array([1, 1, 1, 0], np.float32)              # tp=3 fp=1 -> f1=0.857..
+    p2 = np.array([[0.8, 0.2]], np.float32)              # predict 0 x1
+    l2 = np.array([1], np.float32)                       # fn=1 -> f1=0
+    macro = mx.metric.F1(average="macro")
+    micro = mx.metric.F1(average="micro")
+    for m in (macro, micro):
+        m.update([nd.array(l1)], [nd.array(p1)])
+        m.update([nd.array(l2)], [nd.array(p2)])
+    f1_a = 2 * (3 / 4) * 1.0 / (3 / 4 + 1.0)             # update 1: tp=3 fp=1 fn=0
+    assert abs(macro.get()[1] - (f1_a + 0.0) / 2) < 1e-6
+    # pooled: tp=3 fp=1 fn=1 -> p=0.75 r=0.75 f1=0.75
+    assert abs(micro.get()[1] - 0.75) < 1e-6
+
+
+def test_f1_rejects_multiclass():
+    m = mx.metric.F1()
+    pred = nd.array(np.eye(3, dtype=np.float32))
+    label = nd.array(np.array([0, 1, 2], np.float32))
+    try:
+        m.update([label], [pred])
+        assert False, "expected ValueError"
+    except ValueError:
+        pass
+
+
+def test_perplexity_ignore_label():
+    # Row 1 is padding (label == ignore_label): must not count toward the
+    # mean, in numerator or denominator.
+    pred = nd.array(np.array([[0.25, 0.75], [0.9, 0.1], [0.5, 0.5]],
+                             np.float32))
+    label = nd.array(np.array([1, 0, 0], np.float32))
+    pp = mx.metric.Perplexity(ignore_label=0)
+    pp.update([label], [pred])
+    expected = np.exp(-np.log(0.75) / 1)  # only row 0 has label != 0
+    assert abs(pp.get()[1] - expected) < 1e-5
+
+
+def test_composite_get_metric_raises():
+    m = mx.metric.CompositeEvalMetric()
+    m.add("acc")
+    assert isinstance(m.get_metric(0), mx.metric.Accuracy)
+    try:
+        m.get_metric(5)
+        assert False, "expected ValueError for out-of-range index"
+    except ValueError:
+        pass
+
+
+def test_topk_ties_and_update_dict():
+    m = mx.metric.TopKAccuracy(top_k=3)
+    pred = np.random.RandomState(0).rand(32, 10).astype(np.float32)
+    label = np.random.RandomState(1).randint(0, 10, 32).astype(np.float32)
+    m.update([nd.array(label)], [nd.array(pred)])
+    # cross-check against a reference argsort implementation
+    order = np.argsort(pred, axis=1)
+    hits = sum(int(label[i]) in order[i, -3:] for i in range(32))
+    assert m.get()[1] == hits / 32
+    m2 = mx.metric.Accuracy(output_names=["out"], label_names=["lab"])
+    m2.update_dict({"lab": nd.array(label)}, {"out": nd.array(pred)})
+    assert 0.0 <= m2.get()[1] <= 1.0
