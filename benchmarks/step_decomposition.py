@@ -1,0 +1,85 @@
+"""Trustworthy decomposition: every timing chains iterations AND ends with a
+float() readback of a value depending on the whole computation."""
+import time, numpy as np, jax, jax.numpy as jnp
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.ndarray import NDArray
+from mxnet_tpu.gluon.model_zoo import vision
+from mxnet_tpu.parallel.functional import functionalize, swap_param_buffers
+from mxnet_tpu import random as _random
+
+B = 256
+net = vision.resnet50_v1()
+net.initialize(mx.init.Xavier())
+net(mx.nd.zeros((1, 3, 224, 224)))
+rng = np.random.RandomState(0)
+x0 = jnp.asarray(rng.uniform(-1, 1, (B, 3, 224, 224)).astype(np.float32))
+y0 = jnp.asarray(rng.randint(0, 1000, (B,)).astype(np.int32))
+
+plist = list(net.collect_params().values())
+vals = [p._data._data for p in plist]
+apply_eval, _, _ = functionalize(net, train_mode=False)
+bf = [v.astype(jnp.bfloat16) if jnp.issubdtype(v.dtype, jnp.floating) else v for v in vals]
+
+def run(tag, fn, state, n=12):
+    s = fn(state)          # warmup/compile
+    float(s[0]) if isinstance(s, tuple) else float(s[0][0].ravel()[0])
+    s = fn(s)
+    float(s[0])
+    t0 = time.perf_counter()
+    for _ in range(n):
+        s = fn(s)
+    float(s[0])            # true completion readback
+    dt = (time.perf_counter() - t0) / n
+    print("%-34s %7.2f ms  %7.0f img/s" % (tag, dt*1e3, B/dt))
+    return dt
+
+# 1. eval-mode fwd only (bf16): state = (acc, x)
+@jax.jit
+def f1(st):
+    acc, x = st
+    out = apply_eval(bf, x)
+    acc2 = acc + jnp.sum(out.astype(jnp.float32))
+    return (acc2, x + (0.0 * acc2).astype(x.dtype))
+run("fwd eval bf16", f1, (jnp.float32(0), x0.astype(jnp.bfloat16)))
+
+# 2. eval-mode fwd+bwd (bf16 params)
+def loss_eval(p, x):
+    out = apply_eval(p, x)
+    return jnp.mean(jax.scipy.special.logsumexp(out.astype(jnp.float32), axis=1))
+@jax.jit
+def f2(st):
+    acc, p = st
+    g = jax.grad(loss_eval)(p, x0.astype(jnp.bfloat16))
+    acc2 = acc + jnp.sum(g[0].astype(jnp.float32))
+    p2 = [w - (0.0 * acc2).astype(w.dtype) * gw for w, gw in zip(p, g)]
+    return (acc2, p2)
+run("fwd+bwd eval bf16", f2, (jnp.float32(0), bf))
+
+# 3. train-mode fwd+bwd, f32 masters cast in-graph + BN batch stats
+def loss_train(pv, x, key):
+    pv16 = [v.astype(jnp.bfloat16) if jnp.issubdtype(v.dtype, jnp.floating) else v for v in pv]
+    with swap_param_buffers(plist, pv16):
+        with autograd._RecordingStateScope(False, True), _random.trace_key_scope(key):
+            out = net.forward(NDArray(x.astype(jnp.bfloat16)))
+        return jnp.mean(jax.scipy.special.logsumexp(out._data.astype(jnp.float32), axis=1))
+key0 = jax.random.PRNGKey(0)
+@jax.jit
+def f3(st):
+    acc, p = st
+    g = jax.grad(loss_train)(p, x0, key0)
+    acc2 = acc + jnp.sum(g[0])
+    p2 = [w - (0.0 * acc2).astype(w.dtype) * gw for w, gw in zip(p, g)]
+    return (acc2, p2)
+run("fwd+bwd train f32-masters", f3, (jnp.float32(0), vals))
+
+# 4. + sgd-mom update (hand-rolled full step)
+@jax.jit
+def f4(st):
+    acc, p, mom = st
+    g = jax.grad(loss_train)(p, x0, key0)
+    mom2 = [0.9*m - 0.05*(gw + 1e-4*w) for m, gw, w in zip(mom, g, p)]
+    p2 = [w + m for w, m in zip(p, mom2)]
+    acc2 = acc + jnp.sum(p2[0])
+    return (acc2, p2, mom2)
+run("full step hand-rolled", f4, (jnp.float32(0), vals, [jnp.zeros_like(v) for v in vals]))

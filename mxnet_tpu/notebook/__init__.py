@@ -1,0 +1,2 @@
+"""Notebook helpers (parity: reference python/mxnet/notebook/)."""
+from . import callback

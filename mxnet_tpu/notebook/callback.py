@@ -1,0 +1,169 @@
+"""Training-curve logging and live plotting for notebooks.
+
+Parity: reference ``python/mxnet/notebook/callback.py`` (PandasLogger,
+LiveLearningCurve, args_wrapper). Redesigned: the live chart renders with
+matplotlib (present in this environment) instead of bokeh, and the loggers
+are plain callables compatible with ``Module.fit``'s
+``batch_end_callback`` / ``eval_end_callback`` / ``epoch_end_callback``
+hooks.
+"""
+from __future__ import annotations
+
+import time
+import collections
+
+try:
+    import pandas as _pd
+except ImportError:  # pragma: no cover - pandas is baked into this env
+    _pd = None
+
+
+def _metric_dict(param):
+    """Pull {name: value} out of a BatchEndParam-style namedtuple."""
+    if param.eval_metric is None:
+        return {}
+    return dict(param.eval_metric.get_name_value())
+
+
+class PandasLogger:
+    """Accumulate train/eval/epoch statistics into pandas DataFrames.
+
+    ``train_df`` gets a row every ``frequent`` training batches (with an
+    ``elapsed`` seconds column and throughput), ``eval_df`` one row per
+    evaluation pass, ``epoch_df`` one timing row per epoch.
+    """
+
+    def __init__(self, batch_size, frequent=50):
+        if _pd is None:
+            raise ImportError("PandasLogger needs pandas")
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self._rows = {"train": [], "eval": [], "epoch": []}
+        self._tick = time.time()
+        self._epoch_tick = time.time()
+
+    def _frame(self, which):
+        return _pd.DataFrame(self._rows[which])
+
+    @property
+    def train_df(self):
+        return self._frame("train")
+
+    @property
+    def eval_df(self):
+        return self._frame("eval")
+
+    @property
+    def epoch_df(self):
+        return self._frame("epoch")
+
+    @property
+    def all_dataframes(self):
+        return {k: self._frame(k) for k in self._rows}
+
+    def elapsed(self):
+        return time.time() - self._tick
+
+    def train_cb(self, param):
+        if param.nbatch % self.frequent != 0:
+            return
+        row = {"epoch": param.epoch, "batch": param.nbatch,
+               "elapsed": self.elapsed(),
+               "samples/sec": self.frequent * self.batch_size /
+                              max(self.elapsed(), 1e-9)}
+        row.update(_metric_dict(param))
+        self._rows["train"].append(row)
+        self._tick = time.time()
+
+    def eval_cb(self, param):
+        row = {"epoch": param.epoch}
+        row.update(_metric_dict(param))
+        self._rows["eval"].append(row)
+
+    def epoch_cb(self, *_):
+        self._rows["epoch"].append(
+            {"elapsed": time.time() - self._epoch_tick})
+        self._epoch_tick = time.time()
+
+    def callback_args(self):
+        """kwargs fragment for Module.fit (combine with args_wrapper)."""
+        return {"batch_end_callback": self.train_cb,
+                "eval_end_callback": self.eval_cb,
+                "epoch_end_callback": self.epoch_cb}
+
+
+class LiveLearningCurve:
+    """Redraw a train/validation metric curve as training progresses.
+
+    Uses matplotlib; inside Jupyter the figure updates in place via
+    ``IPython.display``, elsewhere it just accumulates the series (access
+    them with ``.train_series`` / ``.eval_series`` or call ``.figure()``).
+    """
+
+    def __init__(self, metric_name="accuracy", frequent=50):
+        self.metric_name = metric_name
+        self.frequent = frequent
+        self.train_series = collections.OrderedDict()   # step -> value
+        self.eval_series = collections.OrderedDict()    # epoch -> value
+        self._step = 0
+        self._fig = None
+
+    def _record(self, series, param):
+        values = _metric_dict(param)
+        if self.metric_name in values:
+            # both series share the batch-step x axis so the curves align
+            series[self._step] = values[self.metric_name]
+            self._redraw()
+
+    def train_cb(self, param):
+        self._step += 1
+        if self._step % self.frequent == 0:
+            self._record(self.train_series, param)
+
+    def eval_cb(self, param):
+        self._record(self.eval_series, param)
+
+    def figure(self):
+        import matplotlib.pyplot as plt
+        fig, ax = plt.subplots()
+        if self.train_series:
+            ax.plot(list(self.train_series), list(self.train_series.values()),
+                    label="train")
+        if self.eval_series:
+            ax.plot(list(self.eval_series), list(self.eval_series.values()),
+                    marker="o", label="validation")
+        ax.set_xlabel("step")
+        ax.set_ylabel(self.metric_name)
+        ax.legend(loc="best")
+        self._fig = fig
+        return fig
+
+    def _redraw(self):
+        try:
+            from IPython import display, get_ipython
+            if get_ipython() is None:
+                return
+        except ImportError:
+            return
+        import matplotlib.pyplot as plt
+        fig = self.figure()
+        display.clear_output(wait=True)
+        display.display(fig)
+        plt.close(fig)
+
+    def callback_args(self):
+        return {"batch_end_callback": self.train_cb,
+                "eval_end_callback": self.eval_cb}
+
+
+def args_wrapper(*callbacks):
+    """Merge several loggers' callback_args() into one fit(**kwargs) dict.
+
+    Values for a repeated hook become a list — Module.fit accepts either a
+    callable or a list of callables for each callback slot.
+    """
+    merged = collections.defaultdict(list)
+    for cb in callbacks:
+        for hook, fn in cb.callback_args().items():
+            merged[hook].append(fn)
+    return dict(merged)

@@ -128,6 +128,40 @@ def _sample_poisson(lam, shape=None, dtype=None):
     return jax.random.poisson(next_key(), l).astype(lam.dtype)
 
 
+@register("_sample_negative_binomial", differentiable=False, stochastic=True)
+def _sample_negative_binomial(k, p, shape=None, dtype=None):
+    """Per-element NB(k_i, p_i) draws via the gamma-Poisson mixture
+    (parity: multisample_op.cc _sample_negative_binomial)."""
+    s = _shp(shape)
+    kb = jnp.broadcast_to(
+        k.reshape(k.shape + (1,) * len(s)).astype(jnp.float32), k.shape + s)
+    pb = jnp.broadcast_to(
+        p.reshape(p.shape + (1,) * len(s)).astype(jnp.float32), p.shape + s)
+    lam = jax.random.gamma(next_key(), kb) * (1 - pb) / pb
+    return jax.random.poisson(next_key(), lam).astype(
+        dtype_np(dtype or "float32"))
+
+
+@register("_sample_generalized_negative_binomial", differentiable=False,
+          stochastic=True)
+def _sample_generalized_negative_binomial(mu, alpha, shape=None, dtype=None):
+    """Per-element GNB(mu_i, alpha_i); alpha_i == 0 degenerates to
+    Poisson(mu_i) (parity: multisample_op.cc)."""
+    s = _shp(shape)
+    mub = jnp.broadcast_to(
+        mu.reshape(mu.shape + (1,) * len(s)).astype(jnp.float32),
+        mu.shape + s)
+    ab = jnp.broadcast_to(
+        alpha.reshape(alpha.shape + (1,) * len(s)).astype(jnp.float32),
+        alpha.shape + s)
+    r = 1.0 / jnp.maximum(ab, 1e-6)
+    pgb = r / (r + mub)
+    lam = jnp.where(ab <= 1e-6, mub,
+                    jax.random.gamma(next_key(), r) * (1 - pgb) / pgb)
+    return jax.random.poisson(next_key(), lam).astype(
+        dtype_np(dtype or "float32"))
+
+
 @register("_sample_multinomial", differentiable=False, stochastic=True)
 def _sample_multinomial(data, shape=None, get_prob=False, dtype="int32"):
     """data: [..., K] probabilities; returns [..., *shape] class indices."""

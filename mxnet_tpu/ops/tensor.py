@@ -785,6 +785,22 @@ def pick(data, index, axis=-1, keepdims=False, mode="clip"):
     return out
 
 
+@register("choose_element_0index")
+def choose_element_0index(lhs, rhs):
+    """out[i] = lhs[i, rhs[i]] — legacy name for pick along axis 1
+    (parity: src/operator/tensor/indexing_op.cc choose_element_0index)."""
+    return pick(lhs, rhs, axis=1)
+
+
+@register("fill_element_0index")
+def fill_element_0index(lhs, mhs, rhs):
+    """out = lhs with out[i, rhs[i]] = mhs[i]
+    (parity: src/operator/tensor/indexing_op.cc fill_element_0index)."""
+    idx = jnp.clip(rhs.astype(jnp.int32), 0, lhs.shape[1] - 1)
+    rows = jnp.arange(lhs.shape[0])
+    return lhs.at[rows, idx].set(mhs.astype(lhs.dtype))
+
+
 @register("one_hot", differentiable=False)
 def one_hot(indices, depth=0, on_value=1.0, off_value=0.0, dtype="float32"):
     from ..base import dtype_np

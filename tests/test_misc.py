@@ -141,3 +141,26 @@ def test_log_libinfo_kvstore_server_torch_modules():
     back = mxt.from_torch(t * 2)
     np.testing.assert_allclose(back.asnumpy(), [2.0, 4.0])
     assert mxt.TorchBlock is not None
+
+
+def test_notebook_callbacks_log_training():
+    from mxnet_tpu.notebook.callback import (PandasLogger, LiveLearningCurve,
+                                             args_wrapper)
+    import mxnet_tpu as mx
+    train, val = mx.test_utils.get_mnist_iterator(batch_size=100,
+                                                  input_shape=(784,))
+    logger = PandasLogger(batch_size=100, frequent=1)
+    curve = LiveLearningCurve(metric_name="accuracy", frequent=1)
+    kwargs = args_wrapper(logger, curve)
+    assert set(kwargs) == {"batch_end_callback", "eval_end_callback",
+                           "epoch_end_callback"}
+    mod = mx.mod.Module(mx.models.get_mlp(), context=mx.cpu())
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.1}, num_epoch=1, **kwargs)
+    assert len(logger.train_df) > 0
+    assert "samples/sec" in logger.train_df.columns
+    assert len(logger.epoch_df) == 1
+    assert len(curve.train_series) > 0
+    fig = curve.figure()
+    assert fig is not None

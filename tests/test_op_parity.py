@@ -206,3 +206,36 @@ def test_scatter_and_identity_attr_ops():
     np.testing.assert_allclose(
         nd._identity_with_attr_like_rhs(a, nd.zeros((2,))).asnumpy(),
         a.asnumpy())
+
+
+def test_multisample_nb_and_legacy_0index_ops():
+    # _sample_negative_binomial: per-element (k, p) draws
+    k = nd.array(np.array([1.0, 20.0], np.float32))
+    p = nd.array(np.array([0.5, 0.5], np.float32))
+    draws = nd._sample_negative_binomial(k, p, shape=(500,))
+    assert draws.shape == (2, 500)
+    m = draws.asnumpy().mean(axis=1)
+    # NB mean = k(1-p)/p = [1, 20]
+    assert abs(m[0] - 1.0) < 0.5 and abs(m[1] - 20.0) < 3.0
+    # _sample_generalized_negative_binomial: alpha=0 row is Poisson(mu)
+    mu = nd.array(np.array([4.0, 4.0], np.float32))
+    alpha = nd.array(np.array([0.0, 0.5], np.float32))
+    g = nd._sample_generalized_negative_binomial(mu, alpha, shape=(500,))
+    gm = g.asnumpy()
+    assert abs(gm[0].mean() - 4.0) < 1.0
+    assert gm[1].var() > gm[0].var()  # overdispersed when alpha > 0
+    # choose/fill_element_0index
+    lhs = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    rhs = nd.array(np.array([2, 0], np.float32))
+    picked = nd.choose_element_0index(lhs, rhs)
+    np.testing.assert_array_equal(picked.asnumpy(), [2.0, 3.0])
+    mhs = nd.array(np.array([-1.0, -2.0], np.float32))
+    filled = nd.fill_element_0index(lhs, mhs, rhs)
+    expect = np.arange(6, dtype=np.float32).reshape(2, 3)
+    expect[0, 2] = -1.0
+    expect[1, 0] = -2.0
+    np.testing.assert_array_equal(filled.asnumpy(), expect)
+    for name in ["_sample_negative_binomial",
+                 "_sample_generalized_negative_binomial",
+                 "choose_element_0index", "fill_element_0index"]:
+        assert hasattr(nd, name), name
