@@ -49,11 +49,19 @@ class SparseLinear:
         loss = -jnp.mean(jnp.take_along_axis(logp, yi[:, None], axis=1))
         prob = jax.nn.softmax(scores._data, axis=-1)
         dscore = (prob - jax.nn.one_hot(yi, self.num_classes)) / n
-        xd = x.todense()._data if isinstance(x, CSRNDArray) else x._data
-        wgrad_dense = xd.T @ dscore
+        if isinstance(x, CSRNDArray):
+            # csr^T . dense via the segment-sum kernel — never densifies x
+            wgrad_dense = sparse_dot(x, NDArray(dscore),
+                                     transpose_a=True)._data
+            # explicit stored zeros carry no gradient: keep the touched set
+            # identical to the dense branch's nonzero-column test
+            nz = np.asarray(x._values) != 0
+            touched = np.unique(np.asarray(x._indices)[nz])
+        else:
+            wgrad_dense = x._data.T @ dscore
+            touched = np.nonzero(np.asarray(jnp.any(x._data != 0, axis=0)))[0]
         bgrad = jnp.sum(dscore, axis=0)
-        # only rows with any non-zero feature received gradient -> row_sparse
-        touched = np.nonzero(np.asarray(jnp.any(xd != 0, axis=0)))[0]
+        # only feature rows present in the batch received gradient
         wgrad = RowSparseNDArray(jnp.asarray(touched, dtype=jnp.int32),
                                  wgrad_dense[touched],
                                  wgrad_dense.shape)

@@ -223,12 +223,14 @@ def zeros(stype, shape, ctx=None, dtype=None):
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     """Sparse-aware dot (parity: dot-inl.h sparse kernels)."""
     if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray):
-        if transpose_a:
-            out = lhs.todense()._data.T @ rhs._data
-            return NDArray(out, ctx=rhs._ctx)
+        if transpose_b:
+            raise NotImplementedError(
+                "dot(csr, dense, transpose_b=True) is not supported; "
+                "transpose the dense operand first")
         out = _registry.get("_csr_dot_dense").fn(
             lhs._indptr, lhs._indices, lhs._values, rhs._data,
-            num_rows=lhs.shape[0])
+            num_rows=lhs.shape[0], num_cols=lhs.shape[1],
+            transpose_lhs=transpose_a)
         return NDArray(out, ctx=rhs._ctx)
     if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
         from . import dot as _dense_dot

@@ -62,20 +62,32 @@ def sparse_retain(indices, values, new_idx):
 
 
 @register("_csr_dot_dense")
-def csr_dot_dense(indptr, indices, values, rhs, num_rows=0, transpose_lhs=False):
-    """dot(csr, dense) via segment-sum (parity: dot-inl.h csr kernels)."""
+def csr_dot_dense(indptr, indices, values, rhs, num_rows=0, num_cols=0,
+                  transpose_lhs=False):
+    """dot(csr, dense) / dot(csr^T, dense) via segment-sum over nnz
+    (parity: dot-inl.h csr kernels; the transposed form is the gradient
+    path of sparse linear models)."""
     nnz = values.shape[0]
     rows = jnp.searchsorted(indptr.astype(jnp.int32),
                             jnp.arange(nnz, dtype=jnp.int32), side="right") - 1
     cols = indices.astype(jnp.int32)
+    matvec = rhs.ndim == 1
+    if matvec:
+        rhs = rhs[:, None]
     if transpose_lhs:
-        # out[c, :] += v * rhs[r, :]
+        if int(num_cols) <= 0:
+            raise ValueError(
+                "csr_dot_dense(transpose_lhs=True) needs num_cols (the "
+                "csr's column count) to size the output")
+        # out[c, :] = sum_{nnz with col c} v * rhs[row, :]
         contrib = values[:, None] * rhs[rows]
-        out = jnp.zeros((rhs.shape[1] if rhs.ndim > 1 else 1,), dtype=values.dtype)
-        ncols_out = int(jnp.max(cols)) + 1 if nnz else 0
-        raise NotImplementedError("use dense fallback for csr^T dot")
-    contrib = values[:, None] * rhs[cols]
-    out = jax.ops.segment_sum(contrib, rows, num_segments=int(num_rows))
+        out = jax.ops.segment_sum(contrib, cols, num_segments=int(num_cols))
+    else:
+        # out[r, :] = sum_{nnz in row r} v * rhs[col, :]
+        contrib = values[:, None] * rhs[cols]
+        out = jax.ops.segment_sum(contrib, rows, num_segments=int(num_rows))
+    if matvec:
+        out = out[:, 0]
     return out.astype(rhs.dtype)
 
 

@@ -23,6 +23,73 @@ jax.config.update("jax_platforms", "cpu")
 # matmuls out of the correctness suite (bench keeps the fast default)
 jax.config.update("jax_default_matmul_precision", "highest")
 
+# persistent XLA compile cache: shared across xdist workers and runs, so the
+# fast tier pays each conv-net compile once per machine, not once per worker
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("MXTPU_TEST_CACHE",
+                                 "/tmp/mxtpu_xla_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+
+# Two-tier suite (reference pattern: tests/python/unittest vs tests/nightly):
+# `pytest -m "not slow"` is the fast tier (<120 s, every subsystem);
+# the slow tier holds multiprocess/subprocess and example-smoke tests.
+_SLOW_FILES = {
+    "test_examples.py",       # subprocess example smokes
+    "test_kvstore_dist.py",   # multiprocess dist kvstore
+    "test_env_vars.py",       # subprocess per-env-var reimports
+    "test_recovery.py",       # kill/resume subprocess drills
+}
+
+# Individual compile-heavy tests (>~30 s on the 8-worker CPU tier). Every
+# subsystem they cover retains at least one light test in the fast tier.
+_SLOW_TESTS = {
+    "test_psroi_pooling", "test_deformable_psroi_grad",
+    "test_deformable_convolution_grad",
+    "test_ssd_end_to_end",
+    "test_multichip_dryrun_entry",
+    "test_model_zoo_all_families_forward", "test_model_zoo_constructs",
+    "test_transformer_moe_ep_trains", "test_transformer_dp_tp_sp_trains",
+    "test_transformer_sharded_matches_single_device",
+    "test_gpipe_grads_match",
+    "test_symbolic_cell_stack_trains_via_module",
+    "test_bucketing_lstm_lm_converges", "test_bucketing_module_mesh",
+    "test_tensorboard_callback",
+    "test_multisample_nb_draws",
+    "test_transformer_uses_flash", "test_flash_gradients_match_reference",
+    "test_quantized_model_binds_via_module",
+    "test_module_mesh_fit_converges",
+    "test_trainstep_sharded_optimizer_states_match_replicated",
+    "test_random_moments",
+    "test_notebook_callbacks_log_training",
+    "test_export_model_zoo_resnet",
+    "test_module_mesh_matches_single_device",
+    "test_custom_op_trains_inside_module",
+    "test_model_zoo_get_model",
+}
+
+# fused-optimizer equality: sgd stays in the fast tier as the smoke for the
+# TrainStep fusion path; the other 16 rules are slow-tier (~35 s each)
+_SLOW_PARAMS = {
+    "test_fused_matches_eager": lambda param: param != "sgd",
+    "test_flash_matches_reference": lambda param: param.endswith("True"),
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multiprocess/subprocess/example/compile-heavy "
+        "tests (excluded from the fast tier; run with -m slow)")
+
+
+def pytest_collection_modifyitems(items):
+    for item in items:
+        base, _, param = item.name.partition("[")
+        if item.path.name in _SLOW_FILES or base in _SLOW_TESTS:
+            item.add_marker(pytest.mark.slow)
+        elif base in _SLOW_PARAMS and _SLOW_PARAMS[base](param.rstrip("]")):
+            item.add_marker(pytest.mark.slow)
+
 
 @pytest.fixture(autouse=True)
 def _seed_all():

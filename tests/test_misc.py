@@ -164,3 +164,30 @@ def test_notebook_callbacks_log_training():
     assert len(curve.train_series) > 0
     fig = curve.figure()
     assert fig is not None
+
+
+def test_notebook_callbacks_unit():
+    """Fast-tier notebook coverage: callbacks fed synthetic BatchEndParams
+    (the fit-integrated version is slow-tier)."""
+    import collections
+    from mxnet_tpu.notebook.callback import (PandasLogger, LiveLearningCurve,
+                                             args_wrapper)
+    import mxnet_tpu as mx
+    Param = collections.namedtuple("Param", ["epoch", "nbatch", "eval_metric"])
+    m = mx.metric.Accuracy()
+    m.update([mx.nd.array(np.array([1.0], np.float32))],
+             [mx.nd.array(np.array([[0.1, 0.9]], np.float32))])
+    logger = PandasLogger(batch_size=4, frequent=1)
+    curve = LiveLearningCurve(metric_name="accuracy", frequent=1)
+    for i in range(3):
+        p = Param(epoch=0, nbatch=i, eval_metric=m)
+        logger.train_cb(p)
+        curve.train_cb(p)
+    logger.eval_cb(Param(epoch=0, nbatch=0, eval_metric=m))
+    curve.eval_cb(Param(epoch=0, nbatch=0, eval_metric=m))
+    logger.epoch_cb()
+    assert len(logger.train_df) == 3 and len(logger.eval_df) == 1
+    assert list(logger.train_df["accuracy"]) == [1.0] * 3
+    assert len(curve.train_series) == 3 and len(curve.eval_series) == 1
+    assert set(args_wrapper(logger, curve)) == {
+        "batch_end_callback", "eval_end_callback", "epoch_end_callback"}

@@ -112,3 +112,22 @@ def test_bucketing_module_mesh():
         lab = b.label[0].asnumpy().reshape(-1).astype(int)
         losses.append(-np.log(out[np.arange(len(lab)), lab] + 1e-8).mean())
     assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_module_mesh_smoke_one_step():
+    """Fast-tier mesh coverage: one fit step of a tiny MLP under dp=8
+    (the convergence + equality versions are slow-tier)."""
+    import jax as _jax
+    mesh = build_mesh({"dp": 8}, _jax.devices()[:8])
+    X = np.random.RandomState(0).rand(32, 16).astype(np.float32)
+    y = (X.sum(axis=1) > 8).astype(np.float32)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu(), mesh=mesh)
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod.fit(it, optimizer="sgd", initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.1}, num_epoch=1)
+    assert mod.score(it, "acc")[0][1] >= 0.0  # ran end to end

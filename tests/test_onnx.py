@@ -1,0 +1,241 @@
+"""ONNX import tests: fixtures are hand-encoded with the wire codec (no
+onnx package in this env), then imported and executed; expected values come
+from imperative nd ops with the same parameters, so what's under test is
+the graph translation itself (parity: reference
+tests/python-pytest/onnx/import/ suite's role)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib.onnx import import_model, get_model_metadata
+from mxnet_tpu.contrib.onnx import wire
+
+
+# -- fixture building (onnx.proto3 field numbers) ---------------------------
+
+def t_proto(name, arr):
+    arr = np.asarray(arr)
+    code = {np.dtype(np.float32): 1, np.dtype(np.int64): 7}[arr.dtype]
+    return (wire.packed_varints(1, list(arr.shape)) +
+            wire.field_varint(2, code) +
+            wire.field_bytes(8, name) +
+            wire.field_bytes(9, arr.tobytes()))
+
+
+def attr_proto(name, value):
+    out = wire.field_bytes(1, name)
+    if isinstance(value, float):
+        return out + wire.field_fixed32(2, value) + wire.field_varint(20, 1)
+    if isinstance(value, int):
+        return out + wire.field_varint(3, value) + wire.field_varint(20, 2)
+    if isinstance(value, (list, tuple)):
+        return out + wire.packed_varints(8, list(value)) + \
+            wire.field_varint(20, 7)
+    raise TypeError(value)
+
+
+def node_proto(op_type, inputs, outputs, **attrs):
+    out = b"".join(wire.field_bytes(1, i) for i in inputs)
+    out += b"".join(wire.field_bytes(2, o) for o in outputs)
+    out += wire.field_bytes(4, op_type)
+    out += b"".join(wire.field_bytes(5, attr_proto(k, v))
+                    for k, v in attrs.items())
+    return out
+
+
+def vinfo_proto(name, shape):
+    dims = b"".join(wire.field_bytes(1, wire.field_varint(1, d))
+                    for d in shape)
+    tensor = wire.field_varint(1, 1) + wire.field_bytes(2, dims)
+    return wire.field_bytes(1, name) + \
+        wire.field_bytes(2, wire.field_bytes(1, tensor))
+
+
+def model_proto(nodes, initializers, inputs, outputs, opset=13):
+    graph = b"".join(wire.field_bytes(1, n) for n in nodes)
+    graph += b"".join(wire.field_bytes(5, t_proto(k, v))
+                      for k, v in initializers.items())
+    graph += b"".join(wire.field_bytes(11, vinfo_proto(n, s))
+                      for n, s in inputs)
+    graph += b"".join(wire.field_bytes(12, vinfo_proto(n, s))
+                      for n, s in outputs)
+    opset_msg = wire.field_bytes(1, "") + wire.field_varint(2, opset)
+    return (wire.field_varint(1, 8) + wire.field_bytes(7, graph) +
+            wire.field_bytes(8, opset_msg))
+
+
+def _write(tmp_path, blob):
+    p = tmp_path / "model.onnx"
+    p.write_bytes(blob)
+    return str(p)
+
+
+def _run(sym, arg_params, aux_params, **inputs):
+    ex = sym.bind(mx.cpu(),
+                  {**{k: mx.nd.array(v) for k, v in inputs.items()},
+                   **arg_params},
+                  aux_states=aux_params)
+    return [o.asnumpy() for o in ex.forward(is_train=False)]
+
+
+# -- tests ------------------------------------------------------------------
+
+def test_import_mlp_gemm_softmax(tmp_path):
+    rng = np.random.RandomState(0)
+    w = rng.randn(5, 8).astype(np.float32)   # Gemm transB=1: (out, in)
+    b = rng.randn(5).astype(np.float32)
+    blob = model_proto(
+        nodes=[node_proto("Flatten", ["x"], ["flat"]),
+               node_proto("Gemm", ["flat", "w", "b"], ["fc"], transB=1),
+               node_proto("Softmax", ["fc"], ["y"], axis=-1)],
+        initializers={"w": w, "b": b},
+        inputs=[("x", (2, 8)), ("w", (5, 8)), ("b", (5,))],
+        outputs=[("y", (2, 5))])
+    sym, args, auxs = import_model(_write(tmp_path, blob))
+    assert sorted(sym.list_arguments()) == ["b", "w", "x"]
+    x = np.random.RandomState(1).randn(2, 8).astype(np.float32)
+    out = _run(sym, args, auxs, x=x)[0]
+    z = x @ w.T + b
+    expect = np.exp(z - z.max(-1, keepdims=True))
+    expect /= expect.sum(-1, keepdims=True)
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_import_gemm_untransposed_and_alpha_beta(tmp_path):
+    rng = np.random.RandomState(2)
+    w = rng.randn(8, 5).astype(np.float32)   # transB=0: (in, out)
+    b = rng.randn(5).astype(np.float32)
+    blob = model_proto(
+        nodes=[node_proto("Gemm", ["x", "w", "b"], ["y"],
+                          alpha=2.0, beta=0.5)],
+        initializers={"w": w, "b": b},
+        inputs=[("x", (3, 8))], outputs=[("y", (3, 5))])
+    sym, args, auxs = import_model(_write(tmp_path, blob))
+    x = rng.randn(3, 8).astype(np.float32)
+    out = _run(sym, args, auxs, x=x)[0]
+    np.testing.assert_allclose(out, 2.0 * (x @ w) + 0.5 * b, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_import_resnet_block(tmp_path):
+    """Conv-BN-Relu x2 with identity skip, global pool, FC — the model-zoo
+    residual unit shape."""
+    rng = np.random.RandomState(3)
+    C = 4
+    conv0_w = (rng.randn(C, 3, 3, 3) * 0.2).astype(np.float32)
+    conv1_w = (rng.randn(C, C, 3, 3) * 0.2).astype(np.float32)
+    conv2_w = (rng.randn(C, C, 3, 3) * 0.2).astype(np.float32)
+    gamma = rng.rand(C).astype(np.float32) + 0.5
+    beta = rng.randn(C).astype(np.float32)
+    mean = rng.randn(C).astype(np.float32) * 0.1
+    var = rng.rand(C).astype(np.float32) + 0.5
+    fc_w = rng.randn(10, C).astype(np.float32)
+    fc_b = rng.randn(10).astype(np.float32)
+    inits = {"c0w": conv0_w, "c1w": conv1_w, "c2w": conv2_w,
+             "g": gamma, "be": beta, "mu": mean, "va": var,
+             "fw": fc_w, "fb": fc_b}
+    blob = model_proto(
+        nodes=[
+            node_proto("Conv", ["x", "c0w"], ["t0"], kernel_shape=[3, 3],
+                       pads=[1, 1, 1, 1]),
+            node_proto("Relu", ["t0"], ["r0"]),
+            node_proto("Conv", ["r0", "c1w"], ["t1"], kernel_shape=[3, 3],
+                       pads=[1, 1, 1, 1]),
+            node_proto("BatchNormalization",
+                       ["t1", "g", "be", "mu", "va"], ["bn1"],
+                       epsilon=1e-5),
+            node_proto("Relu", ["bn1"], ["r1"]),
+            node_proto("Conv", ["r1", "c2w"], ["t2"], kernel_shape=[3, 3],
+                       pads=[1, 1, 1, 1]),
+            node_proto("Add", ["t2", "r0"], ["sum"]),
+            node_proto("Relu", ["sum"], ["r2"]),
+            node_proto("GlobalAveragePool", ["r2"], ["gap"]),
+            node_proto("Flatten", ["gap"], ["flat"]),
+            node_proto("Gemm", ["flat", "fw", "fb"], ["y"], transB=1),
+        ],
+        initializers=inits,
+        inputs=[("x", (2, 3, 8, 8))], outputs=[("y", (2, 10))])
+    sym, args, auxs = import_model(_write(tmp_path, blob))
+    # BN stats must land in aux, everything else in args
+    assert sorted(auxs) == ["mu", "va"]
+    assert set(args) == {"c0w", "c1w", "c2w", "g", "be", "fw", "fb"}
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    out = _run(sym, args, auxs, x=x)[0]
+
+    # imperative reference with the same params
+    def conv(d, w):
+        return mx.nd.Convolution(mx.nd.array(d), mx.nd.array(w),
+                                 kernel=(3, 3), pad=(1, 1), no_bias=True,
+                                 num_filter=w.shape[0]).asnumpy()
+    r0 = np.maximum(conv(x, conv0_w), 0)
+    t1 = conv(r0, conv1_w)
+    bn1 = gamma.reshape(1, -1, 1, 1) * (
+        t1 - mean.reshape(1, -1, 1, 1)) / np.sqrt(
+        var.reshape(1, -1, 1, 1) + 1e-5) + beta.reshape(1, -1, 1, 1)
+    r1 = np.maximum(bn1, 0)
+    r2 = np.maximum(conv(r1, conv2_w) + r0, 0)
+    gap = r2.mean(axis=(2, 3))
+    expect = gap @ fc_w.T + fc_b
+    np.testing.assert_allclose(out, expect, rtol=1e-3, atol=1e-4)
+
+
+def test_import_pool_concat_reshape_mul(tmp_path):
+    rng = np.random.RandomState(4)
+    scale = rng.rand(1, 2, 1, 1).astype(np.float32)
+    shape_t = np.array([2, -1], np.int64)
+    blob = model_proto(
+        nodes=[
+            node_proto("MaxPool", ["x"], ["mp"], kernel_shape=[2, 2],
+                       strides=[2, 2]),
+            node_proto("AveragePool", ["x"], ["ap"], kernel_shape=[2, 2],
+                       strides=[2, 2]),
+            node_proto("Concat", ["mp", "ap"], ["cat"], axis=1),
+            node_proto("Mul", ["cat", "s"], ["m"]),
+            node_proto("Reshape", ["m", "shp"], ["y"]),
+        ],
+        initializers={"s": scale, "shp": shape_t},
+        inputs=[("x", (2, 1, 4, 4))], outputs=[("y", (2, 8))])
+    sym, args, auxs = import_model(_write(tmp_path, blob))
+    x = rng.randn(2, 1, 4, 4).astype(np.float32)
+    out = _run(sym, args, auxs, x=x)[0]
+    mp = x.reshape(2, 1, 2, 2, 2, 2).max(axis=(3, 5))
+    ap = x.reshape(2, 1, 2, 2, 2, 2).mean(axis=(3, 5))
+    expect = (np.concatenate([mp, ap], axis=1) * scale).reshape(2, -1)
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_metadata_and_unsupported_op(tmp_path):
+    blob = model_proto(
+        nodes=[node_proto("NotARealOp", ["x"], ["y"])],
+        initializers={}, inputs=[("x", (1, 3))], outputs=[("y", (1, 3))])
+    path = _write(tmp_path, blob)
+    meta = get_model_metadata(path)
+    assert meta["input_tensor_data"] == [("x", (1, 3))]
+    with pytest.raises(NotImplementedError, match="NotARealOp"):
+        import_model(path)
+
+
+def test_shared_gemm_weight_not_corrupted(tmp_path):
+    # one initializer feeding two Gemm nodes with different transB must not
+    # be double-transformed
+    rng = np.random.RandomState(8)
+    w = rng.randn(6, 6).astype(np.float32)
+    blob = model_proto(
+        nodes=[node_proto("Gemm", ["x", "w"], ["a"], transB=1),
+               node_proto("Gemm", ["a", "w"], ["y"], transB=0)],
+        initializers={"w": w},
+        inputs=[("x", (2, 6))], outputs=[("y", (2, 6))])
+    sym, args, auxs = import_model(_write(tmp_path, blob))
+    x = rng.randn(2, 6).astype(np.float32)
+    out = _run(sym, args, auxs, x=x)[0]
+    np.testing.assert_allclose(out, (x @ w.T) @ w, rtol=1e-4, atol=1e-5)
+
+
+def test_unsupported_geometry_raises(tmp_path):
+    blob = model_proto(
+        nodes=[node_proto("MaxPool", ["x"], ["y"], kernel_shape=[2, 2],
+                          ceil_mode=1)],
+        initializers={}, inputs=[("x", (1, 1, 4, 4))],
+        outputs=[("y", (1, 1, 2, 2))])
+    with pytest.raises(NotImplementedError, match="ceil_mode"):
+        import_model(_write(tmp_path, blob))

@@ -208,7 +208,7 @@ def test_scatter_and_identity_attr_ops():
         a.asnumpy())
 
 
-def test_multisample_nb_and_legacy_0index_ops():
+def test_multisample_nb_draws():
     # _sample_negative_binomial: per-element (k, p) draws
     k = nd.array(np.array([1.0, 20.0], np.float32))
     p = nd.array(np.array([0.5, 0.5], np.float32))
@@ -224,7 +224,12 @@ def test_multisample_nb_and_legacy_0index_ops():
     gm = g.asnumpy()
     assert abs(gm[0].mean() - 4.0) < 1.0
     assert gm[1].var() > gm[0].var()  # overdispersed when alpha > 0
-    # choose/fill_element_0index
+    for name in ["_sample_negative_binomial",
+                 "_sample_generalized_negative_binomial"]:
+        assert hasattr(nd, name), name
+
+
+def test_legacy_0index_ops():
     lhs = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
     rhs = nd.array(np.array([2, 0], np.float32))
     picked = nd.choose_element_0index(lhs, rhs)
@@ -235,7 +240,5 @@ def test_multisample_nb_and_legacy_0index_ops():
     expect[0, 2] = -1.0
     expect[1, 0] = -2.0
     np.testing.assert_array_equal(filled.asnumpy(), expect)
-    for name in ["_sample_negative_binomial",
-                 "_sample_generalized_negative_binomial",
-                 "choose_element_0index", "fill_element_0index"]:
+    for name in ["choose_element_0index", "fill_element_0index"]:
         assert hasattr(nd, name), name
