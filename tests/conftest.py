@@ -64,6 +64,7 @@ _SLOW_TESTS = {
     "test_notebook_callbacks_log_training",
     "test_export_model_zoo_resnet",
     "test_module_mesh_matches_single_device",
+    "test_resnetish_dp_tp_matches_single_device",
     "test_custom_op_trains_inside_module",
     "test_model_zoo_get_model",
 }
