@@ -5,7 +5,7 @@
   4. SSD object detection (multibox ops)    -> ssd.SSDLite
   5. Sparse linear classification           -> sparse_linear.SparseLinear
 """
-from .lenet import get_lenet, get_mlp, LeNet
+from .lenet import get_lenet, get_mlp, get_resnetish, LeNet
 from .word_lm import RNNModel
 from .ssd import SSDLite
 from .sparse_linear import SparseLinear

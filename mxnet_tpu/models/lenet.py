@@ -56,3 +56,29 @@ class LeNet(HybridBlock):
         x = self.flatten(x)
         x = self.fc1(x)
         return self.fc2(x)
+
+
+def get_resnetish(classes=10, prefix="rn_"):
+    """Small ResNet-shaped Gluon net (7x7 stride-2 stem, BN, maxpool,
+    stride-2 + stride-1 conv blocks, global pool): the shared fixture for
+    multi-chip sharding equality checks (strided convs + BatchNorm are
+    where GSPMD sharding bugs live). Deferred init: run a (2,3,64,64)
+    batch through it after initialize()."""
+    from ..gluon import nn
+
+    net = nn.HybridSequential(prefix=prefix)
+    with net.name_scope():
+        net.add(nn.Conv2D(8, 7, strides=2, padding=3))
+        net.add(nn.BatchNorm())
+        net.add(nn.Activation("relu"))
+        net.add(nn.MaxPool2D(pool_size=3, strides=2, padding=1))
+        net.add(nn.Conv2D(16, 3, strides=2, padding=1))
+        net.add(nn.BatchNorm())
+        net.add(nn.Activation("relu"))
+        net.add(nn.Conv2D(16, 3, strides=1, padding=1))
+        net.add(nn.BatchNorm())
+        net.add(nn.Activation("relu"))
+        net.add(nn.GlobalAvgPool2D())
+        net.add(nn.Flatten())
+        net.add(nn.Dense(classes))
+    return net

@@ -540,18 +540,7 @@ def test_resnetish_dp_tp_matches_single_device():
     def build():
         mx.random.seed(3)
         np.random.seed(3)
-        r = nn.HybridSequential(prefix="rn_")
-        with r.name_scope():
-            r.add(nn.Conv2D(8, 7, strides=2, padding=3))
-            r.add(nn.BatchNorm())
-            r.add(nn.Activation("relu"))
-            r.add(nn.MaxPool2D(pool_size=3, strides=2, padding=1))
-            r.add(nn.Conv2D(16, 3, strides=2, padding=1))
-            r.add(nn.BatchNorm())
-            r.add(nn.Activation("relu"))
-            r.add(nn.GlobalAvgPool2D())
-            r.add(nn.Flatten())
-            r.add(nn.Dense(10))
+        r = mx.models.get_resnetish()
         r.initialize(mx.init.Xavier())
         r(nd.zeros((2, 3, 64, 64)))
         return r
