@@ -77,6 +77,8 @@ def functionalize(net, train_mode=False):
         with swap_param_buffers(plist, param_values):
             with autograd._RecordingStateScope(False, train_mode), key_scope:
                 out = net.forward(NDArray(x))
+            if isinstance(out, (list, tuple)):
+                return tuple(o._data for o in out)
             return out._data
 
     return apply_fn, names, values
