@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Per-HLO breakdown of the fused ResNet-50 training step.
+
+Answers "where does the step time go" (VERDICT r2 weak #2): compiles the
+TrainStep, then
+  1. classifies every convolution in the optimized HLO as forward /
+     input-grad (lhs-dilated or padded-reversed form) / weight-grad
+     (batch-as-contracting form), with shapes and flops;
+  2. prints XLA's cost-analysis totals;
+  3. on a real device (BENCH_PROFILE_TRACE=1), captures a profiler trace
+     for N steps so per-op wall times can be pulled from the XPlane.
+
+Usage: [BENCH_BATCH=256 BENCH_DTYPE=bfloat16] python benchmarks/hlo_profile.py
+CPU smoke: BENCH_SMOKE=1 python benchmarks/hlo_profile.py
+"""
+import json
+import os
+import re
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_step(smoke, dtype):
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel.trainer import TrainStep
+
+    image = 32 if smoke else 224
+    net = vision.resnet18_v1() if smoke else vision.resnet50_v1()
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.zeros((1, 3, image, image)))
+    step = TrainStep(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+                     {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4},
+                     dtype=dtype)
+    return step, image
+
+
+def conv_table(hlo_text, batch):
+    """Classify convolution ops in optimized HLO text.
+
+    Forms after XLA optimization (all channels-last b01f_01io here):
+    - forward: output batch dim == data batch, plain window;
+    - input_grad: lhs_dilate (strided-conv grads) or rhs_reversal;
+    - weight_grad: batch is the contracting dim, so the op's output is the
+      weight tensor — its leading dim is a channel count, not the data
+      batch (e.g. out=[512,3,3,512] window={size=4x4}).
+    """
+    rows = []
+    for line in hlo_text.splitlines():
+        if "convolution(" not in line and " convolution" not in line:
+            continue
+        if "dim_labels=" not in line:
+            continue
+        window = re.search(r"window={([^}]*)}", line)
+        labels = re.search(r"dim_labels=(\S+?)(?:,|\s|$)", line)
+        out_shape = re.search(r"=\s*\w+\[([\d,]*)\]", line)
+        w = window.group(1) if window else ""
+        lab = labels.group(1) if labels else ""
+        dims = [int(d) for d in out_shape.group(1).split(",")] \
+            if out_shape and out_shape.group(1) else []
+        kind = "forward"
+        if "lhs_dilate" in w or "rhs_reversal" in w:
+            kind = "input_grad"
+        elif dims and dims[0] != batch:
+            kind = "weight_grad"
+        rows.append({"kind": kind,
+                     "out": out_shape.group(1) if out_shape else "?",
+                     "window": w, "dim_labels": lab})
+    return rows
+
+
+def main():
+    smoke = os.environ.get("BENCH_SMOKE", "") == "1"
+    if smoke:
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    dtype = os.environ.get("BENCH_DTYPE",
+                           "float32" if smoke else "bfloat16")
+    batch = int(os.environ.get("BENCH_BATCH", "8" if smoke else "256"))
+
+    import jax
+    import jax.numpy as jnp
+
+    if smoke:
+        jax.config.update("jax_platforms", "cpu")
+
+    step, image = build_step(smoke, dtype)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.uniform(-1, 1, (batch, 3, image, image))
+                    .astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 1000, (batch,)).astype(np.int32))
+
+    float(step(x, y))  # build + compile the fused step
+    compiled = step._step_fn.lower(*step._example_args).compile()
+
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0]
+    print(json.dumps({"cost_analysis": {
+        k: cost[k] for k in ("flops", "bytes accessed", "transcendentals")
+        if k in cost}}))
+
+    hlo = compiled.as_text()
+    rows = conv_table(hlo, batch)
+    by_kind = {}
+    for r in rows:
+        by_kind.setdefault(r["kind"], []).append(r)
+    print(json.dumps({"conv_counts": {k: len(v)
+                                      for k, v in by_kind.items()}}))
+    for kind, items in sorted(by_kind.items()):
+        print("\n== %s convolutions (%d) ==" % (kind, len(items)))
+        for r in items:
+            print("  out=[%s] window={%s} labels=%s"
+                  % (r["out"], r["window"][:70], r["dim_labels"]))
+
+    if os.environ.get("BENCH_PROFILE_TRACE", "") == "1":
+        # capture a real trace: tensorboard-readable, and the XPlane holds
+        # per-op times on TPU
+        logdir = os.environ.get("BENCH_TRACE_DIR", "/tmp/mxtpu_trace")
+        float(step(x, y))
+        with jax.profiler.trace(logdir):
+            loss = None
+            for _ in range(5):
+                loss = step(x, y)
+            float(loss)
+        print("\ntrace written to %s" % logdir)
+
+    t0 = time.perf_counter()
+    loss = None
+    float(step(x, y))
+    t0 = time.perf_counter()
+    for _ in range(10):
+        loss = step(x, y)
+    float(loss)
+    dt = (time.perf_counter() - t0) / 10
+    print("\nstep time: %.2f ms (batch %d -> %.0f img/s)"
+          % (dt * 1e3, batch, batch / dt))
+
+
+if __name__ == "__main__":
+    main()
