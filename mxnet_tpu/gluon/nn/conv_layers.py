@@ -35,10 +35,14 @@ class _Conv(HybridBlock):
         if adj is not None:
             self._kwargs["adj"] = adj
         self._act_type = activation
+        from ...ops.nn import _CHANNELS_LAST
+        self._channels_last = layout in _CHANNELS_LAST
         with self.name_scope():
+            cin = in_channels // groups if in_channels else 0
             if op_name == "Convolution":
-                wshape = (channels, in_channels // groups if in_channels else 0) \
-                    + kernel_size
+                # channels-last stores the weight as (O, *k, I)
+                wshape = (channels,) + kernel_size + (cin,) \
+                    if self._channels_last else (channels, cin) + kernel_size
             else:  # Deconvolution: (in_channels, channels//groups, *k)
                 wshape = (in_channels, channels // groups) + kernel_size \
                     if in_channels else (0, channels // groups) + kernel_size
@@ -53,11 +57,12 @@ class _Conv(HybridBlock):
                 self.bias = None
 
     def _shape_probe(self, x, *args):
-        cin = x.shape[1]
+        cin = x.shape[-1] if self._channels_last else x.shape[1]
         g = self._kwargs["num_group"]
         k = tuple(self._kwargs["kernel"])
         if self._op_name == "Convolution":
-            self.weight.shape = (self._channels, cin // g) + k
+            self.weight.shape = (self._channels,) + k + (cin // g,) \
+                if self._channels_last else (self._channels, cin // g) + k
         else:
             self.weight.shape = (cin, self._channels // g) + k
         self.weight._finish_deferred_init(self.weight.shape)
@@ -153,13 +158,14 @@ class Conv3DTranspose(_Conv):
 
 class _Pooling(HybridBlock):
     def __init__(self, pool_size, strides, padding, ceil_mode, global_pool,
-                 pool_type, count_include_pad=None, **kwargs):
+                 pool_type, count_include_pad=None, layout=None, **kwargs):
         super().__init__(**kwargs)
         if strides is None:
             strides = pool_size
         self._kwargs = {
             "kernel": pool_size, "stride": strides, "pad": padding,
             "global_pool": global_pool, "pool_type": pool_type,
+            "layout": layout,
             "pooling_convention": "full" if ceil_mode else "valid"}
         if count_include_pad is not None:
             self._kwargs["count_include_pad"] = count_include_pad
@@ -176,7 +182,7 @@ class MaxPool1D(_Pooling):
                  ceil_mode=False, **kwargs):
         super().__init__(_pair(pool_size, 1),
                          _pair(strides, 1) if strides is not None else None,
-                         _pair(padding, 1), ceil_mode, False, "max", **kwargs)
+                         _pair(padding, 1), ceil_mode, False, "max", layout=layout, **kwargs)
 
 
 class MaxPool2D(_Pooling):
@@ -184,7 +190,7 @@ class MaxPool2D(_Pooling):
                  layout="NCHW", ceil_mode=False, **kwargs):
         super().__init__(_pair(pool_size, 2),
                          _pair(strides, 2) if strides is not None else None,
-                         _pair(padding, 2), ceil_mode, False, "max", **kwargs)
+                         _pair(padding, 2), ceil_mode, False, "max", layout=layout, **kwargs)
 
 
 class MaxPool3D(_Pooling):
@@ -192,7 +198,7 @@ class MaxPool3D(_Pooling):
                  layout="NCDHW", ceil_mode=False, **kwargs):
         super().__init__(_pair(pool_size, 3),
                          _pair(strides, 3) if strides is not None else None,
-                         _pair(padding, 3), ceil_mode, False, "max", **kwargs)
+                         _pair(padding, 3), ceil_mode, False, "max", layout=layout, **kwargs)
 
 
 class AvgPool1D(_Pooling):
@@ -201,7 +207,7 @@ class AvgPool1D(_Pooling):
         super().__init__(_pair(pool_size, 1),
                          _pair(strides, 1) if strides is not None else None,
                          _pair(padding, 1), ceil_mode, False, "avg",
-                         count_include_pad, **kwargs)
+                         count_include_pad, layout=layout, **kwargs)
 
 
 class AvgPool2D(_Pooling):
@@ -211,7 +217,7 @@ class AvgPool2D(_Pooling):
         super().__init__(_pair(pool_size, 2),
                          _pair(strides, 2) if strides is not None else None,
                          _pair(padding, 2), ceil_mode, False, "avg",
-                         count_include_pad, **kwargs)
+                         count_include_pad, layout=layout, **kwargs)
 
 
 class AvgPool3D(_Pooling):
@@ -221,39 +227,39 @@ class AvgPool3D(_Pooling):
         super().__init__(_pair(pool_size, 3),
                          _pair(strides, 3) if strides is not None else None,
                          _pair(padding, 3), ceil_mode, False, "avg",
-                         count_include_pad, **kwargs)
+                         count_include_pad, layout=layout, **kwargs)
 
 
 class GlobalMaxPool1D(_Pooling):
     def __init__(self, layout="NCW", **kwargs):
-        super().__init__((1,), None, (0,), False, True, "max", **kwargs)
+        super().__init__((1,), None, (0,), False, True, "max", layout=layout, **kwargs)
 
 
 class GlobalMaxPool2D(_Pooling):
     def __init__(self, layout="NCHW", **kwargs):
-        super().__init__((1, 1), None, (0, 0), False, True, "max", **kwargs)
+        super().__init__((1, 1), None, (0, 0), False, True, "max", layout=layout, **kwargs)
 
 
 class GlobalMaxPool3D(_Pooling):
     def __init__(self, layout="NCDHW", **kwargs):
-        super().__init__((1, 1, 1), None, (0, 0, 0), False, True, "max",
+        super().__init__((1, 1, 1), None, (0, 0, 0), False, True, "max", layout=layout,
                          **kwargs)
 
 
 class GlobalAvgPool1D(_Pooling):
     def __init__(self, layout="NCW", **kwargs):
-        super().__init__((1,), None, (0,), False, True, "avg", **kwargs)
+        super().__init__((1,), None, (0,), False, True, "avg", layout=layout, **kwargs)
 
 
 class GlobalAvgPool2D(_Pooling):
     def __init__(self, layout="NCHW", **kwargs):
-        super().__init__((1, 1), None, (0, 0), False, True, "avg", **kwargs)
+        super().__init__((1, 1), None, (0, 0), False, True, "avg", layout=layout, **kwargs)
 
 
 class GlobalAvgPool3D(_Pooling):
     def __init__(self, layout="NCDHW", **kwargs):
         super().__init__((1, 1, 1), None, (0, 0, 0), False, True, "avg",
-                         **kwargs)
+                         layout=layout, **kwargs)
 
 
 class ReflectionPad2D(HybridBlock):

@@ -295,6 +295,23 @@ def _dimnums(nd):
     return ("NCDHW", "OIDHW", "NCDHW")
 
 
+_CHANNELS_LAST = ("NWC", "NHWC", "NDHWC")
+
+
+def _layout_specs(layout, nd):
+    """(lhs_spec, rhs_spec, channel_axis) for a conv/pool layout string.
+
+    Channels-last layouts store the weight as (O, *kernel, I) — the
+    reference's NHWC convention (conv layers docs, convolution-inl.h).
+    """
+    if layout in _CHANNELS_LAST:
+        lhs = {1: "NWC", 2: "NHWC", 3: "NDHWC"}[nd]
+        rhs = {1: "OWI", 2: "OHWI", 3: "ODHWI"}[nd]
+        return lhs, rhs, nd + 1
+    lhs, rhs, _ = _dimnums(nd)
+    return lhs, rhs, 1
+
+
 def _tup(v, nd, default):
     if not v:
         return (default,) * nd
@@ -311,7 +328,9 @@ def Convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     stride = _tup(stride, nd, 1)
     dilate = _tup(dilate, nd, 1)
     pad = _tup(pad, nd, 0)
-    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _dimnums(nd))
+    lhs_spec, rhs_spec, ch_axis = _layout_specs(layout, nd)
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    (lhs_spec, rhs_spec, lhs_spec))
     # bf16 convs accumulate in f32 on the MXU by default; forcing
     # preferred_element_type here breaks the conv transpose rule under AD
     out = lax.conv_general_dilated(
@@ -319,7 +338,9 @@ def Convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
         padding=[(p, p) for p in pad], rhs_dilation=dilate,
         dimension_numbers=dn, feature_group_count=num_group)
     if bias is not None and not no_bias:
-        out = out + bias.reshape((1, -1) + (1,) * nd)
+        bshape = [1] * out.ndim
+        bshape[ch_axis] = -1
+        out = out + bias.reshape(bshape)
     return out
 
 
@@ -329,6 +350,10 @@ def Deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
                   workspace=512, no_bias=True, cudnn_tune=None,
                   cudnn_off=False, layout=None):
     """Transposed conv as an input-dilated conv (XLA-native formulation)."""
+    if layout in _CHANNELS_LAST:
+        raise NotImplementedError(
+            "Deconvolution supports channel-first layouts only; transpose "
+            "the data or use the default NCHW layout")
     nd = _conv_dims(kernel)
     stride = _tup(stride, nd, 1)
     dilate = _tup(dilate, nd, 1)
@@ -365,31 +390,42 @@ def Pooling(data, kernel=(), pool_type="max", global_pool=False,
             cudnn_off=False, pooling_convention="valid", stride=(), pad=(),
             p_value=2, count_include_pad=True, layout=None):
     nd = data.ndim - 2
+    channels_last = layout in _CHANNELS_LAST
+    sp0 = 1 if channels_last else 2  # first spatial axis
     if global_pool:
-        ax = tuple(range(2, data.ndim))
+        ax = tuple(range(sp0, sp0 + nd))
         if pool_type == "max":
             return jnp.max(data, axis=ax, keepdims=True)
         if pool_type in ("avg", "sum"):
             red = jnp.sum(data, axis=ax, keepdims=True)
-            return red / float(np.prod(data.shape[2:])) if pool_type == "avg" else red
+            n = float(np.prod([data.shape[a] for a in ax]))
+            return red / n if pool_type == "avg" else red
         if pool_type == "lp":
             return jnp.power(jnp.sum(jnp.power(jnp.abs(data), p_value),
                                      axis=ax, keepdims=True), 1.0 / p_value)
     kernel = _tup(kernel, nd, 1)
     stride = _tup(stride, nd, 1)
     pad = _tup(pad, nd, 0)
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
-    base_pad = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+    if channels_last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        spatial_pad = [(p, p) for p in pad]
+        base_pad = [(0, 0)] + spatial_pad + [(0, 0)]
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        base_pad = [(0, 0), (0, 0)] + [(p, p) for p in pad]
     if pooling_convention == "full":
         # ceil-mode: add extra right/bottom padding so the last window fits
         extra = []
         for i in range(nd):
-            size = data.shape[2 + i] + 2 * pad[i]
+            size = data.shape[sp0 + i] + 2 * pad[i]
             out = int(np.ceil((size - kernel[i]) / stride[i])) + 1
             need = (out - 1) * stride[i] + kernel[i] - size
             extra.append(max(0, need))
-        base_pad = [(0, 0), (0, 0)] + [(p, p + e) for p, e in zip(pad, extra)]
+        sp = [(p, p + e) for p, e in zip(pad, extra)]
+        base_pad = ([(0, 0)] + sp + [(0, 0)]) if channels_last else \
+            ([(0, 0), (0, 0)] + sp)
     # NB: init values must be Python scalars so JAX recognizes the max/add
     # monoid and dispatches to the differentiable reduce_window variants
     if pool_type == "max":

@@ -1009,3 +1009,67 @@ def test_multi_proposal():
         nd.array(im_info[1:]), rpn_pre_nms_top_n=50, rpn_post_nms_top_n=10,
         feature_stride=16).asnumpy()
     assert_almost_equal(rois[10:, 1:], one[:, 1:], rtol=1e-4, atol=1e-4)
+
+
+def test_convolution_pooling_nhwc_layout():
+    """layout='NHWC' (weights (O,kH,kW,I)) must match the NCHW op on
+    transposed data (parity: convolution-inl.h layout support)."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32)       # OIHW
+    b = rng.randn(4).astype(np.float32)
+    ref = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                         kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                         num_filter=4).asnumpy()
+    w_last = np.transpose(w, (0, 2, 3, 1))             # OHWI
+    out = nd.Convolution(nd.array(np.transpose(x, (0, 2, 3, 1))),
+                         nd.array(w_last), nd.array(b),
+                         kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                         num_filter=4, layout="NHWC").asnumpy()
+    np.testing.assert_allclose(np.transpose(out, (0, 3, 1, 2)), ref,
+                               rtol=1e-4, atol=1e-5)
+    # pooling, incl. ceil-mode convention and global pool
+    for kwargs in ({"pool_type": "max", "kernel": (2, 2), "stride": (2, 2)},
+                   {"pool_type": "avg", "kernel": (3, 3), "stride": (2, 2),
+                    "pooling_convention": "full"},
+                   {"pool_type": "avg", "global_pool": True, "kernel": (1, 1)}):
+        pref = nd.Pooling(nd.array(x), **kwargs).asnumpy()
+        pout = nd.Pooling(nd.array(np.transpose(x, (0, 2, 3, 1))),
+                          layout="NHWC", **kwargs).asnumpy()
+        np.testing.assert_allclose(np.transpose(pout, (0, 3, 1, 2)), pref,
+                                   rtol=1e-5, atol=1e-6, err_msg=str(kwargs))
+
+
+def test_gluon_conv2d_nhwc():
+    from mxnet_tpu.gluon import nn as gnn
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 6, 6, 3).astype(np.float32)       # NHWC input
+    net = gnn.Conv2D(5, 3, padding=1, layout="NHWC")
+    net.initialize(mx.init.Xavier())
+    out = net(nd.array(x))
+    assert out.shape == (2, 6, 6, 5)
+    # weight is (O, kH, kW, I); same weights through the NCHW layer agree
+    wv = net.weight.data().asnumpy()
+    ref = gnn.Conv2D(5, 3, padding=1, in_channels=3)
+    ref.initialize(mx.init.Xavier())
+    ref.weight.set_data(nd.array(np.transpose(wv, (0, 3, 1, 2))))
+    ref.bias.set_data(net.bias.data())
+    out_ref = ref(nd.array(np.transpose(x, (0, 3, 1, 2)))).asnumpy()
+    np.testing.assert_allclose(np.transpose(out.asnumpy(), (0, 3, 1, 2)),
+                               out_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_gluon_pooling_nhwc():
+    from mxnet_tpu.gluon import nn as gnn
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    x_last = np.transpose(x, (0, 2, 3, 1))
+    for ref_layer, nhwc_layer in [
+            (gnn.MaxPool2D(2), gnn.MaxPool2D(2, layout="NHWC")),
+            (gnn.AvgPool2D(3, strides=2, ceil_mode=True),
+             gnn.AvgPool2D(3, strides=2, ceil_mode=True, layout="NHWC")),
+            (gnn.GlobalAvgPool2D(), gnn.GlobalAvgPool2D(layout="NHWC"))]:
+        ref = ref_layer(nd.array(x)).asnumpy()
+        out = nhwc_layer(nd.array(x_last)).asnumpy()
+        np.testing.assert_allclose(np.transpose(out, (0, 3, 1, 2)), ref,
+                                   rtol=1e-5, atol=1e-6)
