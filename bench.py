@@ -102,17 +102,24 @@ def bench_resnet50(smoke, dtype, device_kind):
     batch = int(os.environ.get("BENCH_BATCH", "8" if smoke else "256"))
     steps = int(os.environ.get("BENCH_STEPS", "3" if smoke else "20"))
     image = 32 if smoke else 224
+    layout = os.environ.get("BENCH_LAYOUT", "NCHW")  # layout A/B knob
+    if layout not in ("NCHW", "NHWC"):
+        raise ValueError("BENCH_LAYOUT must be NCHW or NHWC, got %r"
+                         % layout)
 
-    net = vision.resnet18_v1() if smoke else vision.resnet50_v1()
+    make = vision.resnet18_v1 if smoke else vision.resnet50_v1
+    net = make(layout=layout)
     net.initialize(mx.init.Xavier())
-    net(mx.nd.zeros((1, 3, image, image)))
+    shape = (1, image, image, 3) if layout == "NHWC" else (1, 3, image, image)
+    net(mx.nd.zeros(shape))
 
     step = TrainStep(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
                      {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4},
                      dtype=dtype)
     rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.uniform(-1, 1, (batch, 3, image, image))
-                    .astype(np.float32))
+    xshape = (batch, image, image, 3) if layout == "NHWC" \
+        else (batch, 3, image, image)
+    x = jnp.asarray(rng.uniform(-1, 1, xshape).astype(np.float32))
     y = jnp.asarray(rng.randint(0, 1000, (batch,)).astype(np.int32))
     x.block_until_ready()
 
@@ -139,7 +146,7 @@ def bench_resnet50(smoke, dtype, device_kind):
         "value": round(img_s, 2), "unit": "img/s",
         "vs_baseline": 0.0 if smoke else round(img_s / 109.0, 3),
         "batch": batch, "mfu": round(mfu, 4) if mfu is not None else None,
-        "flops_per_step": flops,
+        "flops_per_step": flops, "layout": layout,
     }
 
 

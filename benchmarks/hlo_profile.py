@@ -31,13 +31,19 @@ def build_step(smoke, dtype):
     from mxnet_tpu.parallel.trainer import TrainStep
 
     image = 32 if smoke else 224
-    net = vision.resnet18_v1() if smoke else vision.resnet50_v1()
+    layout = os.environ.get("BENCH_LAYOUT", "NCHW")
+    if layout not in ("NCHW", "NHWC"):
+        raise ValueError("BENCH_LAYOUT must be NCHW or NHWC, got %r"
+                         % layout)
+    make = vision.resnet18_v1 if smoke else vision.resnet50_v1
+    net = make(layout=layout)
     net.initialize(mx.init.Xavier())
-    net(mx.nd.zeros((1, 3, image, image)))
+    shape = (1, image, image, 3) if layout == "NHWC" else (1, 3, image, image)
+    net(mx.nd.zeros(shape))
     step = TrainStep(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
                      {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4},
                      dtype=dtype)
-    return step, image
+    return step, image, layout
 
 
 def conv_table(hlo_text, batch):
@@ -89,10 +95,11 @@ def main():
     if smoke:
         jax.config.update("jax_platforms", "cpu")
 
-    step, image = build_step(smoke, dtype)
+    step, image, layout = build_step(smoke, dtype)
+    xshape = (batch, image, image, 3) if layout == "NHWC" \
+        else (batch, 3, image, image)
     rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.uniform(-1, 1, (batch, 3, image, image))
-                    .astype(np.float32))
+    x = jnp.asarray(rng.uniform(-1, 1, xshape).astype(np.float32))
     y = jnp.asarray(rng.randint(0, 1000, (batch,)).astype(np.int32))
 
     float(step(x, y))  # build + compile the fused step

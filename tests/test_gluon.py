@@ -349,3 +349,33 @@ def test_model_zoo_all_families_forward(name, size):
     net.initialize(mx.init.Xavier())
     out = net(mx.nd.zeros((1, 3, size, size)))
     assert out.shape == (1, 10)
+
+
+def test_resnet_nhwc_matches_nchw():
+    """resnet18_v1(layout='NHWC') == the NCHW net with transposed weights
+    (the TPU layout A/B experiment path)."""
+    from mxnet_tpu.gluon.model_zoo import vision
+    mx.random.seed(0)
+    np.random.seed(0)
+    a = vision.resnet18_v1()
+    a.initialize(mx.init.Xavier())
+    x = np.random.RandomState(1).rand(2, 3, 32, 32).astype(np.float32)
+    out_a = a(nd.array(x)).asnumpy()
+
+    b = vision.resnet18_v1(layout="NHWC")
+    b.initialize(mx.init.Xavier())
+    b(nd.array(np.transpose(x, (0, 2, 3, 1))))  # shape inference
+    pa, pb = a.collect_params(), b.collect_params()
+
+    def stripped(params):  # drop the per-instance resnetv1N_ prefix
+        import re as _re
+        return sorted(_re.sub(r"^resnetv1\d+_", "", k) for k in params)
+
+    assert stripped(pa) == stripped(pb)
+    for (ka, va), (kb, vb) in zip(sorted(pa.items()), sorted(pb.items())):
+        w = va.data().asnumpy()
+        if w.ndim == 4:  # OIHW -> OHWI
+            w = np.transpose(w, (0, 2, 3, 1))
+        vb.set_data(nd.array(w))
+    out_b = b(nd.array(np.transpose(x, (0, 2, 3, 1)))).asnumpy()
+    np.testing.assert_allclose(out_b, out_a, rtol=1e-3, atol=1e-4)
