@@ -31,6 +31,10 @@ import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "benchmarks"))
+from _layout import bench_layout, img_shape  # noqa: E402
+
 # bf16 peak TFLOP/s per chip by device kind (public spec sheets); used only
 # to normalize MFU. Unknown kinds fall back to v5e-class.
 _PEAK_BF16 = {
@@ -102,24 +106,19 @@ def bench_resnet50(smoke, dtype, device_kind):
     batch = int(os.environ.get("BENCH_BATCH", "8" if smoke else "256"))
     steps = int(os.environ.get("BENCH_STEPS", "3" if smoke else "20"))
     image = 32 if smoke else 224
-    layout = os.environ.get("BENCH_LAYOUT", "NCHW")  # layout A/B knob
-    if layout not in ("NCHW", "NHWC"):
-        raise ValueError("BENCH_LAYOUT must be NCHW or NHWC, got %r"
-                         % layout)
+    layout = bench_layout()  # layout A/B knob
 
     make = vision.resnet18_v1 if smoke else vision.resnet50_v1
     net = make(layout=layout)
     net.initialize(mx.init.Xavier())
-    shape = (1, image, image, 3) if layout == "NHWC" else (1, 3, image, image)
-    net(mx.nd.zeros(shape))
+    net(mx.nd.zeros(img_shape(layout, 1, image)))
 
     step = TrainStep(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
                      {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4},
                      dtype=dtype)
     rng = np.random.RandomState(0)
-    xshape = (batch, image, image, 3) if layout == "NHWC" \
-        else (batch, 3, image, image)
-    x = jnp.asarray(rng.uniform(-1, 1, xshape).astype(np.float32))
+    x = jnp.asarray(rng.uniform(-1, 1, img_shape(layout, batch, image))
+                    .astype(np.float32))
     y = jnp.asarray(rng.randint(0, 1000, (batch,)).astype(np.int32))
     x.block_until_ready()
 

@@ -1,0 +1,15 @@
+"""Shared BENCH_LAYOUT handling for bench.py and benchmarks/*."""
+import os
+
+
+def bench_layout():
+    layout = os.environ.get("BENCH_LAYOUT", "NCHW")
+    if layout not in ("NCHW", "NHWC"):
+        raise ValueError("BENCH_LAYOUT must be NCHW or NHWC, got %r"
+                         % layout)
+    return layout
+
+
+def img_shape(layout, n, image, channels=3):
+    return (n, image, image, channels) if layout == "NHWC" \
+        else (n, channels, image, image)

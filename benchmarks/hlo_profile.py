@@ -22,6 +22,8 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _layout import bench_layout, img_shape  # noqa: E402
 
 
 def build_step(smoke, dtype):
@@ -31,15 +33,11 @@ def build_step(smoke, dtype):
     from mxnet_tpu.parallel.trainer import TrainStep
 
     image = 32 if smoke else 224
-    layout = os.environ.get("BENCH_LAYOUT", "NCHW")
-    if layout not in ("NCHW", "NHWC"):
-        raise ValueError("BENCH_LAYOUT must be NCHW or NHWC, got %r"
-                         % layout)
+    layout = bench_layout()
     make = vision.resnet18_v1 if smoke else vision.resnet50_v1
     net = make(layout=layout)
     net.initialize(mx.init.Xavier())
-    shape = (1, image, image, 3) if layout == "NHWC" else (1, 3, image, image)
-    net(mx.nd.zeros(shape))
+    net(mx.nd.zeros(img_shape(layout, 1, image)))
     step = TrainStep(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
                      {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4},
                      dtype=dtype)
@@ -96,10 +94,9 @@ def main():
         jax.config.update("jax_platforms", "cpu")
 
     step, image, layout = build_step(smoke, dtype)
-    xshape = (batch, image, image, 3) if layout == "NHWC" \
-        else (batch, 3, image, image)
     rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.uniform(-1, 1, xshape).astype(np.float32))
+    x = jnp.asarray(rng.uniform(-1, 1, img_shape(layout, batch, image))
+                    .astype(np.float32))
     y = jnp.asarray(rng.randint(0, 1000, (batch,)).astype(np.int32))
 
     float(step(x, y))  # build + compile the fused step

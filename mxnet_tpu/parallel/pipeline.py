@@ -61,7 +61,7 @@ def gpipe_apply(stage_fn, stacked_params, x, n_microbatches, mesh,
     x: (B, ...) batch; split into n_microbatches along axis 0.
     Returns (B, ...) outputs of the last stage.
     """
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     B = x.shape[0]
     assert B % n_microbatches == 0, "batch must divide into microbatches"
@@ -74,6 +74,6 @@ def gpipe_apply(stage_fn, stacked_params, x, n_microbatches, mesh,
         mesh=mesh,
         in_specs=(param_specs, P()),
         out_specs=P(),
-        check_rep=False)
+        check_vma=False)
     out_mb = fn(stacked_params, x_mb)
     return out_mb.reshape((B,) + out_mb.shape[2:])

@@ -89,7 +89,7 @@ def ring_attention_sharded(mesh, q, k, v, axis_name="sp", causal=False,
                            scale=None):
     """Convenience wrapper: shard the sequence axis over `axis_name` of
     `mesh` and run ring attention. q/k/v: [B, H, T, D] global arrays."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     spec = P(None, None, axis_name, None)
 
@@ -97,7 +97,7 @@ def ring_attention_sharded(mesh, q, k, v, axis_name="sp", causal=False,
         functools.partial(ring_attention, axis_name=axis_name, causal=causal,
                           scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_rep=False)
+        check_vma=False)
     return fn(q, k, v)
 
 

@@ -351,25 +351,28 @@ def test_model_zoo_all_families_forward(name, size):
     assert out.shape == (1, 10)
 
 
-def test_resnet_nhwc_matches_nchw():
-    """resnet18_v1(layout='NHWC') == the NCHW net with transposed weights
-    (the TPU layout A/B experiment path)."""
+@pytest.mark.parametrize("ctor", ["resnet18_v1", "resnet50_v1",
+                                  "resnet18_v2"])
+def test_resnet_nhwc_matches_nchw(ctor):
+    """layout='NHWC' == the NCHW net with transposed weights, across the
+    basic/bottleneck x V1/V2 block types (the TPU layout A/B path)."""
     from mxnet_tpu.gluon.model_zoo import vision
+    make = getattr(vision, ctor)
     mx.random.seed(0)
     np.random.seed(0)
-    a = vision.resnet18_v1()
+    a = make()
     a.initialize(mx.init.Xavier())
     x = np.random.RandomState(1).rand(2, 3, 32, 32).astype(np.float32)
     out_a = a(nd.array(x)).asnumpy()
 
-    b = vision.resnet18_v1(layout="NHWC")
+    b = make(layout="NHWC")
     b.initialize(mx.init.Xavier())
     b(nd.array(np.transpose(x, (0, 2, 3, 1))))  # shape inference
     pa, pb = a.collect_params(), b.collect_params()
 
-    def stripped(params):  # drop the per-instance resnetv1N_ prefix
+    def stripped(params):  # drop the per-instance resnetvMN_ prefix
         import re as _re
-        return sorted(_re.sub(r"^resnetv1\d+_", "", k) for k in params)
+        return sorted(_re.sub(r"^resnetv\d+_", "", k) for k in params)
 
     assert stripped(pa) == stripped(pb)
     for (ka, va), (kb, vb) in zip(sorted(pa.items()), sorted(pb.items())):

@@ -38,7 +38,7 @@ def test_shard_batch_and_replicate():
 
 
 def test_collectives_psum_allgather():
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     m = pmesh.build_mesh({"dp": 8})
     x = jnp.arange(8.0)
 
@@ -55,12 +55,12 @@ def test_collectives_psum_allgather():
     # infer — disable it (the value check below proves replication)
     gath = shard_map(lambda v: coll.all_gather(v, "dp"), mesh=m,
                      in_specs=P("dp"), out_specs=P(),
-                     check_rep=False)(x)
+                     check_vma=False)(x)
     assert_almost_equal(np.asarray(gath), np.arange(8.0))
 
 
 def test_ring_permute():
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     m = pmesh.build_mesh({"dp": 8})
     x = jnp.arange(8.0)
     out = shard_map(lambda v: coll.ring_permute(v, "dp", shift=1), mesh=m,
@@ -70,7 +70,7 @@ def test_ring_permute():
 
 
 def test_reduce_scatter():
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     m = pmesh.build_mesh({"dp": 8})
     x = jnp.asarray(rand(8, 8))
     # each device holds one row; psum_scatter leaves device i with element i
