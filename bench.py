@@ -31,9 +31,8 @@ import time
 
 import numpy as np
 
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                "benchmarks"))
-from _layout import bench_layout, img_shape  # noqa: E402
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from benchmarks._layout import bench_layout, img_shape  # noqa: E402
 
 # bf16 peak TFLOP/s per chip by device kind (public spec sheets); used only
 # to normalize MFU. Unknown kinds fall back to v5e-class.

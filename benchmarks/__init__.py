@@ -1,0 +1,1 @@
+"""Benchmark scripts and shared helpers (importable as benchmarks.*)."""

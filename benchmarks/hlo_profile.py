@@ -22,8 +22,7 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from _layout import bench_layout, img_shape  # noqa: E402
+from benchmarks._layout import bench_layout, img_shape  # noqa: E402
 
 
 def build_step(smoke, dtype):

@@ -51,6 +51,7 @@ from . import module
 from . import module as mod
 from . import callback
 from . import monitor
+from . import monitor as mon  # parity: mx.mon alias
 from . import profiler
 from . import visualization
 from . import visualization as viz  # parity: mx.viz

@@ -102,10 +102,12 @@ def _quantize_weight(arr):
     return q, -amax, amax
 
 
-def quantize_model(sym, arg_params, aux_params=None, excluded_sym_names=(),
+def quantize_model(sym, arg_params, aux_params=None,
+                   data_names=("data",), label_names=("softmax_label",),
+                   ctx=None, excluded_sym_names=(),
                    calib_mode="none", calib_data=None,
-                   num_calib_examples=None, quantized_dtype="int8",
-                   ctx=None):
+                   num_calib_examples=None, calib_layer=None,
+                   quantized_dtype="int8", logger=None):
     """Rewrite FullyConnected/Convolution nodes to int8 (parity:
     contrib.quantization.quantize_model).
 
@@ -122,7 +124,14 @@ def quantize_model(sym, arg_params, aux_params=None, excluded_sym_names=(),
             if node.op is not None and node.op.name in QUANTIZABLE and \
                     node.name not in excluded:
                 inp_node, inp_idx = node.inputs[0]
-                node_inputs.append(_output_name(inp_node, inp_idx))
+                name = _output_name(inp_node, inp_idx)
+                # calib_layer: reference's per-tensor calibration filter
+                if calib_layer is not None and not calib_layer(name):
+                    continue
+                node_inputs.append(name)
+        if logger is not None:
+            logger.info("calibrating %d tensors (%s mode)",
+                        len(node_inputs), calib_mode)
         stats = _collect_layer_stats(sym, arg_params, aux_params or {},
                                      calib_data, node_inputs,
                                      num_calib_examples, ctx=ctx)

@@ -63,15 +63,33 @@ class BaseRNNCell(object):
         return ()
 
     def begin_state(self, func=None, batch_size=0, **kwargs):
-        """Initial states as concrete-shape zeros (see module docstring)."""
+        """Initial states.
+
+        With a concrete ``batch_size``: zeros of the full shape (eager).
+        With ``batch_size=0`` (the reference's symbolic default): aux
+        Variables carrying a batch-deferred shape hint — shape inference
+        resolves the 0 dim from the bound data batch, and the executor
+        zero-fills unprovided aux states (parity: rnn_cell.py begin_state
+        with symbol.zeros' 0-as-unknown shapes)."""
         assert not self._modified, \
             "After applying modifier cells the base cell cannot be called "\
             "directly. Call the modifier cell instead."
-        assert batch_size > 0, (
-            "begin_state needs a concrete batch_size (eager shape "
-            "inference — see module docstring)")
-        func = func or S.zeros
         states = []
+        if batch_size == 0:
+            if func is not None:
+                raise ValueError(
+                    "begin_state(func=...) needs a concrete batch_size; "
+                    "with batch_size=0 states are deferred zero aux vars")
+            for info in self.state_info:
+                self._init_counter += 1
+                v = S.Variable("%sbegin_state_%d" % (self._prefix,
+                                                     self._init_counter),
+                               shape=tuple(info["shape"]),
+                               attr={"__init__": "zeros"})
+                v._outputs[0][0].is_aux = True
+                states.append(v)
+            return states
+        func = func or S.zeros
         for info in self.state_info:
             self._init_counter += 1
             shape = tuple(batch_size if d == 0 else d

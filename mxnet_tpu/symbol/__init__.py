@@ -298,6 +298,37 @@ class Symbol:
             return None, None, None
 
     def _infer_shape_impl(self, known, partial=False):
+        # mxnet convention: 0 in a variable's shape hint = unknown dim
+        # (RNN begin states, reference rnn_cell.py symbol.zeros shapes).
+        # Forward eval_shape inference can't solve for it, so try batch
+        # candidates drawn from the known shapes — data-named entries'
+        # leading dim first — until the whole graph checks out.
+        has_unknown = any(
+            n.op is None and n.name not in known and
+            n.shape_hint is not None and 0 in tuple(n.shape_hint)
+            for n in self._topo())
+        if not has_unknown:
+            return self._infer_shape_once(known, partial, None)
+        candidates = []
+        ordered = sorted(known.items(),
+                         key=lambda kv: 0 if "data" in kv[0] else 1)
+        for name, shp in ordered:
+            for d in (shp or ()):
+                if d and d not in candidates:
+                    candidates.append(d)
+        last_err = None
+        for guess in candidates or [None]:
+            try:
+                return self._infer_shape_once(known, partial, guess)
+            except Exception as e:  # wrong guess: try the next dim
+                last_err = e
+        if partial:
+            return None, None, None
+        raise MXNetError(
+            "could not resolve deferred (0) dims from the provided shapes: "
+            "%s" % last_err)
+
+    def _infer_shape_once(self, known, partial, batch_guess):
         import jax
 
         shapes = {}   # node id -> tuple of ShapeDtypeStruct per output
@@ -306,6 +337,12 @@ class Symbol:
         for node in order:
             if node.op is None:
                 shp = known.get(node.name, node.shape_hint)
+                if shp is not None and 0 in tuple(shp):
+                    if batch_guess:
+                        shp = tuple(batch_guess if d == 0 else d
+                                    for d in shp)
+                    else:
+                        shp = None
                 if shp is not None:
                     dt = dtype_np(node.dtype_hint)
                     shapes[id(node)] = (jax.ShapeDtypeStruct(tuple(shp), dt),)

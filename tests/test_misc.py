@@ -191,3 +191,14 @@ def test_notebook_callbacks_unit():
     assert len(curve.train_series) == 3 and len(curve.eval_series) == 1
     assert set(args_wrapper(logger, curve)) == {
         "batch_end_callback", "eval_end_callback", "epoch_end_callback"}
+
+
+def test_mon_alias_and_quantize_reference_kwargs():
+    import mxnet_tpu as mx
+    assert mx.mon.Monitor is mx.monitor.Monitor
+    from mxnet_tpu.contrib.quantization import quantize_model
+    import inspect
+    sig = inspect.signature(quantize_model)
+    for kw in ("data_names", "label_names", "ctx", "calib_layer", "logger",
+               "num_calib_examples"):
+        assert kw in sig.parameters, kw

@@ -148,3 +148,59 @@ def test_fused_cell_merge_outputs_false_splits_steps():
     exe = outs[2].simple_bind(mx.cpu(), data=(3, 5))
     o = exe.forward(is_train=False, data=mx.nd.zeros((3, 5)))[0]
     assert o.shape == (3, 6)
+
+
+def test_symbolic_unroll_without_batch_size():
+    """Reference parity: cell.unroll with begin_state=None and no
+    batch_size builds a symbol whose begin states are zero aux vars with
+    batch resolved at bind time (reference rnn_cell.py begin_state)."""
+    import tempfile
+    from mxnet_tpu import rnn as mrnn
+    cell = mrnn.LSTMCell(20, prefix="lstm_")
+    outputs, _ = cell.unroll(5, mx.sym.Variable("data"))
+    sym = mx.sym.Group(outputs)
+    assert sym.list_auxiliary_states() == ["lstm_begin_state_0",
+                                           "lstm_begin_state_1"]
+    mod = mx.mod.Module(sym, data_names=["data"], label_names=None,
+                        context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 5, 8))])
+    mod.init_params(mx.init.Xavier())
+    # begin states zero-filled, resolved to the bound batch
+    _, aux = mod.get_params()
+    assert aux["lstm_begin_state_0"].shape == (2, 20)
+    assert float(np.abs(aux["lstm_begin_state_0"].asnumpy()).sum()) == 0.0
+    # checkpoint helpers round-trip the unrolled net
+    pre = tempfile.mktemp()
+    arg, aux = mod.get_params()
+    mrnn.save_rnn_checkpoint([cell], pre, 1, sym, arg, aux)
+    _, a2, _ = mrnn.load_rnn_checkpoint([cell], pre, 1)
+    assert set(a2) == set(arg)
+
+
+def test_symbolic_unroll_batch_resolution_tnc_and_weight_first():
+    """The deferred begin-state batch dim must resolve correctly even when
+    (a) layout is TNC (batch is dim 1 of data) and (b) a weight shape is
+    passed to infer_shape before data."""
+    from mxnet_tpu import rnn as mrnn
+    cell = mrnn.LSTMCell(16, prefix="l_")
+    outs, _ = cell.unroll(3, mx.sym.Variable("data"), layout="TNC")
+    _, o, _ = mx.sym.Group(outs).infer_shape(data=(3, 2, 5))
+    assert o[0] == (2, 16)
+    cell2 = mrnn.LSTMCell(16, prefix="l2_")
+    outs2, _ = cell2.unroll(3, mx.sym.Variable("data"))
+    _, o2, _ = mx.sym.Group(outs2).infer_shape(l2_i2h_weight=(64, 5),
+                                               data=(2, 3, 5))
+    assert o2[0] == (2, 16)
+
+
+def test_begin_state_func_requires_batch():
+    from mxnet_tpu import rnn as mrnn
+    import mxnet_tpu.symbol as S
+    cell = mrnn.LSTMCell(8, prefix="f_")
+    try:
+        cell.begin_state(func=S.uniform)
+        assert False, "expected ValueError"
+    except ValueError:
+        pass
+    states = cell.begin_state(func=S.ones, batch_size=4)
+    assert len(states) == 2
