@@ -2,3 +2,4 @@
 containers and experimental rnn cells)."""
 from .nn import Concurrent, HybridConcurrent, Identity
 from . import rnn
+from . import data

@@ -1,0 +1,2 @@
+"""Contrib data helpers (parity: gluon/contrib/data/)."""
+from .sampler import IntervalSampler

@@ -1,0 +1,2 @@
+"""Contrib samplers (parity: gluon/contrib/data/sampler.py)."""
+from ...data.sampler import IntervalSampler  # noqa: F401

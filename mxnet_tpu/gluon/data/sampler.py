@@ -20,6 +20,9 @@ class SequentialSampler(Sampler):
         return iter(range(self._length))
 
     def __len__(self):
+        # parity quirk: like the reference contrib sampler, len() reports
+        # the full dataset length even with rollover=False (which yields
+        # only ceil(length/interval) indices)
         return self._length
 
 
@@ -33,6 +36,9 @@ class RandomSampler(Sampler):
         return iter(indices.tolist())
 
     def __len__(self):
+        # parity quirk: like the reference contrib sampler, len() reports
+        # the full dataset length even with rollover=False (which yields
+        # only ceil(length/interval) indices)
         return self._length
 
 
@@ -73,3 +79,28 @@ class BatchSampler(Sampler):
         raise ValueError(
             "last_batch must be one of 'keep', 'discard', or 'rollover', "
             "but got %s" % self._last_batch)
+
+
+class IntervalSampler(Sampler):
+    """Sample i, i+interval, i+2*interval, ... for each offset i (parity:
+    gluon/contrib/data/sampler.py IntervalSampler; rollover=True starts at
+    every offset, False only at 0)."""
+
+    def __init__(self, length, interval, rollover=True):
+        assert interval <= length, (
+            "interval %d must not be larger than length %d"
+            % (interval, length))
+        self._length = length
+        self._interval = interval
+        self._rollover = rollover
+
+    def __iter__(self):
+        for start in range(self._interval if self._rollover else 1):
+            for i in range(start, self._length, self._interval):
+                yield i
+
+    def __len__(self):
+        # parity quirk: like the reference contrib sampler, len() reports
+        # the full dataset length even with rollover=False (which yields
+        # only ceil(length/interval) indices)
+        return self._length

@@ -57,3 +57,82 @@ def test_variational_dropout_cell():
     cell.reset()
     o2, _ = cell.unroll(6, x, merge_outputs=True)
     np.testing.assert_allclose(o1.asnumpy(), o2.asnumpy(), rtol=1e-6)
+
+
+def test_conv_rnn_cells():
+    """Conv{1,2,3}D x {RNN,LSTM,GRU} cells: shapes, unroll, gradient flow,
+    and the ConvRNN recurrence against a manual numpy step."""
+    from mxnet_tpu.gluon.contrib import rnn as crnn
+    from mxnet_tpu import autograd
+
+    cell = crnn.Conv2DRNNCell(input_shape=(3, 8, 8), hidden_channels=4,
+                              i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.RandomState(0).rand(2, 3, 8, 8)
+                    .astype(np.float32))
+    states = cell.begin_state(batch_size=2)
+    out, new_states = cell(x, states)
+    assert out.shape == (2, 4, 8, 8)
+    # recurrence check vs direct convolution math
+    from mxnet_tpu import nd as F
+    i2h = F.Convolution(x, cell.i2h_weight.data(), cell.i2h_bias.data(),
+                        kernel=(3, 3), pad=(1, 1), num_filter=4)
+    h2h = F.Convolution(states[0], cell.h2h_weight.data(),
+                        cell.h2h_bias.data(), kernel=(3, 3), pad=(1, 1),
+                        num_filter=4)
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.tanh(i2h.asnumpy() + h2h.asnumpy()),
+                               rtol=1e-4, atol=1e-5)
+
+    lstm = crnn.Conv2DLSTMCell(input_shape=(3, 8, 8), hidden_channels=4,
+                               i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    lstm.initialize(mx.init.Xavier())
+    o2, s2 = lstm(x, lstm.begin_state(batch_size=2))
+    assert o2.shape == (2, 4, 8, 8) and len(s2) == 2
+
+    gru = crnn.Conv1DGRUCell(input_shape=(3, 10), hidden_channels=5,
+                             i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    gru.initialize(mx.init.Xavier())
+    x1 = mx.nd.array(np.random.rand(2, 3, 10).astype(np.float32))
+    o3, _ = gru(x1, gru.begin_state(batch_size=2))
+    assert o3.shape == (2, 5, 10)
+
+    c3 = crnn.Conv3DLSTMCell(input_shape=(2, 4, 4, 4), hidden_channels=3,
+                             i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    c3.initialize(mx.init.Xavier())
+    x3 = mx.nd.array(np.random.rand(1, 2, 4, 4, 4).astype(np.float32))
+    o4, _ = c3(x3, c3.begin_state(batch_size=1))
+    assert o4.shape == (1, 3, 4, 4, 4)
+
+    # unroll + backward through time
+    with autograd.record():
+        outs, _ = cell.unroll(3, mx.nd.array(
+            np.random.rand(2, 3, 3, 8, 8).astype(np.float32)),
+            layout="NTC", merge_outputs=False,
+            begin_state=cell.begin_state(batch_size=2))
+        loss = sum((o * o).sum() for o in outs)
+    loss.backward()
+    g = cell.i2h_weight.grad()
+    assert float(np.abs(g.asnumpy()).sum()) > 0
+
+
+def test_lstmp_cell():
+    from mxnet_tpu.gluon.contrib.rnn import LSTMPCell
+    cell = LSTMPCell(hidden_size=16, projection_size=6)
+    cell.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.rand(3, 10).astype(np.float32))
+    out, states = cell(x, cell.begin_state(batch_size=3))
+    assert out.shape == (3, 6)            # projected
+    assert states[0].shape == (3, 6) and states[1].shape == (3, 16)
+    outs, _ = cell.unroll(4, mx.nd.array(
+        np.random.rand(3, 4, 10).astype(np.float32)), merge_outputs=True)
+    assert outs.shape == (3, 4, 6)
+
+
+def test_interval_sampler():
+    from mxnet_tpu.gluon.contrib.data import IntervalSampler
+    s = list(IntervalSampler(10, 3))
+    assert s == [0, 3, 6, 9, 1, 4, 7, 2, 5, 8]
+    assert len(IntervalSampler(10, 3)) == 10
+    s2 = list(IntervalSampler(10, 3, rollover=False))
+    assert s2 == [0, 3, 6, 9]
