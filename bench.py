@@ -5,8 +5,9 @@ Headline: ResNet-50 training throughput (img/s) on one chip vs the
 reference's published 109 img/s (1x K80, example/image-classification/
 README.md:147-157). Also measured, one JSON line each: LSTM word LM
 (example/rnn/word_lm), transformer LM with vs without the Pallas flash
-attention kernel, SSD forward (example/ssd), and sparse linear
-(example/sparse/linear_classification).
+attention kernel, SSD forward (example/ssd), sparse linear
+(example/sparse/linear_classification), and the native C++ RecordIO+JPEG
+input pipeline (io_pipeline — host-side, accelerator-independent).
 
 Timing methodology (BENCH_NOTES.md): every loop chains iterations through
 a data dependency (donated params feed the next step) and ends with a
@@ -320,11 +321,70 @@ def bench_sparse_linear(smoke, dtype, device_kind):
             "final_loss": round(loss, 4)}
 
 
+def bench_io_pipeline(smoke, dtype, device_kind):
+    """Native C++ RecordIO + JPEG decode/augment pipeline throughput
+    (the input half of the reference's ImageRecordIter benchmark; host-
+    side, so the number is real regardless of accelerator state)."""
+    import io as pyio
+    import tempfile
+    from PIL import Image
+    import mxnet_tpu as mx
+    from mxnet_tpu import native
+
+    if not native.AVAILABLE:
+        return {"metric": "io_pipeline_img_per_sec", "value": None,
+                "unit": "img/s", "error": "native extension not built"}
+    n, side = (64, 64) if smoke else (512, 224)
+    fd, rec = tempfile.mkstemp(suffix=".rec")
+    os.close(fd)
+    it = None
+    try:
+        w = mx.recordio.MXRecordIO(rec, "w")
+        rng = np.random.RandomState(0)
+        jpgs = []
+        for i in range(8):  # 8 distinct images, reused to keep packing fast
+            arr = rng.randint(0, 255, (side, side, 3)).astype(np.uint8)
+            buf = pyio.BytesIO()
+            Image.fromarray(arr).save(buf, format="JPEG", quality=90)
+            jpgs.append(buf.getvalue())
+        for i in range(n):
+            w.write(mx.recordio.pack(
+                mx.recordio.IRHeader(0, float(i % 10), i, 0), jpgs[i % 8]))
+        w.close()
+
+        it = native.NativeImageIter(rec, batch_size=32,
+                                    data_shape=(3, side, side),
+                                    num_threads=0, rand_mirror=True)
+        # warm epoch (thread spin-up), then timed epoch
+        while it.next_batch() is not None:
+            pass
+        it.reset()
+        total = 0
+        t0 = time.perf_counter()
+        while True:
+            out = it.next_batch()
+            if out is None:
+                break
+            total += out[2]
+        dt = time.perf_counter() - t0
+    finally:
+        if it is not None:
+            it.close()
+        try:
+            os.unlink(rec)
+        except OSError:
+            pass
+    return {"metric": "io_pipeline_img_per_sec",
+            "value": round(total / dt, 1), "unit": "img/s",
+            "image": side, "images": total}
+
+
 _CONFIGS = [
     ("lstm_lm", bench_lstm_lm),
     ("transformer_flash", bench_transformer_flash),
     ("ssd_forward", bench_ssd_forward),
     ("sparse_linear", bench_sparse_linear),
+    ("io_pipeline", bench_io_pipeline),
     ("resnet50", bench_resnet50),   # headline LAST: the driver parses the
 ]                                   # final stdout JSON line
 
