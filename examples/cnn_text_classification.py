@@ -1,0 +1,88 @@
+"""CNN sentence classification, Kim 2014 style (parity role:
+example/cnn_text_classification/).
+
+Multi-width 1D convolutions over an embedded token sequence, max-over-time
+pooling, trained on a synthetic keyword-detection task.
+"""
+import argparse
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.contrib.nn import HybridConcurrent
+
+
+def build(vocab, emb=32, widths=(3, 4, 5), filters=16, classes=2):
+    net = nn.HybridSequential(prefix="textcnn_")
+    with net.name_scope():
+        net.add(nn.Embedding(vocab, emb))
+        # NTC -> NCT for Conv1D
+        net.add(nn.HybridLambda(lambda F, x: F.transpose(x, axes=(0, 2, 1))))
+        branches = HybridConcurrent(axis=1)
+        for w in widths:
+            b = nn.HybridSequential()
+            b.add(nn.Conv1D(filters, w, padding=w // 2, activation="relu"))
+            b.add(nn.GlobalMaxPool1D())
+            b.add(nn.Flatten())
+            branches.add(b)
+        net.add(branches)
+        net.add(nn.Dropout(0.3))
+        net.add(nn.Dense(classes))
+    return net
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--vocab", type=int, default=500)
+    ap.add_argument("--seq-len", type=int, default=30)
+    ap.add_argument("--epochs", type=int, default=5)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    n = 2048
+    x = rng.randint(2, args.vocab, (n, args.seq_len))
+    y = rng.randint(0, 2, n)
+    # plant signal: class-1 sentences contain token 1 somewhere
+    for i in range(n):
+        if y[i]:
+            x[i, rng.randint(0, args.seq_len)] = 1
+
+    net = build(args.vocab)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    lossfn = gluon.loss.SoftmaxCrossEntropyLoss()
+    it = mx.io.NDArrayIter(x.astype(np.float32), y.astype(np.float32),
+                           batch_size=128, shuffle=True)
+    for epoch in range(args.epochs):
+        it.reset()
+        total = count = 0.0
+        for batch in it:
+            with autograd.record():
+                loss = lossfn(net(batch.data[0]), batch.label[0]).mean()
+            loss.backward()
+            trainer.step(128)
+            total += float(loss.asnumpy())
+            count += 1
+        print("epoch %d loss %.4f" % (epoch, total / count))
+
+    it.reset()
+    correct = seen = 0
+    for batch in it:
+        pred = net(batch.data[0]).asnumpy().argmax(axis=1)
+        correct += int((pred == batch.label[0].asnumpy()).sum())
+        seen += pred.shape[0]
+    acc = correct / seen
+    print("train accuracy %.3f" % acc)
+    assert acc > 0.8, acc
+
+
+if __name__ == "__main__":
+    main()

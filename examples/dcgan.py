@@ -1,0 +1,104 @@
+"""DCGAN on (synthetic) MNIST (parity role: example/gan/dcgan.py).
+
+Generator: transposed convs from a latent vector to 28x28; discriminator:
+strided convs to a single logit. Demonstrates two Trainers stepping
+adversarially inside autograd.record().
+"""
+import argparse
+import time
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def build_generator(ngf=32):
+    net = nn.HybridSequential(prefix="gen_")
+    with net.name_scope():
+        net.add(nn.Dense(ngf * 2 * 7 * 7, use_bias=False))
+        net.add(nn.BatchNorm())
+        net.add(nn.Activation("relu"))
+        net.add(nn.HybridLambda(lambda F, x: F.Reshape(
+            x, shape=(-1, ngf * 2, 7, 7))))
+        net.add(nn.Conv2DTranspose(ngf, 4, 2, 1, use_bias=False))
+        net.add(nn.BatchNorm())
+        net.add(nn.Activation("relu"))
+        net.add(nn.Conv2DTranspose(1, 4, 2, 1, use_bias=False))
+        net.add(nn.Activation("tanh"))
+    return net
+
+
+def build_discriminator(ndf=32):
+    net = nn.HybridSequential(prefix="disc_")
+    with net.name_scope():
+        net.add(nn.Conv2D(ndf, 4, 2, 1, use_bias=False))
+        net.add(nn.LeakyReLU(0.2))
+        net.add(nn.Conv2D(ndf * 2, 4, 2, 1, use_bias=False))
+        net.add(nn.BatchNorm())
+        net.add(nn.LeakyReLU(0.2))
+        net.add(nn.Flatten())
+        net.add(nn.Dense(1))
+    return net
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--latent", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=2e-4)
+    args = ap.parse_args()
+
+    train, _ = mx.test_utils.get_mnist_iterator(
+        batch_size=args.batch_size, input_shape=(1, 28, 28))
+    gen, disc = build_generator(), build_discriminator()
+    gen.initialize(mx.init.Normal(0.02))
+    disc.initialize(mx.init.Normal(0.02))
+    g_tr = gluon.Trainer(gen.collect_params(), "adam",
+                         {"learning_rate": args.lr, "beta1": 0.5})
+    d_tr = gluon.Trainer(disc.collect_params(), "adam",
+                         {"learning_rate": args.lr, "beta1": 0.5})
+    lossfn = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+
+    ones = mx.nd.ones((args.batch_size,))
+    zeros = mx.nd.zeros((args.batch_size,))
+    it = iter(train)
+    t0 = time.time()
+    for i in range(args.iters):
+        try:
+            batch = next(it)
+        except StopIteration:
+            train.reset()
+            it = iter(train)
+            batch = next(it)
+        real = batch.data[0] * 2.0 - 1.0  # [-1, 1] to match tanh output
+        noise = mx.nd.array(np.random.randn(
+            args.batch_size, args.latent).astype(np.float32))
+        # discriminator step: real -> 1, fake -> 0
+        with autograd.record():
+            fake = gen(noise)
+            d_loss = (lossfn(disc(real), ones) +
+                      lossfn(disc(fake.detach()), zeros)).mean()
+        d_loss.backward()
+        d_tr.step(args.batch_size)
+        # generator step: fool the discriminator
+        with autograd.record():
+            g_loss = lossfn(disc(gen(noise)), ones).mean()
+        g_loss.backward()
+        g_tr.step(args.batch_size)
+        if i % 5 == 0 or i == args.iters - 1:
+            print("iter %3d d_loss %.4f g_loss %.4f (%.1f s)"
+                  % (i, float(d_loss.asnumpy()), float(g_loss.asnumpy()),
+                     time.time() - t0))
+    print("final", float(d_loss.asnumpy()), float(g_loss.asnumpy()))
+
+
+if __name__ == "__main__":
+    main()
