@@ -430,6 +430,16 @@ class HybridBlock(Block):
         from ..utils import serialization
         if isinstance(inputs, str):
             inputs = (inputs,)
+        # Symbols cannot enter the jit cache: trace through plain forward,
+        # temporarily deactivating hybridize() across the whole tree
+        toggled = []
+
+        def _deactivate(b):
+            if getattr(b, "_active", False):
+                b._active = False
+                toggled.append(b)
+
+        self.apply(_deactivate)
         try:
             out = self(*[S.Variable(n) for n in inputs])
         except TypeError as e:
@@ -437,6 +447,9 @@ class HybridBlock(Block):
                 "export could not trace %s with inputs %s — pass the "
                 "block's input names via export(..., inputs=(...)): %s"
                 % (self.name, list(inputs), e)) from None
+        finally:
+            for b in toggled:
+                b._active = True
         if isinstance(out, (list, tuple)):
             out = S.Group(list(out))
         out.save("%s-symbol.json" % path)

@@ -272,6 +272,19 @@ class NDArray:
 
     __rmul__ = __mul__
 
+    def __matmul__(self, other):
+        if not isinstance(other, NDArray):
+            return NotImplemented
+        from . import dot as _dot, batch_dot as _batch_dot
+        if self.ndim == 2 and other.ndim == 2:
+            return _dot(self, other)
+        if self.ndim == 3 and other.ndim == 3:
+            return _batch_dot(self, other)
+        raise TypeError(
+            "@ supports 2-D (dot) and 3-D (batch_dot) operands; got "
+            "%s @ %s — use nd.dot/linalg_gemm2 for other ranks"
+            % (self.shape, other.shape))
+
     def __truediv__(self, other):
         return self._binary(other, "broadcast_div", "_div_scalar")
 

@@ -223,6 +223,11 @@ class Symbol:
             if isinstance(other, Symbol) else \
             create("_rminus_scalar", self, scalar=float(other))
 
+    def __matmul__(self, other):
+        # 2-D contract mirrors NDArray.__matmul__; symbolic shapes are
+        # checked at infer/bind time
+        return create("dot", self, other)
+
     def __mul__(self, other):
         return self._binary(other, "elemwise_mul", "_mul_scalar")
 

@@ -134,3 +134,23 @@ def test_export_rnn_net_exact(tmp_path):
     mod.forward(mx.io.DataBatch(data=[x]), is_train=False)
     np.testing.assert_allclose(mod.get_outputs()[0].asnumpy(), eager,
                                rtol=1e-5, atol=1e-6)
+
+
+def test_export_after_hybridize_roundtrips(tmp_path):
+    """export() must trace symbolically even when the net is hybridized
+    (the jit cache can't take Symbol inputs), and leave hybridization
+    active afterwards."""
+    from mxnet_tpu import gluon
+    pre = str(tmp_path / "hyb")
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4, activation="relu"), gluon.nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    net(nd.zeros((1, 6)))
+    net.export(pre, epoch=0)
+    assert net._active  # still hybridized
+    back = gluon.SymbolBlock.imports(pre + "-symbol.json", ["data"],
+                                     pre + "-0000.params")
+    x = nd.array(np.random.RandomState(0).rand(2, 6).astype(np.float32))
+    np.testing.assert_allclose(back(x).asnumpy(), net(x).asnumpy(),
+                               rtol=1e-5, atol=1e-6)

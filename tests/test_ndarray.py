@@ -189,3 +189,34 @@ def test_random_ops():
     mx.random.seed(7)
     b = nd.random.uniform(shape=(5,)).asnumpy()
     assert_almost_equal(a, b)
+
+
+def test_matmul_operator():
+    a = nd.array(np.random.RandomState(0).rand(3, 4).astype(np.float32))
+    b = nd.array(np.random.RandomState(1).rand(4, 5).astype(np.float32))
+    np.testing.assert_allclose((a @ b).asnumpy(),
+                               a.asnumpy() @ b.asnumpy(), rtol=1e-5)
+
+
+def test_matmul_batch_and_errors():
+    a3 = nd.array(np.random.RandomState(2).rand(2, 3, 4).astype(np.float32))
+    b3 = nd.array(np.random.RandomState(3).rand(2, 4, 5).astype(np.float32))
+    np.testing.assert_allclose((a3 @ b3).asnumpy(),
+                               a3.asnumpy() @ b3.asnumpy(), rtol=1e-5)
+    try:
+        a3 @ nd.array(np.zeros((4, 5), np.float32))
+        assert False, "expected TypeError for mixed ranks"
+    except TypeError:
+        pass
+    try:
+        nd.array(np.zeros((2, 2), np.float32)) @ 2.0
+        assert False, "expected TypeError for scalar rhs"
+    except TypeError:
+        pass
+    # symbolic @ mirrors the eager operator
+    import mxnet_tpu as mx
+    s = mx.sym.Variable("a") @ mx.sym.Variable("b")
+    ex = s.bind(mx.cpu(), {"a": nd.array(np.eye(3, dtype=np.float32)),
+                           "b": nd.array(np.ones((3, 2), np.float32))})
+    out = ex.forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), np.ones((3, 2)), rtol=1e-6)
