@@ -36,6 +36,12 @@ def _box_areas(boxes):
         np.maximum(0, boxes[:, 3] - boxes[:, 1])
 
 
+def _as_np(x):
+    """Augmenters compute on host: coerce NDArray (image or label) to
+    numpy."""
+    return x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+
+
 class DetAugmenter:
     """Base detection augmenter: transforms (image, label) jointly."""
 
@@ -86,8 +92,9 @@ class DetHorizontalFlipAug(DetAugmenter):
         self.p = p
 
     def __call__(self, src, label):
+        label = _as_np(label)
         if random.random() < self.p:
-            arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+            arr = _as_np(src)
             src = NDArray(arr[:, ::-1].copy())
             label = label.copy()
             x1 = label[:, 1].copy()
@@ -158,7 +165,8 @@ class DetRandomCropAug(DetAugmenter):
         return out[keep]
 
     def __call__(self, src, label):
-        arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+        label = _as_np(label)
+        arr = _as_np(src)
         H, W = arr.shape[:2]
         if not self.enabled or H <= 0 or W <= 0:
             return src, label
@@ -208,7 +216,8 @@ class DetRandomPadAug(DetAugmenter):
                         0 < aspect_ratio_range[0] <= aspect_ratio_range[1])
 
     def __call__(self, src, label):
-        arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+        label = _as_np(label)
+        arr = _as_np(src)
         H, W, C = arr.shape
         if not self.enabled or H <= 0 or W <= 0:
             return src, label

@@ -272,18 +272,33 @@ class NDArray:
 
     __rmul__ = __mul__
 
+    def __and__(self, other):
+        return self._binary(other, "broadcast_logical_and",
+                            "_logical_and_scalar")
+
+    __rand__ = __and__
+
+    def __or__(self, other):
+        return self._binary(other, "broadcast_logical_or",
+                            "_logical_or_scalar")
+
+    __ror__ = __or__
+
+    def __xor__(self, other):
+        return self._binary(other, "broadcast_logical_xor",
+                            "_logical_xor_scalar")
+
+    __rxor__ = __xor__
+
+    def __invert__(self):
+        from . import logical_not
+        return logical_not(self)
+
     def __matmul__(self, other):
         if not isinstance(other, NDArray):
             return NotImplemented
-        from . import dot as _dot, batch_dot as _batch_dot
-        if self.ndim == 2 and other.ndim == 2:
-            return _dot(self, other)
-        if self.ndim == 3 and other.ndim == 3:
-            return _batch_dot(self, other)
-        raise TypeError(
-            "@ supports 2-D (dot) and 3-D (batch_dot) operands; got "
-            "%s @ %s — use nd.dot/linalg_gemm2 for other ranks"
-            % (self.shape, other.shape))
+        from . import _matmul
+        return _matmul(self, other)
 
     def __truediv__(self, other):
         return self._binary(other, "broadcast_div", "_div_scalar")

@@ -442,6 +442,18 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
 
 
+@register("_matmul")
+def _matmul(lhs, rhs):
+    """The Python @ operator: numpy matmul semantics (2-D dot, batched
+    for higher ranks). Shared by NDArray.__matmul__ and
+    Symbol.__matmul__ so eager and traced code agree."""
+    if lhs.ndim < 2 or rhs.ndim < 2:
+        raise TypeError(
+            "@ needs operands of rank >= 2; got %s @ %s"
+            % (lhs.shape, rhs.shape))
+    return jnp.matmul(lhs, rhs)
+
+
 @register("batch_dot")
 def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
     a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs

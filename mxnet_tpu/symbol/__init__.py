@@ -224,9 +224,31 @@ class Symbol:
             create("_rminus_scalar", self, scalar=float(other))
 
     def __matmul__(self, other):
-        # 2-D contract mirrors NDArray.__matmul__; symbolic shapes are
-        # checked at infer/bind time
-        return create("dot", self, other)
+        if not isinstance(other, Symbol):
+            return NotImplemented
+        # numpy matmul semantics, same op as NDArray.__matmul__
+        return create("_matmul", self, other)
+
+    def __and__(self, other):
+        return self._binary(other, "broadcast_logical_and",
+                            "_logical_and_scalar")
+
+    __rand__ = __and__
+
+    def __or__(self, other):
+        return self._binary(other, "broadcast_logical_or",
+                            "_logical_or_scalar")
+
+    __ror__ = __or__
+
+    def __xor__(self, other):
+        return self._binary(other, "broadcast_logical_xor",
+                            "_logical_xor_scalar")
+
+    __rxor__ = __xor__
+
+    def __invert__(self):
+        return create("logical_not", self)
 
     def __mul__(self, other):
         return self._binary(other, "elemwise_mul", "_mul_scalar")

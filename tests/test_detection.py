@@ -159,3 +159,21 @@ def test_ssd_end_to_end(tmp_path):
     assert dets.shape[0] == 16 and dets.shape[2] == 6
     iou = evaluate(net, batch)
     assert 0.0 <= iou <= 1.0
+
+
+def test_det_augmenter_chain_accepts_ndarray_labels():
+    """The full crop+pad+flip chain must accept NDArray labels (the
+    iterator contract) — regression for the '&' / numpy-helper mismatch."""
+    import random as pyrandom
+    from mxnet_tpu import image_detection as det
+    pyrandom.seed(0)
+    np.random.seed(0)
+    augs = det.CreateDetAugmenter(data_shape=(3, 16, 16), rand_crop=0.5,
+                                  rand_pad=0.5, rand_mirror=True)
+    for _ in range(20):
+        img = mx.nd.array(np.random.rand(20, 24, 3).astype(np.float32) * 255)
+        label = mx.nd.array(np.array([[0, 0.1, 0.1, 0.6, 0.7]], np.float32))
+        for a in augs:
+            img, label = a(img, label)
+        lab = label if isinstance(label, np.ndarray) else label.asnumpy()
+        assert lab.shape[1] == 5 and np.isfinite(lab).all()

@@ -203,9 +203,13 @@ def test_matmul_batch_and_errors():
     b3 = nd.array(np.random.RandomState(3).rand(2, 4, 5).astype(np.float32))
     np.testing.assert_allclose((a3 @ b3).asnumpy(),
                                a3.asnumpy() @ b3.asnumpy(), rtol=1e-5)
+    # numpy matmul semantics: 3-D @ 2-D broadcasts the 2-D operand
+    b2 = nd.array(np.random.RandomState(4).rand(4, 5).astype(np.float32))
+    np.testing.assert_allclose((a3 @ b2).asnumpy(),
+                               a3.asnumpy() @ b2.asnumpy(), rtol=1e-5)
     try:
-        a3 @ nd.array(np.zeros((4, 5), np.float32))
-        assert False, "expected TypeError for mixed ranks"
+        a3 @ nd.array(np.zeros(4, np.float32))
+        assert False, "expected TypeError for rank-1 operand"
     except TypeError:
         pass
     try:
@@ -220,3 +224,36 @@ def test_matmul_batch_and_errors():
                            "b": nd.array(np.ones((3, 2), np.float32))})
     out = ex.forward()[0]
     np.testing.assert_allclose(out.asnumpy(), np.ones((3, 2)), rtol=1e-6)
+
+
+def test_logical_operators():
+    a = nd.array(np.array([1.0, 0.0, 2.0], np.float32))
+    b = nd.array(np.array([1.0, 1.0, 0.0], np.float32))
+    np.testing.assert_array_equal((a & b).asnumpy(), [1, 0, 0])
+    np.testing.assert_array_equal((a | b).asnumpy(), [1, 1, 1])
+    np.testing.assert_array_equal((a ^ b).asnumpy(), [0, 1, 1])
+
+
+def test_matmul_and_logical_hybrid_parity():
+    """@ and & must behave identically eagerly and under hybridize()."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+
+    class M(gluon.HybridBlock):
+        def hybrid_forward(self, F, x, y):
+            mask = (x > 0) & (y > 0)
+            return mask + 0 * F.sum(x @ y)
+
+    m = M()
+    m.initialize()
+    x = nd.array(np.random.RandomState(0).randn(3, 3).astype(np.float32))
+    y = nd.array(np.random.RandomState(1).randn(3, 3).astype(np.float32))
+    eager = m(x, y).asnumpy()
+    m.hybridize()
+    np.testing.assert_allclose(m(x, y).asnumpy(), eager, rtol=1e-5)
+    # symbolic @ rejects non-symbols at construction
+    try:
+        mx.sym.Variable("a") @ 2.0
+        assert False, "expected TypeError"
+    except TypeError:
+        pass
