@@ -272,7 +272,8 @@ def bench_ssd_forward(smoke, dtype, device_kind):
         values = [v.astype(jnp.bfloat16) if v.dtype == jnp.float32 else v
                   for v in values]
 
-    fwd = jax.jit(lambda vals, img: apply_fn(vals, img))
+    in_dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    fwd = jax.jit(lambda vals, img: apply_fn(vals, img.astype(in_dtype)))
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.uniform(-1, 1, (batch, 3, image, image))
                     .astype(np.float32))
