@@ -1,0 +1,21 @@
+#!/bin/bash
+# The full TPU measurement session, one command. Run when the tunnel is up:
+#   bash benchmarks/tpu_session.sh
+# Produces: BENCH_ALL.json + BENCH_LAST_TPU.json (committed numbers),
+# layout A/B lines, and the per-HLO profile in BENCH_PROFILE.txt.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+echo "=== 1. full bench (all configs, NCHW) ==="
+python bench.py | tee /tmp/bench_nchw.out
+
+echo "=== 2. headline with NHWC layout (A/B) ==="
+BENCH_CONFIGS=headline BENCH_LAYOUT=NHWC python bench.py | tee /tmp/bench_nhwc.out
+
+echo "=== 3. per-HLO profile (NCHW) ==="
+python benchmarks/hlo_profile.py 2>&1 | tee BENCH_PROFILE.txt
+
+echo "=== 4. per-HLO profile (NHWC) ==="
+BENCH_LAYOUT=NHWC python benchmarks/hlo_profile.py 2>&1 | tee BENCH_PROFILE_NHWC.txt
+
+echo "=== done; remember: git add BENCH_ALL.json BENCH_LAST_TPU.json BENCH_PROFILE*.txt && commit ==="
