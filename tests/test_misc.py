@@ -202,3 +202,42 @@ def test_mon_alias_and_quantize_reference_kwargs():
     for kw in ("data_names", "label_names", "ctx", "calib_layer", "logger",
                "num_calib_examples"):
         assert kw in sig.parameters, kw
+
+
+def test_attr_scope_and_name_prefix_semantics():
+    """Explicit attrs beat AttrScope; name.Prefix applies per thread
+    (parity: reference test_attr.py / test_thread_local.py)."""
+    import threading
+    import mxnet_tpu as mx
+    with mx.AttrScope(group="4", data="great"):
+        d = mx.sym.Variable("data", attr={"dtype": "data", "group": "1"})
+        s = mx.sym.Variable("sdata")
+    assert d.attr("group") == "1" and s.attr("group") == "4"
+    assert d.attr("dtype") == "data"
+
+    results = {}
+
+    def worker():
+        with mx.name.Prefix("thread_"):
+            results["t"] = mx.sym.FullyConnected(
+                mx.sym.Variable("x"), num_hidden=2).name
+
+    t = threading.Thread(target=worker)
+    with mx.name.Prefix("main_"):
+        t.start()
+        t.join()
+        results["m"] = mx.sym.FullyConnected(
+            mx.sym.Variable("y"), num_hidden=2).name
+    assert results["t"].startswith("thread_")
+    assert results["m"].startswith("main_")
+
+
+def test_exception_recovery_imperative():
+    """A failed op must raise and leave the session usable (parity:
+    reference test_exc_handling.py)."""
+    import mxnet_tpu as mx
+    import pytest as _pytest
+    with _pytest.raises(Exception):
+        mx.nd.Reshape(mx.nd.zeros((2, 3)), shape=(7,))
+    out = mx.nd.zeros((2, 2)) + 1
+    assert float(out.asnumpy().sum()) == 4.0
