@@ -91,6 +91,12 @@ class KVStore:
         return keys, values
 
     @staticmethod
+    def _merge_rowsparse(vlist):
+        """Sparse-preserving reduce: see ndarray.sparse.merge_rowsparse."""
+        from .ndarray.sparse import merge_rowsparse
+        return merge_rowsparse(vlist)
+
+    @staticmethod
     def _aggregate(vlist):
         """Sum a per-device list of values into one (the local reduce —
         parity: comm.h Reduce; on TPU XLA fuses/overlaps these adds)."""
@@ -99,9 +105,7 @@ class KVStore:
         if isinstance(vlist[0], RowSparseNDArray):
             if len(vlist) == 1:
                 return vlist[0]
-            dense = sum((v.todense()._data for v in vlist[1:]),
-                        vlist[0].todense()._data)
-            return RowSparseNDArray.from_dense(NDArray(dense))
+            return KVStore._merge_rowsparse(vlist)
         out = vlist[0]._data
         for v in vlist[1:]:
             out = out + v._data
@@ -130,7 +134,10 @@ class KVStore:
                 self._updater(self._resolve_key(k), agg, self._store[k])
             else:
                 stored = self._store[k]
-                if isinstance(stored, RowSparseNDArray) or \
+                if isinstance(stored, RowSparseNDArray) and \
+                        isinstance(agg, RowSparseNDArray):
+                    self._store[k] = self._merge_rowsparse([stored, agg])
+                elif isinstance(stored, RowSparseNDArray) or \
                         isinstance(agg, RowSparseNDArray):
                     dense = (stored.todense()._data
                              if isinstance(stored, RowSparseNDArray)

@@ -112,8 +112,7 @@ class RowSparseNDArray(BaseSparseNDArray):
 
     def __add__(self, other):
         if isinstance(other, RowSparseNDArray):
-            merged = self.todense() + other.todense()
-            return RowSparseNDArray.from_dense(merged)
+            return merge_rowsparse([self, other])
         return self.todense() + other
 
 
@@ -237,6 +236,26 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
         return _dense_dot(lhs, rhs, transpose_a=transpose_a,
                           transpose_b=transpose_b)
     raise TypeError("unsupported sparse dot: %s x %s" % (type(lhs), type(rhs)))
+
+
+def merge_rowsparse(vlist):
+    """Sum row-sparse arrays WITHOUT densifying: concatenate nnz rows and
+    compact duplicate ids with a segment-sum. Only the int row-id vectors
+    touch the host (np.unique needs static shapes); values stay on
+    device. O(total nnz), not O(num_rows) — the sparse-embedding
+    aggregation kernel (parity: comm.h Reduce for row_sparse).
+
+    Returned indices are sorted ascending (np.unique), preserving the
+    class invariant the lazy optimizers rely on."""
+    import jax
+    idx = np.concatenate([np.asarray(v._indices) for v in vlist])
+    vals = jnp.concatenate([v._values for v in vlist], axis=0)
+    uniq, inverse = np.unique(idx, return_inverse=True)
+    summed = jax.ops.segment_sum(
+        vals, jnp.asarray(inverse.astype(np.int32)),
+        num_segments=int(uniq.size))
+    return RowSparseNDArray(jnp.asarray(uniq.astype(np.int32)), summed,
+                            vlist[0].shape)
 
 
 def add(lhs, rhs):

@@ -95,10 +95,3 @@ def csr_dot_dense(indptr, indices, values, rhs, num_rows=0, num_cols=0,
 def rsp_dot_dense(indices, values, rhs):
     return jnp.matmul(values, rhs)  # caller scatters rows back
 
-
-@register("_rsp_elemwise_add", num_outputs=2)
-def rsp_elemwise_add(idx_a, val_a, idx_b, val_b):
-    """Add two row_sparse pairs -> merged (concatenated, caller may compact)."""
-    idx = jnp.concatenate([idx_a, idx_b])
-    vals = jnp.concatenate([val_a, val_b])
-    return idx, vals

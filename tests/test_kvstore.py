@@ -143,3 +143,30 @@ def test_kvstore_type_and_rank():
     assert kv.type == "local"
     assert kv.rank == 0
     assert kv.num_workers == 1
+
+
+def test_rowsparse_aggregation_stays_sparse():
+    """Multi-device row-sparse pushes merge by segment-sum (never
+    densifying): duplicate row ids sum, untouched rows stay absent."""
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+    kv = mx.kv.create("local")
+    shape = (1000, 4)
+    kv.init("emb", mx.nd.zeros(shape))
+
+    def rsp(rows, val):
+        vals = np.full((len(rows), 4), val, np.float32)
+        return RowSparseNDArray(np.array(rows, np.int32), vals, shape)
+
+    # two "devices" push overlapping sparse grads
+    kv.push("emb", [rsp([3, 10], 1.0), rsp([10, 500], 2.0)])
+    out = mx.nd.zeros(shape)
+    kv.pull("emb", out=out)
+    o = out.asnumpy()
+    np.testing.assert_array_equal(o[3], 1.0)
+    np.testing.assert_array_equal(o[10], 3.0)   # summed across devices
+    np.testing.assert_array_equal(o[500], 2.0)
+    assert o.sum() == (1.0 + 3.0 + 2.0) * 4
+    # merged aggregate preserved sparsity internally
+    merged = mx.kv.KVStore._merge_rowsparse([rsp([3, 10], 1.0),
+                                             rsp([10, 500], 2.0)])
+    assert merged._indices.shape[0] == 3      # {3, 10, 500}, not 1000
