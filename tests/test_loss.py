@@ -1,5 +1,6 @@
 """Gluon loss tests (parity: reference tests/python/unittest/test_loss.py)."""
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import ndarray as nd
@@ -129,3 +130,72 @@ def test_loss_is_differentiable():
     loss.backward()
     assert net_w.grad is not None
     assert float(np.abs(net_w.grad.asnumpy()).sum()) > 0
+
+
+def test_losses_match_torch():
+    """Independent oracle: every loss with a torch equivalent must agree
+    numerically (torch ships in this environment)."""
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as tF
+    from mxnet_tpu.gluon import loss as gloss
+
+    rng = np.random.RandomState(0)
+    logits = rng.randn(8, 5).astype(np.float32)
+    labels = rng.randint(0, 5, 8).astype(np.float32)
+    pred = rng.randn(8, 4).astype(np.float32)
+    target = rng.randn(8, 4).astype(np.float32)
+
+    # SoftmaxCrossEntropy vs torch cross_entropy (mean over batch)
+    ours = gloss.SoftmaxCrossEntropyLoss()(
+        nd.array(logits), nd.array(labels)).asnumpy().mean()
+    ref = tF.cross_entropy(torch.tensor(logits),
+                           torch.tensor(labels.astype(np.int64))).item()
+    np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+    # L2: mxnet convention is 1/2 * MSE
+    ours = gloss.L2Loss()(nd.array(pred), nd.array(target)).asnumpy().mean()
+    ref = 0.5 * tF.mse_loss(torch.tensor(pred), torch.tensor(target)).item()
+    np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+    # L1
+    ours = gloss.L1Loss()(nd.array(pred), nd.array(target)).asnumpy().mean()
+    ref = tF.l1_loss(torch.tensor(pred), torch.tensor(target)).item()
+    np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+    # SigmoidBCE (from logits)
+    blab = (rng.rand(8, 4) > 0.5).astype(np.float32)
+    ours = gloss.SigmoidBinaryCrossEntropyLoss()(
+        nd.array(pred), nd.array(blab)).asnumpy().mean()
+    ref = tF.binary_cross_entropy_with_logits(
+        torch.tensor(pred), torch.tensor(blab)).item()
+    np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+    # KLDiv: mxnet takes log-probs input when from_logits=True
+    logp = tF.log_softmax(torch.tensor(pred), dim=-1)
+    q = tF.softmax(torch.tensor(target), dim=-1)
+    ours = gloss.KLDivLoss(from_logits=True)(
+        nd.array(logp.numpy()), nd.array(q.numpy())).asnumpy().mean()
+    ref = tF.kl_div(logp, q, reduction="batchmean").item() / pred.shape[1]
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-6)
+
+    # Huber (SmoothL1 with rho=1)
+    ours = gloss.HuberLoss(rho=1.0)(
+        nd.array(pred), nd.array(target)).asnumpy().mean()
+    ref = tF.smooth_l1_loss(torch.tensor(pred), torch.tensor(target),
+                            beta=1.0).item()
+    np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+    # CTC
+    T, B, C = 10, 2, 6
+    ctc_logits = rng.randn(B, T, C).astype(np.float32)
+    tlabels = np.array([[1, 2, 3, -1], [2, 4, -1, -1]], np.float32)
+    ours_v = gloss.CTCLoss(layout="NTC")(
+        nd.array(ctc_logits), nd.array(tlabels)).asnumpy()
+    logp_t = tF.log_softmax(torch.tensor(ctc_logits), dim=-1).transpose(0, 1)
+    targets = torch.tensor([[1, 2, 3], [2, 4, 0]], dtype=torch.long)
+    # mxnet convention: the LAST class (C-1) is the blank label
+    ref_v = tF.ctc_loss(logp_t, targets,
+                        input_lengths=torch.tensor([T, T]),
+                        target_lengths=torch.tensor([3, 2]),
+                        blank=C - 1, reduction="none").numpy()
+    np.testing.assert_allclose(ours_v, ref_v, rtol=1e-3, atol=1e-3)
