@@ -1073,3 +1073,57 @@ def test_gluon_pooling_nhwc():
         out = nhwc_layer(nd.array(x_last)).asnumpy()
         np.testing.assert_allclose(np.transpose(out, (0, 3, 1, 2)), ref,
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_convolution_matches_torch():
+    """Convolution forward AND input/weight grads vs torch (independent
+    oracle for the benchmark-critical kernel), incl. stride/pad/dilation/
+    groups."""
+    import pytest as _pytest
+    torch = _pytest.importorskip("torch")
+    import torch.nn.functional as tF
+    from mxnet_tpu import autograd
+
+    rng = np.random.RandomState(0)
+    cases = [
+        dict(stride=1, pad=1, dilate=1, groups=1, k=3),
+        dict(stride=2, pad=3, dilate=1, groups=1, k=7),
+        dict(stride=1, pad=2, dilate=2, groups=1, k=3),
+        dict(stride=1, pad=1, dilate=1, groups=2, k=3),
+    ]
+    for c in cases:
+        cin, cout = 4, 6
+        x_np = rng.randn(2, cin, 12, 12).astype(np.float32)
+        w_np = rng.randn(cout, cin // c["groups"], c["k"], c["k"]) \
+            .astype(np.float32)
+        b_np = rng.randn(cout).astype(np.float32)
+
+        x = nd.array(x_np)
+        w = nd.array(w_np)
+        b = nd.array(b_np)
+        for t in (x, w, b):
+            t.attach_grad()
+        with autograd.record():
+            out = nd.Convolution(x, w, b, kernel=(c["k"], c["k"]),
+                                 stride=(c["stride"],) * 2,
+                                 pad=(c["pad"],) * 2,
+                                 dilate=(c["dilate"],) * 2,
+                                 num_filter=cout, num_group=c["groups"])
+            loss = (out * out).sum()
+        loss.backward()
+
+        xt = torch.tensor(x_np, requires_grad=True)
+        wt = torch.tensor(w_np, requires_grad=True)
+        bt = torch.tensor(b_np, requires_grad=True)
+        ot = tF.conv2d(xt, wt, bt, stride=c["stride"], padding=c["pad"],
+                       dilation=c["dilate"], groups=c["groups"])
+        (ot * ot).sum().backward()
+
+        np.testing.assert_allclose(out.asnumpy(), ot.detach().numpy(),
+                                   rtol=1e-4, atol=1e-4, err_msg=str(c))
+        np.testing.assert_allclose(x.grad.asnumpy(), xt.grad.numpy(),
+                                   rtol=1e-3, atol=1e-3, err_msg=str(c))
+        np.testing.assert_allclose(w.grad.asnumpy(), wt.grad.numpy(),
+                                   rtol=1e-3, atol=1e-3, err_msg=str(c))
+        np.testing.assert_allclose(b.grad.asnumpy(), bt.grad.numpy(),
+                                   rtol=1e-3, atol=1e-3, err_msg=str(c))

@@ -204,3 +204,36 @@ def test_begin_state_func_requires_batch():
         pass
     states = cell.begin_state(func=S.ones, batch_size=4)
     assert len(states) == 2
+
+
+def test_fused_rnn_matches_torch():
+    """The lax.scan fused LSTM/GRU must agree with torch.nn.LSTM/GRU given
+    identical weights (independent oracle; gate orders coincide)."""
+    import pytest as _pytest
+    torch = _pytest.importorskip("torch")
+    from mxnet_tpu.gluon import rnn as grnn
+
+    rng = np.random.RandomState(0)
+    T, B, I, H = 5, 3, 4, 6
+    x = rng.randn(T, B, I).astype(np.float32)
+
+    for mode, gcls, tcls in [("lstm", grnn.LSTM, torch.nn.LSTM),
+                             ("gru", grnn.GRU, torch.nn.GRU)]:
+        tnet = tcls(I, H, num_layers=2)
+        gnet = gcls(H, num_layers=2, input_size=I)
+        gnet.initialize(mx.init.Xavier())
+        gnet(mx.nd.zeros((T, B, I)))  # finish deferred init
+        params = gnet.collect_params()
+        for li in range(2):
+            for gname, tname in [("l%d_i2h_weight" % li, "weight_ih_l%d" % li),
+                                 ("l%d_h2h_weight" % li, "weight_hh_l%d" % li),
+                                 ("l%d_i2h_bias" % li, "bias_ih_l%d" % li),
+                                 ("l%d_h2h_bias" % li, "bias_hh_l%d" % li)]:
+                full = [k for k in params if k.endswith(gname)]
+                assert len(full) == 1, (gname, list(params))
+                params[full[0]].set_data(mx.nd.array(
+                    getattr(tnet, tname).detach().numpy()))
+        ours = gnet(mx.nd.array(x)).asnumpy()
+        ref, _ = tnet(torch.tensor(x))
+        np.testing.assert_allclose(ours, ref.detach().numpy(), rtol=1e-4,
+                                   atol=1e-5, err_msg=mode)
