@@ -1127,3 +1127,42 @@ def test_convolution_matches_torch():
                                    rtol=1e-3, atol=1e-3, err_msg=str(c))
         np.testing.assert_allclose(b.grad.asnumpy(), bt.grad.numpy(),
                                    rtol=1e-3, atol=1e-3, err_msg=str(c))
+
+
+def test_batchnorm_and_deconv_match_torch():
+    import pytest as _pytest
+    torch = _pytest.importorskip("torch")
+    import torch.nn.functional as tF
+    from mxnet_tpu import autograd
+
+    rng = np.random.RandomState(1)
+    x_np = rng.randn(4, 3, 8, 8).astype(np.float32)
+    gamma = rng.rand(3).astype(np.float32) + 0.5
+    beta = rng.randn(3).astype(np.float32)
+    rmean = rng.randn(3).astype(np.float32) * 0.1
+    rvar = rng.rand(3).astype(np.float32) + 0.5
+
+    # train-mode BN: normalized output + updated running stats
+    with autograd.record():
+        out, mean_out, var_out = nd.BatchNorm(
+            nd.array(x_np), nd.array(gamma), nd.array(beta),
+            nd.array(rmean.copy()), nd.array(rvar.copy()),
+            eps=1e-5, momentum=0.9, fix_gamma=False,
+            output_mean_var=True)
+    rm_t = torch.tensor(rmean.copy())
+    rv_t = torch.tensor(rvar.copy())
+    ref = tF.batch_norm(torch.tensor(x_np), rm_t, rv_t,
+                        torch.tensor(gamma), torch.tensor(beta),
+                        training=True, momentum=0.1, eps=1e-5)
+    np.testing.assert_allclose(out.asnumpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+    # Deconvolution vs conv_transpose2d (stride 2, pad 1)
+    w_np = rng.randn(3, 5, 4, 4).astype(np.float32)  # (C_in, C_out, k, k)
+    ours = nd.Deconvolution(nd.array(x_np), nd.array(w_np), kernel=(4, 4),
+                            stride=(2, 2), pad=(1, 1), num_filter=5,
+                            no_bias=True)
+    ref = tF.conv_transpose2d(torch.tensor(x_np), torch.tensor(w_np),
+                              stride=2, padding=1)
+    np.testing.assert_allclose(ours.asnumpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)

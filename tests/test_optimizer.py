@@ -171,3 +171,34 @@ def test_create_optimizer_registry():
     for name in ALL_OPTS:
         o = opt.create_optimizer(name, learning_rate=0.1)
         assert isinstance(o, opt.Optimizer)
+
+
+def test_sgd_momentum_and_adam_trajectories_match_torch():
+    """10 updates of sgd+momentum and adam must track torch.optim (the
+    momentum buffers differ by a -lr factor; trajectories coincide for
+    constant lr)."""
+    import pytest as _pytest
+    torch = _pytest.importorskip("torch")
+
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(6, 4).astype(np.float32)
+    grads = [rng.randn(6, 4).astype(np.float32) for _ in range(10)]
+
+    for name, kwargs, topt, tkw in [
+            ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 0.0},
+             torch.optim.SGD, {"lr": 0.1, "momentum": 0.9}),
+            ("adam", {"learning_rate": 0.01, "beta1": 0.9, "beta2": 0.999,
+                      "epsilon": 1e-8, "wd": 0.0},
+             torch.optim.Adam, {"lr": 0.01, "betas": (0.9, 0.999),
+                                "eps": 1e-8})]:
+        o = mx.optimizer.create(name, rescale_grad=1.0, **kwargs)
+        upd = mx.optimizer.get_updater(o)
+        w = mx.nd.array(w0.copy())
+        wt = torch.tensor(w0.copy(), requires_grad=True)
+        topti = topt([wt], **tkw)
+        for g in grads:
+            upd(0, mx.nd.array(g), w)
+            wt.grad = torch.tensor(g)
+            topti.step()
+        np.testing.assert_allclose(w.asnumpy(), wt.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5, err_msg=name)
