@@ -517,8 +517,11 @@ def main():
                 headline.get("value") is not None:
             headline = dict(headline, stale=True,
                             measured_at=cached.get("measured_at"),
-                            note="tunnel down at bench time; value is the "
-                                 "last healthy TPU measurement")
+                            stale_note="tunnel down at bench time; value "
+                                       "is the last healthy TPU "
+                                       "measurement")
+            if cached.get("source"):
+                headline["source"] = cached["source"]
             print(json.dumps(headline))
     except (OSError, ValueError, KeyError, IndexError):
         pass
