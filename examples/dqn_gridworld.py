@@ -1,0 +1,119 @@
+"""DQN on a 5x5 gridworld (parity role: example/reinforcement-learning).
+
+Self-contained environment (no gym): the agent walks to a goal; reward -1
+per step, +10 at the goal. Q-network + target network + replay buffer +
+epsilon-greedy, trained with gluon; asserts the greedy policy reaches the
+goal afterwards.
+"""
+import argparse
+import collections
+import random
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+SIZE = 5
+GOAL = (4, 4)
+ACTIONS = [(-1, 0), (1, 0), (0, -1), (0, 1)]
+
+
+def encode(pos):
+    v = np.zeros(SIZE * SIZE, np.float32)
+    v[pos[0] * SIZE + pos[1]] = 1.0
+    return v
+
+
+def step_env(pos, a):
+    dr, dc = ACTIONS[a]
+    nxt = (min(max(pos[0] + dr, 0), SIZE - 1),
+           min(max(pos[1] + dc, 0), SIZE - 1))
+    done = nxt == GOAL
+    return nxt, (10.0 if done else -1.0), done
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--episodes", type=int, default=120)
+    ap.add_argument("--gamma", type=float, default=0.95)
+    args = ap.parse_args()
+    random.seed(0)
+    np.random.seed(0)
+    mx.random.seed(0)
+
+    def make_q():
+        q = nn.HybridSequential()
+        q.add(nn.Dense(64, activation="relu"), nn.Dense(4))
+        q.initialize(mx.init.Xavier())
+        return q
+
+    qnet, target = make_q(), make_q()
+    # finish deferred shape inference before weights can be copied
+    dummy = mx.nd.array(encode((0, 0))[None])
+    qnet(dummy)
+    target(dummy)
+
+    def sync_target():
+        for (_, p), (_, t) in zip(qnet.collect_params().items(),
+                                  target.collect_params().items()):
+            t.set_data(p.data())
+
+    sync_target()
+    trainer = gluon.Trainer(qnet.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    buf = collections.deque(maxlen=4000)
+    eps = 1.0
+
+    for ep in range(args.episodes):
+        pos = (0, 0)
+        for t in range(40):
+            if random.random() < eps:
+                a = random.randrange(4)
+            else:
+                qv = qnet(mx.nd.array(encode(pos)[None])).asnumpy()[0]
+                a = int(qv.argmax())
+            nxt, r, done = step_env(pos, a)
+            buf.append((encode(pos), a, r, encode(nxt), done))
+            pos = nxt
+            if done:
+                break
+        eps = max(0.05, eps * 0.96)
+        for _ in range(4 if len(buf) >= 64 else 0):
+            batch = random.sample(buf, 64)
+            s = mx.nd.array(np.stack([b[0] for b in batch]))
+            a = np.array([b[1] for b in batch])
+            r = np.array([b[2] for b in batch], np.float32)
+            s2 = mx.nd.array(np.stack([b[3] for b in batch]))
+            done_m = np.array([b[4] for b in batch], np.float32)
+            q_next = target(s2).asnumpy().max(axis=1)
+            y = r + args.gamma * q_next * (1.0 - done_m)
+            y_nd = mx.nd.array(y)
+            a_nd = mx.nd.array(a.astype(np.float32))
+            with autograd.record():
+                q_all = qnet(s)
+                q_sa = mx.nd.pick(q_all, a_nd, axis=1)
+                loss = ((q_sa - y_nd) ** 2).mean()
+            loss.backward()
+            trainer.step(64)
+        if ep % 5 == 0:
+            sync_target()
+
+    # greedy rollout must reach the goal
+    pos, steps = (0, 0), 0
+    while pos != GOAL and steps < 20:
+        qv = qnet(mx.nd.array(encode(pos)[None])).asnumpy()[0]
+        pos, _, _ = step_env(pos, int(qv.argmax()))
+        steps += 1
+    print("greedy rollout reached goal in %d steps" % steps)
+    assert pos == GOAL, "policy failed to reach the goal"
+
+
+if __name__ == "__main__":
+    main()

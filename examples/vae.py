@@ -1,0 +1,94 @@
+"""Variational autoencoder on synthetic MNIST (parity role: example/vae).
+
+Reparameterization trick with mx.nd.random inside autograd.record();
+ELBO = reconstruction BCE + KL(q(z|x) || N(0,1)).
+"""
+import argparse
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+class VAE(gluon.HybridBlock):
+    def __init__(self, latent=8, hidden=128, **kwargs):
+        super().__init__(**kwargs)
+        self.latent = latent
+        with self.name_scope():
+            self.enc = nn.HybridSequential()
+            self.enc.add(nn.Dense(hidden, activation="relu"))
+            self.enc.add(nn.Dense(latent * 2))      # mu, logvar
+            self.dec = nn.HybridSequential()
+            self.dec.add(nn.Dense(hidden, activation="relu"))
+            self.dec.add(nn.Dense(784))             # logits
+
+    def hybrid_forward(self, F, x, eps):
+        h = self.enc(x)
+        mu = F.slice_axis(h, axis=1, begin=0, end=self.latent)
+        logvar = F.slice_axis(h, axis=1, begin=self.latent,
+                              end=2 * self.latent)
+        z = mu + F.exp(0.5 * logvar) * eps          # reparameterize
+        logits = self.dec(z)
+        return logits, mu, logvar
+
+
+def elbo_loss(F, logits, x, mu, logvar):
+    # BCE from logits, summed over pixels
+    bce = F.sum(F.relu(logits) - logits * x +
+                F.log(1.0 + F.exp(-F.abs(logits))), axis=1)
+    kl = -0.5 * F.sum(1 + logvar - mu * mu - F.exp(logvar), axis=1)
+    return bce + kl
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=100)
+    ap.add_argument("--latent", type=int, default=8)
+    args = ap.parse_args()
+
+    train, _ = mx.test_utils.get_mnist_iterator(
+        batch_size=args.batch_size, input_shape=(784,))
+    net = VAE(latent=args.latent)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+
+    first = last = None
+    for epoch in range(args.epochs):
+        train.reset()
+        total = count = 0.0
+        for batch in train:
+            x = batch.data[0] / 255.0 if float(
+                batch.data[0].asnumpy().max()) > 1.5 else batch.data[0]
+            eps = mx.nd.random.normal(
+                shape=(x.shape[0], args.latent))
+            with autograd.record():
+                logits, mu, logvar = net(x, eps)
+                loss = elbo_loss(mx.nd, logits, x, mu, logvar).mean()
+            loss.backward()
+            trainer.step(x.shape[0])
+            total += float(loss.asnumpy())
+            count += 1
+            if count == 5 and first is None:
+                first = total / count   # early-batches ELBO
+        avg = total / count
+        last = avg
+        print("epoch %d elbo %.2f" % (epoch, avg))
+    assert last < first, (first, last)
+    # decode a few samples to prove the generator path works standalone
+    z = mx.nd.random.normal(shape=(4, args.latent))
+    imgs = net.dec(z)
+    assert imgs.shape == (4, 784)
+    print("ELBO %.2f -> %.2f; sampled %s" % (first, last, imgs.shape))
+
+
+if __name__ == "__main__":
+    main()

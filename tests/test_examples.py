@@ -38,6 +38,8 @@ _CASES = [
     ("adversary_fgsm.py", ["--epochs", "1"]),
     ("matrix_factorization.py", ["--steps", "60"]),
     ("cnn_text_classification.py", ["--epochs", "5"]),
+    ("vae.py", ["--epochs", "1"]),
+    ("dqn_gridworld.py", []),
 ]
 
 
