@@ -66,8 +66,7 @@ def main():
         train.reset()
         total = count = 0.0
         for batch in train:
-            x = batch.data[0] / 255.0 if float(
-                batch.data[0].asnumpy().max()) > 1.5 else batch.data[0]
+            x = batch.data[0]   # get_mnist_iterator is already [0, 1]
             eps = mx.nd.random.normal(
                 shape=(x.shape[0], args.latent))
             with autograd.record():
@@ -77,8 +76,8 @@ def main():
             trainer.step(x.shape[0])
             total += float(loss.asnumpy())
             count += 1
-            if count == 5 and first is None:
-                first = total / count   # early-batches ELBO
+            if first is None and count == min(5, 1):
+                first = total / count   # first-batch ELBO baseline
         avg = total / count
         last = avg
         print("epoch %d elbo %.2f" % (epoch, avg))
