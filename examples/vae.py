@@ -76,8 +76,8 @@ def main():
             trainer.step(x.shape[0])
             total += float(loss.asnumpy())
             count += 1
-            if first is None and count == min(5, 1):
-                first = total / count   # first-batch ELBO baseline
+            if first is None and count == 5:
+                first = total / count   # 5-batch ELBO baseline
         avg = total / count
         last = avg
         print("epoch %d elbo %.2f" % (epoch, avg))

@@ -9,6 +9,7 @@ from .lenet import get_lenet, get_mlp, get_resnetish, LeNet
 from .word_lm import RNNModel
 from .ssd import SSDLite
 from .sparse_linear import SparseLinear
+from .fm import FactorizationMachine
 
 # mesh-first transformer LM (capability upgrade: dp/tp/sp/ep parallelism)
 from .transformer import (TransformerConfig, init_transformer_params,

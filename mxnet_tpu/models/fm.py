@@ -1,0 +1,88 @@
+"""Factorization machine over sparse features (parity: reference
+example/sparse/factorization_machine + contrib FM operators' role).
+
+score(x) = w.x + b + 0.5 * sum_f [(x V)_f^2 - (x.x)(V.V)_f]
+
+Everything sparse stays sparse: both the forward products and the factor
+gradient run through the csr / csr^T segment-sum kernels
+(ops/sparse.py), and the weight/factor gradients are row-sparse over the
+features present in the batch — the same lazy-update flow as
+SparseLinear.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..ndarray import NDArray
+from ..ndarray.sparse import (CSRNDArray, RowSparseNDArray,
+                              dot as sparse_dot, touched_rows)
+from .. import optimizer as opt
+
+
+class FactorizationMachine:
+    """Binary FM classifier trained with logistic loss."""
+
+    def __init__(self, num_features, num_factors=8, optimizer="sgd",
+                 learning_rate=0.1, seed=0):
+        rng = np.random.RandomState(seed)
+        self.num_features = num_features
+        self.num_factors = num_factors
+        self.w = NDArray(np.zeros((num_features, 1), dtype=np.float32))
+        self.v = NDArray((rng.randn(num_features, num_factors) * 0.05)
+                         .astype(np.float32))
+        self.b = NDArray(np.zeros((1,), dtype=np.float32))
+        self._opt = opt.create(optimizer, learning_rate=learning_rate)
+        self._updater = opt.get_updater(self._opt)
+
+    def _squared(self, x):
+        """Element-squared csr with the same sparsity structure."""
+        return CSRNDArray(x._values * x._values, x._indices, x._indptr,
+                          x.shape)
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        s1 = sparse_dot(x, self.v)._data                 # (n, k)
+        s2 = sparse_dot(self._squared(x),
+                        NDArray(self.v._data ** 2))._data
+        pair = 0.5 * jnp.sum(s1 * s1 - s2, axis=1)
+        lin = sparse_dot(x, self.w)._data[:, 0]
+        return lin + pair + self.b._data[0], s1
+
+    def loss_grad(self, x, y):
+        """Logistic loss + row-sparse grads for w and V."""
+        import jax
+        import jax.numpy as jnp
+        score, s1 = self.forward(x)
+        yv = y._data if isinstance(y, NDArray) else jnp.asarray(y)
+        prob = jax.nn.sigmoid(score)
+        loss = -jnp.mean(yv * jnp.log(prob + 1e-12) +
+                         (1 - yv) * jnp.log(1 - prob + 1e-12))
+        g = (prob - yv) / score.shape[0]                 # dL/dscore, (n,)
+        # w grad: x^T g            (features, 1)
+        wgrad = sparse_dot(x, NDArray(g[:, None]), transpose_a=True)._data
+        # V grad: x^T (g*s1) - V * ((x.x)^T g)
+        t1 = sparse_dot(x, NDArray(g[:, None] * s1), transpose_a=True)._data
+        t2 = self.v._data * sparse_dot(self._squared(x),
+                                       NDArray(g[:, None]),
+                                       transpose_a=True)._data
+        vgrad = t1 - t2
+        bgrad = jnp.sum(g)[None]
+        touched = touched_rows(x)
+        return (float(loss),
+                RowSparseNDArray(touched.astype(np.int32), wgrad[touched],
+                                 wgrad.shape),
+                RowSparseNDArray(touched.astype(np.int32), vgrad[touched],
+                                 vgrad.shape),
+                NDArray(bgrad))
+
+    def step(self, x, y):
+        loss, wg, vg, bg = self.loss_grad(x, y)
+        self._updater("w", wg, self.w)
+        self._updater("v", vg, self.v)
+        self._updater("b", bg, self.b)
+        return loss
+
+    def predict(self, x):
+        import jax
+        score, _ = self.forward(x)
+        return np.asarray(jax.nn.sigmoid(score))

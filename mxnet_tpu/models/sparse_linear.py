@@ -6,7 +6,8 @@ from __future__ import annotations
 import numpy as np
 
 from ..ndarray import NDArray
-from ..ndarray.sparse import CSRNDArray, RowSparseNDArray, dot as sparse_dot
+from ..ndarray.sparse import (CSRNDArray, RowSparseNDArray,
+                              dot as sparse_dot, touched_rows)
 from .. import ndarray as nd
 from .. import kvstore as kvs
 from .. import optimizer as opt
@@ -53,10 +54,7 @@ class SparseLinear:
             # csr^T . dense via the segment-sum kernel — never densifies x
             wgrad_dense = sparse_dot(x, NDArray(dscore),
                                      transpose_a=True)._data
-            # explicit stored zeros carry no gradient: keep the touched set
-            # identical to the dense branch's nonzero-column test
-            nz = np.asarray(x._values) != 0
-            touched = np.unique(np.asarray(x._indices)[nz])
+            touched = touched_rows(x)
         else:
             wgrad_dense = x._data.T @ dscore
             touched = np.nonzero(np.asarray(jnp.any(x._data != 0, axis=0)))[0]

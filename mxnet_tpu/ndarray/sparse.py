@@ -238,6 +238,14 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     raise TypeError("unsupported sparse dot: %s x %s" % (type(lhs), type(rhs)))
 
 
+def touched_rows(csr):
+    """Feature columns carrying gradient in a csr batch: unique column ids
+    of the structurally-stored NONZERO values (explicit stored zeros carry
+    no gradient — keeps csr and dense training paths identical)."""
+    nz = np.asarray(csr._values) != 0
+    return np.unique(np.asarray(csr._indices)[nz])
+
+
 def merge_rowsparse(vlist):
     """Sum row-sparse arrays WITHOUT densifying: concatenate nnz rows and
     compact duplicate ids with a segment-sum. Only the int row-id vectors
