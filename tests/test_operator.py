@@ -1166,3 +1166,56 @@ def test_batchnorm_and_deconv_match_torch():
                               stride=2, padding=1)
     np.testing.assert_allclose(ours.asnumpy(), ref.numpy(), rtol=1e-4,
                                atol=1e-4)
+
+
+def test_layernorm_embedding_pooling_match_torch():
+    import pytest as _pytest
+    torch = _pytest.importorskip("torch")
+    import torch.nn.functional as tF
+    from mxnet_tpu import autograd
+
+    rng = np.random.RandomState(2)
+    # LayerNorm fwd + grads
+    x_np = rng.randn(4, 10).astype(np.float32)
+    g_np = rng.rand(10).astype(np.float32) + 0.5
+    b_np = rng.randn(10).astype(np.float32)
+    x = nd.array(x_np)
+    x.attach_grad()
+    with autograd.record():
+        out = nd.LayerNorm(x, nd.array(g_np), nd.array(b_np), eps=1e-5)
+        ((out * out).sum()).backward()
+    xt = torch.tensor(x_np, requires_grad=True)
+    ot = tF.layer_norm(xt, (10,), torch.tensor(g_np), torch.tensor(b_np),
+                       eps=1e-5)
+    (ot * ot).sum().backward()
+    np.testing.assert_allclose(out.asnumpy(), ot.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(x.grad.asnumpy(), xt.grad.numpy(),
+                               rtol=1e-3, atol=1e-4)
+
+    # Embedding gradient: scattered rows sum duplicates
+    w_np = rng.randn(20, 6).astype(np.float32)
+    ids = np.array([[1, 3, 1], [5, 3, 1]], np.float32)
+    w = nd.array(w_np)
+    w.attach_grad()
+    with autograd.record():
+        emb = nd.Embedding(nd.array(ids), w, input_dim=20, output_dim=6)
+        (emb.sum()).backward()
+    wt = torch.tensor(w_np, requires_grad=True)
+    et = tF.embedding(torch.tensor(ids.astype(np.int64)), wt)
+    et.sum().backward()
+    np.testing.assert_allclose(emb.asnumpy(), et.detach().numpy(), rtol=1e-6)
+    np.testing.assert_allclose(w.grad.asnumpy(), wt.grad.numpy(), rtol=1e-6)
+
+    # Pooling: max + avg count_include_pad=False vs torch
+    p_np = rng.randn(2, 3, 9, 9).astype(np.float32)
+    ours = nd.Pooling(nd.array(p_np), kernel=(3, 3), stride=(2, 2),
+                      pad=(1, 1), pool_type="max").asnumpy()
+    ref = tF.max_pool2d(torch.tensor(p_np), 3, 2, 1).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-6)
+    ours = nd.Pooling(nd.array(p_np), kernel=(3, 3), stride=(2, 2),
+                      pad=(1, 1), pool_type="avg",
+                      count_include_pad=False).asnumpy()
+    ref = tF.avg_pool2d(torch.tensor(p_np), 3, 2, 1,
+                        count_include_pad=False).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)
