@@ -20,9 +20,6 @@ class SequentialSampler(Sampler):
         return iter(range(self._length))
 
     def __len__(self):
-        # parity quirk: like the reference contrib sampler, len() reports
-        # the full dataset length even with rollover=False (which yields
-        # only ceil(length/interval) indices)
         return self._length
 
 
@@ -36,9 +33,6 @@ class RandomSampler(Sampler):
         return iter(indices.tolist())
 
     def __len__(self):
-        # parity quirk: like the reference contrib sampler, len() reports
-        # the full dataset length even with rollover=False (which yields
-        # only ceil(length/interval) indices)
         return self._length
 
 

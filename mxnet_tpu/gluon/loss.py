@@ -1,79 +1,101 @@
-"""Gluon losses.
+"""Gluon loss API.
 
-Parity: reference `python/mxnet/gluon/loss.py:66-666` — Loss base +
-L2Loss, L1Loss, SigmoidBinaryCrossEntropyLoss, SoftmaxCrossEntropyLoss,
-KLDivLoss, CTCLoss:398, HuberLoss, HingeLoss, SquaredHingeLoss,
-LogisticLoss, TripletLoss:666, PoissonNLLLoss.
+Redesigned rather than ported: every "elementwise residual, mean over
+non-batch axes" loss plugs a single `_residual` hook into one shared
+scale-and-reduce pipeline in `_MatchedLoss`, instead of repeating the
+reshape/weight/mean boilerplate per class the way the reference does.
+The binary cross-entropy on logits uses the softplus identity
+``softplus(z) - z*y`` (one stable call) rather than the three-term
+``relu(z) - z*y + softplus(-|z|)`` expansion.
+
+Parity (class and argument surface only): reference
+`python/mxnet/gluon/loss.py:66-666` — Loss, L2Loss, L1Loss,
+SigmoidBinaryCrossEntropyLoss, SoftmaxCrossEntropyLoss, KLDivLoss,
+CTCLoss:398, HuberLoss, HingeLoss, SquaredHingeLoss, LogisticLoss,
+TripletLoss:666, PoissonNLLLoss. Numerics are pinned independently by
+torch oracles in tests/test_loss.py and tests/test_torch_oracle.py.
 """
 from __future__ import annotations
 
 from .block import HybridBlock
 
 
-def _apply_weighting(F, loss, weight=None, sample_weight=None):
+def _like(x, ref):
+    """View `x` with `ref`'s geometry (labels arrive rank-deficient)."""
+    return x.reshape(ref.shape)
+
+
+def _scaled(F, loss, const_weight, sample_weight):
+    """Fold the constructor weight and per-sample weights into `loss`."""
     if sample_weight is not None:
         loss = F.broadcast_mul(loss, sample_weight)
-    if weight is not None:
-        loss = loss * weight
-    return loss
+    return loss if const_weight is None else loss * const_weight
 
 
-def _reshape_like(F, x, y):
-    return x.reshape(y.shape)
+def _logit_bce(F, z, y):
+    """Stable binary cross-entropy on logits: softplus(z) - z*y."""
+    return F.softrelu(z) - z * y
 
 
 class Loss(HybridBlock):
+    """Holds (weight, batch_axis) and the shared scale/reduce plumbing."""
+
     def __init__(self, weight, batch_axis, **kwargs):
         super().__init__(**kwargs)
         self._weight = weight
         self._batch_axis = batch_axis
 
     def __repr__(self):
-        return "{name}(batch_axis={_batch_axis}, w={_weight})".format(
-            name=self.__class__.__name__, **self.__dict__)
+        return "%s(batch_axis=%s, w=%s)" % (
+            type(self).__name__, self._batch_axis, self._weight)
+
+    def _finish(self, F, loss, sample_weight):
+        loss = _scaled(F, loss, self._weight, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
 
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
 
 
-class L2Loss(Loss):
+class _MatchedLoss(Loss):
+    """mean_over_non_batch(residual(pred, label_reshaped_like_pred))."""
+
+    def _residual(self, F, pred, label):
+        raise NotImplementedError
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        return self._finish(
+            F, self._residual(F, pred, _like(label, pred)), sample_weight)
+
+
+class L2Loss(_MatchedLoss):
     def __init__(self, weight=1., batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.square(pred - label)
-        loss = _apply_weighting(F, loss, self._weight / 2, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+    def _residual(self, F, pred, label):
+        return 0.5 * F.square(pred - label)
 
 
-class L1Loss(Loss):
+class L1Loss(_MatchedLoss):
     def __init__(self, weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.abs(pred - label)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+    def _residual(self, F, pred, label):
+        return F.abs(pred - label)
 
 
-class SigmoidBinaryCrossEntropyLoss(Loss):
-    def __init__(self, from_sigmoid=False, weight=None, batch_axis=0, **kwargs):
+class SigmoidBinaryCrossEntropyLoss(_MatchedLoss):
+    def __init__(self, from_sigmoid=False, weight=None, batch_axis=0,
+                 **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._from_sigmoid = from_sigmoid
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        if not self._from_sigmoid:
-            # stable log-sum-exp formulation (parity: loss.py SigmoidBCE)
-            loss = F.relu(pred) - pred * label + \
-                F.Activation(-F.abs(pred), act_type="softrelu")
-        else:
-            loss = -(F.log(pred + 1e-12) * label +
-                     F.log(1. - pred + 1e-12) * (1. - label))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+    def _residual(self, F, pred, label):
+        if self._from_sigmoid:
+            eps = 1e-12
+            return -label * F.log(pred + eps) \
+                - (1. - label) * F.log(1. - pred + eps)
+        return _logit_bce(F, pred, label)
 
 
 SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
@@ -88,15 +110,14 @@ class SoftmaxCrossEntropyLoss(Loss):
         self._from_logits = from_logits
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        if not self._from_logits:
-            pred = F.log_softmax(pred, axis=self._axis)
+        logp = pred if self._from_logits \
+            else F.log_softmax(pred, axis=self._axis)
         if self._sparse_label:
-            loss = -F.pick(pred, label, axis=self._axis, keepdims=True)
+            nll = -F.pick(logp, label, axis=self._axis, keepdims=True)
         else:
-            label = _reshape_like(F, label, pred)
-            loss = -F.sum(pred * label, axis=self._axis, keepdims=True)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+            nll = -F.sum(logp * _like(label, logp), axis=self._axis,
+                         keepdims=True)
+        return self._finish(F, nll, sample_weight)
 
 
 SoftmaxCELoss = SoftmaxCrossEntropyLoss
@@ -110,111 +131,99 @@ class KLDivLoss(Loss):
         self._axis = axis
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        if not self._from_logits:
-            pred = F.log_softmax(pred, axis=self._axis)
-        loss = label * (F.log(label + 1e-12) - pred)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        # pred is expected in log space; label stays a distribution
+        logp = pred if self._from_logits \
+            else F.log_softmax(pred, axis=self._axis)
+        div = label * (F.log(label + 1e-12) - logp)
+        return self._finish(F, div, sample_weight)
 
 
 class CTCLoss(Loss):
-    """Parity: loss.py:398 over contrib ctc_loss (layouts NTC/TNC)."""
+    """Connectionist temporal classification, blank = last class.
 
-    def __init__(self, layout="NTC", label_layout="NT", weight=None, **kwargs):
-        assert layout in ("NTC", "TNC")
-        assert label_layout in ("NT", "TN")
+    Parity: loss.py:398 (layouts NTC/TNC over contrib ctc_loss).
+    """
+
+    def __init__(self, layout="NTC", label_layout="NT", weight=None,
+                 **kwargs):
+        if layout not in ("NTC", "TNC"):
+            raise ValueError("layout must be NTC or TNC, got %r" % layout)
+        if label_layout not in ("NT", "TN"):
+            raise ValueError("label_layout must be NT or TN, got %r"
+                             % label_layout)
         self._layout = layout
         self._label_layout = label_layout
-        batch_axis = label_layout.find("N")
-        super().__init__(weight, batch_axis, **kwargs)
+        super().__init__(weight, label_layout.find("N"), **kwargs)
 
     def hybrid_forward(self, F, pred, label, pred_lengths=None,
                        label_lengths=None, sample_weight=None):
+        # the kernel wants time-major activations and batch-major labels
         if self._layout == "NTC":
             pred = F.swapaxes(pred, dim1=0, dim2=1)
-        if self._batch_axis == 1:
+        if self._label_layout == "TN":
             label = F.swapaxes(label, dim1=0, dim2=1)
-        loss = F.CTCLoss(pred, label,
-                         pred_lengths if pred_lengths is not None else None,
-                         label_lengths if label_lengths is not None else None,
-                         use_data_lengths=pred_lengths is not None,
-                         use_label_lengths=label_lengths is not None,
-                         blank_label="last")
-        return _apply_weighting(F, loss, self._weight, sample_weight)
+        per_seq = F.CTCLoss(pred, label, pred_lengths, label_lengths,
+                            use_data_lengths=pred_lengths is not None,
+                            use_label_lengths=label_lengths is not None,
+                            blank_label="last")
+        return _scaled(F, per_seq, self._weight, sample_weight)
 
 
-class HuberLoss(Loss):
+class HuberLoss(_MatchedLoss):
     def __init__(self, rho=1, weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._rho = rho
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.abs(pred - label)
-        loss = F.where(loss > self._rho,
-                       loss - 0.5 * self._rho,
-                       (0.5 / self._rho) * F.square(loss))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+    def _residual(self, F, pred, label):
+        err = F.abs(pred - label)
+        # quadratic inside the rho tube, linear outside (equal at err==rho)
+        return F.where(err < self._rho,
+                       (0.5 / self._rho) * F.square(err),
+                       err - 0.5 * self._rho)
 
 
-class HingeLoss(Loss):
+class HingeLoss(_MatchedLoss):
     def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._margin = margin
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.relu(self._margin - pred * label)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+    def _residual(self, F, pred, label):
+        return F.relu(self._margin - pred * label)
 
 
-class SquaredHingeLoss(Loss):
-    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
-        super().__init__(weight, batch_axis, **kwargs)
-        self._margin = margin
-
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.square(F.relu(self._margin - pred * label))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+class SquaredHingeLoss(HingeLoss):
+    def _residual(self, F, pred, label):
+        return F.square(super()._residual(F, pred, label))
 
 
-class LogisticLoss(Loss):
+class LogisticLoss(_MatchedLoss):
     def __init__(self, weight=None, batch_axis=0, label_format="signed",
                  **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
+        if label_format not in ("signed", "binary"):
+            raise ValueError("label_format must be signed or binary, got %r"
+                             % label_format)
         self._label_format = label_format
-        if self._label_format not in ["signed", "binary"]:
-            raise ValueError("label_format can only be signed or binary, "
-                             "recieved %s." % label_format)
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
+    def _residual(self, F, pred, label):
         if self._label_format == "signed":
-            label = (label + 1.0) / 2.0
-        loss = F.relu(pred) - pred * label + \
-            F.Activation(-F.abs(pred), act_type="softrelu")
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+            label = 0.5 * (label + 1.)   # {-1,1} -> {0,1}
+        return _logit_bce(F, pred, label)
 
 
 class TripletLoss(Loss):
-    """Parity: loss.py:666."""
+    """Parity: loss.py:666 (reduce the margin gap, then clamp)."""
 
     def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._margin = margin
 
     def hybrid_forward(self, F, pred, positive, negative, sample_weight=None):
-        positive = _reshape_like(F, positive, pred)
-        negative = _reshape_like(F, negative, pred)
-        loss = F.sum(F.square(pred - positive) - F.square(pred - negative),
-                     axis=self._batch_axis, exclude=True)
-        loss = F.relu(loss + self._margin)
-        return _apply_weighting(F, loss, self._weight, sample_weight)
+        gap = F.square(pred - _like(positive, pred)) \
+            - F.square(pred - _like(negative, pred))
+        gap = F.sum(gap, axis=self._batch_axis, exclude=True)
+        return _scaled(F, F.relu(gap + self._margin), self._weight,
+                       sample_weight)
 
 
 class PoissonNLLLoss(Loss):
@@ -224,16 +233,21 @@ class PoissonNLLLoss(Loss):
         self._from_logits = from_logits
         self._compute_full = compute_full
 
-    def hybrid_forward(self, F, pred, target, sample_weight=None, epsilon=1e-08):
-        target = _reshape_like(F, target, pred)
+    def hybrid_forward(self, F, pred, target, sample_weight=None,
+                       epsilon=1e-08):
+        target = _like(target, pred)
         if self._from_logits:
-            loss = F.exp(pred) - target * pred
+            nll = F.exp(pred) - target * pred
         else:
-            loss = pred - target * F.log(pred + epsilon)
+            nll = pred - target * F.log(pred + epsilon)
         if self._compute_full:
-            stirling_factor = target * F.log(target + 1e-12) - target + \
-                0.5 * F.log(2 * target * 3.1415926)
-            stirling_factor = stirling_factor * (target > 1)
-            loss = loss + stirling_factor
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss)
+            # Stirling correction log(k!) ~ k log k - k + log(2*pi*k)/2,
+            # applied only where it is meaningful (target > 1). The clamp
+            # keeps log() finite at target==0 in the unselected branch —
+            # masking by multiply would turn its -inf into NaN.
+            safe = F.maximum(target, 1.)
+            stirling = target * F.log(safe) - target \
+                + 0.5 * F.log(6.2831853 * safe)
+            nll = nll + F.where(target > 1, stirling, stirling * 0.)
+        # reference quirk kept: full mean, not a per-sample vector
+        return F.mean(_scaled(F, nll, self._weight, sample_weight))

@@ -336,19 +336,51 @@ class Symbol:
             for n in self._topo())
         if not has_unknown:
             return self._infer_shape_once(known, partial, None)
-        candidates = []
-        ordered = sorted(known.items(),
-                         key=lambda kv: 0 if "data" in kv[0] else 1)
-        for name, shp in ordered:
+        # dims of data-role inputs are the batch candidates, leading dim
+        # first (NTC keeps batch at dim 0, TNC at dim 1 — both get tried;
+        # first success wins so a batch of 1 can't trip a broadcast-induced
+        # false ambiguity); dims of other known inputs (weights etc.) are a
+        # last resort so a square weight dim can't shadow the data's batch
+        primary, fallback = [], []
+        for name, shp in known.items():
+            bucket = primary if "data" in name else fallback
             for d in (shp or ()):
-                if d and d not in candidates:
-                    candidates.append(d)
+                if d and d not in bucket:
+                    bucket.append(d)
+        fallback = [d for d in fallback if d not in primary]
         last_err = None
-        for guess in candidates or [None]:
+        for guess in primary:
             try:
                 return self._infer_shape_once(known, partial, guess)
             except Exception as e:  # wrong guess: try the next dim
                 last_err = e
+        if not primary:
+            # no data-named input to anchor on: probe every dim and demand
+            # the survivors agree, so a coincidentally type-checking weight
+            # dim can't resolve the graph to the wrong shape silently
+            successes = []
+            for guess in fallback or [None]:
+                try:
+                    successes.append(
+                        (guess, self._infer_shape_once(known, partial, guess)))
+                except Exception as e:
+                    last_err = e
+            if successes:
+                disagreeing = [g for g, res in successes[1:]
+                               if res != successes[0][1]]
+                if disagreeing and not partial:
+                    raise MXNetError(
+                        "ambiguous deferred (0) dims: guesses %s all "
+                        "type-check but yield different shapes; pass an "
+                        "explicit shape for the deferred input(s)"
+                        % ([successes[0][0]] + disagreeing))
+                return successes[0][1]
+        else:
+            for guess in fallback:
+                try:
+                    return self._infer_shape_once(known, partial, guess)
+                except Exception as e:
+                    last_err = e
         if partial:
             return None, None, None
         raise MXNetError(

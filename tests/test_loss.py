@@ -199,3 +199,18 @@ def test_losses_match_torch():
                         target_lengths=torch.tensor([3, 2]),
                         blank=C - 1, reduction="none").numpy()
     np.testing.assert_allclose(ours_v, ref_v, rtol=1e-3, atol=1e-3)
+
+
+def test_poisson_nll_compute_full_zero_targets():
+    """Stirling term must stay finite when a target count is 0 (the common
+    Poisson case); mask-by-multiply would leak -inf*0 = NaN."""
+    pred = np.array([[0.5, 1.0, -0.3]], np.float32)
+    target = np.array([[0.0, 3.0, 1.0]], np.float32)
+    out = gloss.PoissonNLLLoss(from_logits=True, compute_full=True)(
+        nd.array(pred), nd.array(target)).asnumpy()
+    assert np.isfinite(out).all()
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as tF
+    ref = tF.poisson_nll_loss(torch.tensor(pred), torch.tensor(target),
+                              log_input=True, full=True).item()
+    np.testing.assert_allclose(out.mean(), ref, rtol=1e-5)

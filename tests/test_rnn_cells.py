@@ -237,3 +237,13 @@ def test_fused_rnn_matches_torch():
         ref, _ = tnet(torch.tensor(x))
         np.testing.assert_allclose(ours, ref.detach().numpy(), rtol=1e-4,
                                    atol=1e-5, err_msg=mode)
+
+
+def test_symbolic_unroll_batch_one():
+    """batch=1 must resolve, not trip a broadcast-induced false ambiguity
+    (every guess type-checks against size-1 activations by broadcasting)."""
+    from mxnet_tpu import rnn as mrnn
+    cell = mrnn.LSTMCell(20, prefix="b1_")
+    outs, _ = cell.unroll(5, mx.sym.Variable("data"))
+    _, o, _ = mx.sym.Group(outs).infer_shape(data=(1, 5, 8))
+    assert o[0] == (1, 20)
