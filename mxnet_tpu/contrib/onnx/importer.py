@@ -6,10 +6,14 @@ leans on the onnx python package; here the model file is decoded with the
 wire-level codec in ``wire.py`` and translated straight into the native
 Symbol DAG, so ONNX import works with zero extra dependencies.
 
-Supported op set (the model-zoo CNN/MLP surface): Conv, BatchNormalization,
-Relu/Sigmoid/Tanh/LeakyRelu, MaxPool/AveragePool/GlobalAveragePool/
-GlobalMaxPool, Gemm, MatMul, Reshape, Concat, Add/Sum/Mul, Flatten,
-Softmax, Dropout, Identity, Transpose.
+Supported op set matches the reference's ``_convert_map``
+(import_helper.py:38-100): generators (Constant, RandomUniform/Normal[Like]),
+arithmetic (Add/Sub/Mul/Div/Sum/Abs/Neg/Ceil/Floor/Max/Min), NN (Conv,
+ConvTranspose, BatchNormalization/SpatialBN, FC/Gemm/MatMul, LRN, Pad,
+pooling incl. global, Relu/Sigmoid/Tanh/LeakyRelu/Elu/PRelu, Softmax,
+Dropout), shape/type (Reshape, Cast, Split, Slice, Transpose, Squeeze,
+Flatten, Concat, Identity), powers (Reciprocal/Sqrt/Pow/Exp/Log), reductions
+(ReduceMax/Mean/Min/Sum/Prod), search (ArgMax/ArgMin).
 """
 from __future__ import annotations
 
@@ -358,15 +362,306 @@ def _flatten(g, node):
 
 @_translates("Softmax")
 def _softmax(g, node):
-    return g.sym.softmax(g.symbol_of(node.inputs[0]),
-                         axis=int(node.attrs.get("axis", -1)),
-                         name=node.name or None)
+    data = g.symbol_of(node.inputs[0])
+    if g.model.opset >= 13:
+        return g.sym.softmax(data, axis=int(node.attrs.get("axis", -1)),
+                             name=node.name or None)
+    # opset < 13: softmax is defined on the input COERCED to 2-D at `axis`
+    # (default 1) — normalize over everything from `axis` on, jointly
+    axis = int(node.attrs.get("axis", 1))
+    if axis < 0:
+        raise NotImplementedError(
+            "negative Softmax axis on opset<13 needs the input rank; "
+            "re-export with a non-negative axis or opset>=13")
+    flat = g.sym.Reshape(data, shape=(0,) * axis + (-1,))
+    return g.sym.reshape_like(g.sym.softmax(flat, axis=-1), data)
 
 
 @_translates("Dropout", "Identity")
 def _identity(g, node):
     # Dropout at inference is identity; training-mode import re-applies it
     return g.sym.identity(g.symbol_of(node.inputs[0]))
+
+
+# -- generators -------------------------------------------------------------
+
+
+@_translates("Constant")
+def _constant(g, node):
+    arr = node.attrs.get("value")
+    if arr is None:
+        raise NotImplementedError(
+            "Constant without a `value` tensor attribute")
+    # also visible to const_of() consumers (Reshape shapes etc.)
+    g.model.initializers.setdefault(node.outputs[0], np.asarray(arr))
+    return g.new_param(node.name or node.outputs[0], np.asarray(arr))
+
+
+def _like_shape(g, name):
+    """Static shape of ONNX tensor `name` for the Random*Like ops."""
+    if name in g.model.initializers:
+        return g.model.initializers[name].shape
+    for n, shape in g.model.inputs:
+        if n == name and shape and all(int(d) > 0 for d in shape):
+            return tuple(int(d) for d in shape)
+    raise NotImplementedError(
+        "Random*Like needs a static shape for %r (initializer or typed "
+        "graph input)" % name)
+
+
+@_translates("RandomUniform", "RandomUniformLike")
+def _random_uniform(g, node):
+    shape = (tuple(node.attrs["shape"]) if "Like" not in node.op_type
+             else _like_shape(g, node.inputs[0]))
+    return g.sym.uniform(low=float(node.attrs.get("low", 0.0)),
+                         high=float(node.attrs.get("high", 1.0)),
+                         shape=shape, name=node.name or None)
+
+
+@_translates("RandomNormal", "RandomNormalLike")
+def _random_normal(g, node):
+    shape = (tuple(node.attrs["shape"]) if "Like" not in node.op_type
+             else _like_shape(g, node.inputs[0]))
+    return g.sym.normal(loc=float(node.attrs.get("mean", 0.0)),
+                        scale=float(node.attrs.get("scale", 1.0)),
+                        shape=shape, name=node.name or None)
+
+
+# -- arithmetic / elementwise -----------------------------------------------
+
+
+def _fold_broadcast(g, node, op_name):
+    out = g.symbol_of(node.inputs[0])
+    fn = getattr(g.sym, op_name)
+    for name in node.inputs[1:]:
+        out = fn(out, g.symbol_of(name))
+    return out
+
+
+@_translates("Sub")
+def _sub(g, node):
+    return _fold_broadcast(g, node, "broadcast_sub")
+
+
+@_translates("Div")
+def _div(g, node):
+    return _fold_broadcast(g, node, "broadcast_div")
+
+
+@_translates("Max")
+def _elem_max(g, node):
+    return _fold_broadcast(g, node, "broadcast_maximum")
+
+
+@_translates("Min")
+def _elem_min(g, node):
+    return _fold_broadcast(g, node, "broadcast_minimum")
+
+
+@_translates("Abs", "Neg", "Ceil", "Floor", "Reciprocal", "Sqrt", "Exp",
+             "Log")
+def _unary(g, node):
+    fn = {"Abs": "abs", "Neg": "negative", "Ceil": "ceil", "Floor": "floor",
+          "Reciprocal": "reciprocal", "Sqrt": "sqrt", "Exp": "exp",
+          "Log": "log"}[node.op_type]
+    return getattr(g.sym, fn)(g.symbol_of(node.inputs[0]),
+                              name=node.name or None)
+
+
+@_translates("Pow")
+def _pow(g, node):
+    return g.sym.broadcast_power(g.symbol_of(node.inputs[0]),
+                                 g.symbol_of(node.inputs[1]),
+                                 name=node.name or None)
+
+
+# -- NN ---------------------------------------------------------------------
+
+
+@_translates("ConvTranspose")
+def _conv_transpose(g, node):
+    if "output_shape" in node.attrs:
+        raise NotImplementedError(
+            "ConvTranspose with output_shape (implicit padding); re-export "
+            "with explicit pads/output_padding")
+    w_arr = g.model.initializers.get(node.inputs[1])
+    if w_arr is None:
+        raise NotImplementedError("ConvTranspose weights must be initializers")
+    spatial = w_arr.ndim - 2
+    kernel, stride, dilate, pad = _conv_geometry(node.attrs, spatial)
+    adj = tuple(node.attrs.get("output_padding", (0,) * spatial))
+    group = int(node.attrs.get("group", 1))
+    kwargs = dict(kernel=kernel, stride=stride, dilate=dilate, pad=pad,
+                  adj=adj, num_filter=int(w_arr.shape[1]) * group,
+                  num_group=group, weight=g.symbol_of(node.inputs[1]),
+                  name=node.name or None)
+    if len(node.inputs) > 2:
+        kwargs["bias"] = g.symbol_of(node.inputs[2])
+    else:
+        kwargs["no_bias"] = True
+    return g.sym.Deconvolution(g.symbol_of(node.inputs[0]), **kwargs)
+
+
+_TRANSLATORS["SpatialBN"] = _batchnorm  # legacy alias (pre-1.0 exporters)
+
+
+@_translates("Elu")
+def _elu(g, node):
+    return g.sym.LeakyReLU(g.symbol_of(node.inputs[0]), act_type="elu",
+                           slope=float(node.attrs.get("alpha", 1.0)),
+                           name=node.name or None)
+
+
+@_translates("PRelu")
+def _prelu(g, node):
+    return g.sym.LeakyReLU(g.symbol_of(node.inputs[0]),
+                           gamma=g.symbol_of(node.inputs[1]),
+                           act_type="prelu", name=node.name or None)
+
+
+@_translates("FC")
+def _fc(g, node):
+    w_arr = g.model.initializers.get(node.inputs[1])
+    if w_arr is None:
+        raise NotImplementedError("FC weights must be initializers")
+    kwargs = dict(weight=g.symbol_of(node.inputs[1]),
+                  num_hidden=int(w_arr.shape[0]), name=node.name or None)
+    if len(node.inputs) > 2:
+        kwargs["bias"] = g.symbol_of(node.inputs[2])
+    else:
+        kwargs["no_bias"] = True
+    return g.sym.FullyConnected(g.symbol_of(node.inputs[0]), **kwargs)
+
+
+@_translates("LRN")
+def _lrn(g, node):
+    return g.sym.LRN(g.symbol_of(node.inputs[0]),
+                     nsize=int(node.attrs["size"]),
+                     alpha=float(node.attrs.get("alpha", 1e-4)),
+                     beta=float(node.attrs.get("beta", 0.75)),
+                     knorm=float(node.attrs.get("bias", 1.0)),
+                     name=node.name or None)
+
+
+def _ints_from_attr_or_input(g, node, attr, input_pos):
+    """Integer list that newer opsets move from an attribute to an input;
+    the input form resolves when it is a constant initializer."""
+    if attr in node.attrs:
+        return [int(v) for v in node.attrs[attr]]
+    if len(node.inputs) > input_pos:
+        return [int(v) for v in g.const_of(node.inputs[input_pos])]
+    return None
+
+
+@_translates("Pad")
+def _pad(g, node):
+    mode = node.attrs.get("mode", "constant")
+    if isinstance(mode, bytes):
+        mode = mode.decode()
+    if mode not in ("constant", "reflect", "edge"):
+        raise NotImplementedError("Pad mode %r" % mode)
+    pads = _ints_from_attr_or_input(g, node, "pads", 1)
+    if pads is None:
+        raise NotImplementedError(
+            "Pad without pads (attribute or constant input)")
+    value = float(node.attrs.get("value", 0.0))
+    if "value" not in node.attrs and len(node.inputs) > 2:
+        value = float(np.asarray(g.const_of(node.inputs[2])).reshape(()))
+    rank = len(pads) // 2
+    # ONNX: [b_0..b_n, e_0..e_n] -> pad op: (b_0, e_0, b_1, e_1, ...)
+    width = []
+    for i in range(rank):
+        width += [pads[i], pads[rank + i]]
+    return g.sym.pad(g.symbol_of(node.inputs[0]), mode=mode,
+                     pad_width=tuple(width), constant_value=value,
+                     name=node.name or None)
+
+
+# -- shape / type -----------------------------------------------------------
+
+
+@_translates("Cast")
+def _cast(g, node):
+    to = node.attrs["to"]
+    if isinstance(to, str):                # pre-opset-6 string form
+        dtype = to.lower()
+    else:
+        if int(to) not in _DTYPES:
+            raise NotImplementedError("Cast to dtype code %d" % to)
+        dtype = np.dtype(_DTYPES[int(to)]).name
+    return g.sym.cast(g.symbol_of(node.inputs[0]), dtype=dtype,
+                      name=node.name or None)
+
+
+@_translates("Split")
+def _split(g, node):
+    data = g.symbol_of(node.inputs[0])
+    axis = int(node.attrs.get("axis", 0))
+    sizes = _ints_from_attr_or_input(g, node, "split", 1)
+    if sizes is None or len(set(sizes)) == 1:
+        return g.sym.split(data, num_outputs=len(node.outputs), axis=axis,
+                           name=node.name or None)
+    # unequal sections: consecutive slice_axis windows
+    outs, start = [], 0
+    for sz in sizes:
+        outs.append(g.sym.slice_axis(data, axis=axis, begin=start,
+                                     end=start + int(sz)))
+        start += int(sz)
+    return g.sym.Group(outs)
+
+
+@_translates("Slice")
+def _slice(g, node):
+    begin = _ints_from_attr_or_input(g, node, "starts", 1)
+    end = _ints_from_attr_or_input(g, node, "ends", 2)
+    if begin is None or end is None:
+        raise NotImplementedError(
+            "Slice needs starts/ends as attributes or constant inputs")
+    steps = _ints_from_attr_or_input(g, node, "steps", 4)
+    if steps and any(int(s) != 1 for s in steps):
+        raise NotImplementedError("Slice with steps != 1")
+    axes = _ints_from_attr_or_input(g, node, "axes", 3)
+    if axes is None:
+        axes = list(range(len(begin)))
+    out = g.symbol_of(node.inputs[0])
+    for ax, b, e in zip(axes, begin, end):
+        out = g.sym.slice_axis(out, axis=ax, begin=b,
+                               end=None if e >= 2**31 - 1 else e)
+    return out
+
+
+@_translates("Squeeze")
+def _squeeze(g, node):
+    axes = _ints_from_attr_or_input(g, node, "axes", 1)
+    kwargs = {"axis": tuple(int(a) for a in axes)} if axes else {}
+    return g.sym.squeeze(g.symbol_of(node.inputs[0]),
+                         name=node.name or None, **kwargs)
+
+
+# -- reductions / search ----------------------------------------------------
+
+
+@_translates("ReduceMax", "ReduceMean", "ReduceMin", "ReduceSum",
+             "ReduceProd")
+def _reduce(g, node):
+    fn = {"ReduceMax": "max", "ReduceMean": "mean", "ReduceMin": "min",
+          "ReduceSum": "sum", "ReduceProd": "prod"}[node.op_type]
+    axes = _ints_from_attr_or_input(g, node, "axes", 1)
+    kwargs = {"axis": tuple(int(a) for a in axes)} if axes else {}
+    return getattr(g.sym, fn)(g.symbol_of(node.inputs[0]),
+                              keepdims=bool(node.attrs.get("keepdims", 1)),
+                              name=node.name or None, **kwargs)
+
+
+@_translates("ArgMax", "ArgMin")
+def _arg_reduce(g, node):
+    fn = "argmax" if node.op_type == "ArgMax" else "argmin"
+    out = getattr(g.sym, fn)(g.symbol_of(node.inputs[0]),
+                             axis=int(node.attrs.get("axis", 0)),
+                             keepdims=bool(node.attrs.get("keepdims", 1)))
+    # ONNX mandates int64 indices; the framework's index dtype is int32
+    # (JAX x64 is off on TPU), so cast to the widest available int
+    return g.sym.cast(out, dtype="int32", name=node.name or None)
 
 
 def translate(model):

@@ -470,15 +470,21 @@ def _apply_op(opdef, args, kwargs):
         ctx = Context(*ctx.split("(")) if "(" in ctx else Context(ctx)
 
     nd_positions = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
-    nd_inputs = [args[i] for i in nd_positions]
+    nd_kw_names = tuple(k for k, v in kwargs.items() if isinstance(v, NDArray))
+    nd_inputs = [args[i] for i in nd_positions] \
+        + [kwargs[k] for k in nd_kw_names]
     vals = [a._data for a in nd_inputs]
     static_args = [None if isinstance(a, NDArray) else a for a in args]
+    static_kwargs = {k: v for k, v in kwargs.items() if k not in nd_kw_names}
 
     def closed_fn(*tensors):
         full = list(static_args)
         for pos, t in zip(nd_positions, tensors):
             full[pos] = t
-        return opdef.fn(*full, **kwargs)
+        kw = dict(static_kwargs)
+        for name, t in zip(nd_kw_names, tensors[len(nd_positions):]):
+            kw[name] = t
+        return opdef.fn(*full, **kw)
 
     rng_key = None
     recording = autograd.is_recording()
@@ -492,7 +498,8 @@ def _apply_op(opdef, args, kwargs):
             and _JIT_OP_FAILS.get(opdef.name, 0) < _JIT_OP_FAIL_CAP:
         try:
             key = (opdef.fn, _freeze(static_args), tuple(nd_positions),
-                   _freeze(kwargs), autograd.is_training())
+                   nd_kw_names, _freeze(static_kwargs),
+                   autograd.is_training())
             hash(key)
         except TypeError:
             key = None

@@ -354,33 +354,27 @@ class Symbol:
                 return self._infer_shape_once(known, partial, guess)
             except Exception as e:  # wrong guess: try the next dim
                 last_err = e
-        if not primary:
-            # no data-named input to anchor on: probe every dim and demand
-            # the survivors agree, so a coincidentally type-checking weight
-            # dim can't resolve the graph to the wrong shape silently
-            successes = []
-            for guess in fallback or [None]:
-                try:
-                    successes.append(
-                        (guess, self._infer_shape_once(known, partial, guess)))
-                except Exception as e:
-                    last_err = e
-            if successes:
-                disagreeing = [g for g, res in successes[1:]
-                               if res != successes[0][1]]
-                if disagreeing and not partial:
-                    raise MXNetError(
-                        "ambiguous deferred (0) dims: guesses %s all "
-                        "type-check but yield different shapes; pass an "
-                        "explicit shape for the deferred input(s)"
-                        % ([successes[0][0]] + disagreeing))
-                return successes[0][1]
-        else:
-            for guess in fallback:
-                try:
-                    return self._infer_shape_once(known, partial, guess)
-                except Exception as e:
-                    last_err = e
+        # weight-dim guesses are a last resort whether or not a data-named
+        # input existed; either way probe every candidate and demand the
+        # survivors agree, so a coincidentally type-checking weight dim
+        # can't resolve the graph to the wrong shape silently
+        successes = []
+        for guess in fallback or [None]:
+            try:
+                successes.append(
+                    (guess, self._infer_shape_once(known, partial, guess)))
+            except Exception as e:
+                last_err = e
+        if successes:
+            disagreeing = [g for g, res in successes[1:]
+                           if res != successes[0][1]]
+            if disagreeing and not partial:
+                raise MXNetError(
+                    "ambiguous deferred (0) dims: guesses %s all "
+                    "type-check but yield different shapes; pass an "
+                    "explicit shape for the deferred input(s)"
+                    % ([successes[0][0]] + disagreeing))
+            return successes[0][1]
         if partial:
             return None, None, None
         raise MXNetError(
@@ -697,6 +691,9 @@ def create(op_name, *args, name=None, attr=None, **kwargs):
             want.remove("bias")
         if opdef.name == "RNN" and kwargs.get("mode", "lstm") != "lstm":
             want.remove("state_cell")
+        if opdef.name == "LeakyReLU" \
+                and kwargs.get("act_type", "leaky") != "prelu":
+            want.remove("gamma")  # only the prelu variant is parametric
     elif opdef.name == "Custom":
         # the prop's declared argument order defines input binding
         # (reference custom.cc maps kwargs onto list_arguments()) — kwargs

@@ -28,6 +28,11 @@ def attr_proto(name, value):
         return out + wire.field_fixed32(2, value) + wire.field_varint(20, 1)
     if isinstance(value, int):
         return out + wire.field_varint(3, value) + wire.field_varint(20, 2)
+    if isinstance(value, str):
+        return out + wire.field_bytes(4, value) + wire.field_varint(20, 3)
+    if isinstance(value, np.ndarray):
+        return out + wire.field_bytes(5, t_proto(name, value)) + \
+            wire.field_varint(20, 4)
     if isinstance(value, (list, tuple)):
         return out + wire.packed_varints(8, list(value)) + \
             wire.field_varint(20, 7)
@@ -229,6 +234,227 @@ def test_shared_gemm_weight_not_corrupted(tmp_path):
     x = rng.randn(2, 6).astype(np.float32)
     out = _run(sym, args, auxs, x=x)[0]
     np.testing.assert_allclose(out, (x @ w.T) @ w, rtol=1e-4, atol=1e-5)
+
+
+def test_import_arith_and_unary_chain(tmp_path):
+    rng = np.random.RandomState(5)
+    x = (rng.rand(2, 3).astype(np.float32) + 0.5) * 3
+    y = (rng.rand(2, 3).astype(np.float32) + 0.5)
+    blob = model_proto(
+        nodes=[node_proto("Sub", ["x", "y"], ["d"]),
+               node_proto("Abs", ["d"], ["a"]),
+               node_proto("Sqrt", ["a"], ["sq"]),
+               node_proto("Exp", ["sq"], ["e"]),
+               node_proto("Log", ["e"], ["l"]),
+               node_proto("Div", ["l", "y"], ["dv"]),
+               node_proto("Neg", ["dv"], ["n"]),
+               node_proto("Floor", ["n"], ["f"]),
+               node_proto("Ceil", ["f"], ["c"]),
+               node_proto("Reciprocal", ["y"], ["r"]),
+               node_proto("Pow", ["y", "p"], ["pw"]),
+               node_proto("Max", ["c", "r"], ["mx_"]),
+               node_proto("Min", ["mx_", "pw"], ["z"])],
+        initializers={"p": np.full((1,), 2.0, np.float32)},
+        inputs=[("x", (2, 3)), ("y", (2, 3))], outputs=[("z", (2, 3))])
+    sym, args, auxs = import_model(_write(tmp_path, blob))
+    out = _run(sym, args, auxs, x=x, y=y)[0]
+    expect = np.minimum(
+        np.maximum(np.ceil(np.floor(-(np.sqrt(np.abs(x - y)) / y))), 1.0 / y),
+        y ** 2)
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_import_reduce_and_arg_ops(tmp_path):
+    rng = np.random.RandomState(6)
+    x = rng.randn(2, 3, 4).astype(np.float32)
+    blob = model_proto(
+        nodes=[node_proto("ReduceSum", ["x"], ["s"], axes=[1], keepdims=1),
+               node_proto("ReduceMean", ["x"], ["m"], axes=[0, 2],
+                          keepdims=0),
+               node_proto("ReduceMax", ["x"], ["mx_"], axes=[2], keepdims=0),
+               node_proto("ReduceMin", ["x"], ["mn"], axes=[2], keepdims=0),
+               node_proto("ReduceProd", ["x"], ["p"], axes=[0], keepdims=1),
+               node_proto("ArgMax", ["x"], ["am"], axis=2, keepdims=0),
+               node_proto("ArgMin", ["x"], ["an"], axis=1)],
+        initializers={}, inputs=[("x", (2, 3, 4))],
+        outputs=[("s", (2, 1, 4)), ("m", (3,)), ("mx_", (2, 3)),
+                 ("mn", (2, 3)), ("p", (1, 3, 4)), ("am", (2, 3)),
+                 ("an", (2, 1, 4))])
+    sym, args, auxs = import_model(_write(tmp_path, blob))
+    s, m, mx_, mn, p, am, an = _run(sym, args, auxs, x=x)
+    np.testing.assert_allclose(s, x.sum(1, keepdims=True), rtol=1e-5)
+    np.testing.assert_allclose(m, x.mean(axis=(0, 2)), rtol=1e-5)
+    np.testing.assert_allclose(mx_, x.max(2), rtol=1e-6)
+    np.testing.assert_allclose(mn, x.min(2), rtol=1e-6)
+    np.testing.assert_allclose(p, x.prod(0, keepdims=True), rtol=1e-5)
+    assert np.issubdtype(am.dtype, np.integer)
+    assert np.issubdtype(an.dtype, np.integer)
+    np.testing.assert_array_equal(am, x.argmax(2))
+    np.testing.assert_array_equal(an, x.argmin(1)[:, None, :])
+
+
+def test_import_slice_split_squeeze_cast_pad(tmp_path):
+    rng = np.random.RandomState(7)
+    x = rng.randn(2, 6, 4).astype(np.float32)
+    blob = model_proto(
+        nodes=[node_proto("Slice", ["x"], ["sl"], starts=[0, 1],
+                          ends=[2, 5], axes=[0, 1]),
+               node_proto("Split", ["sl"], ["s0", "s1"], axis=1),
+               node_proto("Sub", ["s0", "s1"], ["d"]),
+               node_proto("Pad", ["d"], ["pd"], mode="constant",
+                          pads=[0, 0, 1, 0, 0, 1], value=2.5),
+               node_proto("Cast", ["pd"], ["ci"], to=6),
+               node_proto("Cast", ["ci"], ["y"], to=1),
+               node_proto("Squeeze", ["one"], ["sq"], axes=[0, 2]),
+               node_proto("Add", ["y", "sq"], ["z"])],
+        initializers={"one": np.full((1, 6, 1), 0.25, np.float32)},
+        inputs=[("x", (2, 6, 4))], outputs=[("z", (2, 2, 6))])
+    sym, args, auxs = import_model(_write(tmp_path, blob))
+    out = _run(sym, args, auxs, x=x)[0]
+    sl = x[0:2, 1:5]
+    d = sl[:, :2] - sl[:, 2:]
+    pd = np.pad(d, [(0, 0), (0, 0), (1, 1)], constant_values=2.5)
+    expect = pd.astype(np.int32).astype(np.float32) + 0.25
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+def test_import_unequal_split_sections(tmp_path):
+    x = np.arange(24, dtype=np.float32).reshape(2, 12)
+    blob = model_proto(
+        nodes=[node_proto("Split", ["x"], ["a", "b", "c"], axis=1,
+                          split=[2, 4, 6]),
+               node_proto("Concat", ["c", "b", "a"], ["y"], axis=1)],
+        initializers={}, inputs=[("x", (2, 12))], outputs=[("y", (2, 12))])
+    sym, args, auxs = import_model(_write(tmp_path, blob))
+    out = _run(sym, args, auxs, x=x)[0]
+    expect = np.concatenate([x[:, 6:], x[:, 2:6], x[:, :2]], axis=1)
+    np.testing.assert_allclose(out, expect)
+
+
+def test_import_convtranspose_prelu_elu_lrn(tmp_path):
+    rng = np.random.RandomState(9)
+    w = (rng.randn(3, 2, 2, 2) * 0.3).astype(np.float32)  # (Cin, Cout, kh, kw)
+    gamma = np.array([0.1, 0.3], np.float32)
+    x = rng.randn(1, 3, 4, 4).astype(np.float32)
+    blob = model_proto(
+        nodes=[node_proto("ConvTranspose", ["x", "w"], ["ct"],
+                          kernel_shape=[2, 2], strides=[2, 2]),
+               node_proto("PRelu", ["ct", "g"], ["pr"]),
+               node_proto("Elu", ["pr"], ["el"], alpha=0.5),
+               node_proto("LRN", ["el"], ["y"], size=3, alpha=1e-4,
+                          beta=0.75, bias=2.0)],
+        initializers={"w": w, "g": gamma},
+        inputs=[("x", (1, 3, 4, 4))], outputs=[("y", (1, 2, 8, 8))])
+    sym, args, auxs = import_model(_write(tmp_path, blob))
+    out = _run(sym, args, auxs, x=x)[0]
+    ct = mx.nd.Deconvolution(mx.nd.array(x), mx.nd.array(w), kernel=(2, 2),
+                             stride=(2, 2), num_filter=2,
+                             no_bias=True).asnumpy()
+    pr = np.where(ct > 0, ct, gamma.reshape(1, -1, 1, 1) * ct)
+    el = np.where(pr > 0, pr, 0.5 * np.expm1(pr))
+    lrn = mx.nd.LRN(mx.nd.array(el), nsize=3, alpha=1e-4, beta=0.75,
+                    knorm=2.0).asnumpy()
+    np.testing.assert_allclose(out, lrn, rtol=1e-4, atol=1e-5)
+
+
+def test_import_constant_feeds_reshape_and_fc(tmp_path):
+    rng = np.random.RandomState(10)
+    w = rng.randn(5, 8).astype(np.float32)
+    shp = np.array([2, 8], np.int64)
+    blob = model_proto(
+        nodes=[node_proto("Constant", [], ["shp"], value=shp),
+               node_proto("Reshape", ["x", "shp"], ["flat"]),
+               node_proto("FC", ["flat", "w"], ["y"])],
+        initializers={"w": w},
+        inputs=[("x", (2, 2, 4))], outputs=[("y", (2, 5))])
+    sym, args, auxs = import_model(_write(tmp_path, blob))
+    x = rng.randn(2, 2, 4).astype(np.float32)
+    out = _run(sym, args, auxs, x=x)[0]
+    np.testing.assert_allclose(out, x.reshape(2, 8) @ w.T, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_import_random_generators(tmp_path):
+    blob = model_proto(
+        nodes=[node_proto("RandomUniform", [], ["u"], shape=[64, 8],
+                          low=2.0, high=3.0),
+               node_proto("RandomNormalLike", ["x"], ["n"], mean=10.0,
+                          scale=0.5),
+               node_proto("Add", ["u", "n"], ["y"])],
+        initializers={}, inputs=[("x", (64, 8))], outputs=[("y", (64, 8))])
+    sym, args, auxs = import_model(_write(tmp_path, blob))
+    out = _run(sym, args, auxs, x=np.zeros((64, 8), np.float32))[0]
+    assert out.shape == (64, 8)
+    # u in [2,3), n ~ N(10, .5): sum lands near 12.5 with tight spread
+    assert 11.0 < out.mean() < 14.0
+    assert out.std() < 2.0
+
+
+def test_softmax_old_opset_flatten_coercion(tmp_path):
+    """opset<13 Softmax (no axis attr) normalizes over the FLATTENED
+    trailing dims from axis=1, not a single axis."""
+    rng = np.random.RandomState(11)
+    x = rng.randn(2, 3, 4).astype(np.float32)
+    blob = model_proto(
+        nodes=[node_proto("Softmax", ["x"], ["y"])],
+        initializers={}, inputs=[("x", (2, 3, 4))],
+        outputs=[("y", (2, 3, 4))], opset=9)
+    sym, args, auxs = import_model(_write(tmp_path, blob))
+    out = _run(sym, args, auxs, x=x)[0]
+    flat = x.reshape(2, -1)
+    e = np.exp(flat - flat.max(-1, keepdims=True))
+    expect = (e / e.sum(-1, keepdims=True)).reshape(x.shape)
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+    # each batch row must normalize to 1 over ALL 12 positions
+    np.testing.assert_allclose(out.reshape(2, -1).sum(-1), 1.0, rtol=1e-5)
+
+
+def test_import_opset13_attrs_as_inputs(tmp_path):
+    """Newer opsets move axes/pads/split/starts from attributes to inputs;
+    the constant-initializer form must translate, not silently full-reduce."""
+    rng = np.random.RandomState(12)
+    x = rng.randn(2, 4, 6).astype(np.float32)
+    blob = model_proto(
+        nodes=[node_proto("ReduceSum", ["x", "rax"], ["s"], keepdims=1),
+               node_proto("Pad", ["s", "pds"], ["pd"], mode="constant"),
+               node_proto("Slice", ["pd", "sts", "ens", "sax"], ["sl"]),
+               node_proto("Split", ["sl", "spl"], ["a", "b"], axis=2),
+               node_proto("ReduceSum", ["b"], ["bm"], axes=[2], keepdims=1),
+               node_proto("Sub", ["a", "bm"], ["y"])],
+        initializers={"rax": np.array([1], np.int64),
+                      "pds": np.array([0, 0, 1, 0, 0, 1], np.int64),
+                      "sts": np.array([0], np.int64),
+                      "ens": np.array([1], np.int64),
+                      "sax": np.array([1], np.int64),
+                      "spl": np.array([2, 6], np.int64)},
+        inputs=[("x", (2, 4, 6))], outputs=[("y", (2, 1, 2))])
+    sym, args, auxs = import_model(_write(tmp_path, blob))
+    out = _run(sym, args, auxs, x=x)[0]
+    s = x.sum(1, keepdims=True)
+    pd = np.pad(s, [(0, 0), (0, 0), (1, 1)])
+    sl = pd[:, 0:1, :]
+    expect = sl[:, :, :2] - sl[:, :, 2:].sum(2, keepdims=True)
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_import_covers_reference_convert_map(tmp_path):
+    """Every op type in the reference's _convert_map
+    (import_helper.py:38-100) must have a translator here."""
+    from mxnet_tpu.contrib.onnx import importer
+    reference_ops = [
+        "Constant", "RandomUniform", "RandomNormal", "RandomUniformLike",
+        "RandomNormalLike", "Add", "Sub", "Mul", "Div", "Abs", "Neg",
+        "Sum", "Tanh", "Ceil", "Floor", "Concat", "Sigmoid", "Relu",
+        "Pad", "MatMul", "Conv", "ConvTranspose", "BatchNormalization",
+        "SpatialBN", "LeakyRelu", "Elu", "PRelu", "Softmax", "FC",
+        "GlobalAveragePool", "GlobalMaxPool", "Gemm", "LRN", "Dropout",
+        "Reshape", "Cast", "Split", "Slice", "Transpose", "Squeeze",
+        "Flatten", "Reciprocal", "Sqrt", "Pow", "Exp", "Log",
+        "ReduceMax", "ReduceMean", "ReduceMin", "ReduceSum", "ReduceProd",
+        "AveragePool", "MaxPool", "ArgMax", "ArgMin", "Max", "Min",
+    ]
+    missing = [op for op in reference_ops if op not in importer._TRANSLATORS]
+    assert not missing, "no translator for: %s" % missing
 
 
 def test_unsupported_geometry_raises(tmp_path):
