@@ -341,16 +341,12 @@ def _concat(g, node):
 
 @_translates("Add", "Sum")
 def _add(g, node):
-    out = g.symbol_of(node.inputs[0])
-    for name in node.inputs[1:]:
-        out = g.sym.broadcast_add(out, g.symbol_of(name))
-    return out
+    return _fold_broadcast(g, node, "broadcast_add")
 
 
 @_translates("Mul")
 def _mul(g, node):
-    return g.sym.broadcast_mul(g.symbol_of(node.inputs[0]),
-                               g.symbol_of(node.inputs[1]))
+    return _fold_broadcast(g, node, "broadcast_mul")
 
 
 @_translates("Flatten")
@@ -369,9 +365,11 @@ def _softmax(g, node):
     # opset < 13: softmax is defined on the input COERCED to 2-D at `axis`
     # (default 1) — normalize over everything from `axis` on, jointly
     axis = int(node.attrs.get("axis", 1))
+    if axis == -1:  # coercion at the last axis == plain last-axis softmax
+        return g.sym.softmax(data, axis=-1, name=node.name or None)
     if axis < 0:
         raise NotImplementedError(
-            "negative Softmax axis on opset<13 needs the input rank; "
+            "Softmax axis < -1 on opset<13 needs the input rank; "
             "re-export with a non-negative axis or opset>=13")
     flat = g.sym.Reshape(data, shape=(0,) * axis + (-1,))
     return g.sym.reshape_like(g.sym.softmax(flat, axis=-1), data)
@@ -545,10 +543,11 @@ def _lrn(g, node):
 
 def _ints_from_attr_or_input(g, node, attr, input_pos):
     """Integer list that newer opsets move from an attribute to an input;
-    the input form resolves when it is a constant initializer."""
+    the input form resolves when it is a constant initializer. A skipped
+    optional input is encoded as the empty string — treated as absent."""
     if attr in node.attrs:
         return [int(v) for v in node.attrs[attr]]
-    if len(node.inputs) > input_pos:
+    if len(node.inputs) > input_pos and node.inputs[input_pos]:
         return [int(v) for v in g.const_of(node.inputs[input_pos])]
     return None
 
@@ -565,7 +564,7 @@ def _pad(g, node):
         raise NotImplementedError(
             "Pad without pads (attribute or constant input)")
     value = float(node.attrs.get("value", 0.0))
-    if "value" not in node.attrs and len(node.inputs) > 2:
+    if "value" not in node.attrs and len(node.inputs) > 2 and node.inputs[2]:
         value = float(np.asarray(g.const_of(node.inputs[2])).reshape(()))
     rank = len(pads) // 2
     # ONNX: [b_0..b_n, e_0..e_n] -> pad op: (b_0, e_0, b_1, e_1, ...)
@@ -647,6 +646,9 @@ def _reduce(g, node):
     fn = {"ReduceMax": "max", "ReduceMean": "mean", "ReduceMin": "min",
           "ReduceSum": "sum", "ReduceProd": "prod"}[node.op_type]
     axes = _ints_from_attr_or_input(g, node, "axes", 1)
+    if not axes and node.attrs.get("noop_with_empty_axes", 0):
+        # opset>=13: empty axes + this flag means "return input unchanged"
+        return g.sym.identity(g.symbol_of(node.inputs[0]))
     kwargs = {"axis": tuple(int(a) for a in axes)} if axes else {}
     return getattr(g.sym, fn)(g.symbol_of(node.inputs[0]),
                               keepdims=bool(node.attrs.get("keepdims", 1)),

@@ -775,6 +775,48 @@ def arange(start, stop=None, step=1.0, repeat=1, dtype=None, **kwargs):
                   dtype=dtype or "float32", **kwargs)
 
 
+def full(shape, val, dtype=None, **kwargs):
+    return create("_full", shape=tuple(shape), value=float(val),
+                  dtype=dtype or "float32", **kwargs)
+
+
+def eye(N, M=0, k=0, dtype=None, **kwargs):
+    return create("_eye", N=N, M=M, k=k, dtype=dtype or "float32", **kwargs)
+
+
+def _sym_or_scalar(lhs, rhs, both_op, lscalar_op, rscalar_op):
+    """Dispatch a binary on Symbol/scalar argument mix (parity:
+    symbol/symbol.py pow/maximum/minimum/hypot module functions)."""
+    lsym, rsym = isinstance(lhs, Symbol), isinstance(rhs, Symbol)
+    if lsym and rsym:
+        return create(both_op, lhs, rhs)
+    if lsym:
+        return create(lscalar_op, lhs, scalar=float(rhs))
+    if rsym:
+        return create(rscalar_op, rhs, scalar=float(lhs))
+    raise TypeError("expected at least one Symbol argument")
+
+
+def pow(base, exp):  # overrides the generated two-symbol-only op
+    return _sym_or_scalar(base, exp, "_power", "_power_scalar",
+                          "_rpower_scalar")
+
+
+def maximum(lhs, rhs):
+    return _sym_or_scalar(lhs, rhs, "_maximum", "_maximum_scalar",
+                          "_maximum_scalar")
+
+
+def minimum(lhs, rhs):
+    return _sym_or_scalar(lhs, rhs, "_minimum", "_minimum_scalar",
+                          "_minimum_scalar")
+
+
+def hypot(lhs, rhs):
+    return _sym_or_scalar(lhs, rhs, "_hypot", "_hypot_scalar",
+                          "_hypot_scalar")
+
+
 def load(fname):
     with open(fname) as f:
         return load_json(f.read())

@@ -86,6 +86,62 @@ def check_server_side_optimizer(kv, rank, nworker):
     kv.barrier()
 
 
+def check_combined_nightly_scale(kv, rank, nworker):
+    """The reference nightly's stress shape: a dense key crossing
+    MXNET_KVSTORE_BIGARRAY_BOUND (chunked transport), a row_sparse key, and
+    2-bit compression all active simultaneously, gradients arriving as
+    per-device lists (the local multi-device reduce), with cross-rank
+    bit-identity asserted via a digest key (parity: reference
+    tests/nightly/dist_sync_kvstore.py:36-60 key sizing)."""
+    import jax
+    ndev = jax.local_device_count()
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    big_shape = (3000, 3)  # 9000 elements > the 4096 bound set by the test
+    kv.init("cbig", mx.nd.zeros(big_shape))
+    rsp_shape = (40, 5)
+    kv.init("crsp", RowSparseNDArray.from_dense(mx.nd.zeros(rsp_shape)))
+
+    # per-device shards summing to 2.0 -> local reduce -> quantizes to +0.5
+    grads = [mx.nd.NDArray(mx.nd.ones(big_shape)._data * (2.0 / ndev),
+                           ctx=mx.cpu(d)) for d in range(ndev)]
+    kv.push("cbig", grads)
+    out = mx.nd.zeros(big_shape)
+    kv.pull("cbig", out=out)
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.full(big_shape, 0.5 * nworker), rtol=1e-6)
+
+    # sparse keys bypass the active compressor (as in the reference, which
+    # never compresses row_sparse) — both paths live in the same push cycle
+    rows = np.array([rank, rank + nworker, rsp_shape[0] - 1], np.int32)
+    vals = np.full((3, rsp_shape[1]), rank + 1, np.float32)
+    kv.push("crsp", RowSparseNDArray(rows, vals, rsp_shape))
+    dense = kv.row_sparse_pull(
+        "crsp", row_ids=mx.nd.array(np.arange(rsp_shape[0],
+                                              dtype=np.float32))
+    ).todense().asnumpy()
+    expected = np.zeros(rsp_shape, np.float32)
+    for r in range(nworker):
+        expected[r] += r + 1
+        expected[r + nworker] += r + 1
+        expected[rsp_shape[0] - 1] += r + 1
+    np.testing.assert_allclose(dense, expected, rtol=1e-5)
+
+    # bit-identity: each rank pushes a digest of its pulled bytes; the sum
+    # equals nworker * own-digest only if every rank pulled identical bits
+    kv._compressor = None
+    dig = np.array([np.frombuffer(out.asnumpy().tobytes(),
+                                  np.uint8).sum() % 100003,
+                    np.frombuffer(dense.tobytes(),
+                                  np.uint8).sum() % 100003], np.float32)
+    kv.init("digest", mx.nd.zeros((2,)))
+    kv.push("digest", mx.nd.array(dig))
+    dsum = mx.nd.zeros((2,))
+    kv.pull("digest", out=dsum)
+    np.testing.assert_allclose(dsum.asnumpy(), dig * nworker, rtol=0,
+                               atol=0)
+    kv.barrier()
+
+
 def main():
     kv = kvs.create("dist_sync")
     rank, nworker = kv.rank, kv.num_workers
@@ -95,6 +151,7 @@ def main():
     check_row_sparse(kv, rank, nworker)
     check_compressed(kv, rank, nworker)
     check_server_side_optimizer(kv, rank, nworker)
+    check_combined_nightly_scale(kv, rank, nworker)
     print("DIST_KVSTORE_OK rank=%d nworker=%d" % (rank, nworker), flush=True)
 
 

@@ -1,0 +1,130 @@
+"""Convergence tier: the framework must TRAIN to accuracy bars, not just
+step (parity: reference tests/python/train/ — test_mlp.py and test_conv.py
+assert >97% MNIST accuracy, the bucketing suite asserts perplexity). Run
+with `pytest -m slow -k converge`.
+
+Data is the hermetic synthetic stack (no downloads in this env); every bar
+here sits far above what an un-trained or mis-trained model can reach:
+chance is 10% on the image tasks, perplexity ~= vocab for the LM, and 50%
+for the sparse classifier.
+"""
+import math
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+def test_converge_lenet_module_fit():
+    """LeNet through the symbolic Module.fit path reaches >=0.97 val acc
+    (reference: tests/python/train/test_conv.py)."""
+    train, val = mx.test_utils.get_mnist_iterator(batch_size=100,
+                                                  input_shape=(1, 28, 28))
+    mod = mx.mod.Module(mx.models.get_lenet(), context=mx.cpu())
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.init.Xavier(), num_epoch=2)
+    acc = mod.score(val, "acc")[0][1]
+    assert acc >= 0.97, "LeNet val accuracy %.3f < 0.97" % acc
+
+
+def test_converge_mlp_module_fit():
+    """MLP through Module.fit reaches >=0.97 val acc (reference:
+    tests/python/train/test_mlp.py)."""
+    train, val = mx.test_utils.get_mnist_iterator(batch_size=100,
+                                                  input_shape=(784,))
+    mod = mx.mod.Module(mx.models.get_mlp(), context=mx.cpu())
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.init.Xavier(), num_epoch=2)
+    acc = mod.score(val, "acc")[0][1]
+    assert acc >= 0.97, "MLP val accuracy %.3f < 0.97" % acc
+
+
+def _markov_tokens(n, vocab, seed=0):
+    """First-order chain: successor is (7t+d)%vocab with d in {0,1,2} — an
+    LM that learns the transition structure approaches perplexity 3; one
+    that doesn't sits near `vocab`."""
+    rng = np.random.RandomState(seed)
+    tokens = [0]
+    for _ in range(n):
+        tokens.append((tokens[-1] * 7 + rng.randint(0, 3)) % vocab)
+    return tokens
+
+
+def test_converge_word_lm_perplexity():
+    """The LSTM word LM must cut perplexity by >=3x and land under 12 on a
+    near-deterministic Markov corpus (optimal ~3, chance ~80)."""
+    vocab, bptt, batch_size = 80, 16, 16
+    tokens = _markov_tokens(20000, vocab)
+    n = len(tokens) // batch_size
+    stream = np.asarray(tokens[:n * batch_size]).reshape(batch_size, n).T
+
+    model = mx.models.RNNModel(vocab_size=vocab, num_embed=32, num_hidden=64,
+                               num_layers=1, dropout=0.0)
+    model.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(model.collect_params(), "adam",
+                            {"learning_rate": 3e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def epoch_ppl(train):
+        total, count = 0.0, 0
+        hidden = model.begin_state(batch_size)
+        for t in range(0, stream.shape[0] - bptt - 1, bptt):
+            x = mx.nd.array(stream[t:t + bptt].astype(np.float32))
+            y = mx.nd.array(stream[t + 1:t + bptt + 1].astype(np.float32))
+            if train:
+                with autograd.record():
+                    out, hidden = model(x, hidden)
+                    L = loss_fn(out, y.reshape((-1,)))
+                L.backward()
+                # detach hidden across truncation boundaries
+                hidden = [h.detach() for h in hidden] \
+                    if isinstance(hidden, (list, tuple)) else hidden.detach()
+                trainer.step(x.shape[0] * x.shape[1])
+            else:
+                out, hidden = model(x, hidden)
+                L = loss_fn(out, y.reshape((-1,)))
+            total += float(L.mean().asnumpy())
+            count += 1
+        return math.exp(total / count)
+
+    first = epoch_ppl(train=False)
+    for _ in range(2):
+        epoch_ppl(train=True)
+    final = epoch_ppl(train=False)
+    assert final < first / 3, "ppl %.1f -> %.1f: <3x drop" % (first, final)
+    assert final < 12.0, "final perplexity %.2f >= 12" % final
+
+
+def test_converge_sparse_linear_auc():
+    """Row-sparse linear classifier reaches AUC >= 0.93 on synthetic sparse
+    data (reference: example/sparse/linear_classification's criteo AUC
+    loop, scaled to the hermetic env)."""
+    num_features, batch_size = 1000, 64
+    rng = np.random.RandomState(0)
+    true_w = rng.uniform(-1, 1, (num_features,))
+    kv = mx.kv.create("local")
+    model = mx.models.SparseLinear(num_features, num_classes=2, kvstore=kv,
+                                   learning_rate=0.2)
+    for _ in range(150):
+        mask = rng.uniform(size=(batch_size, num_features)) < 0.05
+        x = mx.nd.array((rng.uniform(-1, 1, mask.shape) * mask)
+                        .astype(np.float32))
+        y = ((x.asnumpy() @ true_w) > 0).astype(np.float32)
+        model.step(x, mx.nd.array(y))
+
+    # AUC over fresh data
+    mask = rng.uniform(size=(512, num_features)) < 0.05
+    x = (rng.uniform(-1, 1, mask.shape) * mask).astype(np.float32)
+    y = ((x @ true_w) > 0).astype(np.int32)
+    scores = model.forward(mx.nd.array(x)).asnumpy()
+    margin = scores[:, 1] - scores[:, 0]
+    order = np.argsort(margin)
+    ranks = np.empty_like(order, dtype=np.float64)
+    ranks[order] = np.arange(1, len(order) + 1)
+    pos = y == 1
+    auc = (ranks[pos].sum() - pos.sum() * (pos.sum() + 1) / 2) / \
+        (pos.sum() * (~pos).sum())
+    assert auc >= 0.93, "AUC %.3f < 0.93" % auc

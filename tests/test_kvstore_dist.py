@@ -23,6 +23,9 @@ def test_dist_sync_kvstore_multiprocess(nworker):
         # small bound so the (1200, 7) key exercises chunked transport
         "MXNET_KVSTORE_BIGARRAY_BOUND": "4096",
         "PYTHONPATH": REPO,
+        # 4 virtual devices per worker: the combined nightly-scale check
+        # pushes per-device gradient lists through the local reduce
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
     })
     # the launcher pins workers to pure-CPU jax (no TPU tunnel contention)
     cmd = [sys.executable, os.path.join(REPO, "tools", "launch.py"),

@@ -229,6 +229,42 @@ def test_multisample_nb_draws():
         assert hasattr(nd, name), name
 
 
+def test_symbol_namespace_carries_nd_surface():
+    """Every registry op exposed on mx.nd must exist on mx.sym, plus the
+    reference's symbol module functions (symbol/symbol.py: pow, maximum,
+    minimum, hypot, eye, zeros, ones, full, arange, var, Group, load,
+    load_json) — symbolic users must not hit AttributeError on ops the
+    imperative API has."""
+    from mxnet_tpu.ops.registry import OPS
+    missing = [n for n in OPS if not callable(getattr(mx.sym, n, None))]
+    assert not missing, "registry ops absent from mx.sym: %s" % missing
+    for name in ["pow", "maximum", "minimum", "hypot", "eye", "zeros",
+                 "ones", "full", "arange", "var", "Variable", "Group",
+                 "load", "load_json"]:
+        assert callable(getattr(mx.sym, name)), name
+
+
+def test_symbol_module_binary_scalar_dispatch():
+    x = mx.sym.Variable("x")
+    xa = nd.array(np.array([2.0, 3.0], np.float32))
+
+    def run(s):
+        return s.bind(mx.cpu(), {"x": xa}).forward()[0].asnumpy()
+
+    np.testing.assert_allclose(run(mx.sym.pow(x, 3.0)), [8.0, 27.0])
+    np.testing.assert_allclose(run(mx.sym.pow(2.0, x)), [4.0, 8.0])
+    np.testing.assert_allclose(run(mx.sym.maximum(x, 2.5)), [2.5, 3.0])
+    np.testing.assert_allclose(run(mx.sym.minimum(2.5, x)), [2.0, 2.5])
+    np.testing.assert_allclose(run(mx.sym.hypot(x, 4.0)),
+                               np.hypot([2.0, 3.0], 4.0), rtol=1e-6)
+    np.testing.assert_allclose(
+        mx.sym.full((2, 2), 7.0).bind(mx.cpu(), {}).forward()[0].asnumpy(),
+        np.full((2, 2), 7.0, np.float32))
+    np.testing.assert_allclose(
+        mx.sym.eye(3, k=1).bind(mx.cpu(), {}).forward()[0].asnumpy(),
+        np.eye(3, k=1, dtype=np.float32))
+
+
 def test_legacy_0index_ops():
     lhs = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
     rhs = nd.array(np.array([2, 0], np.float32))
