@@ -1,0 +1,21 @@
+#!/bin/bash
+# Probe the axon tunnel until it answers, then run the full measurement
+# session EXCLUSIVELY (nothing else may touch the tunnel while this runs —
+# concurrent clients wedge the relay and/or trip bench.py's reachability
+# probe into CPU fallback). Launch detached:
+#   nohup bash benchmarks/tpu_watchdog.sh > /tmp/tpu_watchdog.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+for i in $(seq 1 120); do
+  echo "[watchdog] probe $i at $(date -u +%H:%M:%S)"
+  if timeout 150 python -c "import jax; d=jax.devices()[0]; assert d.platform != 'cpu', d; print('alive:', d)"; then
+    echo "[watchdog] tunnel alive; starting session at $(date -u +%H:%M:%S)"
+    bash benchmarks/tpu_session.sh
+    rc=$?
+    echo "[watchdog] session finished rc=$rc at $(date -u +%H:%M:%S)"
+    exit $rc
+  fi
+  sleep 90
+done
+echo "[watchdog] tunnel never came up"
+exit 1

@@ -1,0 +1,67 @@
+// C++ inference API over exported .mxtpu artifacts.
+//
+// Parity: the reference's C++ prediction surface
+// (cpp-package/include/mxnet-cpp/ + include/mxnet/c_predict_api.h:78-200 —
+// MXPredCreate/SetInput/Forward/GetOutput). TPU-native redesign: instead of
+// wrapping a framework C API, the predictor drives the PJRT C API directly —
+// it dlopens any PJRT plugin (the TPU plugin, or any other conforming .so),
+// compiles the artifact's StableHLO module bytecode, and executes it. No
+// Python, no framework runtime, no protobuf/MLIR dependencies at build time.
+//
+// Artifact contract (written by mxnet_tpu/predict.py export_model):
+// a STORE-only zip holding `model.mlir` (StableHLO bytecode) and
+// `signature.txt` ("in|out <dtype> <d0>x<d1>..." per tensor).
+#ifndef MXTPU_PREDICTOR_HPP_
+#define MXTPU_PREDICTOR_HPP_
+
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace mxtpu {
+
+enum class DType { kF32, kF16, kF64, kBF16, kS32, kS64, kS8, kU8, kPred };
+
+size_t dtype_bytes(DType t);
+const char* dtype_name(DType t);
+
+struct Tensor {
+  DType dtype = DType::kF32;
+  std::vector<int64_t> dims;
+  std::vector<uint8_t> data;  // dense, row-major (major-to-minor)
+
+  int64_t num_elements() const;
+  size_t byte_size() const { return num_elements() * dtype_bytes(dtype); }
+};
+
+class Predictor {
+ public:
+  // Loads `artifact_path` (.mxtpu zip), dlopens `plugin_so` (a PJRT
+  // plugin), creates a client and compiles the module. Throws
+  // std::runtime_error with the PJRT error message on failure.
+  Predictor(const std::string& artifact_path, const std::string& plugin_so);
+  ~Predictor();
+
+  // Input/output specs from the artifact signature (data left empty).
+  const std::vector<Tensor>& input_specs() const;
+  const std::vector<Tensor>& output_specs() const;
+
+  // PJRT platform name of the backing client, e.g. "tpu".
+  const std::string& platform() const;
+
+  // Runs one inference. `inputs` must match input_specs() in count, dtype,
+  // dims, and byte size. Returns fully materialized host tensors.
+  std::vector<Tensor> forward(const std::vector<Tensor>& inputs);
+
+  Predictor(const Predictor&) = delete;
+  Predictor& operator=(const Predictor&) = delete;
+
+ private:
+  struct Impl;
+  std::unique_ptr<Impl> impl_;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_PREDICTOR_HPP_

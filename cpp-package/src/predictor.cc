@@ -1,0 +1,420 @@
+// PJRT-driving implementation of mxtpu::Predictor. See predictor.hpp for
+// the design rationale (reference parity: c_predict_api.cc, redesigned to
+// speak the PJRT C API directly).
+#include "mxtpu/predictor.hpp"
+
+#include <dlfcn.h>
+
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <stdexcept>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace mxtpu {
+
+int64_t Tensor::num_elements() const {
+  int64_t n = 1;
+  for (int64_t d : dims) n *= d;
+  return n;
+}
+
+size_t dtype_bytes(DType t) {
+  switch (t) {
+    case DType::kF64: case DType::kS64: return 8;
+    case DType::kF32: case DType::kS32: return 4;
+    case DType::kF16: case DType::kBF16: return 2;
+    default: return 1;
+  }
+}
+
+const char* dtype_name(DType t) {
+  switch (t) {
+    case DType::kF32: return "f32";
+    case DType::kF16: return "f16";
+    case DType::kF64: return "f64";
+    case DType::kBF16: return "bf16";
+    case DType::kS32: return "s32";
+    case DType::kS64: return "s64";
+    case DType::kS8: return "s8";
+    case DType::kU8: return "u8";
+    case DType::kPred: return "pred";
+  }
+  return "?";
+}
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// minimal STORE-only zip reader (export_model writes with zipfile's default
+// ZIP_STORED; compressed entries are rejected, not silently misread)
+// ---------------------------------------------------------------------------
+
+uint32_t rd32(const uint8_t* p) {
+  return p[0] | (p[1] << 8) | (p[2] << 16) | (uint32_t(p[3]) << 24);
+}
+uint16_t rd16(const uint8_t* p) { return p[0] | (p[1] << 8); }
+
+std::string read_zip_entry(const std::string& path, const std::string& name) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw std::runtime_error("cannot open artifact " + path);
+  std::vector<uint8_t> buf((std::istreambuf_iterator<char>(f)),
+                           std::istreambuf_iterator<char>());
+  if (buf.size() < 22) throw std::runtime_error("artifact too small");
+  // end-of-central-directory: scan back for PK\x05\x06
+  size_t eocd = std::string::npos;
+  for (size_t i = buf.size() - 22; i + 22 > 21; --i) {
+    if (rd32(&buf[i]) == 0x06054b50) { eocd = i; break; }
+    if (i == 0) break;
+  }
+  if (eocd == std::string::npos)
+    throw std::runtime_error("not a zip artifact (no EOCD)");
+  uint16_t n_entries = rd16(&buf[eocd + 10]);
+  size_t off = rd32(&buf[eocd + 16]);
+  for (uint16_t e = 0; e < n_entries; ++e) {
+    if (off + 46 > buf.size() || rd32(&buf[off]) != 0x02014b50)
+      throw std::runtime_error("corrupt zip central directory");
+    uint16_t method = rd16(&buf[off + 10]);
+    uint32_t csize = rd32(&buf[off + 20]);
+    uint16_t name_len = rd16(&buf[off + 28]);
+    uint16_t extra_len = rd16(&buf[off + 30]);
+    uint16_t comment_len = rd16(&buf[off + 32]);
+    uint32_t local_off = rd32(&buf[off + 42]);
+    std::string entry(reinterpret_cast<char*>(&buf[off + 46]), name_len);
+    if (entry == name) {
+      if (method != 0)
+        throw std::runtime_error("zip entry " + name + " is compressed; "
+                                 "artifacts must be STORE-only");
+      // local header: skip its (possibly different) name/extra lengths
+      if (local_off + 30 > buf.size() ||
+          rd32(&buf[local_off]) != 0x04034b50)
+        throw std::runtime_error("corrupt zip local header");
+      uint16_t lname = rd16(&buf[local_off + 26]);
+      uint16_t lextra = rd16(&buf[local_off + 28]);
+      size_t data = local_off + 30 + lname + lextra;
+      if (data + csize > buf.size())
+        throw std::runtime_error("zip entry overruns file");
+      return std::string(reinterpret_cast<char*>(&buf[data]), csize);
+    }
+    off += 46 + name_len + extra_len + comment_len;
+  }
+  throw std::runtime_error("artifact has no entry " + name);
+}
+
+// ---------------------------------------------------------------------------
+// signature.txt parsing
+// ---------------------------------------------------------------------------
+
+DType parse_dtype(const std::string& s) {
+  if (s == "f32") return DType::kF32;
+  if (s == "f16") return DType::kF16;
+  if (s == "f64") return DType::kF64;
+  if (s == "bf16") return DType::kBF16;
+  if (s == "s32") return DType::kS32;
+  if (s == "s64") return DType::kS64;
+  if (s == "s8") return DType::kS8;
+  if (s == "u8") return DType::kU8;
+  if (s == "pred") return DType::kPred;
+  throw std::runtime_error("signature has unknown dtype " + s);
+}
+
+PJRT_Buffer_Type pjrt_type(DType t) {
+  switch (t) {
+    case DType::kF32: return PJRT_Buffer_Type_F32;
+    case DType::kF16: return PJRT_Buffer_Type_F16;
+    case DType::kF64: return PJRT_Buffer_Type_F64;
+    case DType::kBF16: return PJRT_Buffer_Type_BF16;
+    case DType::kS32: return PJRT_Buffer_Type_S32;
+    case DType::kS64: return PJRT_Buffer_Type_S64;
+    case DType::kS8: return PJRT_Buffer_Type_S8;
+    case DType::kU8: return PJRT_Buffer_Type_U8;
+    case DType::kPred: return PJRT_Buffer_Type_PRED;
+  }
+  return PJRT_Buffer_Type_INVALID;
+}
+
+void parse_signature(const std::string& text, std::vector<Tensor>* ins,
+                     std::vector<Tensor>* outs) {
+  std::istringstream ss(text);
+  std::string line;
+  while (std::getline(ss, line)) {
+    if (line.empty()) continue;
+    std::istringstream ls(line);
+    std::string role, dtype, dims;
+    ls >> role >> dtype >> dims;
+    Tensor t;
+    t.dtype = parse_dtype(dtype);
+    if (dims != "" && dims != "scalar") {
+      std::istringstream ds(dims);
+      std::string d;
+      while (std::getline(ds, d, 'x')) t.dims.push_back(std::stoll(d));
+    }
+    if (role == "in") ins->push_back(std::move(t));
+    else if (role == "out") outs->push_back(std::move(t));
+    else throw std::runtime_error("signature has unknown role " + role);
+  }
+  if (outs->empty())
+    throw std::runtime_error("signature declares no outputs");
+}
+
+// ---------------------------------------------------------------------------
+// hand-rolled CompileOptionsProto (xla/pjrt/proto/compile_options.proto):
+// executable_build_options{device_ordinal: -1, num_replicas: 1,
+// num_partitions: 1} — the single-device default, no protobuf dependency
+// ---------------------------------------------------------------------------
+
+std::string compile_options_bytes() {
+  std::string sub;
+  sub += '\x08';                                   // field 1 varint
+  for (int i = 0; i < 9; ++i) sub += '\xff';       // -1 as 64-bit varint
+  sub += '\x01';
+  sub += "\x20\x01";                               // field 4: num_replicas=1
+  sub += "\x28\x01";                               // field 5: num_partitions=1
+  std::string out;
+  out += '\x1a';                                   // field 3 LEN
+  out += static_cast<char>(sub.size());
+  out += sub;
+  return out;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// Impl
+// ---------------------------------------------------------------------------
+
+struct Predictor::Impl {
+  void* dso = nullptr;
+  const PJRT_Api* api = nullptr;
+  PJRT_Client* client = nullptr;
+  PJRT_LoadedExecutable* exec = nullptr;
+  PJRT_Device* device = nullptr;
+  std::string platform;
+  std::vector<Tensor> input_specs;
+  std::vector<Tensor> output_specs;
+
+  void check(PJRT_Error* err, const char* what) {
+    if (err == nullptr) return;
+    PJRT_Error_Message_Args m;
+    std::memset(&m, 0, sizeof(m));
+    m.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+    m.error = err;
+    api->PJRT_Error_Message(&m);
+    std::string msg(m.message, m.message_size);
+    PJRT_Error_Destroy_Args d;
+    std::memset(&d, 0, sizeof(d));
+    d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+    d.error = err;
+    api->PJRT_Error_Destroy(&d);
+    throw std::runtime_error(std::string(what) + ": " + msg);
+  }
+
+  void await(PJRT_Event* ev, const char* what) {
+    if (ev == nullptr) return;
+    PJRT_Event_Await_Args a;
+    std::memset(&a, 0, sizeof(a));
+    a.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+    a.event = ev;
+    PJRT_Error* err = api->PJRT_Event_Await(&a);
+    PJRT_Event_Destroy_Args d;
+    std::memset(&d, 0, sizeof(d));
+    d.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+    d.event = ev;
+    api->PJRT_Event_Destroy(&d);
+    check(err, what);
+  }
+
+  ~Impl() {
+    if (api != nullptr) {
+      if (exec != nullptr) {
+        PJRT_LoadedExecutable_Destroy_Args a;
+        std::memset(&a, 0, sizeof(a));
+        a.struct_size = PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;
+        a.executable = exec;
+        api->PJRT_LoadedExecutable_Destroy(&a);
+      }
+      if (client != nullptr) {
+        PJRT_Client_Destroy_Args a;
+        std::memset(&a, 0, sizeof(a));
+        a.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
+        a.client = client;
+        api->PJRT_Client_Destroy(&a);
+      }
+    }
+    if (dso != nullptr) dlclose(dso);
+  }
+};
+
+Predictor::Predictor(const std::string& artifact_path,
+                     const std::string& plugin_so)
+    : impl_(new Impl()) {
+  Impl& im = *impl_;
+  std::string mlir = read_zip_entry(artifact_path, "model.mlir");
+  parse_signature(read_zip_entry(artifact_path, "signature.txt"),
+                  &im.input_specs, &im.output_specs);
+
+  im.dso = dlopen(plugin_so.c_str(), RTLD_NOW | RTLD_LOCAL);
+  if (im.dso == nullptr)
+    throw std::runtime_error(std::string("dlopen failed: ") + dlerror());
+  using GetApiFn = const PJRT_Api* (*)();
+  auto get_api = reinterpret_cast<GetApiFn>(dlsym(im.dso, "GetPjrtApi"));
+  if (get_api == nullptr)
+    throw std::runtime_error(plugin_so + " exports no GetPjrtApi");
+  im.api = get_api();
+  if (im.api == nullptr)
+    throw std::runtime_error("GetPjrtApi returned null");
+
+  {
+    PJRT_Plugin_Initialize_Args a;
+    std::memset(&a, 0, sizeof(a));
+    a.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
+    im.check(im.api->PJRT_Plugin_Initialize(&a), "plugin init");
+  }
+  {
+    PJRT_Client_Create_Args a;
+    std::memset(&a, 0, sizeof(a));
+    a.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+    im.check(im.api->PJRT_Client_Create(&a), "client create");
+    im.client = a.client;
+  }
+  {
+    PJRT_Client_PlatformName_Args a;
+    std::memset(&a, 0, sizeof(a));
+    a.struct_size = PJRT_Client_PlatformName_Args_STRUCT_SIZE;
+    a.client = im.client;
+    im.check(im.api->PJRT_Client_PlatformName(&a), "platform name");
+    im.platform.assign(a.platform_name, a.platform_name_size);
+  }
+  {
+    PJRT_Client_AddressableDevices_Args a;
+    std::memset(&a, 0, sizeof(a));
+    a.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+    a.client = im.client;
+    im.check(im.api->PJRT_Client_AddressableDevices(&a), "devices");
+    if (a.num_addressable_devices == 0)
+      throw std::runtime_error("client has no addressable devices");
+    im.device = a.addressable_devices[0];
+  }
+  {
+    std::string opts = compile_options_bytes();
+    PJRT_Program program;
+    std::memset(&program, 0, sizeof(program));
+    program.struct_size = PJRT_Program_STRUCT_SIZE;
+    program.code = mlir.data();
+    program.code_size = mlir.size();
+    static const char kFormat[] = "mlir";
+    program.format = kFormat;
+    program.format_size = sizeof(kFormat) - 1;
+    PJRT_Client_Compile_Args a;
+    std::memset(&a, 0, sizeof(a));
+    a.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+    a.client = im.client;
+    a.program = &program;
+    a.compile_options = opts.data();
+    a.compile_options_size = opts.size();
+    im.check(im.api->PJRT_Client_Compile(&a), "compile");
+    im.exec = a.executable;
+  }
+}
+
+Predictor::~Predictor() = default;
+
+const std::vector<Tensor>& Predictor::input_specs() const {
+  return impl_->input_specs;
+}
+const std::vector<Tensor>& Predictor::output_specs() const {
+  return impl_->output_specs;
+}
+const std::string& Predictor::platform() const { return impl_->platform; }
+
+std::vector<Tensor> Predictor::forward(const std::vector<Tensor>& inputs) {
+  Impl& im = *impl_;
+  if (inputs.size() != im.input_specs.size())
+    throw std::runtime_error("expected " +
+                             std::to_string(im.input_specs.size()) +
+                             " inputs, got " + std::to_string(inputs.size()));
+  std::vector<PJRT_Buffer*> in_bufs;
+  std::vector<PJRT_Buffer*> out_bufs(im.output_specs.size(), nullptr);
+  auto destroy_bufs = [&](std::vector<PJRT_Buffer*>& bufs) {
+    for (PJRT_Buffer* b : bufs) {
+      if (b == nullptr) continue;
+      PJRT_Buffer_Destroy_Args d;
+      std::memset(&d, 0, sizeof(d));
+      d.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+      d.buffer = b;
+      im.api->PJRT_Buffer_Destroy(&d);
+    }
+    bufs.clear();
+  };
+  try {
+    for (size_t i = 0; i < inputs.size(); ++i) {
+      const Tensor& spec = im.input_specs[i];
+      const Tensor& t = inputs[i];
+      if (t.dtype != spec.dtype || t.dims != spec.dims ||
+          t.data.size() != spec.byte_size())
+        throw std::runtime_error(
+            "input " + std::to_string(i) + " does not match the artifact "
+            "signature (want " + std::string(dtype_name(spec.dtype)) + ")");
+      PJRT_Client_BufferFromHostBuffer_Args a;
+      std::memset(&a, 0, sizeof(a));
+      a.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+      a.client = im.client;
+      a.data = t.data.data();
+      a.type = pjrt_type(t.dtype);
+      a.dims = t.dims.data();
+      a.num_dims = t.dims.size();
+      a.host_buffer_semantics =
+          PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+      a.device = im.device;
+      im.check(im.api->PJRT_Client_BufferFromHostBuffer(&a), "host->device");
+      in_bufs.push_back(a.buffer);
+      im.await(a.done_with_host_buffer, "host->device transfer");
+    }
+
+    PJRT_ExecuteOptions opts;
+    std::memset(&opts, 0, sizeof(opts));
+    opts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+    PJRT_Buffer* const* arg_list = in_bufs.data();
+    PJRT_Buffer** out_list = out_bufs.data();
+    PJRT_LoadedExecutable_Execute_Args a;
+    std::memset(&a, 0, sizeof(a));
+    a.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+    a.executable = im.exec;
+    a.options = &opts;
+    a.argument_lists = &arg_list;
+    a.num_devices = 1;
+    a.num_args = in_bufs.size();
+    a.output_lists = &out_list;
+    im.check(im.api->PJRT_LoadedExecutable_Execute(&a), "execute");
+
+    std::vector<Tensor> outs;
+    for (size_t i = 0; i < out_bufs.size(); ++i) {
+      Tensor t = im.output_specs[i];  // dtype + dims from the signature
+      PJRT_Buffer_ToHostBuffer_Args h;
+      std::memset(&h, 0, sizeof(h));
+      h.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+      h.src = out_bufs[i];
+      im.check(im.api->PJRT_Buffer_ToHostBuffer(&h), "output size query");
+      im.await(h.event, "output size query");  // null for size-only queries
+      t.data.resize(h.dst_size);
+      std::memset(&h, 0, sizeof(h));
+      h.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+      h.src = out_bufs[i];
+      h.dst = t.data.data();
+      h.dst_size = t.data.size();
+      im.check(im.api->PJRT_Buffer_ToHostBuffer(&h), "device->host");
+      im.await(h.event, "device->host transfer");
+      outs.push_back(std::move(t));
+    }
+    destroy_bufs(in_bufs);
+    destroy_bufs(out_bufs);
+    return outs;
+  } catch (...) {
+    destroy_bufs(in_bufs);
+    destroy_bufs(out_bufs);
+    throw;
+  }
+}
+
+}  // namespace mxtpu

@@ -1,0 +1,180 @@
+// Mock PJRT plugin for testing the predictor's C-API driving without an
+// accelerator: implements exactly the call surface predictor.cc uses.
+// "Compile" records the program; "Execute" echoes the input buffers back
+// as outputs, so a round trip validates struct usage, buffer lifecycle,
+// and data transport byte-for-byte. Built as libmock_pjrt.so by the
+// Makefile; the real-plugin path is exercised against the TPU plugin when
+// one is present (tests/test_cpp_package.py).
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace {
+
+struct MockBuffer {
+  PJRT_Buffer_Type type;
+  std::vector<int64_t> dims;
+  std::vector<uint8_t> data;
+};
+
+struct MockError {
+  std::string message;
+};
+
+PJRT_Error* make_error(const std::string& msg) {
+  return reinterpret_cast<PJRT_Error*>(new MockError{msg});
+}
+
+// PJRT_Client / PJRT_Device / PJRT_LoadedExecutable are opaque; the mock
+// backs them with sentinel statics (one device, one client).
+int client_sentinel, device_sentinel, exec_sentinel, event_sentinel;
+
+size_t type_bytes(PJRT_Buffer_Type t) {
+  switch (t) {
+    case PJRT_Buffer_Type_F64: case PJRT_Buffer_Type_S64: return 8;
+    case PJRT_Buffer_Type_F32: case PJRT_Buffer_Type_S32: return 4;
+    case PJRT_Buffer_Type_F16: case PJRT_Buffer_Type_BF16: return 2;
+    default: return 1;
+  }
+}
+
+// -- error / event ----------------------------------------------------------
+
+void ErrorDestroy(PJRT_Error_Destroy_Args* args) {
+  delete reinterpret_cast<MockError*>(const_cast<PJRT_Error*>(args->error));
+}
+
+void ErrorMessage(PJRT_Error_Message_Args* args) {
+  const MockError* e = reinterpret_cast<const MockError*>(args->error);
+  args->message = e->message.c_str();
+  args->message_size = e->message.size();
+}
+
+PJRT_Error* EventDestroy(PJRT_Event_Destroy_Args*) { return nullptr; }
+PJRT_Error* EventAwait(PJRT_Event_Await_Args*) { return nullptr; }
+
+// -- plugin / client --------------------------------------------------------
+
+PJRT_Error* PluginInitialize(PJRT_Plugin_Initialize_Args*) { return nullptr; }
+
+PJRT_Error* ClientCreate(PJRT_Client_Create_Args* args) {
+  args->client = reinterpret_cast<PJRT_Client*>(&client_sentinel);
+  return nullptr;
+}
+
+PJRT_Error* ClientDestroy(PJRT_Client_Destroy_Args*) { return nullptr; }
+
+PJRT_Error* ClientPlatformName(PJRT_Client_PlatformName_Args* args) {
+  static const char kName[] = "mock";
+  args->platform_name = kName;
+  args->platform_name_size = sizeof(kName) - 1;
+  return nullptr;
+}
+
+PJRT_Error* ClientAddressableDevices(
+    PJRT_Client_AddressableDevices_Args* args) {
+  static PJRT_Device* devices[] = {
+      reinterpret_cast<PJRT_Device*>(&device_sentinel)};
+  args->addressable_devices = devices;
+  args->num_addressable_devices = 1;
+  return nullptr;
+}
+
+PJRT_Error* ClientCompile(PJRT_Client_Compile_Args* args) {
+  if (args->program == nullptr || args->program->code_size == 0)
+    return make_error("mock: empty program");
+  std::string format(args->program->format, args->program->format_size);
+  if (format != "mlir")
+    return make_error("mock: unsupported program format " + format);
+  args->executable = reinterpret_cast<PJRT_LoadedExecutable*>(&exec_sentinel);
+  return nullptr;
+}
+
+// -- buffers ----------------------------------------------------------------
+
+PJRT_Error* BufferFromHostBuffer(PJRT_Client_BufferFromHostBuffer_Args* args) {
+  MockBuffer* b = new MockBuffer();
+  b->type = args->type;
+  b->dims.assign(args->dims, args->dims + args->num_dims);
+  int64_t n = 1;
+  for (int64_t d : b->dims) n *= d;
+  size_t bytes = n * type_bytes(args->type);
+  const uint8_t* src = static_cast<const uint8_t*>(args->data);
+  b->data.assign(src, src + bytes);
+  args->buffer = reinterpret_cast<PJRT_Buffer*>(b);
+  args->done_with_host_buffer =
+      reinterpret_cast<PJRT_Event*>(&event_sentinel);
+  return nullptr;
+}
+
+PJRT_Error* BufferDestroy(PJRT_Buffer_Destroy_Args* args) {
+  delete reinterpret_cast<MockBuffer*>(args->buffer);
+  return nullptr;
+}
+
+PJRT_Error* BufferToHostBuffer(PJRT_Buffer_ToHostBuffer_Args* args) {
+  MockBuffer* b = reinterpret_cast<MockBuffer*>(args->src);
+  if (args->dst == nullptr) {
+    args->dst_size = b->data.size();
+    args->event = nullptr;
+    return nullptr;
+  }
+  if (args->dst_size < b->data.size())
+    return make_error("mock: dst too small");
+  std::memcpy(args->dst, b->data.data(), b->data.size());
+  args->event = reinterpret_cast<PJRT_Event*>(&event_sentinel);
+  return nullptr;
+}
+
+// -- execute ----------------------------------------------------------------
+
+PJRT_Error* Execute(PJRT_LoadedExecutable_Execute_Args* args) {
+  if (args->num_devices != 1)
+    return make_error("mock: expected a single device launch");
+  // echo: output i = copy of input i (the test artifact is an identity fn)
+  for (size_t i = 0; i < args->num_args; ++i) {
+    const MockBuffer* in =
+        reinterpret_cast<const MockBuffer*>(args->argument_lists[0][i]);
+    args->output_lists[0][i] = reinterpret_cast<PJRT_Buffer*>(
+        new MockBuffer(*in));
+  }
+  if (args->device_complete_events != nullptr)
+    args->device_complete_events[0] =
+        reinterpret_cast<PJRT_Event*>(&event_sentinel);
+  return nullptr;
+}
+
+PJRT_Error* ExecutableDestroy(PJRT_LoadedExecutable_Destroy_Args*) {
+  return nullptr;
+}
+
+}  // namespace
+
+extern "C" const PJRT_Api* GetPjrtApi() {
+  static PJRT_Api api;
+  static bool init = false;
+  if (!init) {
+    std::memset(&api, 0, sizeof(api));
+    api.struct_size = PJRT_Api_STRUCT_SIZE;
+    api.PJRT_Error_Destroy = ErrorDestroy;
+    api.PJRT_Error_Message = ErrorMessage;
+    api.PJRT_Plugin_Initialize = PluginInitialize;
+    api.PJRT_Event_Destroy = EventDestroy;
+    api.PJRT_Event_Await = EventAwait;
+    api.PJRT_Client_Create = ClientCreate;
+    api.PJRT_Client_Destroy = ClientDestroy;
+    api.PJRT_Client_PlatformName = ClientPlatformName;
+    api.PJRT_Client_AddressableDevices = ClientAddressableDevices;
+    api.PJRT_Client_Compile = ClientCompile;
+    api.PJRT_Client_BufferFromHostBuffer = BufferFromHostBuffer;
+    api.PJRT_Buffer_Destroy = BufferDestroy;
+    api.PJRT_Buffer_ToHostBuffer = BufferToHostBuffer;
+    api.PJRT_LoadedExecutable_Execute = Execute;
+    api.PJRT_LoadedExecutable_Destroy = ExecutableDestroy;
+    init = true;
+  }
+  return &api;
+}

@@ -172,10 +172,31 @@ def export_model(model, input_shapes, path, params=None,
                         "dtype": str(jnp.dtype(dtypes.get(n, "float32")))}
                        for n, s in shapes],
             "format": 1}
+    # PJRT-facing entries for the C++ predictor (cpp-package/): the raw
+    # StableHLO module bytecode PJRT_Client_Compile accepts, plus a
+    # dependency-free one-line-per-tensor signature so C++ never parses
+    # JSON or MLIR. zipfile defaults to STORE, which the C++ reader relies
+    # on (predictor.cc rejects compressed entries).
+    sig = ["in %s %s" % (_sig_dtype(a.dtype),
+                         "x".join(str(d) for d in a.shape))
+           for a in exported.in_avals]
+    sig += ["out %s %s" % (_sig_dtype(a.dtype),
+                           "x".join(str(d) for d in a.shape))
+            for a in exported.out_avals]
     with zipfile.ZipFile(path, "w") as z:
         z.writestr("meta.json", json.dumps(meta))
         z.writestr("model.stablehlo", blob)
+        z.writestr("model.mlir", exported.mlir_module_serialized)
+        z.writestr("signature.txt", "\n".join(sig) + "\n")
     return path
+
+
+def _sig_dtype(dt):
+    """dtype -> the signature.txt/PJRT token (predictor.cc mirrors this)."""
+    name = jnp.dtype(dt).name
+    return {"float32": "f32", "float16": "f16", "float64": "f64",
+            "bfloat16": "bf16", "int32": "s32", "int64": "s64",
+            "int8": "s8", "uint8": "u8", "bool": "pred"}.get(name, name)
 
 
 class ExportedPredictor:

@@ -40,6 +40,7 @@ _SLOW_FILES = {
     "test_env_vars.py",       # subprocess per-env-var reimports
     "test_recovery.py",       # kill/resume subprocess drills
     "test_converge.py",       # trains to accuracy/perplexity/AUC bars
+    "test_cpp_package.py",    # g++ build + subprocess CLI runs
 }
 
 # Individual compile-heavy tests (>~30 s on the 8-worker CPU tier). Every

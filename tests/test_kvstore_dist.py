@@ -59,3 +59,73 @@ def test_launcher_cli_errors():
     from tools.launch import main
     with pytest.raises(SystemExit):
         main(["-n", "2"])  # no command
+    with pytest.raises(SystemExit):
+        # yarn is a documented disposition, not a silent no-op
+        main(["-n", "2", "--launcher", "yarn", "python", "x.py"])
+
+
+_RANK_PROBE = ("import os;print('RANK %s of %s' % ("
+               "os.environ['DMLC_WORKER_ID'], os.environ['DMLC_NUM_WORKER']),"
+               "flush=True)")
+
+
+def test_launcher_mpi_derives_ranks(tmp_path, capfd):
+    """The mpi launcher's bootstrap must map the scheduler's rank env var
+    onto DMLC_WORKER_ID. The stub mpirun runs each rank sequentially the
+    way OpenMPI would, exporting OMPI_COMM_WORLD_RANK."""
+    stub = tmp_path / "mpirun"
+    stub.write_text(
+        "#!/bin/bash\n"
+        "# parse -n N, honor -x K=V exports, run command once per rank\n"
+        "n=1; declare -a kv\n"
+        "while [[ $# -gt 0 ]]; do\n"
+        "  case $1 in\n"
+        "    -n) n=$2; shift 2;;\n"
+        "    --hostfile) shift 2;;\n"
+        "    -x) kv+=(\"$2\"); shift 2;;\n"
+        "    *) break;;\n"
+        "  esac\n"
+        "done\n"
+        "for ((r=0; r<n; r++)); do\n"
+        "  env \"${kv[@]}\" OMPI_COMM_WORLD_RANK=$r \"$@\" || exit $?\n"
+        "done\n")
+    stub.chmod(0o755)
+    import tools.launch as launch
+    old_path = os.environ["PATH"]
+    os.environ["PATH"] = str(tmp_path) + os.pathsep + old_path
+    try:
+        rc = launch.main(["-n", "3", "--launcher", "mpi", "--platform",
+                          "cpu", sys.executable, "-c", _RANK_PROBE])
+    finally:
+        os.environ["PATH"] = old_path
+    out = capfd.readouterr().out
+    assert rc == 0
+    for r in range(3):
+        assert "RANK %d of 3" % r in out, out
+
+
+def test_launcher_sge_array_job(tmp_path, capfd):
+    """The sge launcher submits a 1-N array job whose tasks derive
+    DMLC_WORKER_ID from SGE_TASK_ID; the stub qsub executes every task."""
+    stub = tmp_path / "qsub"
+    stub.write_text(
+        "#!/bin/bash\n"
+        "while [[ $1 == -* ]]; do shift; [[ $1 == y ]] && shift; done\n"
+        "script=$1\n"
+        "range=$(grep -oP '(?<=#\\$ -t )1-\\d+' \"$script\")\n"
+        "n=${range#1-}\n"
+        "for ((t=1; t<=n; t++)); do\n"
+        "  SGE_TASK_ID=$t bash \"$script\" || exit $?\n"
+        "done\n")
+    stub.chmod(0o755)
+    import tools.launch as launch
+    old_path = os.environ["PATH"]
+    os.environ["PATH"] = str(tmp_path) + os.pathsep + old_path
+    try:
+        rc = launch.main(["-n", "2", "--launcher", "sge", "--platform",
+                          "cpu", sys.executable, "-c", _RANK_PROBE])
+    finally:
+        os.environ["PATH"] = old_path
+    out = capfd.readouterr().out
+    assert rc == 0
+    assert "RANK 0 of 2" in out and "RANK 1 of 2" in out, out

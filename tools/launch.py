@@ -87,6 +87,102 @@ def _worker_env(args, rank, coordinator):
     return env
 
 
+# bootstrap run inside every MPI rank: the scheduler assigns ranks, so
+# DMLC_WORKER_ID is derived from the MPI rank env var, then the user
+# command replaces the shim (parity: dmlc-tracker mpi.py's rank pass-through)
+_MPI_BOOTSTRAP = (
+    "import os,sys;"
+    "r=os.environ.get('OMPI_COMM_WORLD_RANK') or "
+    "os.environ.get('PMI_RANK') or os.environ.get('PMIX_RANK') or "
+    "os.environ.get('MV2_COMM_WORLD_RANK') or '0';"
+    "os.environ['DMLC_WORKER_ID']=r;"
+    "os.execvp(sys.argv[1],sys.argv[1:])"
+)
+
+
+def _launch_mpi(args, cmd):
+    """Fan out via mpirun; common DMLC_* env travels with -x, per-rank id
+    comes from the MPI rank (parity: reference tools/launch.py mpi path)."""
+    hosts = None
+    if args.hostfile:
+        with open(args.hostfile) as f:
+            hosts = [h.strip() for h in f
+                     if h.strip() and not h.startswith("#")]
+    coord_host = hosts[0] if hosts else "127.0.0.1"
+    # fixed default port (like the ssh path): rank 0 binds it on hosts[0],
+    # so probing for a free port HERE would check the wrong machine
+    coordinator = (coord_host, args.port or 9091)
+    env = _worker_env(args, 0, coordinator)
+    env.pop("DMLC_WORKER_ID")        # per-rank, set by the bootstrap
+    mpi_cmd = ["mpirun", "-n", str(args.num_workers)]
+    if args.hostfile:
+        mpi_cmd += ["--hostfile", args.hostfile]
+    for k in sorted(env):
+        if k.startswith(("DMLC_", "JAX_", "MXNET_", "PALLAS_")):
+            mpi_cmd += ["-x", "%s=%s" % (k, env[k])]
+    mpi_cmd += [sys.executable, "-c", _MPI_BOOTSTRAP] + cmd
+    try:
+        return subprocess.call(mpi_cmd, env=env)
+    except FileNotFoundError:
+        print("launch.py: mpirun not found on PATH", file=sys.stderr)
+        return 127
+
+
+def _launch_sge(args, cmd):
+    """Submit an SGE array job, one task per worker; DMLC_WORKER_ID comes
+    from SGE_TASK_ID. Worker 0 lands on an arbitrary execution node, so it
+    PUBLISHES its hostname through a file in the (shared, `-cwd`) working
+    directory and the fleet rendezvouses on that — the submit host never
+    appears in the coordinator address (parity: reference tools/launch.py
+    sge path via the dmlc tracker's shared-FS assumption)."""
+    import tempfile
+    port = args.port or 9091
+    coordinator = ("__COORD__", port)  # placeholder, resolved per task
+    env = _worker_env(args, 0, coordinator)
+    exports = "\n".join(
+        "export %s=%s" % (k, shlex.quote(str(env[k])))
+        for k in sorted(env)
+        if k.startswith(("DMLC_", "JAX_", "MXNET_", "PALLAS_"))
+        and k not in ("DMLC_WORKER_ID", "DMLC_PS_ROOT_URI"))
+    coordfile = os.path.join(
+        os.getcwd(), ".mxtpu_sge_coord_%d_%d" % (os.getpid(), port))
+    script = ("#!/bin/bash\n"
+              "#$ -S /bin/bash\n"
+              "#$ -cwd\n"
+              "#$ -t 1-%d\n"
+              "%s\n"
+              "export DMLC_WORKER_ID=$((SGE_TASK_ID-1))\n"
+              "if [[ $SGE_TASK_ID -eq 1 ]]; then\n"
+              "  hostname > %s.tmp && mv %s.tmp %s\n"
+              "fi\n"
+              "for _ in $(seq 1 300); do\n"
+              "  [[ -s %s ]] && break\n"
+              "  sleep 1\n"
+              "done\n"
+              "if [[ ! -s %s ]]; then\n"
+              "  echo 'launch.py sge: coordinator file never appeared (is "
+              "the working dir on a shared filesystem?)' >&2; exit 1\n"
+              "fi\n"
+              "export DMLC_PS_ROOT_URI=$(cat %s)\n"
+              "exec %s\n" % (args.num_workers, exports,
+                             coordfile, coordfile, coordfile, coordfile,
+                             coordfile, coordfile,
+                             " ".join(shlex.quote(str(c)) for c in cmd)))
+    with tempfile.NamedTemporaryFile("w", suffix=".sge.sh",
+                                     delete=False) as f:
+        f.write(script)
+        path = f.name
+    try:
+        return subprocess.call(["qsub", "-sync", "y", path])
+    except FileNotFoundError:
+        print("launch.py: qsub not found on PATH", file=sys.stderr)
+        return 127
+    finally:
+        os.unlink(path)
+        if os.path.exists(coordfile):
+            os.unlink(coordfile)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="Launch a distributed mxnet_tpu job (parity: "
@@ -95,7 +191,9 @@ def main(argv=None):
     ap.add_argument("-s", "--num-servers", type=int, default=0,
                     help="accepted for reference CLI compatibility; "
                          "collective workers need no servers")
-    ap.add_argument("--launcher", choices=["local", "ssh"], default="local")
+    ap.add_argument("--launcher",
+                    choices=["local", "ssh", "mpi", "sge", "yarn"],
+                    default="local")
     ap.add_argument("-H", "--hostfile", default=None,
                     help="newline-separated hosts (ssh launcher)")
     ap.add_argument("-p", "--port", type=int, default=0,
@@ -118,6 +216,20 @@ def main(argv=None):
             procs.append(subprocess.Popen(
                 cmd, env=_worker_env(args, rank, coordinator)))
         return _wait_fail_fast(procs)
+
+    if args.launcher == "mpi":
+        return _launch_mpi(args, cmd)
+    if args.launcher == "sge":
+        return _launch_sge(args, cmd)
+    if args.launcher == "yarn":
+        # Disposition (docs/PARITY.md): the reference's yarn launcher drives
+        # a Hadoop tracker jar; TPU fleets are scheduled by GKE/XPK or
+        # `gcloud alpha compute tpus`, not YARN. Use ssh/local/mpi here, or
+        # one job-manager pod per worker with the DMLC_* env this launcher
+        # sets (see _worker_env) when running under a cluster scheduler.
+        ap.error("the yarn launcher is not supported on TPU deployments; "
+                 "use --launcher ssh/local/mpi, or have your scheduler set "
+                 "the DMLC_* variables directly (docs/PARITY.md)")
 
     # ssh launcher: round-robin ranks over the hostfile; worker 0's host is
     # the coordinator (parity: dmlc-tracker ssh.py)
