@@ -56,11 +56,15 @@ uint32_t rd32(const uint8_t* p) {
 }
 uint16_t rd16(const uint8_t* p) { return p[0] | (p[1] << 8); }
 
-std::string read_zip_entry(const std::string& path, const std::string& name) {
+std::vector<uint8_t> read_file(const std::string& path) {
   std::ifstream f(path, std::ios::binary);
   if (!f) throw std::runtime_error("cannot open artifact " + path);
-  std::vector<uint8_t> buf((std::istreambuf_iterator<char>(f)),
-                           std::istreambuf_iterator<char>());
+  return std::vector<uint8_t>((std::istreambuf_iterator<char>(f)),
+                              std::istreambuf_iterator<char>());
+}
+
+std::string read_zip_entry(const std::vector<uint8_t>& buf,
+                           const std::string& name) {
   if (buf.size() < 22) throw std::runtime_error("artifact too small");
   // end-of-central-directory: scan back for PK\x05\x06
   size_t eocd = std::string::npos;
@@ -81,7 +85,7 @@ std::string read_zip_entry(const std::string& path, const std::string& name) {
     uint16_t extra_len = rd16(&buf[off + 30]);
     uint16_t comment_len = rd16(&buf[off + 32]);
     uint32_t local_off = rd32(&buf[off + 42]);
-    std::string entry(reinterpret_cast<char*>(&buf[off + 46]), name_len);
+    std::string entry(reinterpret_cast<const char*>(&buf[off + 46]), name_len);
     if (entry == name) {
       if (method != 0)
         throw std::runtime_error("zip entry " + name + " is compressed; "
@@ -95,7 +99,7 @@ std::string read_zip_entry(const std::string& path, const std::string& name) {
       size_t data = local_off + 30 + lname + lextra;
       if (data + csize > buf.size())
         throw std::runtime_error("zip entry overruns file");
-      return std::string(reinterpret_cast<char*>(&buf[data]), csize);
+      return std::string(reinterpret_cast<const char*>(&buf[data]), csize);
     }
     off += 46 + name_len + extra_len + comment_len;
   }
@@ -250,9 +254,12 @@ Predictor::Predictor(const std::string& artifact_path,
                      const std::string& plugin_so)
     : impl_(new Impl()) {
   Impl& im = *impl_;
-  std::string mlir = read_zip_entry(artifact_path, "model.mlir");
-  parse_signature(read_zip_entry(artifact_path, "signature.txt"),
+  std::vector<uint8_t> zip = read_file(artifact_path);
+  std::string mlir = read_zip_entry(zip, "model.mlir");
+  parse_signature(read_zip_entry(zip, "signature.txt"),
                   &im.input_specs, &im.output_specs);
+  zip.clear();
+  zip.shrink_to_fit();
 
   im.dso = dlopen(plugin_so.c_str(), RTLD_NOW | RTLD_LOCAL);
   if (im.dso == nullptr)
@@ -315,6 +322,28 @@ Predictor::Predictor(const std::string& artifact_path,
     a.compile_options_size = opts.size();
     im.check(im.api->PJRT_Client_Compile(&a), "compile");
     im.exec = a.executable;
+  }
+  // the signature drives output buffer allocation; a mismatch with the
+  // compiled module would corrupt the output_lists array, so verify it
+  // (skipped only when the plugin doesn't serve the introspection calls)
+  if (im.api->PJRT_LoadedExecutable_GetExecutable != nullptr &&
+      im.api->PJRT_Executable_NumOutputs != nullptr) {
+    PJRT_LoadedExecutable_GetExecutable_Args g;
+    std::memset(&g, 0, sizeof(g));
+    g.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+    g.loaded_executable = im.exec;
+    im.check(im.api->PJRT_LoadedExecutable_GetExecutable(&g),
+             "get executable");
+    PJRT_Executable_NumOutputs_Args n;
+    std::memset(&n, 0, sizeof(n));
+    n.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+    n.executable = g.executable;
+    im.check(im.api->PJRT_Executable_NumOutputs(&n), "num outputs");
+    if (n.num_outputs != im.output_specs.size())
+      throw std::runtime_error(
+          "artifact signature declares " +
+          std::to_string(im.output_specs.size()) + " outputs but the "
+          "compiled module produces " + std::to_string(n.num_outputs));
   }
 }
 

@@ -3,16 +3,13 @@
 Parity: reference `python/mxnet/gluon/data/dataloader.py:72-94` — batching +
 shuffling + multiprocess workers over POSIX shared memory.
 
-TPU-native redesign: workers use a thread pool by default — batch assembly is
-numpy (releases the GIL) and the expensive device transfer is XLA's async
-host→HBM DMA, so processes+shm buy little; `num_workers>0` therefore maps to
-a prefetching thread pool that keeps the host pipeline ahead of the device
-(the PrefetcherIter capability, iter_prefetcher.h).
+TPU-native redesign: workers use a thread pool — batch assembly is numpy
+(releases the GIL) and the expensive device transfer is XLA's async
+host→HBM DMA, so processes+shm buy little; `num_workers>0` maps to an
+N-thread pool that assembles batches concurrently and hands them off in
+sampler order (the PrefetcherIter capability, iter_prefetcher.h).
 """
 from __future__ import annotations
-
-import threading
-import queue as _queue
 
 import numpy as np
 
@@ -72,24 +69,24 @@ class DataLoader:
             for batch in self._batch_sampler:
                 yield self._make_batch(batch)
             return
-        # prefetching thread pool (double-buffered host pipeline)
-        q = _queue.Queue(maxsize=max(2, self._prefetch))
-        sentinel = object()
-
-        def producer():
-            try:
-                for batch in self._batch_sampler:
-                    q.put(self._make_batch(batch))
-            finally:
-                q.put(sentinel)
-
-        t = threading.Thread(target=producer, daemon=True)
-        t.start()
-        while True:
-            item = q.get()
-            if item is sentinel:
-                break
-            yield item
+        # N-worker prefetching pool with ordered hand-off: batches are
+        # assembled concurrently (numpy/image decode release the GIL) but
+        # yielded in sampler order, keeping at most `prefetch` in flight
+        from concurrent.futures import ThreadPoolExecutor
+        from collections import deque
+        pool = ThreadPoolExecutor(self._num_workers)
+        window = deque()
+        try:
+            for batch in self._batch_sampler:
+                window.append(pool.submit(self._make_batch, batch))
+                if len(window) >= max(2, self._prefetch):
+                    yield window.popleft().result()
+            while window:
+                yield window.popleft().result()
+        finally:
+            for f in window:
+                f.cancel()
+            pool.shutdown(wait=False)
 
     def __len__(self):
         return len(self._batch_sampler)

@@ -238,13 +238,21 @@ def default_interpret():
         return True
 
 
-def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
-                    block_k=128, interpret=None):
+def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
+                    block_k=None, interpret=None):
     """Flash attention over [B, H, T, D] (or [BH, T, D]) q/k/v.
 
     Falls back to the pure-XLA reference when T doesn't tile into the
-    block sizes (shape-polymorphic callers keep working).
+    block sizes (shape-polymorphic callers keep working). Block sizes
+    default to 128x128 (the MXU/VMEM sweet spot on v5e) and are
+    overridable per-run with MXNET_FLASH_BLOCK_Q/MXNET_FLASH_BLOCK_K for
+    on-hardware A/B without code edits.
     """
+    import os
+    if block_q is None:
+        block_q = int(os.environ.get("MXNET_FLASH_BLOCK_Q", "128"))
+    if block_k is None:
+        block_k = int(os.environ.get("MXNET_FLASH_BLOCK_K", "128"))
     squeeze = q.ndim == 4
     if squeeze:
         B, H, T, D = q.shape

@@ -192,11 +192,17 @@ def export_model(model, input_shapes, path, params=None,
 
 
 def _sig_dtype(dt):
-    """dtype -> the signature.txt/PJRT token (predictor.cc mirrors this)."""
+    """dtype -> the signature.txt/PJRT token (predictor.cc mirrors this).
+    Unsupported dtypes fail HERE, at export — not at serving time."""
     name = jnp.dtype(dt).name
-    return {"float32": "f32", "float16": "f16", "float64": "f64",
-            "bfloat16": "bf16", "int32": "s32", "int64": "s64",
-            "int8": "s8", "uint8": "u8", "bool": "pred"}.get(name, name)
+    token = {"float32": "f32", "float16": "f16", "float64": "f64",
+             "bfloat16": "bf16", "int32": "s32", "int64": "s64",
+             "int8": "s8", "uint8": "u8", "bool": "pred"}.get(name)
+    if token is None:
+        raise MXNetError(
+            "export_model: dtype %s has no C++ predictor mapping (supported:"
+            " f32/f16/f64/bf16/s32/s64/s8/u8/bool)" % name)
+    return token
 
 
 class ExportedPredictor:

@@ -101,8 +101,12 @@ _MPI_BOOTSTRAP = (
 
 
 def _launch_mpi(args, cmd):
-    """Fan out via mpirun; common DMLC_* env travels with -x, per-rank id
-    comes from the MPI rank (parity: reference tools/launch.py mpi path)."""
+    """Fan out via mpirun; per-rank id comes from the MPI rank env var
+    (parity: reference tools/launch.py mpi path). Env travels as an
+    `env K=V ...` command prefix — portable across OpenMPI and MPICH,
+    whose env-forwarding flags (-x vs -env) disagree. The hostfile flag
+    is OpenMPI's `--hostfile`; MPICH users should rely on their process
+    manager's host configuration instead."""
     hosts = None
     if args.hostfile:
         with open(args.hostfile) as f:
@@ -117,9 +121,10 @@ def _launch_mpi(args, cmd):
     mpi_cmd = ["mpirun", "-n", str(args.num_workers)]
     if args.hostfile:
         mpi_cmd += ["--hostfile", args.hostfile]
+    mpi_cmd += ["env"]
     for k in sorted(env):
         if k.startswith(("DMLC_", "JAX_", "MXNET_", "PALLAS_")):
-            mpi_cmd += ["-x", "%s=%s" % (k, env[k])]
+            mpi_cmd += ["%s=%s" % (k, env[k])]
     mpi_cmd += [sys.executable, "-c", _MPI_BOOTSTRAP] + cmd
     try:
         return subprocess.call(mpi_cmd, env=env)
