@@ -434,8 +434,8 @@ def _run_configs(smoke):
             try:
                 r = table[name](smoke, dtype, device_kind, **kw)
             except Exception as e:  # one broken config must not eat the rest
-                r = {"metric": name + "_error", "value": None,
-                     "unit": "", "error": "%s: %s" % (type(e).__name__, e)}
+                r = {"metric": name + "_error", "value": None, "unit": "",
+                     "error": "%s: %s" % (type(e).__name__, e), **kw}
             r.update(device=device_kind, dtype=dtype)
             results.append(r)
             print(json.dumps(r))

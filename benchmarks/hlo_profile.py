@@ -77,6 +77,58 @@ def conv_table(hlo_text, batch):
     return rows
 
 
+def xplane_summary(logdir, top=20):
+    """Per-op wall times from the captured XPlane via xprof's hlo_stats
+    table: category totals (where does the step go) + the heaviest ops
+    (what to attack first). Best-effort — any failure leaves the raw
+    trace usable in tensorboard."""
+    import glob
+    try:
+        from xprof.convert import raw_to_tool_data as rtd
+        paths = sorted(glob.glob(logdir + "/**/*.xplane.pb",
+                                 recursive=True))
+        if not paths:
+            print("no xplane.pb under %s" % logdir)
+            return
+        data, _ = rtd.xspace_to_tool_data([paths[-1]], "hlo_stats", {})
+        tab = json.loads(data.decode() if isinstance(data, bytes)
+                         else data)
+        cols = [c["id"] for c in tab.get("cols", [])]
+        rows = []
+        for row in tab.get("rows", []):
+            vals = [c.get("v") if isinstance(c, dict) else c
+                    for c in row["c"]]
+            rows.append(dict(zip(cols, vals)))
+        if not rows:
+            print("xplane has no hlo_stats rows (CPU traces don't carry "
+                  "the device plane; on TPU this table populates)")
+            return
+        def us(r):
+            v = r.get("total_self_time") or 0.0
+            if isinstance(v, str):       # gviz cells may carry "1,234.5"
+                v = v.replace(",", "")
+            return float(v)
+
+        by_cat = {}
+        for r in rows:
+            cat = r.get("category") or "?"
+            by_cat[cat] = by_cat.get(cat, 0.0) + us(r)
+        total = sum(by_cat.values()) or 1.0
+        print("\n== self time by HLO category ==")
+        for cat, t in sorted(by_cat.items(), key=lambda kv: -kv[1]):
+            print("  %-28s %10.0f us  %5.1f%%" % (cat, t, 100 * t / total))
+        rows.sort(key=us, reverse=True)
+        print("\n== top %d ops by self time ==" % top)
+        for r in rows[:top]:
+            print("  %8.0f us  %-16s %s" % (
+                us(r), (r.get("category") or "?")[:16],
+                (r.get("hlo_op_expression") or r.get("hlo_op_name")
+                 or "")[:95]))
+    except Exception as e:
+        print("xplane summary unavailable: %s: %s" % (type(e).__name__, e))
+        return
+
+
 def main():
     smoke = os.environ.get("BENCH_SMOKE", "") == "1"
     if smoke:
@@ -132,6 +184,7 @@ def main():
                 loss = step(x, y)
             float(loss)
         print("\ntrace written to %s" % logdir)
+        xplane_summary(logdir)
 
     t0 = time.perf_counter()
     loss = None

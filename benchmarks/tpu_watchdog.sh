@@ -13,6 +13,18 @@ for i in $(seq 1 120); do
     bash benchmarks/tpu_session.sh
     rc=$?
     echo "[watchdog] session finished rc=$rc at $(date -u +%H:%M:%S)"
+    if [ $rc -eq 0 ]; then
+      # land the evidence even if nobody is watching when the tunnel
+      # lives; add per-file — a single unmatched pathspec would make one
+      # combined `git add` stage NOTHING
+      for f in BENCH_ALL.json BENCH_LAST_TPU.json BENCH_PROFILE.txt \
+               BENCH_PROFILE_NHWC.txt BENCH_FLASH_SWEEP.jsonl \
+               BENCH_CPP_PJRT.txt; do
+        [ -f "$f" ] && git add "$f"
+      done
+      git commit -m "TPU measurement session artifacts (bench, layout A/B, flash sweep, HLO profiles)" \
+        || echo "[watchdog] nothing to commit"
+    fi
     exit $rc
   fi
   sleep 90

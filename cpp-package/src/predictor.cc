@@ -338,7 +338,15 @@ Predictor::Predictor(const std::string& artifact_path,
     std::memset(&n, 0, sizeof(n));
     n.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
     n.executable = g.executable;
-    im.check(im.api->PJRT_Executable_NumOutputs(&n), "num outputs");
+    PJRT_Error* nerr = im.api->PJRT_Executable_NumOutputs(&n);
+    if (im.api->PJRT_Executable_Destroy != nullptr) {
+      PJRT_Executable_Destroy_Args d;
+      std::memset(&d, 0, sizeof(d));
+      d.struct_size = PJRT_Executable_Destroy_Args_STRUCT_SIZE;
+      d.executable = g.executable;
+      im.api->PJRT_Executable_Destroy(&d);
+    }
+    im.check(nerr, "num outputs");
     if (n.num_outputs != im.output_specs.size())
       throw std::runtime_error(
           "artifact signature declares " +
