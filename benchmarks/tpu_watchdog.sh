@@ -22,7 +22,10 @@ for i in $(seq 1 120); do
                BENCH_CPP_PJRT.txt; do
         [ -f "$f" ] && git add "$f"
       done
+      # pathspec-restricted: never sweep up unrelated staged work
       git commit -m "TPU measurement session artifacts (bench, layout A/B, flash sweep, HLO profiles)" \
+        -- BENCH_ALL.json BENCH_LAST_TPU.json BENCH_PROFILE.txt \
+           BENCH_PROFILE_NHWC.txt BENCH_FLASH_SWEEP.jsonl BENCH_CPP_PJRT.txt \
         || echo "[watchdog] nothing to commit"
     fi
     exit $rc

@@ -47,6 +47,10 @@ from . import image
 from . import gluon
 from . import model
 from .model import FeedForward
+from . import executor_manager
+from . import misc
+from . import ndarray_doc
+from . import symbol_doc
 from . import module
 from . import module as mod
 from . import callback

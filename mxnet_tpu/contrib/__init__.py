@@ -9,3 +9,6 @@ from . import autograd
 from . import onnx
 from . import tensorboard
 from . import text
+from . import io
+from . import ndarray
+from . import symbol

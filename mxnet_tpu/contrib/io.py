@@ -1,0 +1,51 @@
+"""Contrib data iterators (parity: reference contrib/io.py).
+
+`DataLoaderIter` adapts a gluon ``DataLoader`` to the symbolic `DataIter`
+contract so Module/`fit` pipelines can consume gluon datasets — the last
+(short) batch is zero-padded up to ``batch_size`` with ``pad`` reporting
+the fill, exactly how NDArrayIter's pad contract works.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io import DataIter, DataDesc, DataBatch
+from ..ndarray import NDArray
+
+
+class DataLoaderIter(DataIter):
+    def __init__(self, loader, data_name="data", label_name="softmax_label",
+                 dtype="float32"):
+        super().__init__()
+        self._loader = loader
+        self._dtype = np.dtype(dtype)
+        first = next(iter(loader))
+        data, label = first[0], first[1]
+        self.batch_size = int(data.shape[0])
+        self.provide_data = [DataDesc(data_name, tuple(data.shape), dtype)]
+        self.provide_label = [DataDesc(label_name, tuple(label.shape),
+                                       dtype)]
+        self._iter = None
+        self.reset()
+
+    def reset(self):
+        self._iter = iter(self._loader)
+
+    def _padded(self, arr):
+        """Zero-fill a short final batch to batch_size rows."""
+        a = np.asarray(arr.asnumpy() if isinstance(arr, NDArray) else arr,
+                       dtype=self._dtype)
+        short = self.batch_size - a.shape[0]
+        if short > 0:
+            a = np.concatenate(
+                [a, np.zeros((short,) + a.shape[1:], self._dtype)])
+        return NDArray(a)
+
+    def next(self):
+        data, label = next(self._iter)
+        pad = self.batch_size - int(data.shape[0])
+        return DataBatch(data=[self._padded(data)],
+                         label=[self._padded(label)],
+                         pad=pad, index=None,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

@@ -1,0 +1,4 @@
+"""Imperative contrib namespace (parity: reference contrib/ndarray.py —
+the registration target for contrib operators; here they are generated
+into ``mxnet_tpu.ndarray.contrib`` and re-exported)."""
+from ..ndarray.contrib import *  # noqa: F401,F403

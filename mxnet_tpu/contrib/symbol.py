@@ -1,0 +1,8 @@
+"""Symbolic contrib namespace (parity: reference contrib/symbol.py — the
+registration target for contrib operators; here they live on
+``mxnet_tpu.symbol.contrib`` and are proxied through)."""
+from ..symbol import contrib as _contrib_ns
+
+
+def __getattr__(name):
+    return getattr(_contrib_ns, name)

@@ -1,2 +1,3 @@
 """Contrib data helpers (parity: gluon/contrib/data/)."""
 from .sampler import IntervalSampler
+from .text import WikiText2, WikiText103

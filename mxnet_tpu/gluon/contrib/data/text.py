@@ -1,0 +1,126 @@
+"""Language-model datasets (parity: reference gluon/contrib/data/text.py —
+WikiText2 / WikiText103 yielding (data, label) next-token windows of
+`seq_len`, with a Vocabulary built from the corpus).
+
+Hermetic-environment behavior: when the real `wiki.<segment>.tokens`
+files exist under `root` they are read verbatim; otherwise (zero-egress
+CI) a deterministic synthetic corpus with Zipf-distributed word
+frequencies and sentence structure stands in, so vocabulary building,
+indexing, and the windowing contract are exercised identically.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ....contrib.text import utils as _text_utils
+from ....contrib.text.vocab import Vocabulary
+from ...data.dataset import Dataset
+from ....ndarray import NDArray
+
+_EOS = "<eos>"
+
+
+def _synthetic_corpus(n_sentences, vocab_size, seed):
+    """Zipf-ish word stream with sentence breaks (deterministic)."""
+    rng = np.random.RandomState(seed)
+    words = ["w%03d" % i for i in range(vocab_size)]
+    p = 1.0 / np.arange(1, vocab_size + 1)
+    p /= p.sum()
+    lines = []
+    for _ in range(n_sentences):
+        length = rng.randint(5, 25)
+        lines.append(" ".join(rng.choice(words, size=length, p=p)))
+    return "\n".join(lines)
+
+
+class _WikiText(Dataset):
+    def __init__(self, root, segment, vocab, seq_len, synth_sentences,
+                 synth_vocab, file_names):
+        self._root = os.path.expanduser(root)
+        self._segment = segment
+        self._seq_len = int(seq_len)
+        self._vocab = vocab
+        self._counter = None
+        if segment not in file_names:
+            raise ValueError("segment must be one of %s"
+                             % sorted(file_names))
+        path = os.path.join(self._root, file_names[segment])
+        if os.path.exists(path):
+            with open(path, encoding="utf8") as f:
+                content = f.read()
+        else:
+            import logging
+            logging.warning(
+                "%s: %s not found — substituting the deterministic "
+                "synthetic corpus (perplexities will NOT be comparable to "
+                "the real dataset)", type(self).__name__, path)
+            content = _synthetic_corpus(
+                synth_sentences,
+                synth_vocab,
+                seed={"train": 11, "validation": 12, "test": 13}[segment])
+        self._load(content)
+
+    @property
+    def vocabulary(self):
+        return self._vocab
+
+    @property
+    def frequencies(self):
+        return self._counter
+
+    def _load(self, content):
+        self._counter = _text_utils.count_tokens_from_str(content)
+        if self._vocab is None:
+            self._vocab = Vocabulary(counter=self._counter,
+                                     reserved_tokens=[_EOS])
+        tokens = []
+        for line in content.splitlines():
+            parts = line.strip().split()
+            if parts:
+                tokens.extend(parts)
+                tokens.append(_EOS)
+        t2i = self._vocab.token_to_idx
+        unk = t2i[self._vocab.unknown_token]
+        idx = np.asarray([t2i.get(t, unk) for t in tokens], np.int32)
+        n = (len(idx) - 1) // self._seq_len
+        self._data = idx[:n * self._seq_len].reshape(n, self._seq_len)
+        self._label = idx[1:n * self._seq_len + 1].reshape(n, self._seq_len)
+
+    def __getitem__(self, i):
+        return NDArray(self._data[i]), NDArray(self._label[i])
+
+    def __len__(self):
+        return len(self._label)
+
+
+class WikiText2(_WikiText):
+    """WikiText-2 word-level LM dataset (reference
+    gluon/contrib/data/text.py:106); reads `wiki.<segment>.tokens` under
+    `root` when present, else a deterministic synthetic stand-in."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "wikitext-2"),
+                 segment="train", vocab=None, seq_len=35):
+        super().__init__(
+            root, segment, vocab, seq_len,
+            synth_sentences=2000, synth_vocab=600,
+            file_names={"train": "wiki.train.tokens",
+                        "validation": "wiki.valid.tokens",
+                        "test": "wiki.test.tokens"})
+
+
+class WikiText103(_WikiText):
+    """WikiText-103 (reference gluon/contrib/data/text.py:144) — same
+    contract, larger corpus."""
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "wikitext-103"),
+                 segment="train", vocab=None, seq_len=35):
+        super().__init__(
+            root, segment, vocab, seq_len,
+            synth_sentences=8000, synth_vocab=2000,
+            file_names={"train": "wiki.train.tokens",
+                        "validation": "wiki.valid.tokens",
+                        "test": "wiki.test.tokens"})

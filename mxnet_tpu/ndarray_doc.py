@@ -1,0 +1,28 @@
+"""Op docstring registry for the imperative namespace (parity: reference
+python/mxnet/ndarray_doc.py). In this framework docstrings live directly
+on the registered op definitions (`ops.registry.OpDef.doc`); this module
+keeps the reference's attachment hook for scripts that used it."""
+from .ops import registry as _registry
+
+
+class NDArrayDoc:
+    """Subclass with a name matching `<op>Doc` and a docstring to attach
+    extended documentation to `mx.nd.<op>` (the reference contract)."""
+
+
+def _build_doc(func_name, desc, arg_names, arg_types, *_, **__):
+    """Compose a numpydoc-style docstring (reference _build_doc role)."""
+    lines = [desc, "", "Parameters", "----------"]
+    for n, t in zip(arg_names, arg_types):
+        lines.append("%s : %s" % (n, t))
+    return "\n".join(lines)
+
+
+def attach(cls=None):
+    """Attach every `<op>Doc` subclass's docstring to its op."""
+    for sub in (cls or NDArrayDoc).__subclasses__():
+        name = sub.__name__[:-3]  # strip "Doc"
+        try:
+            _registry.get(name).doc = sub.__doc__
+        except KeyError:
+            pass

@@ -1,0 +1,17 @@
+"""Op docstring registry for the symbolic namespace (parity: reference
+python/mxnet/symbol_doc.py). Same contract as `ndarray_doc` — docstrings
+live on the shared op definitions, so one attachment serves both
+namespaces."""
+from .ndarray_doc import NDArrayDoc, _build_doc, attach  # noqa: F401
+
+
+class SymbolDoc(NDArrayDoc):
+    """Subclass with a name matching `<op>Doc` and a docstring to attach
+    extended documentation to `mx.sym.<op>`."""
+
+    @staticmethod
+    def get_output_shape(sym, **input_shapes):
+        """Output shapes for given input shapes (the reference's debug
+        helper, symbol_doc.py)."""
+        _, s_outputs, _ = sym.infer_shape(**input_shapes)
+        return dict(zip(sym.list_outputs(), s_outputs))

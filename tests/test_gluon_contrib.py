@@ -136,3 +136,54 @@ def test_interval_sampler():
     assert len(IntervalSampler(10, 3)) == 10
     s2 = list(IntervalSampler(10, 3, rollover=False))
     assert s2 == [0, 3, 6, 9]
+
+
+def test_wikitext_lm_datasets(tmp_path):
+    """WikiText2: next-token windowing, vocabulary contract, and the
+    real-file path (parity: gluon/contrib/data/text.py)."""
+    from mxnet_tpu.gluon.contrib.data import WikiText2
+
+    ds = WikiText2(root=str(tmp_path / "absent"), segment="train",
+                   seq_len=35)
+    x, y = ds[0]
+    assert x.shape == (35,) and y.shape == (35,)
+    assert len(ds) > 100 and len(ds.vocabulary) > 100
+    # labels are the inputs shifted by one across window boundaries
+    fx = np.concatenate([ds[i][0].asnumpy() for i in range(3)])
+    fy = np.concatenate([ds[i][1].asnumpy() for i in range(3)])
+    np.testing.assert_array_equal(fx[1:], fy[:-1])
+    # deterministic; vocab shareable across segments
+    again = WikiText2(root=str(tmp_path / "absent"), segment="train",
+                      seq_len=35)
+    np.testing.assert_array_equal(x.asnumpy(), again[0][0].asnumpy())
+    val = WikiText2(root=str(tmp_path / "absent"), segment="validation",
+                    vocab=ds.vocabulary)
+    assert val.vocabulary is ds.vocabulary
+
+    # real token files are read verbatim, <eos> terminates lines
+    root = tmp_path / "wt2"
+    root.mkdir()
+    (root / "wiki.train.tokens").write_text("a b c\nd e f g\n")
+    real = WikiText2(root=str(root), seq_len=4)
+    assert len(real) == 2
+    eos = real.vocabulary.token_to_idx["<eos>"]
+    assert real[0][0].asnumpy()[3] == eos
+
+
+def test_contrib_io_dataloader_iter():
+    """gluon DataLoader -> Module DataIter adapter (parity:
+    contrib/io.py DataLoaderIter): short final batch zero-padded with
+    pad reported."""
+    from mxnet_tpu.contrib.io import DataLoaderIter
+    from mxnet_tpu.gluon.data import DataLoader, ArrayDataset
+
+    ds = ArrayDataset(np.arange(90, dtype=np.float32).reshape(45, 2),
+                      np.arange(45, dtype=np.float32))
+    it = DataLoaderIter(DataLoader(ds, batch_size=10))
+    batches = list(it)
+    assert len(batches) == 5
+    assert batches[-1].pad == 5
+    assert batches[-1].data[0].shape == (10, 2)
+    assert batches[-1].data[0].asnumpy()[5:].sum() == 0
+    it.reset()
+    assert len(list(it)) == 5
