@@ -50,6 +50,38 @@ _ALL_OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "BENCH_ALL.json")
 
 
+def _merge_results(path, new, key=lambda r: (r.get("metric"),
+                                            r.get("seq_len"),
+                                            r.get("layout"))):
+    """Merge `new` result lines into the JSON list at `path`.
+
+    Partial-config runs (BENCH_CONFIGS=headline, a flash seq sweep) must
+    refresh their own lines without erasing the full set a previous
+    all-config run captured. Lines match on (metric, seq_len, layout);
+    matched lines are replaced in place, unmatched new lines append, and
+    the resnet50 headline is kept LAST (the outage re-emit reads [-1]).
+    """
+    old = []
+    try:
+        with open(path) as f:
+            loaded = json.load(f)
+        old = loaded["results"] if isinstance(loaded, dict) else loaded
+    except (OSError, ValueError, KeyError):
+        pass
+    fresh = {key(r) for r in new}
+    # also dedupe the on-disk list itself (keep the LAST of any repeated
+    # key — later lines are later measurements)
+    seen = set()
+    kept = []
+    for r in reversed(old):
+        if key(r) not in fresh and key(r) not in seen:
+            seen.add(key(r))
+            kept.append(r)
+    merged = list(reversed(kept)) + list(new)
+    merged.sort(key=lambda r: str(r.get("metric", "")).startswith("resnet50"))
+    return merged
+
+
 def _peak_flops(device_kind, dtype):
     kind = (device_kind or "").lower()
     peak = None
@@ -456,10 +488,11 @@ def main():
         if final.get("metric") == "resnet50_train_img_per_sec" and \
                 final.get("value") is not None:
             try:
+                merged = _merge_results(_LAST_TPU, results)
                 with open(_LAST_TPU, "w") as f:
                     json.dump({"measured_at": time.strftime(
                         "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-                        "results": results}, f, indent=1)
+                        "results": merged}, f, indent=1)
             except OSError:
                 pass
         # a crashed headline config must read as a failed run (rc != 0),
@@ -494,9 +527,10 @@ def main():
                     for ln in lines:
                         print(ln)
                     try:
+                        merged = _merge_results(
+                            _ALL_OUT, [json.loads(ln) for ln in lines])
                         with open(_ALL_OUT, "w") as f:
-                            json.dump([json.loads(ln) for ln in lines], f,
-                                      indent=1)
+                            json.dump(merged, f, indent=1)
                     except (OSError, ValueError):
                         pass
                     return

@@ -17,16 +17,19 @@ for i in $(seq 1 120); do
       # land the evidence even if nobody is watching when the tunnel
       # lives; add per-file — a single unmatched pathspec would make one
       # combined `git add` stage NOTHING
+      present=()
       for f in BENCH_ALL.json BENCH_LAST_TPU.json BENCH_PROFILE.txt \
                BENCH_PROFILE_NHWC.txt BENCH_FLASH_SWEEP.jsonl \
                BENCH_CPP_PJRT.txt; do
-        [ -f "$f" ] && git add "$f"
+        [ -f "$f" ] && git add "$f" && present+=("$f")
       done
-      # pathspec-restricted: never sweep up unrelated staged work
-      git commit -m "TPU measurement session artifacts (bench, layout A/B, flash sweep, HLO profiles)" \
-        -- BENCH_ALL.json BENCH_LAST_TPU.json BENCH_PROFILE.txt \
-           BENCH_PROFILE_NHWC.txt BENCH_FLASH_SWEEP.jsonl BENCH_CPP_PJRT.txt \
-        || echo "[watchdog] nothing to commit"
+      # pathspec-restricted to the files that exist: never sweep up
+      # unrelated staged work, and never abort on an artifact an optional
+      # session step (e.g. the C++ predictor) did not produce
+      if [ ${#present[@]} -gt 0 ]; then
+        git commit -m "TPU measurement session artifacts (bench, layout A/B, flash sweep, HLO profiles)" \
+          -- "${present[@]}" || echo "[watchdog] nothing to commit"
+      fi
     fi
     exit $rc
   fi

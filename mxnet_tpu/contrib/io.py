@@ -19,17 +19,22 @@ class DataLoaderIter(DataIter):
         super().__init__()
         self._loader = loader
         self._dtype = np.dtype(dtype)
-        first = next(iter(loader))
+        # Sniff shapes from the first batch, but KEEP the iterator and the
+        # batch: for num_workers>0 a fresh iterator spins up a worker pool
+        # and prefetches — discarding it and re-iterating would pay that
+        # twice per construction.
+        self._iter = iter(loader)
+        first = next(self._iter)
         data, label = first[0], first[1]
+        self._pending = (data, label)
         self.batch_size = int(data.shape[0])
         self.provide_data = [DataDesc(data_name, tuple(data.shape), dtype)]
         self.provide_label = [DataDesc(label_name, tuple(label.shape),
                                        dtype)]
-        self._iter = None
-        self.reset()
 
     def reset(self):
         self._iter = iter(self._loader)
+        self._pending = None
 
     def _padded(self, arr):
         """Zero-fill a short final batch to batch_size rows."""
@@ -42,7 +47,11 @@ class DataLoaderIter(DataIter):
         return NDArray(a)
 
     def next(self):
-        data, label = next(self._iter)
+        if self._pending is not None:
+            data, label = self._pending
+            self._pending = None
+        else:
+            data, label = next(self._iter)
         pad = self.batch_size - int(data.shape[0])
         return DataBatch(data=[self._padded(data)],
                          label=[self._padded(label)],
