@@ -35,12 +35,24 @@ struct Tensor {
   size_t byte_size() const { return num_elements() * dtype_bytes(dtype); }
 };
 
+// One PJRT_Client_Create NamedValue option. Some plugins (e.g. tunneled
+// TPU plugins) refuse to create a client without plugin-specific options;
+// the CLI exposes these as `--opt name=int:N` / `--opt name=str:S`.
+struct CreateOption {
+  std::string name;
+  bool is_int = false;
+  std::string str_value;
+  int64_t int_value = 0;
+};
+
 class Predictor {
  public:
   // Loads `artifact_path` (.mxtpu zip), dlopens `plugin_so` (a PJRT
   // plugin), creates a client and compiles the module. Throws
   // std::runtime_error with the PJRT error message on failure.
-  Predictor(const std::string& artifact_path, const std::string& plugin_so);
+  // `create_options` are passed to PJRT_Client_Create as NamedValues.
+  Predictor(const std::string& artifact_path, const std::string& plugin_so,
+            const std::vector<CreateOption>& create_options = {});
   ~Predictor();
 
   // Input/output specs from the artifact signature (data left empty).

@@ -4,26 +4,67 @@
 // mxnet_predict example / image-classification/predict-cpp).
 //
 //   mxtpu_predict <model.mxtpu> <pjrt_plugin.so> [--echo-input-check]
+//       [--opt name=int:N | --opt name=str:S]...
 //
 // --echo-input-check asserts output 0 byte-equals input 0 (used by the
 // mock-plugin test, whose Execute is an echo).
+// --opt passes a NamedValue to PJRT_Client_Create — some plugins
+// (tunneled TPU clients) require plugin-specific create options.
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <stdexcept>
+#include <string>
+#include <vector>
 
 #include "mxtpu/predictor.hpp"
+
+static mxtpu::CreateOption parse_opt(const char* spec) {
+  const char* eq = std::strchr(spec, '=');
+  if (eq == nullptr)
+    throw std::runtime_error(std::string("--opt needs name=type:value: ") +
+                             spec);
+  mxtpu::CreateOption o;
+  o.name.assign(spec, eq - spec);
+  const char* val = eq + 1;
+  if (std::strncmp(val, "int:", 4) == 0) {
+    o.is_int = true;
+    char* end = nullptr;
+    o.int_value = std::strtoll(val + 4, &end, 10);
+    if (end == val + 4 || *end != '\0')
+      throw std::runtime_error(
+          std::string("--opt int value is not an integer: ") + spec);
+  } else if (std::strncmp(val, "str:", 4) == 0) {
+    o.str_value = val + 4;
+  } else {
+    throw std::runtime_error(
+        std::string("--opt value must be int:N or str:S: ") + spec);
+  }
+  return o;
+}
 
 int main(int argc, char** argv) {
   if (argc < 3) {
     std::fprintf(stderr,
                  "usage: %s <model.mxtpu> <pjrt_plugin.so> "
-                 "[--echo-input-check]\n", argv[0]);
+                 "[--echo-input-check] [--opt name=int:N|name=str:S]...\n",
+                 argv[0]);
     return 2;
   }
-  bool echo_check = argc > 3 &&
-      std::strcmp(argv[3], "--echo-input-check") == 0;
+  bool echo_check = false;
+  std::vector<mxtpu::CreateOption> opts;
   try {
-    mxtpu::Predictor pred(argv[1], argv[2]);
+    for (int i = 3; i < argc; ++i) {
+      if (std::strcmp(argv[i], "--echo-input-check") == 0) {
+        echo_check = true;
+      } else if (std::strcmp(argv[i], "--opt") == 0 && i + 1 < argc) {
+        opts.push_back(parse_opt(argv[++i]));
+      } else {
+        std::fprintf(stderr, "unknown argument: %s\n", argv[i]);
+        return 2;
+      }
+    }
+    mxtpu::Predictor pred(argv[1], argv[2], opts);
     std::printf("platform: %s\n", pred.platform().c_str());
 
     std::vector<mxtpu::Tensor> inputs;

@@ -251,7 +251,8 @@ struct Predictor::Impl {
 };
 
 Predictor::Predictor(const std::string& artifact_path,
-                     const std::string& plugin_so)
+                     const std::string& plugin_so,
+                     const std::vector<CreateOption>& create_options)
     : impl_(new Impl()) {
   Impl& im = *impl_;
   std::vector<uint8_t> zip = read_file(artifact_path);
@@ -279,9 +280,29 @@ Predictor::Predictor(const std::string& artifact_path,
     im.check(im.api->PJRT_Plugin_Initialize(&a), "plugin init");
   }
   {
+    std::vector<PJRT_NamedValue> nvs(create_options.size());
+    for (size_t i = 0; i < create_options.size(); ++i) {
+      const CreateOption& o = create_options[i];
+      PJRT_NamedValue& nv = nvs[i];
+      std::memset(&nv, 0, sizeof(nv));
+      nv.struct_size = PJRT_NamedValue_STRUCT_SIZE;
+      nv.name = o.name.c_str();
+      nv.name_size = o.name.size();
+      if (o.is_int) {
+        nv.type = PJRT_NamedValue_kInt64;
+        nv.int64_value = o.int_value;
+        nv.value_size = 1;
+      } else {
+        nv.type = PJRT_NamedValue_kString;
+        nv.string_value = o.str_value.c_str();
+        nv.value_size = o.str_value.size();
+      }
+    }
     PJRT_Client_Create_Args a;
     std::memset(&a, 0, sizeof(a));
     a.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+    a.create_options = nvs.empty() ? nullptr : nvs.data();
+    a.num_options = nvs.size();
     im.check(im.api->PJRT_Client_Create(&a), "client create");
     im.client = a.client;
   }

@@ -117,10 +117,13 @@ def _attention(x, wqkv, wo, cfg, mesh=None, sp_axis="sp", causal=True):
         out = ring_attention_sharded(mesh, q, k, v, axis_name=sp_axis,
                                      causal=causal)
     elif mesh is None and \
-            os.environ.get("MXNET_FLASH_ATTENTION", "1") == "1":
-        # the Pallas hot-op path: VMEM-streamed online-softmax kernel
-        # (falls back to the XLA reference internally when shapes don't
-        # tile into the attention blocks). Single-device only: a
+            os.environ.get("MXNET_FLASH_ATTENTION", "0") == "1":
+        # OPT-IN Pallas path: the 2026-07-31 v5e sweep (BENCH_FLASH_SWEEP
+        # .jsonl) measured 0.96-1.06x vs XLA attention at seq 1024/2048/
+        # 4096 — below the >=1.2x bar for a default-path kernel, so XLA
+        # attention is the default and MXNET_FLASH_ATTENTION=1 enables the
+        # kernel (VMEM-streamed online softmax; falls back to XLA when
+        # shapes don't tile into the blocks). Single-device only: a
         # pallas_call has no GSPMD partitioning rule, so under a dp/tp
         # mesh it would force replication — the sharded paths go through
         # ring attention / the partitionable XLA reference instead
