@@ -66,6 +66,26 @@ class Predictor {
   // dims, and byte size. Returns fully materialized host tensors.
   std::vector<Tensor> forward(const std::vector<Tensor>& inputs);
 
+  // ---- training artifacts (export_train_step) -----------------------------
+  // Input convention: [state_0..state_{K-1}, x, y, seed, lr, t];
+  // outputs [loss, state'_0..state'_{K-1}]. State lives device-resident
+  // across steps; only the per-step batch/scalars cross the host boundary.
+
+  // True when the artifact carries `train.txt` (a training export).
+  bool is_train() const;
+  // Number of leading state inputs (0 for inference artifacts).
+  size_t n_state() const;
+  // The artifact's initial state values (`state/<i>.bin` blobs).
+  std::vector<Tensor> initial_state() const;
+  // Uploads `state` to the device as the resident training state.
+  void load_state(const std::vector<Tensor>& state);
+  // Runs one training step: `step_inputs` are the non-state inputs
+  // (x, y, seed, lr, t). Returns the loss scalar; the new state replaces
+  // the resident state on device. Requires load_state first.
+  float train_step(const std::vector<Tensor>& step_inputs);
+  // Downloads the resident state (for checkpointing).
+  std::vector<Tensor> read_state();
+
   Predictor(const Predictor&) = delete;
   Predictor& operator=(const Predictor&) = delete;
 

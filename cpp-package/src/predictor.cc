@@ -106,6 +106,16 @@ std::string read_zip_entry(const std::vector<uint8_t>& buf,
   throw std::runtime_error("artifact has no entry " + name);
 }
 
+bool zip_has_entry(const std::vector<uint8_t>& buf,
+                   const std::string& name) {
+  try {
+    read_zip_entry(buf, name);
+    return true;
+  } catch (const std::exception&) {
+    return false;
+  }
+}
+
 // ---------------------------------------------------------------------------
 // signature.txt parsing
 // ---------------------------------------------------------------------------
@@ -197,6 +207,85 @@ struct Predictor::Impl {
   std::string platform;
   std::vector<Tensor> input_specs;
   std::vector<Tensor> output_specs;
+  // training artifacts: leading state inputs resident on device
+  size_t n_state = 0;
+  std::vector<Tensor> init_state;
+  std::vector<PJRT_Buffer*> state_bufs;
+
+  void destroy_buffer(PJRT_Buffer* b) {
+    if (b == nullptr) return;
+    PJRT_Buffer_Destroy_Args d;
+    std::memset(&d, 0, sizeof(d));
+    d.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+    d.buffer = b;
+    api->PJRT_Buffer_Destroy(&d);
+  }
+
+  PJRT_Buffer* upload(const Tensor& t, const Tensor& spec, size_t index) {
+    if (t.dtype != spec.dtype || t.dims != spec.dims ||
+        t.data.size() != spec.byte_size())
+      throw std::runtime_error(
+          "input " + std::to_string(index) + " does not match the artifact "
+          "signature (want " + std::string(dtype_name(spec.dtype)) + ")");
+    PJRT_Client_BufferFromHostBuffer_Args a;
+    std::memset(&a, 0, sizeof(a));
+    a.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+    a.client = client;
+    a.data = t.data.data();
+    a.type = pjrt_type(t.dtype);
+    a.dims = t.dims.data();
+    a.num_dims = t.dims.size();
+    a.host_buffer_semantics =
+        PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+    a.device = device;
+    check(api->PJRT_Client_BufferFromHostBuffer(&a), "host->device");
+    try {
+      await(a.done_with_host_buffer, "host->device transfer");
+    } catch (...) {
+      destroy_buffer(a.buffer);  // not yet owned by any caller list
+      throw;
+    }
+    return a.buffer;
+  }
+
+  // single-device execute over explicit buffer lists
+  void execute(std::vector<PJRT_Buffer*>& in_bufs,
+               std::vector<PJRT_Buffer*>& out_bufs) {
+    PJRT_ExecuteOptions opts;
+    std::memset(&opts, 0, sizeof(opts));
+    opts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+    PJRT_Buffer* const* arg_list = in_bufs.data();
+    PJRT_Buffer** out_list = out_bufs.data();
+    PJRT_LoadedExecutable_Execute_Args a;
+    std::memset(&a, 0, sizeof(a));
+    a.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+    a.executable = exec;
+    a.options = &opts;
+    a.argument_lists = &arg_list;
+    a.num_devices = 1;
+    a.num_args = in_bufs.size();
+    a.output_lists = &out_list;
+    check(api->PJRT_LoadedExecutable_Execute(&a), "execute");
+  }
+
+  Tensor download(PJRT_Buffer* buf, const Tensor& spec) {
+    Tensor t = spec;  // dtype + dims from the signature
+    PJRT_Buffer_ToHostBuffer_Args h;
+    std::memset(&h, 0, sizeof(h));
+    h.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+    h.src = buf;
+    check(api->PJRT_Buffer_ToHostBuffer(&h), "output size query");
+    await(h.event, "output size query");  // null for size-only queries
+    t.data.resize(h.dst_size);
+    std::memset(&h, 0, sizeof(h));
+    h.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+    h.src = buf;
+    h.dst = t.data.data();
+    h.dst_size = t.data.size();
+    check(api->PJRT_Buffer_ToHostBuffer(&h), "device->host");
+    await(h.event, "device->host transfer");
+    return t;
+  }
 
   void check(PJRT_Error* err, const char* what) {
     if (err == nullptr) return;
@@ -231,6 +320,7 @@ struct Predictor::Impl {
 
   ~Impl() {
     if (api != nullptr) {
+      for (PJRT_Buffer* b : state_bufs) destroy_buffer(b);
       if (exec != nullptr) {
         PJRT_LoadedExecutable_Destroy_Args a;
         std::memset(&a, 0, sizeof(a));
@@ -259,6 +349,26 @@ Predictor::Predictor(const std::string& artifact_path,
   std::string mlir = read_zip_entry(zip, "model.mlir");
   parse_signature(read_zip_entry(zip, "signature.txt"),
                   &im.input_specs, &im.output_specs);
+  if (zip_has_entry(zip, "train.txt")) {
+    std::istringstream ts(read_zip_entry(zip, "train.txt"));
+    std::string word;
+    ts >> word >> im.n_state;
+    if (word != "n_state" || im.n_state == 0 ||
+        im.n_state + 5 != im.input_specs.size() ||
+        im.n_state + 1 != im.output_specs.size())
+      throw std::runtime_error(
+          "train.txt n_state inconsistent with the signature");
+    for (size_t i = 0; i < im.n_state; ++i) {
+      Tensor t = im.input_specs[i];
+      std::string blob =
+          read_zip_entry(zip, "state/" + std::to_string(i) + ".bin");
+      if (blob.size() != t.byte_size())
+        throw std::runtime_error("state blob " + std::to_string(i) +
+                                 " size mismatch with signature");
+      t.data.assign(blob.begin(), blob.end());
+      im.init_state.push_back(std::move(t));
+    }
+  }
   zip.clear();
   zip.shrink_to_fit();
 
@@ -395,76 +505,16 @@ std::vector<Tensor> Predictor::forward(const std::vector<Tensor>& inputs) {
   std::vector<PJRT_Buffer*> in_bufs;
   std::vector<PJRT_Buffer*> out_bufs(im.output_specs.size(), nullptr);
   auto destroy_bufs = [&](std::vector<PJRT_Buffer*>& bufs) {
-    for (PJRT_Buffer* b : bufs) {
-      if (b == nullptr) continue;
-      PJRT_Buffer_Destroy_Args d;
-      std::memset(&d, 0, sizeof(d));
-      d.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
-      d.buffer = b;
-      im.api->PJRT_Buffer_Destroy(&d);
-    }
+    for (PJRT_Buffer* b : bufs) im.destroy_buffer(b);
     bufs.clear();
   };
   try {
-    for (size_t i = 0; i < inputs.size(); ++i) {
-      const Tensor& spec = im.input_specs[i];
-      const Tensor& t = inputs[i];
-      if (t.dtype != spec.dtype || t.dims != spec.dims ||
-          t.data.size() != spec.byte_size())
-        throw std::runtime_error(
-            "input " + std::to_string(i) + " does not match the artifact "
-            "signature (want " + std::string(dtype_name(spec.dtype)) + ")");
-      PJRT_Client_BufferFromHostBuffer_Args a;
-      std::memset(&a, 0, sizeof(a));
-      a.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
-      a.client = im.client;
-      a.data = t.data.data();
-      a.type = pjrt_type(t.dtype);
-      a.dims = t.dims.data();
-      a.num_dims = t.dims.size();
-      a.host_buffer_semantics =
-          PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
-      a.device = im.device;
-      im.check(im.api->PJRT_Client_BufferFromHostBuffer(&a), "host->device");
-      in_bufs.push_back(a.buffer);
-      im.await(a.done_with_host_buffer, "host->device transfer");
-    }
-
-    PJRT_ExecuteOptions opts;
-    std::memset(&opts, 0, sizeof(opts));
-    opts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
-    PJRT_Buffer* const* arg_list = in_bufs.data();
-    PJRT_Buffer** out_list = out_bufs.data();
-    PJRT_LoadedExecutable_Execute_Args a;
-    std::memset(&a, 0, sizeof(a));
-    a.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
-    a.executable = im.exec;
-    a.options = &opts;
-    a.argument_lists = &arg_list;
-    a.num_devices = 1;
-    a.num_args = in_bufs.size();
-    a.output_lists = &out_list;
-    im.check(im.api->PJRT_LoadedExecutable_Execute(&a), "execute");
-
+    for (size_t i = 0; i < inputs.size(); ++i)
+      in_bufs.push_back(im.upload(inputs[i], im.input_specs[i], i));
+    im.execute(in_bufs, out_bufs);
     std::vector<Tensor> outs;
-    for (size_t i = 0; i < out_bufs.size(); ++i) {
-      Tensor t = im.output_specs[i];  // dtype + dims from the signature
-      PJRT_Buffer_ToHostBuffer_Args h;
-      std::memset(&h, 0, sizeof(h));
-      h.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
-      h.src = out_bufs[i];
-      im.check(im.api->PJRT_Buffer_ToHostBuffer(&h), "output size query");
-      im.await(h.event, "output size query");  // null for size-only queries
-      t.data.resize(h.dst_size);
-      std::memset(&h, 0, sizeof(h));
-      h.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
-      h.src = out_bufs[i];
-      h.dst = t.data.data();
-      h.dst_size = t.data.size();
-      im.check(im.api->PJRT_Buffer_ToHostBuffer(&h), "device->host");
-      im.await(h.event, "device->host transfer");
-      outs.push_back(std::move(t));
-    }
+    for (size_t i = 0; i < out_bufs.size(); ++i)
+      outs.push_back(im.download(out_bufs[i], im.output_specs[i]));
     destroy_bufs(in_bufs);
     destroy_bufs(out_bufs);
     return outs;
@@ -473,6 +523,86 @@ std::vector<Tensor> Predictor::forward(const std::vector<Tensor>& inputs) {
     destroy_bufs(out_bufs);
     throw;
   }
+}
+
+// ---------------------------------------------------------------------------
+// training-artifact API (export_train_step convention)
+// ---------------------------------------------------------------------------
+
+bool Predictor::is_train() const { return impl_->n_state > 0; }
+size_t Predictor::n_state() const { return impl_->n_state; }
+
+std::vector<Tensor> Predictor::initial_state() const {
+  return impl_->init_state;
+}
+
+void Predictor::load_state(const std::vector<Tensor>& state) {
+  Impl& im = *impl_;
+  if (!is_train())
+    throw std::runtime_error("load_state: not a training artifact");
+  if (state.size() != im.n_state)
+    throw std::runtime_error("load_state: expected " +
+                             std::to_string(im.n_state) + " tensors, got " +
+                             std::to_string(state.size()));
+  std::vector<PJRT_Buffer*> bufs;
+  try {
+    for (size_t i = 0; i < state.size(); ++i)
+      bufs.push_back(im.upload(state[i], im.input_specs[i], i));
+  } catch (...) {
+    for (PJRT_Buffer* b : bufs) im.destroy_buffer(b);
+    throw;
+  }
+  for (PJRT_Buffer* b : im.state_bufs) im.destroy_buffer(b);
+  im.state_bufs = std::move(bufs);
+}
+
+float Predictor::train_step(const std::vector<Tensor>& step_inputs) {
+  Impl& im = *impl_;
+  if (im.state_bufs.size() != im.n_state || im.n_state == 0)
+    throw std::runtime_error("train_step: call load_state first");
+  size_t n_step = im.input_specs.size() - im.n_state;  // x, y, seed, lr, t
+  if (step_inputs.size() != n_step)
+    throw std::runtime_error("train_step: expected " +
+                             std::to_string(n_step) + " step inputs, got " +
+                             std::to_string(step_inputs.size()));
+  std::vector<PJRT_Buffer*> fed;     // uploaded batch/scalars (freed here)
+  std::vector<PJRT_Buffer*> out_bufs(im.output_specs.size(), nullptr);
+  try {
+    std::vector<PJRT_Buffer*> args(im.state_bufs);
+    for (size_t i = 0; i < step_inputs.size(); ++i) {
+      fed.push_back(im.upload(step_inputs[i],
+                              im.input_specs[im.n_state + i],
+                              im.n_state + i));
+      args.push_back(fed.back());
+    }
+    im.execute(args, out_bufs);
+    Tensor loss_t = im.download(out_bufs[0], im.output_specs[0]);
+    if (loss_t.dtype != DType::kF32 || loss_t.data.size() != 4)
+      throw std::runtime_error("train artifact loss is not a f32 scalar");
+    float loss;
+    std::memcpy(&loss, loss_t.data.data(), 4);
+    // chain: new state replaces the resident buffers; old state + fed
+    // inputs + the loss buffer are done
+    for (PJRT_Buffer* b : im.state_bufs) im.destroy_buffer(b);
+    im.state_bufs.assign(out_bufs.begin() + 1, out_bufs.end());
+    im.destroy_buffer(out_bufs[0]);
+    for (PJRT_Buffer* b : fed) im.destroy_buffer(b);
+    return loss;
+  } catch (...) {
+    for (PJRT_Buffer* b : fed) im.destroy_buffer(b);
+    for (PJRT_Buffer* b : out_bufs) im.destroy_buffer(b);
+    throw;
+  }
+}
+
+std::vector<Tensor> Predictor::read_state() {
+  Impl& im = *impl_;
+  if (im.state_bufs.size() != im.n_state || im.n_state == 0)
+    throw std::runtime_error("read_state: call load_state first");
+  std::vector<Tensor> out;
+  for (size_t i = 0; i < im.state_bufs.size(); ++i)
+    out.push_back(im.download(im.state_bufs[i], im.input_specs[i]));
+  return out;
 }
 
 }  // namespace mxtpu

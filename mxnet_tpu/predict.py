@@ -191,6 +191,87 @@ def export_model(model, input_shapes, path, params=None,
     return path
 
 
+def export_train_step(step, example_x, example_y, path):
+    """Serialize a built `TrainStep` as a C++-drivable TRAINING artifact.
+
+    Reference parity: the reference's cpp-package trains through
+    Symbol/Executor bindings (cpp-package/include/mxnet-cpp/executor.h).
+    TPU-native redesign: the whole fused train step (forward + backward +
+    optimizer update) is ONE StableHLO program with training state
+    threaded explicitly, so a dependency-free C++ loop
+    (cpp-package mxtpu_train) can run real training against any PJRT
+    plugin — no Python at train time.
+
+    Artifact layout (on top of the export_model contract):
+      signature.txt  in/out lines; inputs are [state..., x, y, seed, lr,
+                     t] and outputs [loss, state...] (state chains:
+                     output 1+i feeds input i of the next step)
+      train.txt      "n_state <K>" — how many leading inputs are state
+      state/<i>.bin  raw little-endian bytes of each state input's
+                     initial value (the step's current state)
+    """
+    import numpy as _np
+
+    if step._step_fn is None:
+        step._build()
+    if step._mesh is not None:
+        raise MXNetError("export_train_step: mesh-sharded TrainSteps are "
+                         "not exportable to the single-device C++ driver; "
+                         "build the TrainStep without a mesh")
+    xv = jnp.asarray(example_x._data if hasattr(example_x, "_data")
+                     else example_x)
+    yv = jnp.asarray(example_y._data if hasattr(example_y, "_data")
+                     else example_y)
+    grad_vals = tuple(step._grad_vals)
+    nograd_vals = tuple(step._nograd_vals)
+    opt_flat, opt_def = jax.tree.flatten(step._opt_state)
+    n_g, n_n, n_o = len(grad_vals), len(nograd_vals), len(opt_flat)
+    n_state = n_g + n_n + n_o
+    state0 = list(grad_vals) + list(nograd_vals) + list(opt_flat)
+    # the raw python step (pre-jit) — exporting through the donating jit
+    # would bake donation into a calling convention the C++ driver then
+    # has to honor; buffer reuse is the driver's decision, not the
+    # artifact's
+    raw_step = step._step_fn.__wrapped__
+
+    def fn(*flat):
+        state, rest = flat[:n_state], flat[n_state:]
+        x, y, sd, lr, t = rest
+        g = state[:n_g]
+        n = state[n_g:n_g + n_n]
+        o = jax.tree.unflatten(opt_def, state[n_g + n_n:])
+        key = jax.random.PRNGKey(sd)
+        loss, g2, n2, o2 = raw_step(g, n, o, x, y, key, lr, t)
+        return (loss,) + tuple(g2) + tuple(n2) + \
+            tuple(jax.tree.flatten(o2)[0])
+
+    specs = [jax.ShapeDtypeStruct(jnp.shape(v), jnp.asarray(v).dtype)
+             for v in state0]
+    specs += [jax.ShapeDtypeStruct(xv.shape, xv.dtype),
+              jax.ShapeDtypeStruct(yv.shape, yv.dtype),
+              jax.ShapeDtypeStruct((), jnp.int32),    # seed
+              jax.ShapeDtypeStruct((), jnp.float32),  # lr
+              jax.ShapeDtypeStruct((), jnp.int32)]    # t
+    exported = jax.export.export(jax.jit(fn))(*specs)
+    sig = ["in %s %s" % (_sig_dtype(a.dtype),
+                         "x".join(str(d) for d in a.shape))
+           for a in exported.in_avals]
+    sig += ["out %s %s" % (_sig_dtype(a.dtype),
+                           "x".join(str(d) for d in a.shape))
+            for a in exported.out_avals]
+    meta = {"format": 1, "train": {"n_state": n_state, "n_grad": n_g,
+                                   "n_nograd": n_n, "n_opt": n_o}}
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr("meta.json", json.dumps(meta))
+        z.writestr("model.stablehlo", exported.serialize())
+        z.writestr("model.mlir", exported.mlir_module_serialized)
+        z.writestr("signature.txt", "\n".join(sig) + "\n")
+        z.writestr("train.txt", "n_state %d\n" % n_state)
+        for i, v in enumerate(state0):
+            z.writestr("state/%d.bin" % i, _np.asarray(v).tobytes())
+    return path
+
+
 def _sig_dtype(dt):
     """dtype -> the signature.txt/PJRT token (predictor.cc mirrors this).
     Unsupported dtypes fail HERE, at export — not at serving time."""

@@ -1,0 +1,154 @@
+"""Training-artifact export: the C++-drivable train step.
+
+Contract under test (predict.py export_train_step; consumed by
+cpp-package/src/train_cli.cc on real hardware):
+  inputs  = [state_0..state_{K-1}, x, y, seed, lr, t]
+  outputs = [loss, state'_0..state'_{K-1}]   (output 1+i chains to input i)
+plus `train.txt` ("n_state K") and `state/<i>.bin` initial-value blobs.
+The exported StableHLO must be runnable WITHOUT the framework: these
+tests drive it through jax.export.deserialize alone, exactly as the C++
+driver drives it through PJRT alone.
+"""
+import io
+import json
+import zipfile
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import loss as gloss
+from mxnet_tpu.parallel.trainer import TrainStep
+
+
+def _mlp():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"))
+    net.add(gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.zeros((2, 8)))
+    return net
+
+
+def _synthetic(n=64, seed=0):
+    """4-class task with fixed prototypes — converges in a few steps."""
+    rng = np.random.RandomState(seed)
+    protos = rng.uniform(-1, 1, (4, 8)).astype(np.float32)
+    y = rng.randint(0, 4, n).astype(np.int32)
+    x = protos[y] + 0.05 * rng.randn(n, 8).astype(np.float32)
+    return x.astype(np.float32), y
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    x, y = _synthetic()
+    net = _mlp()
+    step = TrainStep(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+                     {"learning_rate": 0.5})
+    float(step(x, y))  # build + one step so exported state is "live"
+    path = str(tmp_path_factory.mktemp("export") / "train.mxtpu")
+    mx.predict.export_train_step(step, x, y, path)
+    return path
+
+
+def _load(path):
+    with zipfile.ZipFile(path) as z:
+        blob = z.read("model.stablehlo")
+        sig = z.read("signature.txt").decode()
+        train = z.read("train.txt").decode()
+        n_state = int(train.split()[1])
+        state = [z.read("state/%d.bin" % i) for i in range(n_state)]
+        meta = json.loads(z.read("meta.json").decode())
+    return blob, sig, n_state, state, meta
+
+
+def test_artifact_layout(artifact):
+    blob, sig, n_state, blobs, meta = _load(artifact)
+    lines = [l for l in sig.splitlines() if l]
+    ins = [l for l in lines if l.startswith("in ")]
+    outs = [l for l in lines if l.startswith("out ")]
+    # inputs: state + x + y + seed + lr + t; outputs: loss + state
+    assert len(ins) == n_state + 5
+    assert len(outs) == n_state + 1
+    assert meta["train"]["n_state"] == n_state
+    # trailing scalar inputs: seed s32, lr f32, t s32
+    assert ins[-3].split() == ["in", "s32"]
+    assert ins[-2].split() == ["in", "f32"]
+    assert ins[-1].split() == ["in", "s32"]
+    # loss is a f32 scalar
+    assert outs[0].split() == ["out", "f32"]
+    # each state blob's byte size matches its signature line
+    sizes = {"f32": 4, "s32": 4, "f64": 8, "s64": 8, "bf16": 2, "f16": 2,
+             "s8": 1, "u8": 1, "pred": 1}
+    for i in range(n_state):
+        _, dt, *dims = ins[i].split()
+        n = int(np.prod([int(d) for d in dims[0].split("x")])) if dims \
+            else 1
+        assert len(blobs[i]) == n * sizes[dt], "state %d" % i
+
+
+def test_deserialized_training_converges(artifact):
+    """Drive the artifact the way the C++ loop does: state chained
+    through outputs, fresh batch scalars per step, framework not used."""
+    blob, sig, n_state, blobs, _ = _load(artifact)
+    fn = jax.export.deserialize(blob).call
+
+    ins = [l.split() for l in sig.splitlines() if l.startswith("in ")]
+    dt_map = {"f32": jnp.float32, "s32": jnp.int32, "f64": jnp.float64,
+              "s64": jnp.int64, "bf16": jnp.bfloat16, "f16": jnp.float16}
+    state = []
+    for i in range(n_state):
+        _, dt, *dims = ins[i]
+        shape = tuple(int(d) for d in dims[0].split("x")) if dims else ()
+        state.append(jnp.asarray(np.frombuffer(
+            blobs[i], np.dtype(dt_map[dt])).reshape(shape)))
+
+    x, y = _synthetic(seed=3)
+    losses = []
+    for t in range(1, 9):
+        out = fn(*state, jnp.asarray(x), jnp.asarray(y),
+                 jnp.int32(t), jnp.float32(0.5), jnp.int32(t))
+        losses.append(float(out[0]))
+        state = list(out[1:])
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0] * 0.7, losses  # actually trains
+
+
+def test_bf16_mixed_state_roundtrips(tmp_path):
+    """bf16 compute keeps f32 masters; blobs must round-trip bf16/f32."""
+    x, y = _synthetic()
+    net = _mlp()
+    step = TrainStep(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+                     {"learning_rate": 0.1}, dtype="bfloat16")
+    float(step(x, y))
+    path = str(tmp_path / "train_bf16.mxtpu")
+    mx.predict.export_train_step(step, x, y, path)
+    blob, sig, n_state, blobs, _ = _load(path)
+    fn = jax.export.deserialize(blob).call
+    ins = [l.split() for l in sig.splitlines() if l.startswith("in ")]
+    dt_map = {"f32": jnp.float32, "s32": jnp.int32, "bf16": jnp.bfloat16}
+    state = []
+    for i in range(n_state):
+        _, dt, *dims = ins[i]
+        shape = tuple(int(d) for d in dims[0].split("x")) if dims else ()
+        state.append(jnp.asarray(np.frombuffer(
+            bytearray(blobs[i]), np.dtype(dt_map[dt])).reshape(shape)))
+    out = fn(*state, jnp.asarray(x), jnp.asarray(y),
+             jnp.int32(1), jnp.float32(0.1), jnp.int32(1))
+    assert np.isfinite(float(out[0]))
+
+
+def test_mesh_trainstep_rejected(tmp_path):
+    from mxnet_tpu.parallel.mesh import build_mesh
+    x, y = _synthetic()
+    net = _mlp()
+    step = TrainStep(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+                     {"learning_rate": 0.1}, mesh=build_mesh({"dp": 2}))
+    float(step(x, y))
+    with pytest.raises(mx.MXNetError, match="mesh"):
+        mx.predict.export_train_step(
+            step, x, y, str(tmp_path / "nope.mxtpu"))
