@@ -5,6 +5,8 @@
 
 #include <dlfcn.h>
 
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <fstream>
 #include <sstream>
@@ -359,6 +361,13 @@ Predictor::Predictor(const std::string& artifact_path,
       throw std::runtime_error(
           "train.txt n_state inconsistent with the signature");
     for (size_t i = 0; i < im.n_state; ++i) {
+      // output 1+i chains into input i next step: specs must agree, or
+      // step 2 would feed wrong-shaped buffers into the executable
+      if (im.output_specs[1 + i].dtype != im.input_specs[i].dtype ||
+          im.output_specs[1 + i].dims != im.input_specs[i].dims)
+        throw std::runtime_error(
+            "state " + std::to_string(i) + ": output spec does not match "
+            "input spec (broken chain in the artifact signature)");
       Tensor t = im.input_specs[i];
       std::string blob =
           read_zip_entry(zip, "state/" + std::to_string(i) + ".bin");
@@ -383,12 +392,27 @@ Predictor::Predictor(const std::string& artifact_path,
   if (im.api == nullptr)
     throw std::runtime_error("GetPjrtApi returned null");
 
+  // MXTPU_VERBOSE=1: stage markers on stderr, so a hang against a remote
+  // plugin (tunneled claim, server-side compile) is localizable from logs
+  const bool verbose = [] {
+    const char* v = std::getenv("MXTPU_VERBOSE");
+    return v != nullptr && v[0] == '1';
+  }();
+  auto stage = [&](const char* what) {
+    if (verbose) {
+      std::fprintf(stderr, "[mxtpu] %s...\n", what);
+      std::fflush(stderr);
+    }
+  };
+
+  stage("plugin init");
   {
     PJRT_Plugin_Initialize_Args a;
     std::memset(&a, 0, sizeof(a));
     a.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
     im.check(im.api->PJRT_Plugin_Initialize(&a), "plugin init");
   }
+  stage("client create");
   {
     std::vector<PJRT_NamedValue> nvs(create_options.size());
     for (size_t i = 0; i < create_options.size(); ++i) {
@@ -434,6 +458,7 @@ Predictor::Predictor(const std::string& artifact_path,
       throw std::runtime_error("client has no addressable devices");
     im.device = a.addressable_devices[0];
   }
+  stage("compile");
   {
     std::string opts = compile_options_bytes();
     PJRT_Program program;

@@ -117,7 +117,11 @@ int main(int argc, char** argv) {
       if (std::strcmp(argv[i], "--steps") == 0 && i + 1 < argc) {
         steps = std::atoi(argv[++i]);
       } else if (std::strcmp(argv[i], "--lr") == 0 && i + 1 < argc) {
-        lr = std::strtof(argv[++i], nullptr);
+        char* end = nullptr;
+        lr = std::strtof(argv[++i], &end);
+        if (end == argv[i] || *end != '\0')
+          throw std::runtime_error(std::string("--lr is not a number: ") +
+                                   argv[i]);
       } else if (std::strcmp(argv[i], "--num-classes") == 0 &&
                  i + 1 < argc) {
         num_classes = std::atoi(argv[++i]);

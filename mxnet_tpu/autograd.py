@@ -14,6 +14,7 @@ order.
 """
 from __future__ import annotations
 
+import contextlib
 import threading
 from collections import defaultdict
 
@@ -107,16 +108,31 @@ class VariableEntry:
         self.grad_req = grad_req
 
 
+# Keyed cache of jitted vjp programs, mirroring the eager forward's
+# _JIT_CACHE (ndarray.py): without it, backward runs jax.vjp EAGERLY —
+# for scan-carrying ops (fused RNN) eager linearization compiles the scan
+# inside op-by-op dispatch on every backward call, turning a word-LM
+# backward from milliseconds into minutes-per-batch. Keyed on the same
+# static specialization tuple the forward jit used plus the
+# input/cotangent avals, so one compile serves every batch of the same
+# shape. Stochastic nodes pass their PRNG key as a traced argument
+# (trace_key_scope installs tracers fine — TrainStep does the same), so
+# the cached program is key-independent.
+_VJP_CACHE = {}
+_VJP_CACHE_CAP = 8192  # same bound as the forward _JIT_CACHE
+_VJP_BLACKLIST = set()
+
+
 class OpNode:
     """One recorded op application (parity: nnvm node on the imperative tape,
     src/imperative/imperative.cc:182 RecordOp)."""
     __slots__ = ("fn", "kwargs", "parent_entries", "input_vals", "num_outputs",
                  "out_avals", "rng_key", "train_flag", "custom_backward",
-                 "differentiable")
+                 "differentiable", "jit_key")
 
     def __init__(self, fn, kwargs, parent_entries, input_vals, num_outputs,
                  out_avals, rng_key, train_flag, differentiable=True,
-                 custom_backward=None):
+                 custom_backward=None, jit_key=None):
         self.fn = fn
         self.kwargs = kwargs
         self.parent_entries = parent_entries  # list of entries or None
@@ -127,6 +143,7 @@ class OpNode:
         self.train_flag = train_flag
         self.differentiable = differentiable
         self.custom_backward = custom_backward
+        self.jit_key = jit_key                # hashable static spec or None
 
     def run_vjp(self, out_grads):
         """Compute input cotangents given output cotangents (list, no Nones)."""
@@ -142,16 +159,56 @@ class OpNode:
             _, vjp_fn = jax.vjp(pure, *self.input_vals)
             return vjp_fn(tuple(out_grads))
 
+        has_rng = self.rng_key is not None
         scope = _RecordingStateScope(False, self.train_flag)
         with scope:
-            if self.rng_key is not None:
+            ck = None
+            if self.jit_key is not None:
+                ck = (self.jit_key, self.train_flag, has_rng,
+                      tuple((v.shape, str(v.dtype))
+                            for v in self.input_vals),
+                      tuple((g.shape, str(g.dtype)) for g in out_grads))
+            if ck is not None and ck not in _VJP_BLACKLIST and \
+                    len(_VJP_CACHE) < _VJP_CACHE_CAP:
+                jitted = _VJP_CACHE.get(ck)
+                if jitted is None:
+                    # arguments flow through vjp as tracers, so the cached
+                    # program is reusable across nodes with the same key;
+                    # the rng key is an argument too, not a baked constant.
+                    # Close over ONLY self.fn/kwargs (static values) — not
+                    # `pure`/`self`, which would pin the node and its whole
+                    # upstream tape (first batch's activations) in the
+                    # module-global cache forever.
+                    def vjp_apply(ins, gs, key, _fn=self.fn, _kw=kwargs):
+                        def _pure(*xs):
+                            out = _fn(*xs, **_kw)
+                            return out if isinstance(out, tuple) else (out,)
+                        ctx = _random.trace_key_scope(key) if key is not None \
+                            else contextlib.nullcontext()
+                        with ctx:
+                            _, vjp_fn = jax.vjp(_pure, *ins)
+                            return vjp_fn(tuple(gs))
+                    jitted = jax.jit(vjp_apply,
+                                     static_argnums=() if has_rng else (2,))
+                try:
+                    res = jitted(tuple(self.input_vals), tuple(out_grads),
+                                 self.rng_key)
+                    _VJP_CACHE[ck] = jitted
+                    return res
+                except (jax.errors.TracerArrayConversionError,
+                        jax.errors.ConcretizationTypeError, TypeError):
+                    # not traceable under jit (host syncs etc.): run this
+                    # specialization eagerly from now on
+                    _VJP_BLACKLIST.add(ck)
+                    _VJP_CACHE.pop(ck, None)
+            if has_rng:
                 with _random.trace_key_scope(self.rng_key):
                     return run()
             return run()
 
 
 def record_op(opdef, input_ndarrays, input_vals, outputs, kwargs,
-              rng_key=None, custom_backward=None, fn=None):
+              rng_key=None, custom_backward=None, fn=None, jit_key=None):
     """Append an op to the tape; sets ._entry on each output NDArray."""
     parent_entries = [getattr(a, "_entry", None) for a in input_ndarrays]
     if custom_backward is None and (
@@ -163,7 +220,7 @@ def record_op(opdef, input_ndarrays, input_vals, outputs, kwargs,
     node = OpNode(fn or opdef.fn, {} if fn is not None else dict(kwargs),
                   parent_entries, list(input_vals),
                   len(out_avals), out_avals, rng_key, is_training(),
-                  opdef.differentiable, custom_backward)
+                  opdef.differentiable, custom_backward, jit_key=jit_key)
     outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     for i, o in enumerate(outs):
         o._entry = (node, i)

@@ -494,8 +494,11 @@ def _apply_op(opdef, args, kwargs):
 
     jit_fn = None
     key = None
-    if _EAGER_JIT and not in_trace and not isinstance(opdef, _AdhocOp) \
-            and _JIT_OP_FAILS.get(opdef.name, 0) < _JIT_OP_FAIL_CAP:
+    # the same static-specialization tuple keys the forward jit cache here
+    # and the backward vjp cache (autograd._VJP_CACHE), so compute it
+    # whenever either consumer can use it
+    if not in_trace and not isinstance(opdef, _AdhocOp) and \
+            (_EAGER_JIT or recording):
         try:
             key = (opdef.fn, _freeze(static_args), tuple(nd_positions),
                    nd_kw_names, _freeze(static_kwargs),
@@ -503,8 +506,9 @@ def _apply_op(opdef, args, kwargs):
             hash(key)
         except TypeError:
             key = None
-        if key is not None and key not in _JIT_BLACKLIST:
-            jit_fn = _jitted_op(opdef, key, lambda: closed_fn)
+    if _EAGER_JIT and key is not None and key not in _JIT_BLACKLIST and \
+            _JIT_OP_FAILS.get(opdef.name, 0) < _JIT_OP_FAIL_CAP:
+        jit_fn = _jitted_op(opdef, key, lambda: closed_fn)
 
     if jit_fn is not None:
         try:
@@ -538,12 +542,12 @@ def _apply_op(opdef, args, kwargs):
         outs = [NDArray(r, ctx=result_ctx) for r in res]
         if recording:
             autograd.record_op(opdef, nd_inputs, vals, outs, kwargs,
-                               rng_key=rng_key, fn=closed_fn)
+                               rng_key=rng_key, fn=closed_fn, jit_key=key)
         return tuple(outs)
     out_nd = NDArray(res, ctx=result_ctx)
     if recording:
         autograd.record_op(opdef, nd_inputs, vals, [out_nd], kwargs,
-                           rng_key=rng_key, fn=closed_fn)
+                           rng_key=rng_key, fn=closed_fn, jit_key=key)
     if out is not None:
         out._data = out_nd._data
         out._entry = out_nd._entry
