@@ -1,0 +1,27 @@
+"""Pre-jax environment setup for virtual-CPU-mesh entry points.
+
+Importable WITHOUT pulling in jax or mxnet_tpu, so callers can fix the
+platform before any backend initializes. Shared by
+benchmarks/scaling_report.py and __graft_entry__.dryrun_multichip
+(tests/conftest.py keeps its own lighter variant: it must NOT override
+an explicitly-set device count).
+"""
+import os
+import re
+
+
+def force_virtual_cpu_devices(n):
+    """Point jax at n virtual CPU devices, overriding any prior count.
+
+    Must run before jax initializes a backend. Also call
+    jax.config.update("jax_platforms", "cpu") after importing jax —
+    sitecustomize may have imported jax already, making env vars alone
+    too late (the axon TPU plugin registers at interpreter start when
+    PALLAS_AXON_POOL_IPS is set).
+    """
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=%d" % n).strip()
