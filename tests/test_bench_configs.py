@@ -1,0 +1,34 @@
+"""bench.py contract: every config emits a JSON line in smoke mode and
+the driver-parsed FINAL line is the resnet headline. The driver runs
+bench.py unattended on real hardware each round — a silently broken
+config would only surface there, so pin the contract in CI."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+@pytest.mark.slow
+def test_bench_smoke_emits_every_config():
+    env = dict(os.environ, BENCH_SMOKE="1", JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                         capture_output=True, text=True, timeout=1200,
+                         env=env, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr[-2000:]
+    lines = [json.loads(l) for l in out.stdout.splitlines()
+             if l.startswith("{")]
+    metrics = [l["metric"] for l in lines]
+    # no config degraded into an error line
+    errors = [m for m in metrics if m.endswith("_error")]
+    assert not errors, (errors, lines)
+    for want in ("infer", "int8_infer", "lstm", "transformer", "ssd",
+                 "sparse", "io_pipeline"):
+        assert any(want in m for m in metrics), (want, metrics)
+    # the driver parses the LAST stdout JSON line as the result
+    assert metrics[-1] == "smoke_resnet18_train_img_per_sec"
+    assert all(l.get("value") is not None for l in lines), lines
