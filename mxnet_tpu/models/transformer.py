@@ -35,6 +35,8 @@ class TransformerConfig:
     n_layers: int = 2
     d_ff: int = 128
     n_experts: int = 0          # 0 => dense FFN; >0 => MoE
+    moe_top_k: int = 0          # 0 => dense dispatch; >0 => top-k routing
+    capacity_factor: float = 1.25  # per-expert buffer over the even share
     max_len: int = 128
     dtype: object = jnp.float32
 
@@ -137,17 +139,71 @@ def _attention(x, wqkv, wo, cfg, mesh=None, sp_axis="sp", causal=True):
 
 
 def _moe_ffn(x, wg, w1, w2):
-    """Gate-weighted MoE; expert dim sharded over 'ep' by GSPMD.
-
-    Dense dispatch (every expert sees every token, outputs weighted by the
-    gate) — the expert-parallel sharding is real; top-k sparse dispatch is a
-    perf refinement on the same sharding layout.
-    """
+    """Gate-weighted dense-dispatch MoE; expert dim sharded over 'ep' by
+    GSPMD. Every expert sees every token, outputs weighted by the full
+    softmax gate — the exact function _moe_ffn_topk approximates (and
+    reproduces when k == n_experts with ample capacity)."""
     gates = jax.nn.softmax(x @ wg, axis=-1)           # (B, S, E)
     h = jnp.einsum("bsd,edf->besf", x, w1)
     h = jax.nn.relu(h)
     y = jnp.einsum("besf,efd->besd", h, w2)
     return jnp.einsum("bse,besd->bsd", gates, y)
+
+
+def _moe_ffn_topk(x, wg, w1, w2, k, capacity_factor=1.25):
+    """Top-k sparse-dispatch MoE (Switch/GShard style) with static
+    shapes throughout — XLA/GSPMD friendly: no gather scatter of
+    dynamic extent, all routing is einsums over one-hot masks, so the
+    expert dimension stays sharded over 'ep' and dispatch/combine lower
+    to all-to-alls on a real mesh.
+
+    Per token: softmax gate over E experts, keep the top k; each expert
+    processes at most C = ceil(capacity_factor * S_tokens * k / E)
+    tokens (position-in-expert via cumsum; overflow tokens drop to the
+    residual path, the standard capacity trade). Combine weights are
+    renormalized over the kept experts.
+
+    Reference seam: the reference's sparse embedding/expert flows ride
+    row_sparse KVStore pulls (reference python/mxnet/kvstore.py
+    row_sparse_pull); here routing is part of the one compiled step.
+    """
+    B, S, D = x.shape
+    E = w1.shape[0]
+    tokens = B * S
+    capacity = int(np.ceil(capacity_factor * tokens * k / E))
+    capacity = max(capacity, k)
+
+    xt = x.reshape(tokens, D)
+    gates = jax.nn.softmax(xt @ wg, axis=-1)              # (T, E)
+    topv, topi = jax.lax.top_k(gates, k)                  # (T, k)
+    # renormalize over the selected experts
+    topv = topv / jnp.maximum(jnp.sum(topv, -1, keepdims=True), 1e-9)
+
+    # routing bookkeeping in int32: under bf16 activations a float
+    # cumsum of token counts goes inexact past 256 and capacity slots
+    # would silently collide — only the masks cast to x.dtype, at the
+    # einsum boundary
+    sel_i = jax.nn.one_hot(topi, E, dtype=jnp.int32)      # (T, k, E)
+    # position of each (token, choice) within its expert's buffer:
+    # cumulative count of prior selections of that expert, counting
+    # choice slots in priority order (k=0 first, matching GShard)
+    flat = sel_i.transpose(1, 0, 2).reshape(k * tokens, E)  # (k*T, E)
+    pos_flat = jnp.cumsum(flat, axis=0) - flat            # prior count
+    pos = pos_flat.reshape(k, tokens, E).transpose(1, 0, 2)  # (T, k, E)
+    in_cap = ((pos < capacity) & (sel_i > 0)).astype(x.dtype)  # kept
+    pos_idx = jnp.sum(pos * sel_i, -1).astype(jnp.int32)  # (T, k)
+
+    # dispatch mask (T, k, E, C) -> one-hot over capacity slots
+    cap_hot = jax.nn.one_hot(pos_idx, capacity, dtype=x.dtype)  # (T,k,C)
+    dispatch = jnp.einsum("tke,tkc->tec", in_cap, cap_hot)      # (T,E,C)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, xt)         # (E,C,D)
+
+    h = jax.nn.relu(jnp.einsum("ecd,edf->ecf", expert_in, w1))
+    expert_out = jnp.einsum("ecf,efd->ecd", h, w2)              # (E,C,D)
+
+    combine = jnp.einsum("tke,tk,tkc->tec", in_cap, topv, cap_hot)
+    out = jnp.einsum("tec,ecd->td", combine, expert_out)
+    return out.reshape(B, S, D)
 
 
 def transformer_apply(params, tokens, cfg, mesh=None, causal=True):
@@ -160,7 +216,11 @@ def transformer_apply(params, tokens, cfg, mesh=None, causal=True):
         x = x + _attention(h, params[pre + "wqkv"], params[pre + "wo"],
                            cfg, mesh=mesh, causal=causal)
         h = _layer_norm(x, params[pre + "ln2_g"], params[pre + "ln2_b"])
-        if cfg.n_experts:
+        if cfg.n_experts and cfg.moe_top_k:
+            x = x + _moe_ffn_topk(h, params[pre + "wg"],
+                                  params[pre + "w1"], params[pre + "w2"],
+                                  cfg.moe_top_k, cfg.capacity_factor)
+        elif cfg.n_experts:
             x = x + _moe_ffn(h, params[pre + "wg"], params[pre + "w1"],
                              params[pre + "w2"])
         else:

@@ -574,3 +574,94 @@ def test_resnetish_dp_tp_matches_single_device():
     # BN moving stats (aux) included in the comparison above proves the
     # cross-replica stat accumulation matches the global computation
     assert any("batchnorm" in k and "running_mean" in k for k in p_ref)
+
+
+def test_moe_topk_equals_dense_when_k_is_all_experts():
+    """With k = n_experts and ample capacity, no token is dropped and the
+    renormalized top-k combine IS the full softmax gate - the sparse
+    dispatch must reproduce the dense-dispatch MoE exactly."""
+    from mxnet_tpu.models.transformer import _moe_ffn, _moe_ffn_topk
+    rng = np.random.RandomState(0)
+    B, S, D, E, F = 2, 8, 16, 4, 32
+    x = jnp.asarray(rng.uniform(-1, 1, (B, S, D)).astype(np.float32))
+    wg = jnp.asarray(rng.uniform(-1, 1, (D, E)).astype(np.float32))
+    w1 = jnp.asarray(rng.uniform(-0.5, 0.5, (E, D, F)).astype(np.float32))
+    w2 = jnp.asarray(rng.uniform(-0.5, 0.5, (E, F, D)).astype(np.float32))
+    dense = _moe_ffn(x, wg, w1, w2)
+    sparse = _moe_ffn_topk(x, wg, w1, w2, k=E, capacity_factor=1.0)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(sparse),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_moe_topk_capacity_drops_overflow_not_nan():
+    """Tight capacity must drop routes (tokens fall back to the residual
+    path = zero FFN contribution), never corrupt the output."""
+    from mxnet_tpu.models.transformer import _moe_ffn_topk
+    rng = np.random.RandomState(1)
+    B, S, D, E, F = 1, 16, 8, 2, 16
+    # positive features + gate weights favoring expert 0: EVERY token
+    # routes to expert 0 -> guaranteed overflow of its capacity
+    x = jnp.asarray(rng.uniform(0.1, 1, (B, S, D)).astype(np.float32))
+    wg = jnp.asarray(np.stack([np.full(D, 5.0), np.full(D, -5.0)], 1)
+                     .astype(np.float32))
+    w1 = jnp.asarray(rng.uniform(-0.5, 0.5, (E, D, F)).astype(np.float32))
+    w2 = jnp.asarray(rng.uniform(-0.5, 0.5, (E, F, D)).astype(np.float32))
+    out = _moe_ffn_topk(x, wg, w1, w2, k=1, capacity_factor=0.25)
+    a = np.asarray(out)
+    assert np.isfinite(a).all()
+    # capacity 0.25 * 16 / 2 = 2 slots on the hot expert: at most 2
+    # tokens produce nonzero output, the overflow rows must be exactly 0
+    nonzero_rows = (np.abs(a[0]) > 1e-7).any(axis=-1).sum()
+    assert nonzero_rows <= 2, nonzero_rows
+
+
+def test_transformer_moe_topk_ep_trains():
+    """Top-k sparse routing under a real dp x tp x ep mesh: the full
+    train step compiles with GSPMD and the loss drops."""
+    from mxnet_tpu.models.transformer import TransformerConfig, \
+        make_train_step
+    m = pmesh.build_mesh({"dp": 2, "tp": 2, "ep": 2})
+    cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=1,
+                            d_ff=64, n_experts=4, moe_top_k=2, max_len=16)
+    run, params = make_train_step(m, cfg, lr=0.1)
+    toks = np.random.randint(0, 64, (4, 16))
+    params, l0 = run(params, toks)
+    for _ in range(5):
+        params, l = run(params, toks)
+    assert float(l) < float(l0)
+
+
+def test_moe_topk_bf16_routing_counts_exact():
+    """Routing bookkeeping must be integer: in bf16, >256 tokens on one
+    expert would collide capacity slots if counts were float. Route 512
+    tokens to one expert in bf16 and check each kept token matches its
+    own f32 expert output (collided slots would corrupt pairs)."""
+    from mxnet_tpu.models.transformer import _moe_ffn_topk
+    rng = np.random.RandomState(2)
+    B, S, D, E, F = 1, 512, 8, 2, 8
+    x32 = rng.uniform(0.1, 1, (B, S, D)).astype(np.float32)
+    wg = np.stack([np.full(D, 5.0), np.full(D, -5.0)], 1).astype(np.float32)
+    # positive weights with positive inputs: every pre-activation sits
+    # far from the relu boundary, so bf16 cannot flip a unit on/off and
+    # any ~100% per-element error can only come from a slot collision
+    w1 = rng.uniform(0.1, 0.5, (E, D, F)).astype(np.float32)
+    w2 = rng.uniform(-0.5, 0.5, (E, F, D)).astype(np.float32)
+    out16 = _moe_ffn_topk(jnp.asarray(x32, jnp.bfloat16),
+                          jnp.asarray(wg, jnp.bfloat16),
+                          jnp.asarray(w1, jnp.bfloat16),
+                          jnp.asarray(w2, jnp.bfloat16),
+                          k=1, capacity_factor=2.0)
+    out32 = _moe_ffn_topk(jnp.asarray(x32), jnp.asarray(wg),
+                          jnp.asarray(w1), jnp.asarray(w2),
+                          k=1, capacity_factor=2.0)
+    a16 = np.asarray(out16, np.float32)[0]
+    a32 = np.asarray(out32)[0]
+    # all 512 tokens fit (capacity 2.0 * 512 / 2 = 512): every row kept
+    assert (np.abs(a32) > 1e-7).any(axis=-1).all()
+    assert (np.abs(a16) > 1e-7).any(axis=-1).all()
+    # bf16 tracks f32 within arithmetic tolerance (mixed bound: bf16 dot
+    # products carry ~1% relative + small absolute error). A capacity
+    # slot COLLISION sums two different tokens' activations — an O(1)
+    # absolute miss that this bound catches with 10x margin.
+    err = np.abs(a16 - a32)
+    assert (err <= 0.05 + 0.05 * np.abs(a32)).all(), err.max()
