@@ -203,12 +203,25 @@ def _moe_ffn_topk(x, wg, w1, w2, k, capacity_factor=1.25):
 
     combine = jnp.einsum("tke,tk,tkc->tec", in_cap, topv, cap_hot)
     out = jnp.einsum("tec,ecd->td", combine, expert_out)
-    return out.reshape(B, S, D)
+
+    # Switch/GShard load-balancing auxiliary: E * sum_e f_e * P_e, where
+    # f_e = fraction of tokens whose TOP choice is expert e (hard count)
+    # and P_e = mean softmax gate mass on e. Minimized at uniform
+    # routing (value 1); without it top-k training collapses experts.
+    f = jnp.mean(sel_i[:, 0, :].astype(jnp.float32), axis=0)   # (E,)
+    p = jnp.mean(gates.astype(jnp.float32), axis=0)            # (E,)
+    aux = E * jnp.sum(f * p)
+    return out.reshape(B, S, D), aux
 
 
-def transformer_apply(params, tokens, cfg, mesh=None, causal=True):
-    """tokens: (B, S) int32 -> logits (B, S, vocab)."""
+def transformer_apply(params, tokens, cfg, mesh=None, causal=True,
+                      return_aux=False):
+    """tokens: (B, S) int32 -> logits (B, S, vocab).
+
+    With return_aux=True also returns the summed MoE load-balancing
+    auxiliary (0.0 for dense-dispatch / non-MoE configs)."""
     B, S = tokens.shape
+    aux_total = jnp.float32(0.0)
     x = params["embed"][tokens] + params["pos_embed"][:S][None]
     for i in range(cfg.n_layers):
         pre = "layer%d_" % i
@@ -217,27 +230,36 @@ def transformer_apply(params, tokens, cfg, mesh=None, causal=True):
                            cfg, mesh=mesh, causal=causal)
         h = _layer_norm(x, params[pre + "ln2_g"], params[pre + "ln2_b"])
         if cfg.n_experts and cfg.moe_top_k:
-            x = x + _moe_ffn_topk(h, params[pre + "wg"],
-                                  params[pre + "w1"], params[pre + "w2"],
-                                  cfg.moe_top_k, cfg.capacity_factor)
+            moe_out, aux = _moe_ffn_topk(h, params[pre + "wg"],
+                                         params[pre + "w1"],
+                                         params[pre + "w2"],
+                                         cfg.moe_top_k,
+                                         cfg.capacity_factor)
+            x = x + moe_out
+            aux_total = aux_total + aux
         elif cfg.n_experts:
             x = x + _moe_ffn(h, params[pre + "wg"], params[pre + "w1"],
                              params[pre + "w2"])
         else:
             x = x + jax.nn.relu(h @ params[pre + "w1"]) @ params[pre + "w2"]
     x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
-    return x @ params["head"]
+    logits = x @ params["head"]
+    if return_aux:
+        return logits, aux_total
+    return logits
 
 
-def lm_loss(params, tokens, cfg, mesh=None):
+def lm_loss(params, tokens, cfg, mesh=None, aux_coef=0.01):
     """Next-token cross entropy. Runs attention on the full (sp-shardable)
     sequence and shifts in loss space, so the sequence axis stays divisible
-    by the 'sp' mesh axis."""
-    logits = transformer_apply(params, tokens, cfg, mesh=mesh)
+    by the 'sp' mesh axis. Top-k MoE configs add the load-balancing
+    auxiliary (Switch-style, coefficient `aux_coef`)."""
+    logits, aux = transformer_apply(params, tokens, cfg, mesh=mesh,
+                                    return_aux=True)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp[:, :-1],
                              tokens[:, 1:][..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    return -jnp.mean(ll) + aux_coef * aux
 
 
 def make_train_step(mesh, cfg, lr=0.1, seed=0):

@@ -588,7 +588,7 @@ def test_moe_topk_equals_dense_when_k_is_all_experts():
     w1 = jnp.asarray(rng.uniform(-0.5, 0.5, (E, D, F)).astype(np.float32))
     w2 = jnp.asarray(rng.uniform(-0.5, 0.5, (E, F, D)).astype(np.float32))
     dense = _moe_ffn(x, wg, w1, w2)
-    sparse = _moe_ffn_topk(x, wg, w1, w2, k=E, capacity_factor=1.0)
+    sparse, _ = _moe_ffn_topk(x, wg, w1, w2, k=E, capacity_factor=1.0)
     np.testing.assert_allclose(np.asarray(dense), np.asarray(sparse),
                                rtol=2e-4, atol=2e-5)
 
@@ -606,7 +606,7 @@ def test_moe_topk_capacity_drops_overflow_not_nan():
                      .astype(np.float32))
     w1 = jnp.asarray(rng.uniform(-0.5, 0.5, (E, D, F)).astype(np.float32))
     w2 = jnp.asarray(rng.uniform(-0.5, 0.5, (E, F, D)).astype(np.float32))
-    out = _moe_ffn_topk(x, wg, w1, w2, k=1, capacity_factor=0.25)
+    out, _ = _moe_ffn_topk(x, wg, w1, w2, k=1, capacity_factor=0.25)
     a = np.asarray(out)
     assert np.isfinite(a).all()
     # capacity 0.25 * 16 / 2 = 2 slots on the hot expert: at most 2
@@ -646,14 +646,14 @@ def test_moe_topk_bf16_routing_counts_exact():
     # any ~100% per-element error can only come from a slot collision
     w1 = rng.uniform(0.1, 0.5, (E, D, F)).astype(np.float32)
     w2 = rng.uniform(-0.5, 0.5, (E, F, D)).astype(np.float32)
-    out16 = _moe_ffn_topk(jnp.asarray(x32, jnp.bfloat16),
-                          jnp.asarray(wg, jnp.bfloat16),
-                          jnp.asarray(w1, jnp.bfloat16),
-                          jnp.asarray(w2, jnp.bfloat16),
-                          k=1, capacity_factor=2.0)
-    out32 = _moe_ffn_topk(jnp.asarray(x32), jnp.asarray(wg),
-                          jnp.asarray(w1), jnp.asarray(w2),
-                          k=1, capacity_factor=2.0)
+    out16, _ = _moe_ffn_topk(jnp.asarray(x32, jnp.bfloat16),
+                             jnp.asarray(wg, jnp.bfloat16),
+                             jnp.asarray(w1, jnp.bfloat16),
+                             jnp.asarray(w2, jnp.bfloat16),
+                             k=1, capacity_factor=2.0)
+    out32, _ = _moe_ffn_topk(jnp.asarray(x32), jnp.asarray(wg),
+                             jnp.asarray(w1), jnp.asarray(w2),
+                             k=1, capacity_factor=2.0)
     a16 = np.asarray(out16, np.float32)[0]
     a32 = np.asarray(out32)[0]
     # all 512 tokens fit (capacity 2.0 * 512 / 2 = 512): every row kept
@@ -665,3 +665,27 @@ def test_moe_topk_bf16_routing_counts_exact():
     # absolute miss that this bound catches with 10x margin.
     err = np.abs(a16 - a32)
     assert (err <= 0.05 + 0.05 * np.abs(a32)).all(), err.max()
+
+
+def test_moe_topk_aux_loss_balancing():
+    """The Switch-style auxiliary is minimized (=1) at uniform routing
+    and grows when routing collapses onto one expert."""
+    from mxnet_tpu.models.transformer import _moe_ffn_topk
+    rng = np.random.RandomState(3)
+    B, S, D, E, F = 1, 64, 8, 4, 8
+    x = jnp.asarray(rng.uniform(0.1, 1, (B, S, D)).astype(np.float32))
+    w1 = jnp.asarray(rng.uniform(-0.5, 0.5, (E, D, F)).astype(np.float32))
+    w2 = jnp.asarray(rng.uniform(-0.5, 0.5, (E, F, D)).astype(np.float32))
+    # collapsed: every token's gate mass on expert 0
+    wg_bad = jnp.asarray(
+        np.concatenate([np.full((D, 1), 5.0), np.full((D, E - 1), -5.0)],
+                       1).astype(np.float32))
+    _, aux_bad = _moe_ffn_topk(x, wg_bad, w1, w2, k=1)
+    # genuinely spread routing: small random logits give each token an
+    # independent (near-uniform over tokens) top-1 choice — ties at
+    # exactly-zero logits would all route to expert 0 and test nothing
+    wg_spread = jnp.asarray(
+        0.01 * rng.standard_normal((D, E)).astype(np.float32))
+    _, aux_uniform = _moe_ffn_topk(x, wg_spread, w1, w2, k=1)
+    assert float(aux_bad) > 3.5, float(aux_bad)        # ~E at collapse
+    assert 0.9 < float(aux_uniform) < 1.6, float(aux_uniform)
