@@ -22,9 +22,13 @@ def test_scaling_report_collectives_invariant(tmp_path):
                                       "scaling_report.py")],
         capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
     assert out.returncode == 0, out.stdout + out.stderr
-    rows = [json.loads(l) for l in out.stdout.splitlines()
-            if l.startswith("{")]
+    lines = [json.loads(l) for l in out.stdout.splitlines()
+             if l.startswith("{")]
+    rows = [r for r in lines if "n_devices" in r]
     assert len(rows) == 2
+    # the expert-parallel section also ran and found collectives
+    moe = [r["moe"] for r in lines if "moe" in r]
+    assert moe and moe[0]["collectives"], moe
     for r in rows:
         assert "all-reduce" in r["collectives"] or \
             "reduce-scatter" in r["collectives"]
