@@ -41,6 +41,10 @@ def main():
     ap.add_argument("--tp", type=int, default=2)
     ap.add_argument("--sp", type=int, default=2)
     ap.add_argument("--experts", type=int, default=0)
+    ap.add_argument("--top-k", type=int, default=0,
+                    help="with --experts: top-k sparse routing "
+                         "(capacity-based GShard dispatch + Switch "
+                         "load-balancing aux); 0 = dense dispatch")
     ap.add_argument("--d-model", type=int, default=64)
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--heads", type=int, default=4)
@@ -71,7 +75,8 @@ def main():
     cfg = TransformerConfig(vocab=args.vocab, d_model=args.d_model,
                             n_heads=args.heads, n_layers=args.layers,
                             d_ff=4 * args.d_model, max_len=args.seq_len,
-                            n_experts=args.experts)
+                            n_experts=args.experts,
+                            moe_top_k=args.top_k)
     run, params = make_train_step(mesh, cfg, lr=args.lr)
 
     losses = []
