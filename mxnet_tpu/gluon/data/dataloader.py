@@ -31,10 +31,48 @@ def default_batchify_fn(data):
     return NDArray(data)
 
 
+def prefetch_to_device(iterable, size=2, device=None):
+    """Stage upcoming batches in accelerator memory while the current one
+    computes.
+
+    `jax.device_put` is an asynchronous host→HBM DMA, so holding `size`
+    batches in flight overlaps the input transfer with the training step
+    — the TPU input-pipeline pattern the reference approximates with
+    engine-async `PrefetcherIter` (iter_prefetcher.h). Works on any
+    iterable of NDArray / array / (nested) tuple-list batches; yields
+    batches with device-resident buffers in original order.
+    """
+    import jax
+    from collections import deque
+
+    if device is None:
+        device = jax.devices()[0]
+
+    def put(b):
+        if isinstance(b, NDArray):
+            return NDArray(jax.device_put(b._data, device))
+        if isinstance(b, (list, tuple)):
+            return type(b)(put(x) for x in b)
+        return jax.device_put(b, device)
+
+    window = deque()
+    it = iter(iterable)
+    try:
+        for batch in it:
+            window.append(put(batch))
+            if len(window) > max(1, size):
+                yield window.popleft()
+        while window:
+            yield window.popleft()
+    finally:
+        window.clear()
+
+
 class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
-                 num_workers=0, pin_memory=False, prefetch=None):
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 device_prefetch=0):
         self._dataset = dataset
         if batch_sampler is None:
             if batch_size is None:
@@ -59,12 +97,19 @@ class DataLoader:
         self._num_workers = num_workers
         self._prefetch = max(0, int(prefetch) if prefetch is not None
                              else 2 * num_workers)
+        self._device_prefetch = max(0, int(device_prefetch))
         self._batchify_fn = batchify_fn or default_batchify_fn
 
     def _make_batch(self, indices):
         return self._batchify_fn([self._dataset[i] for i in indices])
 
     def __iter__(self):
+        if self._device_prefetch:
+            return prefetch_to_device(self._iter_host(),
+                                      self._device_prefetch)
+        return self._iter_host()
+
+    def _iter_host(self):
         if self._num_workers == 0:
             for batch in self._batch_sampler:
                 yield self._make_batch(batch)
