@@ -51,21 +51,19 @@ def prefetch_to_device(iterable, size=2, device=None):
     def put(b):
         if isinstance(b, NDArray):
             return NDArray(jax.device_put(b._data, device))
+        if isinstance(b, tuple) and hasattr(b, "_fields"):  # namedtuple
+            return type(b)(*(put(x) for x in b))
         if isinstance(b, (list, tuple)):
             return type(b)(put(x) for x in b)
         return jax.device_put(b, device)
 
     window = deque()
-    it = iter(iterable)
-    try:
-        for batch in it:
-            window.append(put(batch))
-            if len(window) > max(1, size):
-                yield window.popleft()
-        while window:
+    for batch in iterable:
+        window.append(put(batch))
+        if len(window) > max(1, size):
             yield window.popleft()
-    finally:
-        window.clear()
+    while window:
+        yield window.popleft()
 
 
 class DataLoader:

@@ -402,9 +402,15 @@ def test_dataloader_device_prefetch_values_and_placement():
         np.testing.assert_array_equal(hy.asnumpy(), dy.asnumpy())
         assert list(dx._data.devices())[0] == jax.devices()[0]
 
-    # the generic wrapper also handles bare arrays and nesting
-    batches = list(prefetch_to_device(iter([np.ones(3), (np.zeros(2),
-                                                         np.ones(1))]),
-                                      size=1))
-    assert len(batches) == 2
+    # the generic wrapper also handles bare arrays, nesting, and
+    # namedtuple batches (reconstructed positionally)
+    import collections
+    NT = collections.namedtuple("NT", ["a", "b"])
+    batches = list(prefetch_to_device(
+        iter([np.ones(3), (np.zeros(2), np.ones(1)), NT(np.ones(2),
+                                                        np.zeros(1))]),
+        size=1))
+    assert len(batches) == 3
     np.testing.assert_array_equal(np.asarray(batches[0]), np.ones(3))
+    assert isinstance(batches[2], NT)
+    np.testing.assert_array_equal(np.asarray(batches[2].a), np.ones(2))
