@@ -4,7 +4,9 @@
 # jax process wedges it or trips the reachability probe into CPU fallback):
 #   bash benchmarks/tpu_session.sh
 # Produces: BENCH_ALL.json + BENCH_LAST_TPU.json (committed numbers),
-# layout A/B lines, per-HLO profiles, the flash-attention seq sweep, and
+# layout A/B lines, per-HLO profiles, the flash seq sweep (8192 probes
+# the kernel's O(T)-memory regime, where XLA attention materializes the
+# scores), and
 # the C++ PJRT predictor's real-plugin run.
 set -uo pipefail
 cd "$(dirname "$0")/.."
@@ -16,7 +18,7 @@ echo "=== 2. headline with NHWC layout (A/B) ==="
 BENCH_CONFIGS=headline BENCH_LAYOUT=NHWC python bench.py | tee /tmp/bench_nhwc.out
 
 echo "=== 3. flash attention seq sweep (1024/2048/4096) ==="
-BENCH_CONFIGS=transformer_flash BENCH_FLASH_SEQ=1024,2048,4096 \
+BENCH_CONFIGS=transformer_flash BENCH_FLASH_SEQ=1024,2048,4096,8192 \
   python bench.py | tee BENCH_FLASH_SWEEP.jsonl
 
 echo "=== 4. per-HLO profile (NCHW) ==="
