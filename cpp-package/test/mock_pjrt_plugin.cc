@@ -2,10 +2,14 @@
 // accelerator: implements exactly the call surface predictor.cc uses.
 // "Compile" records the program; "Execute" echoes the input buffers back
 // as outputs, so a round trip validates struct usage, buffer lifecycle,
-// and data transport byte-for-byte. Built as libmock_pjrt.so by the
+// and data transport byte-for-byte. With MOCK_PJRT_TRAIN=1 Execute
+// instead models the train-artifact convention (decreasing f32 loss +
+// state echo) so the C++ training loop is fully testable without an
+// accelerator. Built as libmock_pjrt.so by the
 // Makefile; the real-plugin path is exercised against the TPU plugin when
 // one is present (tests/test_cpp_package.py).
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 #include <vector>
@@ -131,15 +135,45 @@ PJRT_Error* BufferToHostBuffer(PJRT_Buffer_ToHostBuffer_Args* args) {
 
 // -- execute ----------------------------------------------------------------
 
+int train_step_counter = 0;
+
 PJRT_Error* Execute(PJRT_LoadedExecutable_Execute_Args* args) {
   if (args->num_devices != 1)
     return make_error("mock: expected a single device launch");
-  // echo: output i = copy of input i (the test artifact is an identity fn)
-  for (size_t i = 0; i < args->num_args; ++i) {
-    const MockBuffer* in =
-        reinterpret_cast<const MockBuffer*>(args->argument_lists[0][i]);
-    args->output_lists[0][i] = reinterpret_cast<PJRT_Buffer*>(
-        new MockBuffer(*in));
+  // Train mode is opted into EXPLICITLY by the test (MOCK_PJRT_TRAIN=1):
+  // inferring it from input arity would misroute a future 6-input
+  // inference artifact into the wrong output count (out-of-bounds
+  // writes against the caller's output list).
+  const char* train_env = std::getenv("MOCK_PJRT_TRAIN");
+  if (train_env != nullptr && train_env[0] == '1' &&
+      args->num_args >= 6) {
+    // train-artifact convention (export_train_step): inputs are
+    // [state_0..state_{K-1}, x, y, seed, lr, t] and outputs
+    // [loss, state'_0..state'_{K-1}] — model it so mxtpu_train's FULL
+    // loop (loss readback, device-resident state chain, read_state) is
+    // CPU-testable: loss is a decreasing f32 scalar, state echoes.
+    size_t k = args->num_args - 5;
+    MockBuffer* loss = new MockBuffer();
+    loss->type = PJRT_Buffer_Type_F32;
+    float v = 1.0f / static_cast<float>(++train_step_counter);
+    loss->data.resize(4);
+    std::memcpy(loss->data.data(), &v, 4);
+    args->output_lists[0][0] = reinterpret_cast<PJRT_Buffer*>(loss);
+    for (size_t i = 0; i < k; ++i) {
+      const MockBuffer* in =
+          reinterpret_cast<const MockBuffer*>(args->argument_lists[0][i]);
+      args->output_lists[0][1 + i] = reinterpret_cast<PJRT_Buffer*>(
+          new MockBuffer(*in));
+    }
+  } else {
+    // echo: output i = copy of input i (the test artifact is an
+    // identity fn)
+    for (size_t i = 0; i < args->num_args; ++i) {
+      const MockBuffer* in =
+          reinterpret_cast<const MockBuffer*>(args->argument_lists[0][i]);
+      args->output_lists[0][i] = reinterpret_cast<PJRT_Buffer*>(
+          new MockBuffer(*in));
+    }
   }
   if (args->device_complete_events != nullptr)
     args->device_complete_events[0] =

@@ -68,9 +68,10 @@ def test_cpp_predictor_rejects_bad_inputs(tmp_path):
 def test_cpp_train_state_roundtrip(tmp_path):
     """mxtpu_train against the mock: artifact parse (train.txt + state
     blobs), client create with --opt NamedValues, device upload of the
-    full training state, byte-for-byte read-back. Execute (which the echo
-    mock cannot model for a train signature) runs in the real-plugin leg
-    and the TPU session script."""
+    full training state, byte-for-byte read-back — then the FULL loop
+    (execute, loss readback, state chain, --expect-decreasing) with the
+    mock's MOCK_PJRT_TRAIN=1 train-convention Execute. The real-plugin
+    leg and the TPU session script cover the same loop on hardware."""
     _build()
     train_cli = os.path.join(PKG, "build", "mxtpu_train")
     assert os.path.exists(train_cli)
@@ -97,6 +98,17 @@ def test_cpp_train_state_roundtrip(tmp_path):
     assert "state round-trip OK" in out.stdout
     # sgd+momentum: weights+bias x2 layers grad'd + momentum state each
     assert "state tensors: 8" in out.stdout
+
+    # full loop: the mock models the train convention (decreasing loss,
+    # state echo), so chaining + loss readback + --expect-decreasing all
+    # run through the real buffer lifecycle
+    out = subprocess.run([train_cli, artifact, MOCK, "--steps", "5",
+                          "--expect-decreasing"],
+                         capture_output=True, text=True, timeout=60,
+                         env=dict(os.environ, MOCK_PJRT_TRAIN="1"))
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert out.stdout.count("loss") >= 5
+    assert "final state: 8 tensors read back" in out.stdout
 
 
 def test_cpp_train_rejects_inference_artifact(tmp_path):
