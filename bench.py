@@ -740,7 +740,9 @@ def main():
                 "value": headline["value"],
                 "vs_baseline": headline.get("vs_baseline"),
                 "measured_at": cached.get("measured_at"),
-                "source": cached.get("source"),
+                "source": cached.get("source") or
+                "BENCH_LAST_TPU.json — most recent healthy on-device "
+                "bench.py run (committed artifact)",
             }
     except (OSError, ValueError, KeyError, IndexError):
         pass
