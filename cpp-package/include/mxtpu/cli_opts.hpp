@@ -1,0 +1,43 @@
+// Shared CLI helper for the mxtpu tools: parse one "--opt" spec of the
+// form name=int:N or name=str:S into a CreateOption (a NamedValue for
+// PJRT_Client_Create). Lives in one place so the --opt grammar cannot
+// drift between mxtpu_predict and mxtpu_train.
+#ifndef MXTPU_CLI_OPTS_HPP_
+#define MXTPU_CLI_OPTS_HPP_
+
+#include <cstdlib>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+
+#include "mxtpu/predictor.hpp"
+
+namespace mxtpu {
+
+inline CreateOption ParseCliOpt(const char* spec) {
+  const char* eq = std::strchr(spec, '=');
+  if (eq == nullptr)
+    throw std::runtime_error(std::string("--opt needs name=type:value: ") +
+                             spec);
+  CreateOption o;
+  o.name.assign(spec, eq - spec);
+  const char* val = eq + 1;
+  if (std::strncmp(val, "int:", 4) == 0) {
+    o.is_int = true;
+    char* end = nullptr;
+    o.int_value = std::strtoll(val + 4, &end, 10);
+    if (end == val + 4 || *end != '\0')
+      throw std::runtime_error(
+          std::string("--opt int value is not an integer: ") + spec);
+  } else if (std::strncmp(val, "str:", 4) == 0) {
+    o.str_value = val + 4;
+  } else {
+    throw std::runtime_error(
+        std::string("--opt value must be int:N or str:S: ") + spec);
+  }
+  return o;
+}
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_CLI_OPTS_HPP_

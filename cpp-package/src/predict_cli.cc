@@ -17,31 +17,8 @@
 #include <string>
 #include <vector>
 
+#include "mxtpu/cli_opts.hpp"
 #include "mxtpu/predictor.hpp"
-
-static mxtpu::CreateOption parse_opt(const char* spec) {
-  const char* eq = std::strchr(spec, '=');
-  if (eq == nullptr)
-    throw std::runtime_error(std::string("--opt needs name=type:value: ") +
-                             spec);
-  mxtpu::CreateOption o;
-  o.name.assign(spec, eq - spec);
-  const char* val = eq + 1;
-  if (std::strncmp(val, "int:", 4) == 0) {
-    o.is_int = true;
-    char* end = nullptr;
-    o.int_value = std::strtoll(val + 4, &end, 10);
-    if (end == val + 4 || *end != '\0')
-      throw std::runtime_error(
-          std::string("--opt int value is not an integer: ") + spec);
-  } else if (std::strncmp(val, "str:", 4) == 0) {
-    o.str_value = val + 4;
-  } else {
-    throw std::runtime_error(
-        std::string("--opt value must be int:N or str:S: ") + spec);
-  }
-  return o;
-}
 
 int main(int argc, char** argv) {
   if (argc < 3) {
@@ -58,7 +35,7 @@ int main(int argc, char** argv) {
       if (std::strcmp(argv[i], "--echo-input-check") == 0) {
         echo_check = true;
       } else if (std::strcmp(argv[i], "--opt") == 0 && i + 1 < argc) {
-        opts.push_back(parse_opt(argv[++i]));
+        opts.push_back(mxtpu::ParseCliOpt(argv[++i]));
       } else {
         std::fprintf(stderr, "unknown argument: %s\n", argv[i]);
         return 2;

@@ -168,10 +168,15 @@ class OpNode:
                       tuple((v.shape, str(v.dtype))
                             for v in self.input_vals),
                       tuple((g.shape, str(g.dtype)) for g in out_grads))
+            # Cache hits are always served; the cap bounds only how many NEW
+            # programs may be inserted (mirrors _jitted_op in ndarray.py —
+            # gating lookups at cap would silently revert every backward to
+            # eager per-op jax.vjp once the cache fills).
+            jitted = _VJP_CACHE.get(ck) if ck is not None else None
             if ck is not None and ck not in _VJP_BLACKLIST and \
-                    len(_VJP_CACHE) < _VJP_CACHE_CAP:
-                jitted = _VJP_CACHE.get(ck)
-                if jitted is None:
+                    (jitted is not None or len(_VJP_CACHE) < _VJP_CACHE_CAP):
+                fresh = jitted is None
+                if fresh:
                     # arguments flow through vjp as tracers, so the cached
                     # program is reusable across nodes with the same key;
                     # the rng key is an argument too, not a baked constant.
@@ -195,10 +200,18 @@ class OpNode:
                                  self.rng_key)
                     _VJP_CACHE[ck] = jitted
                     return res
-                except (jax.errors.TracerArrayConversionError,
-                        jax.errors.ConcretizationTypeError, TypeError):
-                    # not traceable under jit (host syncs etc.): run this
-                    # specialization eagerly from now on
+                except Exception:
+                    # First call of a NEW program = trace/compile time, where
+                    # backward jits a wider surface than the forward
+                    # _jitted_op saw (host syncs, callbacks, plugin quirks):
+                    # blacklist the specialization and fall through to the
+                    # eager path below — if the op is genuinely broken the
+                    # eager retry raises the real error. A failure from an
+                    # already-validated CACHED program is an execution-time
+                    # error (OOM, transient runtime): propagate it rather
+                    # than silently demoting the specialization forever.
+                    if not fresh:
+                        raise
                     _VJP_BLACKLIST.add(ck)
                     _VJP_CACHE.pop(ck, None)
             if has_rng:

@@ -37,6 +37,7 @@ class TransformerConfig:
     n_experts: int = 0          # 0 => dense FFN; >0 => MoE
     moe_top_k: int = 0          # 0 => dense dispatch; >0 => top-k routing
     capacity_factor: float = 1.25  # per-expert buffer over the even share
+    moe_group_size: int = 4096  # GShard token grouping; <=0 => one group
     max_len: int = 128
     dtype: object = jnp.float32
 
@@ -150,56 +151,40 @@ def _moe_ffn(x, wg, w1, w2):
     return jnp.einsum("bse,besd->bsd", gates, y)
 
 
-def _moe_ffn_topk(x, wg, w1, w2, k, capacity_factor=1.25):
-    """Top-k sparse-dispatch MoE (Switch/GShard style) with static
-    shapes throughout — XLA/GSPMD friendly: no gather scatter of
-    dynamic extent, all routing is einsums over one-hot masks, so the
-    expert dimension stays sharded over 'ep' and dispatch/combine lower
-    to all-to-alls on a real mesh.
-
-    Per token: softmax gate over E experts, keep the top k; each expert
-    processes at most C = ceil(capacity_factor * S_tokens * k / E)
-    tokens (position-in-expert via cumsum; overflow tokens drop to the
-    residual path, the standard capacity trade). Combine weights are
-    renormalized over the kept experts.
-
-    Reference seam: the reference's sparse embedding/expert flows ride
-    row_sparse KVStore pulls (reference python/mxnet/kvstore.py
-    row_sparse_pull); here routing is part of the one compiled step.
-    """
-    B, S, D = x.shape
+def _route_group_topk(xg, wg, w1, w2, k, capacity):
+    """Route ONE token group (Tg, D) through top-k capacity-bounded
+    experts; returns (out (Tg, D), aux scalar). Static shapes, einsums
+    over one-hot masks only — no dynamic-extent gather/scatter, so the
+    expert dim shards over 'ep' and dispatch/combine lower to
+    all-to-alls under GSPMD."""
+    Tg, D = xg.shape
     E = w1.shape[0]
-    tokens = B * S
-    capacity = int(np.ceil(capacity_factor * tokens * k / E))
-    capacity = max(capacity, k)
-
-    xt = x.reshape(tokens, D)
-    gates = jax.nn.softmax(xt @ wg, axis=-1)              # (T, E)
-    topv, topi = jax.lax.top_k(gates, k)                  # (T, k)
+    gates = jax.nn.softmax(xg @ wg, axis=-1)              # (Tg, E)
+    topv, topi = jax.lax.top_k(gates, k)                  # (Tg, k)
     # renormalize over the selected experts
     topv = topv / jnp.maximum(jnp.sum(topv, -1, keepdims=True), 1e-9)
 
     # routing bookkeeping in int32: under bf16 activations a float
     # cumsum of token counts goes inexact past 256 and capacity slots
-    # would silently collide — only the masks cast to x.dtype, at the
+    # would silently collide — only the masks cast to xg.dtype, at the
     # einsum boundary
-    sel_i = jax.nn.one_hot(topi, E, dtype=jnp.int32)      # (T, k, E)
+    sel_i = jax.nn.one_hot(topi, E, dtype=jnp.int32)      # (Tg, k, E)
     # position of each (token, choice) within its expert's buffer:
     # cumulative count of prior selections of that expert, counting
     # choice slots in priority order (k=0 first, matching GShard)
-    flat = sel_i.transpose(1, 0, 2).reshape(k * tokens, E)  # (k*T, E)
+    flat = sel_i.transpose(1, 0, 2).reshape(k * Tg, E)    # (k*Tg, E)
     pos_flat = jnp.cumsum(flat, axis=0) - flat            # prior count
-    pos = pos_flat.reshape(k, tokens, E).transpose(1, 0, 2)  # (T, k, E)
-    in_cap = ((pos < capacity) & (sel_i > 0)).astype(x.dtype)  # kept
-    pos_idx = jnp.sum(pos * sel_i, -1).astype(jnp.int32)  # (T, k)
+    pos = pos_flat.reshape(k, Tg, E).transpose(1, 0, 2)   # (Tg, k, E)
+    in_cap = ((pos < capacity) & (sel_i > 0)).astype(xg.dtype)  # kept
+    pos_idx = jnp.sum(pos * sel_i, -1).astype(jnp.int32)  # (Tg, k)
 
-    # dispatch mask (T, k, E, C) -> one-hot over capacity slots
-    cap_hot = jax.nn.one_hot(pos_idx, capacity, dtype=x.dtype)  # (T,k,C)
-    dispatch = jnp.einsum("tke,tkc->tec", in_cap, cap_hot)      # (T,E,C)
-    expert_in = jnp.einsum("tec,td->ecd", dispatch, xt)         # (E,C,D)
+    # dispatch mask (Tg, E, C) -> one-hot over capacity slots
+    cap_hot = jax.nn.one_hot(pos_idx, capacity, dtype=xg.dtype)  # (Tg,k,C)
+    dispatch = jnp.einsum("tke,tkc->tec", in_cap, cap_hot)       # (Tg,E,C)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, xg)          # (E,C,D)
 
     h = jax.nn.relu(jnp.einsum("ecd,edf->ecf", expert_in, w1))
-    expert_out = jnp.einsum("ecf,efd->ecd", h, w2)              # (E,C,D)
+    expert_out = jnp.einsum("ecf,efd->ecd", h, w2)               # (E,C,D)
 
     combine = jnp.einsum("tke,tk,tkc->tec", in_cap, topv, cap_hot)
     out = jnp.einsum("tec,ecd->td", combine, expert_out)
@@ -208,10 +193,60 @@ def _moe_ffn_topk(x, wg, w1, w2, k, capacity_factor=1.25):
     # f_e = fraction of tokens whose TOP choice is expert e (hard count)
     # and P_e = mean softmax gate mass on e. Minimized at uniform
     # routing (value 1); without it top-k training collapses experts.
-    f = jnp.mean(sel_i[:, 0, :].astype(jnp.float32), axis=0)   # (E,)
-    p = jnp.mean(gates.astype(jnp.float32), axis=0)            # (E,)
+    f = jnp.mean(sel_i[:, 0, :].astype(jnp.float32), axis=0)     # (E,)
+    p = jnp.mean(gates.astype(jnp.float32), axis=0)              # (E,)
     aux = E * jnp.sum(f * p)
-    return out.reshape(B, S, D), aux
+    return out, aux
+
+
+def _moe_groups(tokens, group_size):
+    """Number of routing groups: smallest G dividing `tokens` with
+    tokens/G <= group_size (G=1 when tokens already fit). The divisor
+    hunt is bounded to 2x the ideal count — for prime-ish token counts
+    it would otherwise degenerate to per-token groups (capacity == k,
+    aux loss meaningless); such counts fall back to a single group."""
+    if group_size <= 0 or tokens <= group_size:
+        return 1
+    ideal = (tokens + group_size - 1) // group_size
+    for g in range(ideal, min(2 * ideal, tokens) + 1):
+        if tokens % g == 0:
+            return g
+    return 1
+
+def _moe_ffn_topk(x, wg, w1, w2, k, capacity_factor=1.25,
+                  group_size=4096):
+    """Top-k sparse-dispatch MoE (Switch/GShard style) with static
+    shapes throughout — XLA/GSPMD friendly.
+
+    GShard-style token grouping: the B*S tokens are split into G
+    independent routing groups of Tg = B*S/G tokens (smallest G with
+    Tg <= group_size), each with its own capacity
+    C = ceil(capacity_factor * Tg * k / E). The dispatch/combine
+    one-hot masks are (Tg, E, C) per group — O(T * E * C_group) total
+    instead of the single-group O(T^2 * k * cf / E) blowup (at
+    T = 8192, E = 8, k = 2 a single group's f32 dispatch tensor alone
+    is ~2.7 GB; grouped at 4096 it is 2 x ~0.7 GB and scales linearly
+    in T from there). Per token: softmax gate over E experts, keep the
+    top k; overflow tokens past an expert's capacity drop to the
+    residual path (the standard capacity trade). Combine weights are
+    renormalized over the kept experts. The aux loss is the mean of the
+    per-group Switch/GShard load-balancing terms.
+
+    Reference seam: the reference's sparse embedding/expert flows ride
+    row_sparse KVStore pulls (reference python/mxnet/kvstore.py
+    row_sparse_pull); here routing is part of the one compiled step.
+    """
+    B, S, D = x.shape
+    E = w1.shape[0]
+    tokens = B * S
+    G = _moe_groups(tokens, group_size)
+    tg = tokens // G
+    capacity = max(int(np.ceil(capacity_factor * tg * k / E)), k)
+
+    xg = x.reshape(G, tg, D)
+    out, aux = jax.vmap(
+        lambda g: _route_group_topk(g, wg, w1, w2, k, capacity))(xg)
+    return out.reshape(B, S, D), jnp.mean(aux)
 
 
 def transformer_apply(params, tokens, cfg, mesh=None, causal=True,
@@ -234,7 +269,8 @@ def transformer_apply(params, tokens, cfg, mesh=None, causal=True,
                                          params[pre + "w1"],
                                          params[pre + "w2"],
                                          cfg.moe_top_k,
-                                         cfg.capacity_factor)
+                                         cfg.capacity_factor,
+                                         cfg.moe_group_size)
             x = x + moe_out
             aux_total = aux_total + aux
         elif cfg.n_experts:

@@ -212,3 +212,40 @@ def test_vjp_cache_stochastic_key_not_baked():
     assert len(autograd._VJP_CACHE) == size_after_first
     # different dropout masks -> different zero patterns in the grads
     assert (g1 != g2).any(), "cached vjp replayed a baked-in PRNG key"
+
+
+def test_vjp_cache_hits_served_at_cap():
+    """ADVICE r4: once the cache is AT capacity, existing entries must
+    still be served — only inserting NEW programs is capped. The old
+    gate skipped the whole cache block at cap, silently reverting every
+    backward to eager per-op jax.vjp."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+
+    def one_pass(seed):
+        x = mx.nd.array(np.random.RandomState(seed).randn(4, 8))
+        w = mx.nd.array(np.random.RandomState(seed + 1).randn(8, 3))
+        autograd.mark_variables([w], [mx.nd.zeros_like(w)])
+        with autograd.record():
+            loss = mx.nd.sum(mx.nd.relu(mx.nd.dot(x, w)))
+        loss.backward()
+        return w.grad.asnumpy()
+
+    g1 = one_pass(0)
+    assert len(autograd._VJP_CACHE) > 0
+    saved_cap, saved_entries = autograd._VJP_CACHE_CAP, \
+        dict(autograd._VJP_CACHE)
+    hits = []
+    try:
+        autograd._VJP_CACHE_CAP = len(autograd._VJP_CACHE)  # exactly at cap
+        for ck, fn in saved_entries.items():
+            def spy(*a, _fn=fn, _ck=ck, **kw):
+                hits.append(_ck)
+                return _fn(*a, **kw)
+            autograd._VJP_CACHE[ck] = spy
+        g2 = one_pass(0)
+        assert hits, "at-cap backward bypassed the vjp cache"
+        np.testing.assert_allclose(g1, g2, rtol=1e-6)
+    finally:
+        autograd._VJP_CACHE_CAP = saved_cap
+        autograd._VJP_CACHE.update(saved_entries)

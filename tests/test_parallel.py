@@ -689,3 +689,29 @@ def test_moe_topk_aux_loss_balancing():
     _, aux_uniform = _moe_ffn_topk(x, wg_spread, w1, w2, k=1)
     assert float(aux_bad) > 3.5, float(aux_bad)        # ~E at collapse
     assert 0.9 < float(aux_uniform) < 1.6, float(aux_uniform)
+
+
+def test_moe_topk_grouped_matches_ungrouped():
+    """GShard token grouping (ADVICE r4): with ample capacity no token
+    drops in either regime, and since routing is per-token independent
+    the grouped dispatch must reproduce the single-group output."""
+    from mxnet_tpu.models.transformer import _moe_ffn_topk, _moe_groups
+    rng = np.random.RandomState(4)
+    B, S, D, E, F = 2, 16, 8, 4, 16
+    x = jnp.asarray(rng.uniform(-1, 1, (B, S, D)).astype(np.float32))
+    wg = jnp.asarray(rng.uniform(-1, 1, (D, E)).astype(np.float32))
+    w1 = jnp.asarray(rng.uniform(-0.5, 0.5, (E, D, F)).astype(np.float32))
+    w2 = jnp.asarray(rng.uniform(-0.5, 0.5, (E, F, D)).astype(np.float32))
+    # cf=4 >= E/k=2 guarantees per-group capacity >= group tokens: no drops
+    one, aux1 = _moe_ffn_topk(x, wg, w1, w2, k=2, capacity_factor=4.0,
+                              group_size=0)
+    grp, aux2 = _moe_ffn_topk(x, wg, w1, w2, k=2, capacity_factor=4.0,
+                              group_size=8)
+    np.testing.assert_allclose(np.asarray(one), np.asarray(grp),
+                               rtol=2e-4, atol=2e-5)
+    assert np.isfinite(float(aux1)) and np.isfinite(float(aux2))
+    # group count: smallest divisor of 32 tokens with groups <= 8 -> 4
+    assert _moe_groups(32, 8) == 4
+    assert _moe_groups(32, 0) == 1       # disabled
+    assert _moe_groups(30, 8) == 5       # non-power-of-two divisor hunt
+    assert _moe_groups(7, 8) == 1        # already fits
