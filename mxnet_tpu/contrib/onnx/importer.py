@@ -240,7 +240,7 @@ def _conv(g, node):
     return g.sym.Convolution(data, **kwargs)
 
 
-@_translates("BatchNormalization")
+@_translates("BatchNormalization", "SpatialBN")  # SpatialBN: deprecated alias
 def _batchnorm(g, node):
     return g.sym.BatchNorm(
         g.symbol_of(node.inputs[0]),
@@ -498,9 +498,6 @@ def _conv_transpose(g, node):
     else:
         kwargs["no_bias"] = True
     return g.sym.Deconvolution(g.symbol_of(node.inputs[0]), **kwargs)
-
-
-_TRANSLATORS["SpatialBN"] = _batchnorm  # legacy alias (pre-1.0 exporters)
 
 
 @_translates("Elu")

@@ -55,13 +55,18 @@ def test_dist_data_parallel_training():
     assert "DIST_LENET_OK rank=1" in out.stdout
 
 
-def test_launcher_cli_errors():
+def test_launcher_cli_errors(capsys):
     from tools.launch import main
     with pytest.raises(SystemExit):
         main(["-n", "2"])  # no command
     with pytest.raises(SystemExit):
         # yarn is a documented disposition, not a silent no-op
         main(["-n", "2", "--launcher", "yarn", "python", "x.py"])
+    # the disposition must explain itself, not just exit: the message
+    # names the supported launchers and the DMLC_* escape hatch
+    err = capsys.readouterr().err
+    assert "yarn launcher is not supported on TPU deployments" in err
+    assert "DMLC_" in err and "docs/PARITY.md" in err
 
 
 _RANK_PROBE = ("import os;print('RANK %s of %s' % ("

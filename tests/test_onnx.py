@@ -465,3 +465,28 @@ def test_unsupported_geometry_raises(tmp_path):
         outputs=[("y", (1, 1, 2, 2))])
     with pytest.raises(NotImplementedError, match="ceil_mode"):
         import_model(_write(tmp_path, blob))
+
+
+def test_import_spatialbn_alias(tmp_path):
+    """SpatialBN is the deprecated ONNX alias of BatchNormalization
+    (reference contrib/onnx _convert_map registers both); it must import
+    through the same translator."""
+    rng = np.random.RandomState(7)
+    C = 3
+    gamma = rng.rand(C).astype(np.float32) + 0.5
+    beta = rng.randn(C).astype(np.float32)
+    mean = rng.randn(C).astype(np.float32) * 0.1
+    var = rng.rand(C).astype(np.float32) + 0.5
+    blob = model_proto(
+        nodes=[node_proto("SpatialBN", ["x", "g", "be", "mu", "va"],
+                          ["y"], epsilon=1e-5)],
+        initializers={"g": gamma, "be": beta, "mu": mean, "va": var},
+        inputs=[("x", (2, C, 4, 4))], outputs=[("y", (2, C, 4, 4))])
+    sym, args, auxs = import_model(_write(tmp_path, blob))
+    assert sorted(auxs) == ["mu", "va"]
+    x = rng.randn(2, C, 4, 4).astype(np.float32)
+    out = _run(sym, args, auxs, x=x)[0]
+    expect = gamma.reshape(1, -1, 1, 1) * (
+        x - mean.reshape(1, -1, 1, 1)) / np.sqrt(
+        var.reshape(1, -1, 1, 1) + 1e-5) + beta.reshape(1, -1, 1, 1)
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
