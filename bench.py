@@ -55,7 +55,8 @@ _ALL_OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 def _merge_results(path, new, key=lambda r: (r.get("metric"),
                                             r.get("seq_len"),
                                             r.get("layout"),
-                                            r.get("batch"))):
+                                            r.get("batch"),
+                                            r.get("remat") or "none")):
     """Merge `new` result lines into the JSON list at `path`.
 
     Partial-config runs (BENCH_CONFIGS=headline, a flash seq sweep, a
@@ -181,9 +182,18 @@ def bench_resnet50(smoke, dtype, device_kind):
     net.initialize(mx.init.Xavier())
     net(mx.nd.zeros(img_shape(layout, 1, image)))
 
+    # BENCH_REMAT: none | full | io — the bytes/step experiment knob
+    # (benchmarks/bytes_report.py; "io" keeps MXU outputs + BN stats,
+    # recomputes elementwise chains in backward). Unset -> remat=None so
+    # the framework env vars (MXNET_BACKWARD_DO_MIRROR /
+    # MXNET_REMAT_POLICY) keep their documented effect.
+    remat_env = os.environ.get("BENCH_REMAT")
     step = TrainStep(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
                      {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4},
-                     dtype=dtype)
+                     dtype=dtype,
+                     remat=None if remat_env is None
+                     else (False if remat_env == "none" else remat_env))
+    remat = step._remat  # resolved mode, reported on the line
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.uniform(-1, 1, img_shape(layout, batch, image))
                     .astype(np.float32))
@@ -219,7 +229,7 @@ def bench_resnet50(smoke, dtype, device_kind):
         "flops_per_step": flops, "bytes_per_step": nbytes,
         "hbm_roofline_pct": (round(roofline, 4) if roofline is not None
                              else None),
-        "layout": layout,
+        "layout": layout, "remat": remat,
     }
 
 

@@ -17,6 +17,15 @@ python bench.py | tee /tmp/bench_nchw.out
 echo "=== 2. headline with NHWC layout (A/B) ==="
 BENCH_CONFIGS=headline BENCH_LAYOUT=NHWC python bench.py | tee /tmp/bench_nhwc.out
 
+echo "=== 2b. bytes/step remat-policy A/B (the r4 roofline lever) ==="
+# Authoritative on-chip numbers for the io-remat experiment: XLA cost
+# analysis (bytes accessed) + real step timing per mode. If "io" lands
+# >= 2,800 img/s, promote it: rerun the headline with BENCH_REMAT=io so
+# the canonical line carries the gain.
+BYTES_EXEC=1 PYTHONPATH=. python benchmarks/bytes_report.py \
+  2> >(tee -a BENCH_BYTES_REPORT.txt >&2) | tee -a BENCH_BYTES_REPORT.txt
+BENCH_CONFIGS=headline BENCH_REMAT=io python bench.py | tee /tmp/bench_io.out
+
 echo "=== 3. flash attention seq sweep (1024/2048/4096) ==="
 BENCH_CONFIGS=transformer_flash BENCH_FLASH_SEQ=1024,2048,4096,8192 \
   python bench.py | tee BENCH_FLASH_SWEEP.jsonl

@@ -16,7 +16,7 @@ from __future__ import annotations
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import lax
+from jax import ad_checkpoint, lax
 
 from .registry import register
 from ..random import next_key
@@ -273,6 +273,7 @@ def FullyConnected(data, weight, bias=None, num_hidden=0, no_bias=False,
                    flatten=True):
     x = data.reshape(data.shape[0], -1) if flatten else data
     out = jnp.matmul(x, weight.T)  # weight: (num_hidden, in_units) as in ref
+    out = ad_checkpoint.checkpoint_name(out, "fc_out")
     if bias is not None and not no_bias:
         out = out + bias
     return out
@@ -337,6 +338,10 @@ def Convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
         data, weight, window_strides=stride,
         padding=[(p, p) for p in pad], rhs_dilation=dilate,
         dimension_numbers=dn, feature_group_count=num_group)
+    # remat-policy tag: MXU outputs are the values worth SAVING for the
+    # backward pass; everything cheaper (BN normalize, relu, residual adds)
+    # is recomputed from them under the "io" policy (parallel/trainer.py)
+    out = ad_checkpoint.checkpoint_name(out, "conv_out")
     if bias is not None and not no_bias:
         bshape = [1] * out.ndim
         bshape[ch_axis] = -1
@@ -480,6 +485,10 @@ def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
         mean = jnp.mean(xf, axis=red_ax)
         var = jnp.maximum(
             jnp.mean(jnp.square(xf), axis=red_ax) - jnp.square(mean), 0.0)
+        # remat-policy tag: stats are tiny (C,) but cost a full activation
+        # read to recompute — always worth saving under the "io" policy
+        mean = ad_checkpoint.checkpoint_name(mean, "bn_stats")
+        var = ad_checkpoint.checkpoint_name(var, "bn_stats")
     else:
         mean, var = moving_mean, moving_var
     mean_b = lax.stop_gradient(mean) if not training else mean

@@ -18,9 +18,13 @@ MXU with float32 master weights and optimizer state; logits are promoted to
 f32 before the loss for a stable softmax. This is the reference's
 multi_precision fp16 capability (optimizer.py:483) in its TPU-native form.
 
-Rematerialisation (remat=True): wraps the forward in jax.checkpoint so the
-backward pass recomputes activations instead of storing them — the
-MXNET_BACKWARD_DO_MIRROR capability (docs/faq/env_var.md:93).
+Rematerialisation (remat=True/"full"): wraps each compute block's forward
+in jax.checkpoint so the backward pass recomputes activations instead of
+storing them — the MXNET_BACKWARD_DO_MIRROR capability
+(docs/faq/env_var.md:93). remat="io" (or MXNET_REMAT_POLICY=io) keeps the
+MXU outputs (conv/matmul, tagged checkpoint_name in ops/nn.py) and BN batch
+stats, recomputing only the cheap elementwise chains — trading a few FLOPs
+for HBM bytes on a bandwidth-bound step.
 
 Parity note: the reference overlapped backward with kvstore pushes via
 engine priorities (src/kvstore/comm.h:171); XLA's latency-hiding scheduler
@@ -41,46 +45,125 @@ from .. import random as _random
 from .. import optimizer_rules as _rules
 
 
-def _remat_eligible_children(net):
-    """Top-level children safe to checkpoint as remat segments: blocks whose
-    forward mutates auxiliary state (grad_req 'null' params — BatchNorm
-    running stats) are excluded, because their buffer rebinds inside a
-    checkpointed trace would leak tracers into the outer aux collection."""
-    children = list(getattr(net, "_children", {}).values())
-    return [c for c in children
-            if all(p.grad_req != "null"
-                   for p in c.collect_params().values())]
+#: remat modes -> jax.checkpoint policies. "full" is the reference's
+#: MXNET_BACKWARD_DO_MIRROR trade (save only segment boundaries, recompute
+#: everything). "io" is the HBM-traffic policy: SAVE what the MXU produced
+#: (conv/matmul outputs, tagged in ops/nn.py via checkpoint_name) plus the
+#: tiny BN batch statistics, and RECOMPUTE the cheap elementwise chains
+#: (BN normalize, relu, residual adds) in backward instead of writing them
+#: out in forward and re-reading them — the bandwidth-roofline lever for a
+#: step measured at 95% of the HBM floor (BENCH_NOTES roofline analysis).
+_REMAT_POLICIES = {
+    "full": lambda: None,  # jax.checkpoint default: nothing saveable
+    "io": lambda: jax.checkpoint_policies.save_only_these_names(
+        "conv_out", "bn_stats", "fc_out"),
+}
+
+
+def _remat_mode(remat):
+    """Normalize the TrainStep remat argument / env vars to a mode string
+    in {"none", "full", "io"}."""
+    import os
+    if remat is None:
+        mode = os.environ.get("MXNET_REMAT_POLICY", "").lower()
+        if mode in _REMAT_POLICIES:
+            return mode
+        # parity: MXNET_BACKWARD_DO_MIRROR (docs/faq/env_var.md:93) —
+        # trade recompute for activation memory by default when set
+        if os.environ.get("MXNET_BACKWARD_DO_MIRROR", "0") == "1":
+            return "full"
+        return "none"
+    if remat is True:
+        return "full"
+    if not remat:
+        return "none"
+    if remat in _REMAT_POLICIES:
+        return remat
+    raise ValueError("remat must be bool, 'full' or 'io', got %r" % (remat,))
+
+
+def _remat_segments(net):
+    """Checkpoint segments: walk the block tree, recursing through
+    Sequential-style containers so boundaries land at real compute blocks
+    (a ResNet's 16 bottlenecks, an MLP's Dense layers) rather than one
+    whole-feature-stack segment. Blocks that mutate auxiliary state
+    (BatchNorm running stats) are fine: _segment_remat threads the aux
+    buffers through the checkpoint as explicit inputs/outputs."""
+    from ..gluon.nn.basic_layers import Sequential, HybridSequential
+    segs = []
+
+    def walk(block):
+        for child in getattr(block, "_children", {}).values():
+            if isinstance(child, (Sequential, HybridSequential)):
+                walk(child)
+            else:
+                segs.append(child)
+
+    walk(net)
+    return segs
 
 
 @contextlib.contextmanager
-def _segment_remat(blocks):
+def _segment_remat(blocks, policy=None, net=None):
     """Wrap each block's forward in jax.checkpoint for the duration of the
     step trace. Whole-function checkpoint saves nothing at peak (the
     backward's recompute carries the same live set); per-segment checkpoint
-    keeps only segment boundaries alive — the real
-    MXNET_BACKWARD_DO_MIRROR/memonger trade."""
+    keeps only segment boundaries + policy-saveable values alive — the real
+    MXNET_BACKWARD_DO_MIRROR/memonger trade.
+
+    Aux-state blocks (BatchNorm running stats, grad_req 'null' params) are
+    checkpointable: their buffers enter the checkpointed function as
+    explicit arguments and the mutated values return as explicit outputs,
+    written back in place — no inner tracer ever leaks through
+    Parameter._data, and NDArray references taken before the step stay
+    valid (same object identity as the non-remat path).
+
+    `net` (when given) has its WHOLE tree's CachedOps deactivated for the
+    trace: a hybridized container above the segments would otherwise route
+    through its warmed jit cache and bypass every wrapped forward,
+    silently skipping remat.
+    """
     saved = []
     active = []
-    for block in blocks:
-        # hybridized blocks route through their CachedOp and would bypass
-        # the wrapped forward — deactivate for this trace (inside the step
-        # everything is jitted anyway, the CachedOp adds nothing)
-        if getattr(block, "_active", False):
-            active.append(block)
-            block._active = False
-        orig = block.forward
 
-        def wrapped(*args, _orig=orig):
+    def _collect_active(b):
+        if getattr(b, "_active", False):
+            active.append(b)
+            b._active = False
+
+    if net is not None and hasattr(net, "apply"):
+        # deactivate hybridized blocks ANYWHERE in the tree (containers
+        # included), not just the wrapped segments — inside the step
+        # everything is jitted anyway, the CachedOp adds nothing
+        net.apply(_collect_active)
+    for block in blocks:
+        _collect_active(block)
+        orig = block.forward
+        aux_params = [p for p in block.collect_params().values()
+                      if p.grad_req == "null"]
+
+        def wrapped(*args, _orig=orig, _aux=aux_params):
             if len(args) == 1 and isinstance(args[0], NDArray):
                 # single trace through checkpoint — no retry path, so the
                 # stateful trace-key counter advances exactly once and
                 # remat numerics match the non-remat step bit for bit
-                def pure(xv):
+                def pure(xv, aux_in):
+                    for p, v in zip(_aux, aux_in):
+                        p._data = NDArray(v)
                     out = _orig(NDArray(xv))
-                    if isinstance(out, NDArray):
-                        return out._data
-                    return tuple(o._data for o in out)
-                res = jax.checkpoint(pure)(args[0]._data)
+                    outs = out._data if isinstance(out, NDArray) \
+                        else tuple(o._data for o in out)
+                    return outs, tuple(p._data._data for p in _aux)
+                aux_in = tuple(p._data._data for p in _aux)
+                orig_nd = [p._data for p in _aux]
+                res, aux_out = jax.checkpoint(pure, policy=policy)(
+                    args[0]._data, aux_in)
+                # write back IN PLACE on the pre-call NDArray objects:
+                # rebinding p._data to a fresh NDArray would orphan any
+                # reference taken before the step with a dead inner tracer
+                for p, nd_, v in zip(_aux, orig_nd, aux_out):
+                    nd_._data = v
+                    p._data = nd_
                 if isinstance(res, tuple):
                     return tuple(NDArray(r) for r in res)
                 return NDArray(res)
@@ -110,12 +193,8 @@ class TrainStep:
     def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
                  mesh=None, data_axis="dp", param_shardings=None,
                  dtype="float32", remat=None, shard_optimizer_states=False):
-        import os
         from .. import optimizer as _opt_mod
-        if remat is None:
-            # parity: MXNET_BACKWARD_DO_MIRROR (docs/faq/env_var.md:93) —
-            # trade recompute for activation memory by default when set
-            remat = os.environ.get("MXNET_BACKWARD_DO_MIRROR", "0") == "1"
+        remat = _remat_mode(remat)
         self._net = net
         self._loss = loss_fn
         if isinstance(optimizer, str):
@@ -177,7 +256,9 @@ class TrainStep:
         base_wd = opt.wd
         cdtype = self._compute_dtype
         mixed = cdtype != jnp.float32
-        remat_blocks = _remat_eligible_children(net) if self._remat else []
+        remat_on = self._remat != "none"
+        remat_policy = _REMAT_POLICIES[self._remat]() if remat_on else None
+        remat_blocks = _remat_segments(net) if remat_on else []
 
         def forward_loss(grad_vals, nograd_vals, x, y, key):
             """Trace the eager net with tracer-backed parameter buffers.
@@ -201,8 +282,8 @@ class TrainStep:
                 x = x.astype(cdtype) if jnp.issubdtype(
                     jnp.asarray(x).dtype, jnp.floating) else x
             from .functional import swap_param_buffers
-            remat_ctx = _segment_remat(remat_blocks) if remat_blocks \
-                else contextlib.nullcontext()
+            remat_ctx = _segment_remat(remat_blocks, remat_policy, net) \
+                if remat_blocks else contextlib.nullcontext()
             with swap_param_buffers(plist, merged) as injected:
                 with autograd._RecordingStateScope(False, True), \
                         _random.trace_key_scope(key), remat_ctx:
@@ -216,10 +297,10 @@ class TrainStep:
                            if p._data._data is not injected[i]}
             return loss_val, aux_upd
 
-        if self._remat and not remat_blocks:
+        if remat_on and not remat_blocks:
             # no segmentable children: whole-forward checkpoint (weaker —
             # peak is unchanged, but recompute semantics are preserved)
-            forward_loss = jax.checkpoint(forward_loss)
+            forward_loss = jax.checkpoint(forward_loss, policy=remat_policy)
 
         def step(grad_vals, nograd_vals, opt_state, x, y, key, lr, t):
             # independent streams: forward-trace keys (dropout masks etc.)

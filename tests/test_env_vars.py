@@ -27,15 +27,19 @@ def test_backward_do_mirror_default(monkeypatch):
     net.initialize()
     loss = gluon.loss.L2Loss()
     monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
-    assert TrainStep(net, loss)._remat is True
+    assert TrainStep(net, loss)._remat == "full"
     monkeypatch.delenv("MXNET_BACKWARD_DO_MIRROR")
-    assert TrainStep(net, loss)._remat is False
+    assert TrainStep(net, loss)._remat == "none"
     # explicit argument wins over the env default
     monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
-    assert TrainStep(net, loss, remat=False)._remat is False
+    assert TrainStep(net, loss, remat=False)._remat == "none"
+    # MXNET_REMAT_POLICY selects the policy-based mode
+    monkeypatch.setenv("MXNET_REMAT_POLICY", "io")
+    assert TrainStep(net, loss)._remat == "io"
+    monkeypatch.delenv("MXNET_REMAT_POLICY")
     # the remat step still trains correctly
     step = TrainStep(net, loss, "sgd", {"learning_rate": 0.1})
-    assert step._remat is True
+    assert step._remat == "full"
     l0 = float(step(mx.nd.ones((4, 3)), mx.nd.zeros((4, 2))))
     for _ in range(10):
         l1 = float(step(mx.nd.ones((4, 3)), mx.nd.zeros((4, 2))))
