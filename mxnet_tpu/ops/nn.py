@@ -645,23 +645,25 @@ def _unpack_rnn_params(params, num_layers, input_size, state_size,
     return out
 
 
-def _cell_step(mode, x, h, c, wi, wh, bi, bh):
-    H = h.shape[-1]
+def _cell_step(mode, px, h, c, wh, bh):
+    """One recurrence step from a PRE-PROJECTED input px (= x @ wi.T plus
+    the input-side bias, computed for the whole sequence outside the scan
+    — see _scan_layer). Only the small h @ wh.T matmul runs inside the
+    sequential scan."""
     if mode in ("rnn_relu", "rnn_tanh"):
-        pre = x @ wi.T + h @ wh.T + bi + bh
+        pre = px + h @ wh.T
         h2 = jax.nn.relu(pre) if mode == "rnn_relu" else jnp.tanh(pre)
         return h2, c
     if mode == "lstm":
-        pre = x @ wi.T + h @ wh.T + bi + bh
+        pre = px + h @ wh.T
         i, f, g, o = jnp.split(pre, 4, axis=-1)
         i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
         g = jnp.tanh(g)
         c2 = f * c + i * g
         return o * jnp.tanh(c2), c2
     if mode == "gru":
-        gi = x @ wi.T + bi
         gh = h @ wh.T + bh
-        ir, iz, inn = jnp.split(gi, 3, axis=-1)
+        ir, iz, inn = jnp.split(px, 3, axis=-1)
         hr, hz, hn = jnp.split(gh, 3, axis=-1)
         r = jax.nn.sigmoid(ir + hr)
         z = jax.nn.sigmoid(iz + hz)
@@ -671,11 +673,25 @@ def _cell_step(mode, x, h, c, wi, wh, bi, bh):
 
 
 def _scan_layer(mode, xs, h0, c0, wi, wh, bi, bh, reverse=False):
-    def step(carry, x):
+    """One (direction of one) RNN layer over [T, N, C].
+
+    The input projection for ALL timesteps is hoisted out of the scan as
+    one (T*N, C) @ (C, G*H) matmul — the cuDNN fused-RNN trick
+    (reference src/operator/cudnn_rnn-inl.h): at word-LM shapes the
+    per-step x @ wi.T is a tiny latency-bound matmul repeated T times;
+    batched it runs at MXU efficiency, and the sequential scan carries
+    only the irreducible h @ wh.T recurrence."""
+    T, N = xs.shape[0], xs.shape[1]
+    # input-side bias folds into the hoisted projection; for gru the
+    # hidden-side bias stays inside (it feeds the reset gate product)
+    bias = bi if mode == "gru" else bi + bh
+    pxs = (xs.reshape(T * N, -1) @ wi.T + bias).reshape(T, N, -1)
+
+    def step(carry, px):
         h, c = carry
-        h2, c2 = _cell_step(mode, x, h, c, wi, wh, bi, bh)
+        h2, c2 = _cell_step(mode, px, h, c, wh, bh)
         return (h2, c2), h2
-    (hT, cT), ys = lax.scan(step, (h0, c0), xs, reverse=reverse)
+    (hT, cT), ys = lax.scan(step, (h0, c0), pxs, reverse=reverse)
     return ys, hT, cT
 
 

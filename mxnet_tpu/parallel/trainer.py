@@ -66,7 +66,12 @@ def _remat_mode(remat):
     import os
     if remat is None:
         mode = os.environ.get("MXNET_REMAT_POLICY", "").lower()
-        if mode in _REMAT_POLICIES:
+        if mode:
+            if mode != "none" and mode not in _REMAT_POLICIES:
+                # a typo must not silently measure a different config
+                raise ValueError(
+                    "MXNET_REMAT_POLICY must be none, full or io, got %r"
+                    % (mode,))
             return mode
         # parity: MXNET_BACKWARD_DO_MIRROR (docs/faq/env_var.md:93) —
         # trade recompute for activation memory by default when set
@@ -75,11 +80,12 @@ def _remat_mode(remat):
         return "none"
     if remat is True:
         return "full"
-    if not remat:
+    if not remat or remat == "none":
         return "none"
     if remat in _REMAT_POLICIES:
         return remat
-    raise ValueError("remat must be bool, 'full' or 'io', got %r" % (remat,))
+    raise ValueError(
+        "remat must be bool, 'none', 'full' or 'io', got %r" % (remat,))
 
 
 def _remat_segments(net):
