@@ -1,7 +1,20 @@
-"""BaseModule: the fit/score/predict training loop.
+"""BaseModule: the fit/score/predict training-loop surface.
 
-Parity: reference `python/mxnet/module/base_module.py:395-520` (fit),
-score, predict, iter_predict, forward_backward.
+API parity with the reference's `python/mxnet/module/base_module.py`
+(fit/score/predict/iter_predict/forward_backward and the abstract
+bind/init/forward/update contract), re-built around this framework's
+execution model:
+
+- All three evaluation entry points (score, predict, iter_predict) drain
+  one shared `_eval_batches` generator — a single place owns the
+  reset / batch-limit / eval-forward / pad-trim protocol.
+- `fit` is a plain loop over the data iterator. The reference interleaved
+  a one-batch lookahead with the engine's async dispatch to overlap IO
+  with compute (base_module.py:507-519); here overlap is owned by the IO
+  layer (PrefetchingIter / DevicePrefetchIter stage batches host- and
+  device-side), so the training loop stays sequential and readable.
+- Batch callbacks receive a BatchEndParams record (same attribute names
+  the reference's Speedometer-style callbacks read).
 """
 from __future__ import annotations
 
@@ -13,6 +26,38 @@ import numpy as np
 from .. import metric as metric_mod
 from ..base import MXNetError
 from ..ndarray import NDArray
+
+
+class BatchEndParams:
+    """What a batch/score callback sees; attribute-compatible with the
+    reference's namedtuple (epoch, nbatch, eval_metric, locals)."""
+
+    __slots__ = ("epoch", "nbatch", "eval_metric", "locals")
+
+    def __init__(self, epoch, nbatch, eval_metric, locals=None):
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.eval_metric = eval_metric
+        self.locals = locals or {}
+
+
+_BatchEndParam = BatchEndParams  # back-compat alias
+
+
+def _callbacks(cbs):
+    """Normalize a callback argument (None | callable | list) to a list."""
+    if cbs is None:
+        return []
+    if isinstance(cbs, (list, tuple)):
+        return list(cbs)
+    return [cbs]
+
+
+def _trim_pad(outputs, pad):
+    """Drop the iterator's fill-up rows from the tail of each output."""
+    if not pad:
+        return list(outputs)
+    return [out[:out.shape[0] - pad] for out in outputs]
 
 
 class BaseModule:
@@ -29,76 +74,74 @@ class BaseModule:
         self.forward(data_batch, is_train=True)
         self.backward()
 
+    def _require_ready(self):
+        if not (self.binded and self.params_initialized):
+            raise MXNetError("module is not ready: call bind() and "
+                             "init_params() (or fit()) first")
+
+    def _eval_batches(self, eval_data, num_batch, reset):
+        """Shared evaluation drain: inference-mode forward over up to
+        `num_batch` batches, yielding (nbatch, batch, pad). Consumers that
+        want outputs call get_outputs() themselves (score never does, so
+        the drain must not pay for trimming)."""
+        self._require_ready()
+        if reset:
+            eval_data.reset()
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch >= num_batch:
+                return
+            self.forward(batch, is_train=False)
+            yield nbatch, batch, getattr(batch, "pad", 0)
+
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
               epoch=0, sparse_row_id_fn=None):
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
+        """Run `eval_metric` over the eval set; returns name/value pairs."""
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
         eval_metric.reset()
-        actual_num_batch = 0
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            self.update_metric(eval_metric, eval_batch.label)
-            if batch_end_callback is not None:
-                params = _BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                        eval_metric=eval_metric, locals=locals())
-                for callback in _as_list(batch_end_callback):
-                    callback(params)
-            actual_num_batch += 1
-        if score_end_callback:
-            params = _BatchEndParam(epoch=epoch, nbatch=actual_num_batch,
-                                    eval_metric=eval_metric, locals=locals())
-            for callback in _as_list(score_end_callback):
-                callback(params)
+        seen = 0
+        for nbatch, batch, _ in self._eval_batches(eval_data, num_batch,
+                                                   reset):
+            self.update_metric(eval_metric, batch.label)
+            seen = nbatch + 1
+            for cb in _callbacks(batch_end_callback):
+                cb(BatchEndParams(epoch, nbatch, eval_metric, locals()))
+        for cb in _callbacks(score_end_callback):
+            cb(BatchEndParams(epoch, seen, eval_metric, locals()))
         return eval_metric.get_name_value()
 
     def iter_predict(self, eval_data, num_batch=None, reset=True):
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad] for out in
-                       self.get_outputs()]
-            yield (outputs, nbatch, eval_batch)
+        """Yield (outputs, nbatch, batch) per evaluation batch."""
+        for nbatch, batch, pad in self._eval_batches(eval_data,
+                                                     num_batch, reset):
+            yield _trim_pad(self.get_outputs(), pad), nbatch, batch
 
     def predict(self, eval_data, num_batch=None, merge_batches=True,
                 reset=True, always_output_list=False):
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        output_list = []
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad].copy()
-                       for out in self.get_outputs()]
-            output_list.append(outputs)
-        if len(output_list) == 0:
-            return output_list
-        if merge_batches:
-            num_outputs = len(output_list[0])
-            for out in output_list:
-                assert len(out) == num_outputs, \
-                    "Cannot merge batches, as num of outputs is not the same " \
-                    "in mini-batches. Maybe bucketing is used?"
-            from .. import ndarray as nd
-            output_list2 = [nd.concatenate([out[i] for out in output_list])
-                            for i in range(num_outputs)]
-            if num_outputs == 1 and not always_output_list:
-                return output_list2[0]
-            return output_list2
-        return output_list
+        """Collect forward outputs over the eval set.
+
+        merge_batches=True concatenates along the batch axis and unwraps a
+        single output (unless always_output_list); False returns the raw
+        per-batch list-of-lists."""
+        collected = [_trim_pad(self.get_outputs(), pad) for _, _, pad in
+                     self._eval_batches(eval_data, num_batch, reset)]
+        if not collected:
+            return collected
+        if not merge_batches:
+            return collected
+        width = len(collected[0])
+        if any(len(outputs) != width for outputs in collected):
+            raise MXNetError(
+                "predict(merge_batches=True) needs every mini-batch to "
+                "produce the same number of outputs; got a varying count "
+                "(bucketed executors do this — pass merge_batches=False)")
+        from .. import ndarray as nd
+        merged = [nd.concatenate([outputs[i] for outputs in collected])
+                  for i in range(width)]
+        if width == 1 and not always_output_list:
+            return merged[0]
+        return merged
 
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None,
@@ -109,73 +152,59 @@ class BaseModule:
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, sparse_row_id_fn=None):
-        """Train the module (parity: base_module.py:395)."""
-        assert num_epoch is not None, "please specify number of epochs"
+        """The reference's one-call training loop (API parity:
+        base_module.py fit): bind -> init params/optimizer -> epochs of
+        forward_backward/update with metric + callback plumbing."""
+        if num_epoch is None:
+            raise ValueError("fit() needs num_epoch")
         from ..initializer import Uniform
-        initializer = initializer or Uniform(0.01)
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label, for_training=True,
                   force_rebind=force_rebind)
         if monitor is not None:
             self.install_monitor(monitor)
-        self.init_params(initializer=initializer, arg_params=arg_params,
-                         aux_params=aux_params, allow_missing=allow_missing,
-                         force_init=force_init)
+        self.init_params(initializer=initializer or Uniform(0.01),
+                         arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing, force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=dict(optimizer_params))
 
-        if validation_metric is None:
-            validation_metric = eval_metric
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
+        validation_metric = validation_metric or eval_metric
 
         for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
+            started = time.time()
             eval_metric.reset()
-            nbatch = 0
-            data_iter = iter(train_data)
-            end_of_batch = False
-            next_data_batch = next(data_iter)
-            while not end_of_batch:
-                data_batch = next_data_batch
+            for nbatch, batch in enumerate(train_data):
                 if monitor is not None:
                     monitor.tic()
-                self.forward_backward(data_batch)
+                self.forward_backward(batch)
                 self.update()
-                try:
-                    next_data_batch = next(data_iter)
-                except StopIteration:
-                    end_of_batch = True
-                self.update_metric(eval_metric, data_batch.label)
+                self.update_metric(eval_metric, batch.label)
                 if monitor is not None:
                     monitor.toc_print()
-                if batch_end_callback is not None:
-                    params = _BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                            eval_metric=eval_metric,
-                                            locals=locals())
-                    for callback in _as_list(batch_end_callback):
-                        callback(params)
-                nbatch += 1
+                for cb in _callbacks(batch_end_callback):
+                    cb(BatchEndParams(epoch, nbatch, eval_metric, locals()))
 
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                             time.time() - started)
 
-            arg_params_, aux_params_ = self.get_params()
-            self.set_params(arg_params_, aux_params_)
-
-            if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params_, aux_params_)
+            # materialize the epoch's parameters host-side: checkpoints
+            # written by epoch callbacks must not hold donated buffers
+            arg_now, aux_now = self.get_params()
+            self.set_params(arg_now, aux_now)
+            for cb in _callbacks(epoch_end_callback):
+                cb(epoch, self.symbol, arg_now, aux_now)
 
             if eval_data is not None:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
+                for name, val in self.score(
+                        eval_data, validation_metric, epoch=epoch,
+                        batch_end_callback=eval_batch_end_callback,
+                        score_end_callback=eval_end_callback):
                     self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
                                      name, val)
 
@@ -229,17 +258,17 @@ class BaseModule:
 
     def load_params(self, fname):
         from ..utils import serialization
-        save_dict = serialization.load_ndarrays(fname)
-        arg_params = {}
-        aux_params = {}
-        for k, value in save_dict.items():
-            arg_type, name = k.split(":", 1)
-            if arg_type == "arg":
+        arg_params, aux_params = {}, {}
+        for key, value in serialization.load_ndarrays(fname).items():
+            kind, _, name = key.partition(":")
+            if kind == "arg":
                 arg_params[name] = value
-            elif arg_type == "aux":
+            elif kind == "aux":
                 aux_params[name] = value
             else:
-                raise ValueError("Invalid param file " + fname)
+                raise ValueError(
+                    "%s is not a module parameter file: entry %r is "
+                    "neither arg: nor aux:" % (fname, key))
         self.set_params(arg_params, aux_params)
 
     def forward(self, data_batch, is_train=None):
@@ -272,17 +301,3 @@ class BaseModule:
 
     def install_monitor(self, mon):
         raise NotImplementedError()
-
-
-class _BatchEndParam:
-    def __init__(self, epoch, nbatch, eval_metric, locals):
-        self.epoch = epoch
-        self.nbatch = nbatch
-        self.eval_metric = eval_metric
-        self.locals = locals
-
-
-def _as_list(obj):
-    if isinstance(obj, (list, tuple)):
-        return obj
-    return [obj]
