@@ -139,3 +139,94 @@ def test_cpp_predictor_real_plugin(tmp_path):
                          capture_output=True, text=True, timeout=300)
     assert out.returncode == 0, out.stdout + out.stderr
     assert "echo check OK" in out.stdout
+
+
+def test_c_predict_api_mock(tmp_path):
+    """The standalone C ABI (include/mxtpu/c_predict_api.h — the
+    reference's c_predict_api role): drive Create/counts/shapes/SetInput/
+    Forward/GetOutput/Free + the thread-local error string via ctypes
+    against the echo mock plugin with an identity artifact."""
+    import ctypes
+    _build()
+    lib_path = os.path.join(PKG, "build", "libmxtpu_predict.so")
+    assert os.path.exists(lib_path)
+
+    net = _Identity()
+    net.initialize()
+    artifact = str(tmp_path / "identity.mxtpu")
+    mx.predict.export_model(net, [("data", (2, 5))], artifact)
+
+    lib = ctypes.CDLL(lib_path)
+    lib.MXTPUPredGetLastError.restype = ctypes.c_char_p
+
+    handle = ctypes.c_void_p()
+    rc = lib.MXTPUPredCreate(artifact.encode(), MOCK.encode(), None, 0,
+                             ctypes.byref(handle))
+    assert rc == 0, lib.MXTPUPredGetLastError()
+
+    name = ctypes.c_char_p()
+    assert lib.MXTPUPredGetPlatform(handle, ctypes.byref(name)) == 0
+    assert name.value == b"mock"
+
+    n_in, n_out = ctypes.c_int(), ctypes.c_int()
+    assert lib.MXTPUPredGetInputCount(handle, ctypes.byref(n_in)) == 0
+    assert lib.MXTPUPredGetOutputCount(handle, ctypes.byref(n_out)) == 0
+    assert (n_in.value, n_out.value) == (1, 1)
+
+    shp = ctypes.POINTER(ctypes.c_int64)()
+    ndim = ctypes.c_int()
+    dt = ctypes.c_char_p()
+    assert lib.MXTPUPredGetOutputShape(handle, 0, ctypes.byref(shp),
+                                       ctypes.byref(ndim),
+                                       ctypes.byref(dt)) == 0
+    assert ndim.value == 2 and [shp[i] for i in range(2)] == [2, 5]
+    assert dt.value == b"f32"
+
+    x = np.arange(10, dtype=np.float32).reshape(2, 5) * 0.5
+    assert lib.MXTPUPredSetInput(
+        handle, 0, x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_uint64(x.size)) == 0
+    assert lib.MXTPUPredForward(handle) == 0, lib.MXTPUPredGetLastError()
+
+    out = np.zeros_like(x)
+    assert lib.MXTPUPredGetOutput(
+        handle, 0, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_uint64(out.size)) == 0
+    np.testing.assert_array_equal(out, x)  # identity net + echo plugin
+
+    # the raw-bytes variants (the only path for non-f32 slots) + the
+    # input-shape query round-trip the same way
+    assert lib.MXTPUPredGetInputShape(handle, 0, ctypes.byref(shp),
+                                      ctypes.byref(ndim),
+                                      ctypes.byref(dt)) == 0
+    assert ndim.value == 2 and [shp[i] for i in range(2)] == [2, 5]
+    x2 = x + 1.0
+    assert lib.MXTPUPredSetInputBytes(
+        handle, 0, x2.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_uint64(x2.nbytes)) == 0
+    assert lib.MXTPUPredForward(handle) == 0
+    out2 = np.zeros_like(x2)
+    assert lib.MXTPUPredGetOutputBytes(
+        handle, 0, out2.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_uint64(out2.nbytes)) == 0
+    np.testing.assert_array_equal(out2, x2)
+
+    # error paths: wrong size -> -1 + message; bad index -> -1;
+    # null opt array with positive count -> -1 (no segfault)
+    assert lib.MXTPUPredSetInput(
+        handle, 0, x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_uint64(3)) == -1
+    assert b"expects 10 f32 elements" in lib.MXTPUPredGetLastError()
+    assert lib.MXTPUPredSetInputBytes(
+        handle, 0, x2.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_uint64(5)) == -1
+    assert b"bytes" in lib.MXTPUPredGetLastError()
+    assert lib.MXTPUPredGetOutputShape(handle, 7, ctypes.byref(shp),
+                                       ctypes.byref(ndim), None) == -1
+    assert b"out of range" in lib.MXTPUPredGetLastError()
+    h2 = ctypes.c_void_p()
+    assert lib.MXTPUPredCreate(artifact.encode(), MOCK.encode(), None, 2,
+                               ctypes.byref(h2)) == -1
+    assert b"opt_specs is null" in lib.MXTPUPredGetLastError()
+
+    assert lib.MXTPUPredFree(handle) == 0
