@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Custom numpy operator (parity: reference example/numpy-ops/
+custom_softmax.py): a softmax-with-loss op whose forward AND backward are
+plain numpy, registered via CustomOpProp and trained inside a Module
+graph. The executor embeds the host computation via pure_callback, so the
+rest of the graph still jits.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+import mxnet_tpu.operator as operator  # noqa: E402
+
+
+class NumpySoftmax(operator.CustomOp):
+    """Softmax + cross-entropy gradient, all in numpy."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        y = np.exp(x - x.max(axis=1, keepdims=True))
+        y /= y.sum(axis=1, keepdims=True)
+        self.assign(out_data[0], req[0], mx.nd.array(y))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        label = in_data[1].asnumpy().astype(np.int64)
+        y = out_data[0].asnumpy().copy()
+        y[np.arange(label.shape[0]), label] -= 1.0
+        self.assign(in_grad[0], req[0], mx.nd.array(y / label.shape[0]))
+
+
+@operator.register("numpy_softmax")
+class NumpySoftmaxProp(operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return [in_shape[0], (in_shape[0][0],)], [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return NumpySoftmax()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-epochs", type=int, default=2)
+    args = ap.parse_args()
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=128)
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=10)
+    net = mx.sym.Custom(fc2, label, op_type="numpy_softmax",
+                        name="softmax")
+
+    train, val = mx.test_utils.get_mnist_iterator(
+        batch_size=args.batch_size, input_shape=(784,))
+    mod = mx.mod.Module(net, context=mx.cpu(),
+                        label_names=["softmax_label"])
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.1},
+            num_epoch=args.num_epochs)
+    acc = mod.score(val, "acc")[0][1]
+    print("validation accuracy with numpy softmax op: %.4f" % acc)
+    if acc < 0.9:
+        print("custom-op training failed to converge", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Multi-task learning (parity: reference example/multi-task): one shared
+backbone, two heads — digit class (10-way) and parity (odd/even) — trained
+jointly with a weighted sum of losses through one fused TrainStep, each
+head scored separately.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon  # noqa: E402
+from mxnet_tpu.gluon import loss as gloss  # noqa: E402
+
+
+class MultiTaskNet(gluon.HybridBlock):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.backbone = gluon.nn.HybridSequential()
+            self.backbone.add(gluon.nn.Dense(128, activation="relu"))
+            self.backbone.add(gluon.nn.Dense(64, activation="relu"))
+            self.digit_head = gluon.nn.Dense(10)
+            self.parity_head = gluon.nn.Dense(2)
+
+    def hybrid_forward(self, F, x):
+        h = self.backbone(x)
+        return self.digit_head(h), self.parity_head(h)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-epochs", type=int, default=1)
+    ap.add_argument("--parity-weight", type=float, default=0.3)
+    args = ap.parse_args()
+
+    train, val = mx.test_utils.get_mnist_iterator(
+        batch_size=args.batch_size, input_shape=(784,))
+    net = MultiTaskNet()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    ce = gloss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.num_epochs):
+        train.reset()
+        total = 0.0
+        nbatch = 0
+        for batch in train:
+            x, y = batch.data[0], batch.label[0]
+            parity = mx.nd.array(y.asnumpy() % 2)
+            with autograd.record():
+                digit_out, parity_out = net(x)
+                loss = ce(digit_out, y) + \
+                    args.parity_weight * ce(parity_out, parity)
+            loss.backward()
+            trainer.step(x.shape[0])
+            total += float(loss.mean().asscalar())
+            nbatch += 1
+        print("epoch %d mean joint loss %.4f" % (epoch, total / nbatch))
+
+    val.reset()
+    dig_ok = par_ok = n = 0
+    for batch in val:
+        digit_out, parity_out = net(batch.data[0])
+        y = batch.label[0].asnumpy()
+        dig_ok += int((digit_out.asnumpy().argmax(1) == y).sum())
+        par_ok += int((parity_out.asnumpy().argmax(1) == (y % 2)).sum())
+        n += y.size
+    print("digit accuracy %.4f | parity accuracy %.4f" %
+          (dig_ok / n, par_ok / n))
+    if dig_ok / n < 0.9 or par_ok / n < 0.9:
+        print("multi-task training failed to converge", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""INT8 post-training quantization (parity: reference example/quantization
+— imagenet_gen_qsym.py's calibrate-then-evaluate flow, on hermetic
+synthetic MNIST).
+
+Trains a small conv net with the Module API, quantizes the symbol with
+entropy/minmax calibration over a calibration iterator
+(contrib.quantization.quantize_model — conv/FC run on the MXU int8 path
+via lax.dot_general with int32 accumulation), then compares fp32 vs int8
+accuracy and reports both.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.contrib.quantization import quantize_model  # noqa: E402
+
+
+def conv_net():
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(data, name="conv1", kernel=(3, 3),
+                            num_filter=8, pad=(1, 1))
+    a1 = mx.sym.Activation(c1, act_type="relu")
+    p1 = mx.sym.Pooling(a1, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    fc1 = mx.sym.FullyConnected(p1, name="fc1", num_hidden=64)
+    a2 = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(a2, name="fc2", num_hidden=10)
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def accuracy(sym, args, auxs, it):
+    it.reset()
+    correct = total = 0
+    exe = None
+    for batch in it:
+        # SoftmaxOutput declares a label argument; inference ignores it
+        dummy = mx.nd.zeros((batch.data[0].shape[0],))
+        if exe is None:
+            exe = sym.bind(mx.cpu(),
+                           args={**args, "data": batch.data[0],
+                                 "softmax_label": dummy},
+                           aux_states=auxs, grad_req="null")
+            out = exe.forward(is_train=False)[0]
+        else:
+            out = exe.forward(is_train=False, data=batch.data[0],
+                              softmax_label=dummy)[0]
+        pred = out.asnumpy().argmax(axis=1)
+        label = batch.label[0].asnumpy()
+        correct += int((pred == label).sum())
+        total += label.size
+    return correct / total
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-epochs", type=int, default=1)
+    ap.add_argument("--calib-mode", default="entropy",
+                    choices=["none", "naive", "entropy"])
+    ap.add_argument("--num-calib-batches", type=int, default=4)
+    args = ap.parse_args()
+
+    train, val = mx.test_utils.get_mnist_iterator(
+        batch_size=args.batch_size, input_shape=(1, 28, 28))
+    sym = conv_net()
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.fit(train, optimizer="sgd", initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.1},
+            num_epoch=args.num_epochs)
+    arg_params, aux_params = mod.get_params()
+
+    fp32_acc = accuracy(sym, arg_params, aux_params, val)
+    print("fp32 accuracy: %.4f" % fp32_acc)
+
+    val.reset()
+    qsym, qargs, qauxs = quantize_model(
+        sym, arg_params, aux_params, calib_mode=args.calib_mode,
+        calib_data=val,
+        num_calib_examples=args.num_calib_batches * args.batch_size)
+    int8_acc = accuracy(qsym, qargs, qauxs, val)
+    print("int8 accuracy: %.4f (calib_mode=%s)" % (int8_acc,
+                                                   args.calib_mode))
+    if int8_acc < fp32_acc - 0.05:
+        print("int8 accuracy dropped more than 5 points", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

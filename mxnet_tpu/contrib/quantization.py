@@ -62,30 +62,52 @@ def _kl_optimal_threshold(samples, num_bins=2001, num_quantized_bins=255):
         return 1e-8
     hist, edges = np.histogram(arr, bins=num_bins, range=(0, amax))
     best_kl, best_t = np.inf, amax
+
+    def _smooth(d, eps=1e-4):
+        # eps-smooth a count vector (the reference's _smooth_distribution
+        # role): q zeros where p > 0 would otherwise send KL to infinity
+        # at honest thresholds
+        zeros = d == 0
+        n_zero, n_nonzero = int(zeros.sum()), int((~zeros).sum())
+        if n_zero == 0 or n_nonzero == 0:
+            return d
+        take = eps * n_zero / n_nonzero
+        if take >= d[~zeros].min():
+            return d + eps * zeros  # tiny counts: just lift zeros
+        return d + eps * zeros - take * ~zeros
+
     for i in range(num_quantized_bins, num_bins + 1,
                    max(1, (num_bins - num_quantized_bins) // 64)):
-        p = hist[:i].astype(np.float64).copy()
-        p[-1] += hist[i:].sum()  # clip tail into the last bin
+        sliced = hist[:i].astype(np.float64)
+        # p carries the CLIPPED tail mass in its edge bin; q is built from
+        # the unclipped slice only. The asymmetry is the point: a
+        # threshold that clips real mass shows up as p[-1] >> q[-1] and
+        # pays KL for it. (Building q from the clipped p makes the
+        # factor-1 candidate — the smallest threshold — a lossless
+        # projection with KL 0, and calibration degenerates to clipping
+        # most of the distribution: the r5 int8-accuracy bug.)
+        p = sliced.copy()
+        p[-1] += hist[i:].sum()
         if p.sum() == 0:
             continue
-        # project p onto num_quantized_bins then expand back
         factor = i / num_quantized_bins
         q = np.zeros(i)
         for j in range(num_quantized_bins):
             lo, hi = int(j * factor), max(int((j + 1) * factor), int(
                 j * factor) + 1)
-            chunk = p[lo:hi]
+            chunk = sliced[lo:hi]
             nz = (chunk > 0).sum()
             if nz:
                 q[lo:hi] = np.where(chunk > 0, chunk.sum() / nz, 0)
-        pn = p / p.sum()
-        qs = q.sum()
-        if qs == 0:
+        ps = _smooth(p)
+        qs = _smooth(q)
+        if qs.sum() == 0:  # all mass beyond the slice: q is empty
             continue
-        qn = q / qs
+        pn = ps / ps.sum()
+        qn = qs / qs.sum()
         mask = pn > 0
         kl = float(np.sum(pn[mask] * np.log(
-            pn[mask] / np.maximum(qn[mask], 1e-12))))
+            pn[mask] / np.maximum(qn[mask], 1e-300))))
         if kl < best_kl:
             best_kl, best_t = kl, float(edges[i if i < len(edges) else -1])
     return max(best_t, 1e-8)

@@ -40,6 +40,9 @@ _CASES = [
     ("cnn_text_classification.py", ["--epochs", "5"]),
     ("vae.py", ["--epochs", "1"]),
     ("dqn_gridworld.py", []),
+    ("quantize_int8.py", ["--num-epochs", "1", "--num-calib-batches", "2"]),
+    ("custom_op.py", ["--num-epochs", "2"]),
+    ("multi_task.py", ["--num-epochs", "1"]),
 ]
 
 
