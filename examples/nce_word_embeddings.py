@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Word embeddings with noise-contrastive estimation (parity: reference
+example/nce-loss — toy_nce.py/wordvec.py). The full-vocab softmax is
+replaced by NCE: each positive (center, context) pair is scored against k
+noise words sampled from the unigram distribution, turning the output
+layer into k+1 binary classifications per token — the standard trick for
+large-vocab output layers (the reference's large_word_lm uses the same
+family). All sampling rides the framework RNG (mx.nd.random) and the
+whole update runs under autograd.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+
+class NCEEmbeddings(gluon.Block):
+    """In/out embedding tables; score(center, word) = <in[c], out[w]>."""
+
+    def __init__(self, vocab, dim, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.emb_in = nn.Embedding(vocab, dim)
+            self.emb_out = nn.Embedding(vocab, dim)
+
+    def forward(self, center, words):
+        # center: (N,), words: (N, K) -> logits (N, K)
+        c = self.emb_in(center)                  # (N, D)
+        w = self.emb_out(words)                  # (N, K, D)
+        return (w * c.expand_dims(1)).sum(axis=-1)
+
+
+def synthetic_corpus(rng, vocab, n):
+    """Markov-ish toy corpus: word w is followed by (w+1) % vocab with
+    probability 0.8, else uniform — so true context structure exists."""
+    seq = np.zeros(n, np.int64)
+    for i in range(1, n):
+        if rng.rand() < 0.8:
+            seq[i] = (seq[i - 1] + 1) % vocab
+        else:
+            seq[i] = rng.randint(vocab)
+    return seq
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--vocab", type=int, default=50)
+    ap.add_argument("--dim", type=int, default=16)
+    ap.add_argument("--k-noise", type=int, default=8)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=400)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    corpus = synthetic_corpus(rng, args.vocab, 20000)
+    centers, contexts = corpus[:-1], corpus[1:]
+
+    net = NCEEmbeddings(args.vocab, args.dim)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.05})
+    sig_bce = gluon.loss.SigmoidBinaryCrossEntropyLoss(from_sigmoid=False)
+
+    first = last = None
+    for step in range(args.steps):
+        idx = rng.randint(0, centers.size, args.batch_size)
+        c = mx.nd.array(centers[idx])
+        pos = contexts[idx]
+        # k noise words per positive from the (uniform here) noise dist,
+        # drawn through the framework RNG
+        noise = mx.nd.random.uniform(
+            0, args.vocab, (args.batch_size, args.k_noise)).floor()
+        words = mx.nd.concat(mx.nd.array(pos).reshape((-1, 1)), noise,
+                             dim=1)                       # (N, 1+K)
+        labels = mx.nd.concat(
+            mx.nd.ones((args.batch_size, 1)),
+            mx.nd.zeros((args.batch_size, args.k_noise)), dim=1)
+        with autograd.record():
+            logits = net(c, words)
+            loss = sig_bce(logits, labels)
+        loss.backward()
+        trainer.step(args.batch_size)
+        v = float(loss.mean().asscalar())
+        first = v if first is None else first
+        last = v
+        if step % 100 == 0:
+            print("step %4d nce loss %.4f" % (step, v))
+
+    # the learned tables must score the true successor above a random word
+    test_c = mx.nd.array(np.arange(args.vocab))
+    succ = mx.nd.array((np.arange(args.vocab) + 1) % args.vocab)
+    rand_w = mx.nd.array(rng.randint(0, args.vocab, args.vocab))
+    s_true = net(test_c, succ.reshape((-1, 1))).asnumpy().ravel()
+    s_rand = net(test_c, rand_w.reshape((-1, 1))).asnumpy().ravel()
+    frac = float((s_true > s_rand).mean())
+    print("final loss %.4f (from %.4f); true-successor wins %.2f"
+          % (last, first, frac))
+    if not (last < first and frac > 0.75):
+        print("nce embeddings failed to learn structure", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

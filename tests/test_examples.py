@@ -43,6 +43,8 @@ _CASES = [
     ("quantize_int8.py", ["--num-epochs", "1", "--num-calib-batches", "2"]),
     ("custom_op.py", ["--num-epochs", "2"]),
     ("multi_task.py", ["--num-epochs", "1"]),
+    ("bi_lstm_sort.py", ["--steps", "150", "--seq-len", "6"]),
+    ("nce_word_embeddings.py", ["--steps", "250"]),
 ]
 
 
