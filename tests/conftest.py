@@ -46,6 +46,7 @@ _SLOW_FILES = {
 # Individual compile-heavy tests (>~30 s on the 8-worker CPU tier). Every
 # subsystem they cover retains at least one light test in the fast tier.
 _SLOW_TESTS = {
+    "test_tool_diagnose_runs", "test_tool_bandwidth_runs",
     "test_psroi_pooling", "test_deformable_psroi_grad",
     "test_deformable_convolution_grad",
     "test_ssd_end_to_end",

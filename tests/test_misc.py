@@ -241,3 +241,72 @@ def test_exception_recovery_imperative():
         mx.nd.Reshape(mx.nd.zeros((2, 3)), shape=(7,))
     out = mx.nd.zeros((2, 2)) + 1
     assert float(out.asnumpy().sum()) == 4.0
+
+
+def test_tool_rec2idx_roundtrip(tmp_path):
+    """tools/rec2idx.py (reference rec2idx role): the generated .idx must
+    let MXIndexedRecordIO random-access every record of a plain .rec."""
+    import mxnet_tpu as mx
+    from tools.rec2idx import build_index
+    rec = str(tmp_path / "a.rec")
+    w = mx.recordio.MXRecordIO(rec, "w")
+    payloads = [("rec%03d" % i).encode() * (i + 1) for i in range(7)]
+    for i, b in enumerate(payloads):
+        w.write(mx.recordio.pack(mx.recordio.IRHeader(0, 0.0, i, 0), b))
+    w.close()
+    idx = str(tmp_path / "a.idx")
+    assert build_index(rec, idx) == 7
+    r = mx.recordio.MXIndexedRecordIO(idx, rec, "r")
+    for i in (6, 0, 3):  # out of order: true random access
+        _, blob = mx.recordio.unpack(r.read_idx(i))
+        assert blob == payloads[i]
+    r.close()
+
+
+def test_tool_parse_log():
+    """tools/parse_log.py parses the fit loop's own log lines."""
+    from tools.parse_log import parse, render
+    lines = [
+        "INFO:root:Epoch[0] Train-accuracy=0.5",
+        "INFO:root:Epoch[0] Time cost=1.5",
+        "INFO:root:Epoch[0] Validation-accuracy=0.6",
+        "Epoch[1] Train-accuracy=0.9",
+        "Epoch[1] Time cost=1.25",
+        "noise line",
+    ]
+    epochs, table, cols = parse(lines)
+    assert epochs == [0, 1]
+    assert table[0]["val-accuracy"] == 0.6
+    assert table[1]["train-accuracy"] == 0.9
+    md = render(epochs, table, cols, "markdown")
+    assert "| epoch |" in md and "0.9" in md
+    csv = render(epochs, table, cols, "csv")
+    assert csv.splitlines()[0].startswith("epoch,")
+    # epoch 1 has no validation column value -> empty cell, not a crash
+    assert csv.splitlines()[-1].endswith(",")
+
+
+def test_tool_diagnose_runs():
+    import subprocess, sys, os
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out = subprocess.run(
+        [sys.executable, os.path.join("tools", "diagnose.py"),
+         "--no-device-probe"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert out.returncode == 0, out.stderr
+    assert "mxnet_tpu" in out.stdout and "Native extension" in out.stdout
+
+
+def test_tool_bandwidth_runs():
+    import subprocess, sys, os
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=4")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out = subprocess.run(
+        [sys.executable, os.path.join("tools", "bandwidth.py"),
+         "--size-mb", "1", "--iters", "2"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr
+    assert "host->device staging" in out.stdout
+    assert "allreduce over 4 dev" in out.stdout

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Parse training logs into a markdown or CSV table (parity: reference
+tools/parse_log.py). Understands the fit-loop log lines this framework
+emits (module/base_module.py / model.py):
+
+    Epoch[3] Train-accuracy=0.982134
+    Epoch[3] Validation-accuracy=0.971200
+    Epoch[3] Time cost=12.345
+
+and prints one row per epoch with every metric seen.
+"""
+import argparse
+import re
+import sys
+
+_NUM = r"(?:[0-9.eE+-]+|-?nan|-?inf)"  # %f prints nan/inf on divergence
+_LINE = re.compile(
+    r"Epoch\[(\d+)\]\s+"
+    r"(?:(Train|Validation)-(\S+?)=(%s)|Time cost=(%s))" % (_NUM, _NUM))
+
+
+def parse(lines):
+    """-> (ordered epoch list, {epoch: {column: value}}, ordered columns)."""
+    epochs, table, columns = [], {}, []
+
+    def put(epoch, col, val):
+        if epoch not in table:
+            table[epoch] = {}
+            epochs.append(epoch)
+        if col not in columns:
+            columns.append(col)
+        table[epoch][col] = val
+
+    for line in lines:
+        m = _LINE.search(line)
+        if not m:
+            continue
+        epoch = int(m.group(1))
+        if m.group(5) is not None:
+            put(epoch, "time", float(m.group(5)))
+        else:
+            side = "train" if m.group(2) == "Train" else "val"
+            put(epoch, "%s-%s" % (side, m.group(3)), float(m.group(4)))
+    return epochs, table, columns
+
+
+def render(epochs, table, columns, fmt):
+    out = []
+    if fmt == "markdown":
+        out.append("| epoch | " + " | ".join(columns) + " |")
+        out.append("| --- " * (len(columns) + 1) + "|")
+        row = "| %d | " + " | ".join("%s" for _ in columns) + " |"
+    else:
+        out.append("epoch," + ",".join(columns))
+        row = "%d," + ",".join("%s" for _ in columns)
+    for e in epochs:
+        vals = tuple(("%.6g" % table[e][c]) if c in table[e] else ""
+                     for c in columns)
+        out.append(row % ((e,) + vals))
+    return "\n".join(out)
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="summarize a training log as a table")
+    ap.add_argument("logfile", nargs="?", default="-",
+                    help="log file ('-' = stdin)")
+    ap.add_argument("--format", default="markdown",
+                    choices=["markdown", "csv"])
+    args = ap.parse_args()
+    lines = sys.stdin if args.logfile == "-" else open(args.logfile)
+    epochs, table, columns = parse(lines)
+    if not epochs:
+        print("no Epoch[...] lines found", file=sys.stderr)
+        return 1
+    print(render(epochs, table, columns, args.format))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
