@@ -45,6 +45,8 @@ _CASES = [
     ("multi_task.py", ["--num-epochs", "1"]),
     ("bi_lstm_sort.py", ["--steps", "150", "--seq-len", "6"]),
     ("nce_word_embeddings.py", ["--steps", "250"]),
+    ("neural_style.py", ["--steps", "80"]),
+    ("conv_autoencoder.py", []),
 ]
 
 
