@@ -47,6 +47,7 @@ _CASES = [
     ("nce_word_embeddings.py", ["--steps", "250"]),
     ("neural_style.py", ["--steps", "80"]),
     ("conv_autoencoder.py", []),
+    ("capsnet.py", ["--num-batches", "60"]),
 ]
 
 
