@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Stochastic depth (parity: reference example/stochastic-depth): each
+residual block is randomly skipped during training with a depth-dependent
+survival probability and always kept (scaled) at inference — a
+regularizer that also shortens the expected backward path. The skip draw
+rides the framework RNG, so under the fused TrainStep it becomes a traced
+random bernoulli per block per step, not Python-side branching.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon  # noqa: E402
+from mxnet_tpu.gluon import loss as gloss, nn  # noqa: E402
+
+
+class StochasticResidual(gluon.HybridBlock):
+    """y = x + gate * f(x); gate ~ Bernoulli(p_survive) when training,
+    E[gate] = p_survive at inference (the linear-decay rule)."""
+
+    def __init__(self, channels, p_survive, **kwargs):
+        super().__init__(**kwargs)
+        self.p = p_survive
+        with self.name_scope():
+            self.body = nn.HybridSequential()
+            self.body.add(nn.Conv2D(channels, 3, padding=1,
+                                    activation="relu"))
+            self.body.add(nn.Conv2D(channels, 3, padding=1))
+
+    def hybrid_forward(self, F, x):
+        out = self.body(x)
+        if autograd.is_training():
+            gate = F.random.uniform(0, 1, (1, 1, 1, 1)) < self.p
+            return x + out * gate
+        return x + out * self.p
+
+
+def build(n_blocks, p_last):
+    net = gluon.nn.HybridSequential(prefix="sd_")
+    with net.name_scope():
+        net.add(nn.Conv2D(32, 3, padding=1, activation="relu"))
+        net.add(nn.MaxPool2D(2, 2))                    # 28 -> 14
+        for i in range(n_blocks):
+            # linear decay: early blocks almost always survive
+            p = 1.0 - (i + 1) / n_blocks * (1.0 - p_last)
+            net.add(StochasticResidual(32, p))
+        net.add(nn.MaxPool2D(2, 2))                    # 14 -> 7
+        net.add(nn.Flatten())
+        net.add(nn.Dense(64, activation="relu"))
+        net.add(nn.Dense(10))
+    return net
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-epochs", type=int, default=1)
+    ap.add_argument("--blocks", type=int, default=4)
+    ap.add_argument("--p-last", type=float, default=0.5)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    np.random.seed(args.seed)
+    mx.random.seed(args.seed)
+    train, val = mx.test_utils.get_mnist_iterator(
+        batch_size=args.batch_size, input_shape=(1, 28, 28))
+    net = build(args.blocks, args.p_last)
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.001})
+    ce = gloss.SoftmaxCrossEntropyLoss()
+
+    first = last = None
+    for epoch in range(args.num_epochs):
+        train.reset()
+        for batch in train:
+            with autograd.record():
+                loss = ce(net(batch.data[0]), batch.label[0])
+            loss.backward()
+            trainer.step(batch.data[0].shape[0])
+            v = float(loss.mean().asscalar())
+            first = v if first is None else first
+            last = v
+        print("epoch %d loss %.4f" % (epoch, last))
+
+    val.reset()
+    ok = n = 0
+    for batch in val:
+        p = net(batch.data[0]).asnumpy().argmax(1)
+        ok += int((p == batch.label[0].asnumpy()).sum())
+        n += p.size
+    acc = ok / n
+    print("loss %.4f -> %.4f; val accuracy %.4f" % (first, last, acc))
+    if not (last < first and acc > 0.9):
+        print("stochastic-depth training failed", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

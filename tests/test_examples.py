@@ -48,6 +48,7 @@ _CASES = [
     ("neural_style.py", ["--steps", "80"]),
     ("conv_autoencoder.py", []),
     ("capsnet.py", ["--num-batches", "60"]),
+    ("stochastic_depth.py", []),
 ]
 
 
