@@ -49,6 +49,7 @@ _CASES = [
     ("conv_autoencoder.py", []),
     ("capsnet.py", ["--num-batches", "60"]),
     ("stochastic_depth.py", []),
+    ("dsd_training.py", []),
 ]
 
 
