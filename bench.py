@@ -571,37 +571,52 @@ def bench_sparse_linear(smoke, dtype, device_kind):
             "final_loss": round(loss, 4)}
 
 
-def bench_io_pipeline(smoke, dtype, device_kind):
-    """Native C++ RecordIO + JPEG decode/augment pipeline throughput
-    (the input half of the reference's ImageRecordIter benchmark; host-
-    side, so the number is real regardless of accelerator state)."""
+def _write_synthetic_rec(n, side):
+    """Pack n JPEG records (8 distinct images reused, labels i%10) into a
+    temp .rec; shared by the io-pipeline and e2e-train benches. Caller
+    unlinks the returned path."""
     import io as pyio
     import tempfile
     from PIL import Image
     import mxnet_tpu as mx
+
+    fd, rec = tempfile.mkstemp(suffix=".rec")
+    os.close(fd)
+    try:
+        rng = np.random.RandomState(0)
+        jpgs = []
+        for _ in range(8):
+            arr = rng.randint(0, 255, (side, side, 3)).astype(np.uint8)
+            buf = pyio.BytesIO()
+            Image.fromarray(arr).save(buf, format="JPEG", quality=90)
+            jpgs.append(buf.getvalue())
+        w = mx.recordio.MXRecordIO(rec, "w")
+        for i in range(n):
+            w.write(mx.recordio.pack(
+                mx.recordio.IRHeader(0, float(i % 10), i, 0), jpgs[i % 8]))
+        w.close()
+    except BaseException:
+        try:
+            os.unlink(rec)
+        except OSError:
+            pass
+        raise
+    return rec
+
+
+def bench_io_pipeline(smoke, dtype, device_kind):
+    """Native C++ RecordIO + JPEG decode/augment pipeline throughput
+    (the input half of the reference's ImageRecordIter benchmark; host-
+    side, so the number is real regardless of accelerator state)."""
     from mxnet_tpu import native
 
     if not native.AVAILABLE:
         return {"metric": "io_pipeline_img_per_sec", "value": None,
                 "unit": "img/s", "error": "native extension not built"}
     n, side = (64, 64) if smoke else (512, 224)
-    fd, rec = tempfile.mkstemp(suffix=".rec")
-    os.close(fd)
+    rec = _write_synthetic_rec(n, side)
     it = None
     try:
-        w = mx.recordio.MXRecordIO(rec, "w")
-        rng = np.random.RandomState(0)
-        jpgs = []
-        for i in range(8):  # 8 distinct images, reused to keep packing fast
-            arr = rng.randint(0, 255, (side, side, 3)).astype(np.uint8)
-            buf = pyio.BytesIO()
-            Image.fromarray(arr).save(buf, format="JPEG", quality=90)
-            jpgs.append(buf.getvalue())
-        for i in range(n):
-            w.write(mx.recordio.pack(
-                mx.recordio.IRHeader(0, float(i % 10), i, 0), jpgs[i % 8]))
-        w.close()
-
         it = native.NativeImageIter(rec, batch_size=32,
                                     data_shape=(3, side, side),
                                     num_threads=0, rand_mirror=True)
@@ -639,9 +654,6 @@ def bench_e2e_train_io(smoke, dtype, device_kind):
     is the overlap ratio + the decode-pool worker scaling table.
     Reference recipe: iter_image_recordio_2.cc's double-buffered pipeline
     feeding benchmark.py."""
-    import io as pyio
-    import tempfile
-    from PIL import Image
     import jax
     import jax.numpy as jnp
     import mxnet_tpu as mx
@@ -658,21 +670,9 @@ def bench_e2e_train_io(smoke, dtype, device_kind):
                 "unit": "img/s", "error": "native extension not built"}
     n, side, batch = (128, 64, 32) if smoke else (1024, 224, 64)
     n = int(os.environ.get("BENCH_E2E_N", n))
-    fd, rec = tempfile.mkstemp(suffix=".rec")
-    os.close(fd)
+    rec = _write_synthetic_rec(n, side)
     try:
-        w = mx.recordio.MXRecordIO(rec, "w")
         rng = np.random.RandomState(0)
-        jpgs = []
-        for i in range(8):
-            arr = rng.randint(0, 255, (side, side, 3)).astype(np.uint8)
-            buf = pyio.BytesIO()
-            Image.fromarray(arr).save(buf, format="JPEG", quality=90)
-            jpgs.append(buf.getvalue())
-        for i in range(n):
-            w.write(mx.recordio.pack(
-                mx.recordio.IRHeader(0, float(i % 10), i, 0), jpgs[i % 8]))
-        w.close()
 
         def host_iter(threads=0):
             return ImageRecordIter(path_imgrec=rec, batch_size=batch,
