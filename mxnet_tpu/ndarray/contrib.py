@@ -24,6 +24,40 @@ CTCLoss = ctc_loss  # noqa: F821 — defined by the loop above
 quadratic = _make("quadratic")
 
 
+def rand_zipfian(true_classes, num_sampled, range_max, ctx=None):
+    """Log-uniform (Zipfian) candidate sampler (parity:
+    reference python/mxnet/ndarray/contrib.py:32 rand_zipfian — the
+    sampled-softmax helper for frequency-sorted vocabularies).
+
+    P(class) = (log(class + 2) - log(class + 1)) / log(range_max + 1)
+
+    Returns (sampled_classes, expected_count_true,
+    expected_count_sampled). Samples are drawn with replacement through
+    the framework RNG. Dtype note: the pipeline runs in float32/int32
+    (JAX's defaults; the reference computes in float64/int64), which is
+    exact for range_max up to ~2^24 (16M classes) — beyond that float32
+    spacing quantizes which class ids are reachable.
+    """
+    if range_max > (1 << 24):
+        raise ValueError(
+            "rand_zipfian: range_max %d exceeds the float32 sampling "
+            "pipeline's exact range (2^24)" % range_max)
+    import math
+    from . import random as _nd_random
+    log_range = math.log(range_max + 1)
+    rand = _nd_random.uniform(0, log_range, shape=(num_sampled,))
+    # u ~ U(0, log(R+1)) => floor(e^u - 1) is log-uniform over [0, R)
+    sampled = (rand.exp() - 1).astype("int32") % range_max
+
+    def expected_count(cls_float):
+        prob = ((cls_float + 2.0) / (cls_float + 1.0)).log() / log_range
+        return prob * num_sampled
+
+    return (sampled,
+            expected_count(true_classes.astype("float32")),
+            expected_count(sampled.astype("float32")))
+
+
 def foreach(body, data, init_states):
     """Parity: contrib control-flow op `foreach` — here a Python loop in eager
     mode; inside a CachedOp trace XLA unrolls or the user uses lax.scan via

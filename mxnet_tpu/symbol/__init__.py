@@ -926,3 +926,28 @@ contrib = _SubNS("contrib", dict(
      if n.startswith("_contrib_")},
     quadratic="quadratic",
 ))
+
+
+def _rand_zipfian(true_classes, num_sampled, range_max):
+    """Symbolic log-uniform candidate sampler (parity: reference
+    python/mxnet/symbol/contrib.py:31 rand_zipfian) — composed from
+    registered ops, same math as the ndarray version
+    (ndarray/contrib.py rand_zipfian)."""
+    import math as _math
+    log_range = _math.log(range_max + 1)
+    # keyword form: symbol create() keeps only Symbol positional args,
+    # so positional low/high would silently fall back to U(0, 1)
+    rand = random.uniform(low=0, high=log_range, shape=(num_sampled,))
+    sampled = _mod_scalar(cast(exp(rand) - 1, dtype="int32"),  # noqa: F821
+                          scalar=range_max)
+
+    def expected_count(cls_sym):
+        prob = log((cls_sym + 2.0) / (cls_sym + 1.0))  # noqa: F821
+        return prob * (float(num_sampled) / log_range)
+
+    return (sampled,
+            expected_count(cast(true_classes, dtype="float32")),  # noqa: F821
+            expected_count(cast(sampled, dtype="float32")))  # noqa: F821
+
+
+contrib.rand_zipfian = _rand_zipfian

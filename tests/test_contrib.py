@@ -125,3 +125,54 @@ def test_embedding_unknown_token_row_from_file(tmp_path):
     assert len(e) == 2  # <unk> + hello
     np.testing.assert_allclose(e.get_vecs_by_tokens("oov").asnumpy(),
                                [7, 7, 7])
+
+
+def test_rand_zipfian_nd_and_sym():
+    """rand_zipfian (reference ndarray/contrib.py:32 + symbol/contrib.py):
+    log-uniform candidate sampler. Pins (a) the analytic expected-count
+    formula exactly, (b) the empirical sample distribution against
+    P(c) = log((c+2)/(c+1)) / log(R+1), (c) nd/sym agreement."""
+    import math
+    import mxnet_tpu as mx
+
+    R, N = 50, 20000
+    mx.random.seed(7)
+    true_cls = mx.nd.array([0.0, 3.0, 49.0])
+    samples, exp_true, exp_sampled = mx.nd.contrib.rand_zipfian(
+        true_cls, N, R)
+    s = samples.asnumpy()
+    assert s.shape == (N,) and s.min() >= 0 and s.max() < R
+    # (a) expected counts are the closed form
+    want = np.log((true_cls.asnumpy() + 2) / (true_cls.asnumpy() + 1)) \
+        / math.log(R + 1) * N
+    np.testing.assert_allclose(exp_true.asnumpy(), want, rtol=1e-5)
+    # sampled-class expected counts use the same formula on the samples
+    want_s = np.log((s + 2.0) / (s + 1.0)) / math.log(R + 1) * N
+    np.testing.assert_allclose(exp_sampled.asnumpy(), want_s, rtol=1e-5)
+    # (b) empirical counts track the analytic distribution (4-sigma-ish)
+    counts = np.bincount(s.astype(np.int64), minlength=R)
+    probs = np.log((np.arange(R) + 2.0) / (np.arange(R) + 1.0)) \
+        / math.log(R + 1)
+    sigma = np.sqrt(N * probs * (1 - probs))
+    assert (np.abs(counts - N * probs) < 5 * sigma + 5).all()
+
+    # (c) the symbolic composition computes the same things
+    import mxnet_tpu.symbol as S
+    tc = S.Variable("tc")
+    sym_s, sym_t, sym_e = S.contrib.rand_zipfian(tc, 100, R)
+    exe = S.Group([sym_s, sym_t, sym_e]).bind(
+        mx.cpu(), {"tc": true_cls}, grad_req="null")
+    outs = exe.forward()
+    ss = outs[0].asnumpy()
+    assert ss.shape == (100,) and ss.min() >= 0 and ss.max() < R
+    # the distribution must actually be log-uniform over [0, R), not a
+    # degenerate U(0,1)->{0,1} sampler (regression: symbol create() drops
+    # non-Symbol positional args, so low/high must be keywords)
+    assert ss.max() >= 5 and len(np.unique(ss)) > 10, ss
+    np.testing.assert_allclose(
+        outs[1].asnumpy(),
+        np.log((true_cls.asnumpy() + 2) / (true_cls.asnumpy() + 1))
+        / math.log(R + 1) * 100, rtol=1e-5)
+    np.testing.assert_allclose(
+        outs[2].asnumpy(),
+        np.log((ss + 2.0) / (ss + 1.0)) / math.log(R + 1) * 100, rtol=1e-4)
