@@ -1,5 +1,6 @@
 """Gluon data API (parity: python/mxnet/gluon/data/)."""
 from .dataset import Dataset, ArrayDataset, SimpleDataset, RecordFileDataset
 from .sampler import Sampler, SequentialSampler, RandomSampler, BatchSampler
-from .dataloader import DataLoader
+from .dataloader import (DataLoader, default_batchify_fn,
+                         default_mp_batchify_fn)
 from . import vision

@@ -31,6 +31,12 @@ def default_batchify_fn(data):
     return NDArray(data)
 
 
+# parity alias (reference dataloader.py default_mp_batchify_fn): the
+# reference's mp variant stacks into shared memory for its worker->main
+# NDArray pickler; here workers hand back numpy and stacking is identical
+default_mp_batchify_fn = default_batchify_fn
+
+
 def prefetch_to_device(iterable, size=2, device=None):
     """Stage upcoming batches in accelerator memory while the current one
     computes.

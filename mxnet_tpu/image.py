@@ -166,6 +166,34 @@ class SequentialAug(Augmenter):
         return src
 
 
+class RandomOrderAug(Augmenter):
+    """Apply the augmenter list in a fresh random order per image
+    (parity: image.py RandomOrderAug)."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = list(ts)
+
+    def __call__(self, src):
+        order = list(range(len(self.ts)))
+        random.shuffle(order)
+        for i in order:
+            src = self.ts[i](src)
+        return src
+
+
+def scale_down(src_size, size):
+    """Shrink a crop size to fit inside the image, preserving the crop's
+    aspect ratio (parity: image.py scale_down); sizes are (w, h)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
 class ResizeAug(Augmenter):
     def __init__(self, size, interp=2):
         super().__init__(size=size, interp=interp)

@@ -300,3 +300,6 @@ class init:
     FusedRNN = FusedRNN
     Load = Load
     Mixed = Mixed
+    # registry surface (parity: @mx.init.register custom initializers)
+    register = staticmethod(register)
+    create = staticmethod(create)

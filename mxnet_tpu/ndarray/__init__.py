@@ -169,6 +169,18 @@ def save(fname, data):
     serialization.save_ndarrays(fname, data)
 
 
+def load_frombuffer(buf):
+    """Deserialize ndarrays from in-memory bytes (parity:
+    ndarray/utils.py load_frombuffer — the c_predict_api param-bytes
+    contract; handles both this framework's container and the
+    reference's legacy binary format)."""
+    if not isinstance(buf, (bytes, bytearray)):
+        raise TypeError("load_frombuffer expects bytes, got %s"
+                        % type(buf).__name__)
+    from ..utils import serialization
+    return serialization.load_ndarrays(buf)
+
+
 def imdecode(buf, flag=1, to_rgb=True):
     from ..image import imdecode as _imdecode
     return _imdecode(buf, flag=flag, to_rgb=to_rgb)

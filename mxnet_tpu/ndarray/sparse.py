@@ -266,12 +266,91 @@ def merge_rowsparse(vlist):
                             vlist[0].shape)
 
 
+def array(source_array, ctx=None, dtype=None):
+    """Build a sparse ndarray from a sparse source (parity:
+    ndarray/sparse.py array): another sparse ndarray (same stype) or a
+    scipy.sparse csr matrix. Dense sources belong to nd.array /
+    .tostype()."""
+    def _vals(values, src_dtype):
+        # dtype=None preserves the source dtype (reference semantics)
+        return values.astype(dtype_np(dtype) if dtype is not None
+                             else src_dtype)
+
+    if isinstance(source_array, RowSparseNDArray):
+        return RowSparseNDArray(
+            source_array._indices,
+            _vals(source_array._values, source_array.dtype),
+            source_array.shape, ctx=ctx)
+    if isinstance(source_array, CSRNDArray):
+        return CSRNDArray(
+            _vals(source_array._values, source_array.dtype),
+            source_array._indices, source_array._indptr,
+            source_array.shape, ctx=ctx)
+    try:
+        import scipy.sparse as sps
+        if sps.issparse(source_array):
+            m = source_array.tocsr()
+            return CSRNDArray(
+                jnp.asarray(_vals(m.data, m.data.dtype)),
+                jnp.asarray(m.indices.astype(np.int32)),
+                jnp.asarray(m.indptr.astype(np.int32)), m.shape, ctx=ctx)
+    except ImportError:
+        pass
+    raise TypeError(
+        "sparse.array expects a sparse ndarray or scipy.sparse matrix; "
+        "for dense sources use mx.nd.array(...).tostype('csr'/"
+        "'row_sparse')")
+
+
+def empty(stype, shape, ctx=None, dtype=None):
+    """Parity: sparse.empty — an uninitialized sparse ndarray is an
+    all-zero one (no storage is allocated until rows/values appear)."""
+    return zeros(stype, shape, ctx=ctx, dtype=dtype)
+
+
+def _densify_binary(lhs, rhs, op):
+    """Elementwise arithmetic on mixed sparse/dense operands; general
+    case densifies (the reference's fallback path for these ops —
+    structure-preserving fast paths exist only where the result provably
+    keeps the sparse structure, e.g. add of matching row_sparse)."""
+    ld = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+    rd = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    return op(ld, rd)
+
+
 def add(lhs, rhs):
     return lhs + rhs
 
 
 def elemwise_add(lhs, rhs):
     return lhs + rhs
+
+
+def _map_values(sp, fn):
+    """Structure-preserving elementwise op on the stored values only."""
+    if isinstance(sp, RowSparseNDArray):
+        return RowSparseNDArray(sp._indices, fn(sp._values), sp.shape,
+                                ctx=sp._ctx)
+    return CSRNDArray(fn(sp._values), sp._indices, sp._indptr, sp.shape,
+                      ctx=sp._ctx)
+
+
+def subtract(lhs, rhs):
+    return _densify_binary(lhs, rhs, lambda a, b: a - b)
+
+
+def multiply(lhs, rhs):
+    if isinstance(lhs, BaseSparseNDArray) and np.isscalar(rhs):
+        return _map_values(lhs, lambda v: v * rhs)
+    return _densify_binary(lhs, rhs, lambda a, b: a * b)
+
+
+def divide(lhs, rhs):
+    if isinstance(lhs, BaseSparseNDArray) and np.isscalar(rhs):
+        # true division of the stored values: rhs=0 yields inf/nan like
+        # the dense path, never a host-side ZeroDivisionError
+        return _map_values(lhs, lambda v: v / rhs)
+    return _densify_binary(lhs, rhs, lambda a, b: a / b)
 
 
 def retain(data, indices):

@@ -103,5 +103,7 @@ from .rnn_cell import (BaseRNNCell, RNNCell, LSTMCell, GRUCell,  # noqa: E402,F4
                        FusedRNNCell, SequentialRNNCell, DropoutCell,
                        ModifierCell, ZoneoutCell, ResidualCell,
                        BidirectionalCell, RNNParams,
+                       BaseConvRNNCell, ConvRNNCell, ConvLSTMCell,
+                       ConvGRUCell,
                        save_rnn_checkpoint, load_rnn_checkpoint,
                        do_rnn_checkpoint, rnn_unroll)

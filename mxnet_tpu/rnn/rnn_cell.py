@@ -512,3 +512,138 @@ def rnn_unroll(cell, length, inputs=None, begin_state=None,
     return cell.unroll(length, inputs=inputs, begin_state=begin_state,
                        input_prefix=input_prefix, layout=layout,
                        batch_size=batch_size)
+
+
+# ---------------------------------------------------------------------------
+# Convolutional recurrent cells (parity: the legacy symbolic
+# rnn/rnn_cell.py BaseConvRNNCell/ConvRNNCell/ConvLSTMCell/ConvGRUCell —
+# gluon-side equivalents live in gluon.contrib.rnn). States are feature
+# maps; i2h/h2h are same-padded convolutions, so state spatial dims equal
+# the input's.
+# ---------------------------------------------------------------------------
+
+
+class BaseConvRNNCell(BaseRNNCell):
+    """Shared machinery: gate convolutions over NCHW feature maps.
+
+    input_shape: (C, H, W) of each timestep's input. Odd kernels only
+    (same padding keeps the recurrent state shape fixed, the invariant
+    every conv-RNN formulation assumes)."""
+
+    _num_gates = 1
+
+    def __init__(self, input_shape, num_hidden, h2h_kernel=(3, 3),
+                 i2h_kernel=(3, 3), activation="tanh", prefix="",
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        if any(k % 2 == 0 for k in tuple(h2h_kernel) + tuple(i2h_kernel)):
+            raise ValueError("conv RNN cells need odd kernels (same "
+                             "padding must preserve the state shape)")
+        self._input_shape = tuple(input_shape)
+        self._num_hidden = num_hidden
+        self._h2h_kernel = tuple(h2h_kernel)
+        self._i2h_kernel = tuple(i2h_kernel)
+        self._activation = activation
+        self._iW = self.params.get("i2h_weight")
+        self._hW = self.params.get("h2h_weight")
+        self._iB = self.params.get("i2h_bias", init="zeros")
+        self._hB = self.params.get("h2h_bias", init="zeros")
+
+    @property
+    def state_info(self):
+        shape = (0, self._num_hidden) + self._input_shape[1:]
+        return [{"shape": shape, "__layout__": "NCHW"}]
+
+    def _conv_gates(self, inputs, state, name):
+        nf = self._num_hidden * self._num_gates
+        i2h = S.Convolution(
+            inputs, weight=self._iW, bias=self._iB,
+            kernel=self._i2h_kernel,
+            pad=tuple(k // 2 for k in self._i2h_kernel),
+            num_filter=nf, name="%si2h" % name)
+        h2h = S.Convolution(
+            state, weight=self._hW, bias=self._hB,
+            kernel=self._h2h_kernel,
+            pad=tuple(k // 2 for k in self._h2h_kernel),
+            num_filter=nf, name="%sh2h" % name)
+        return i2h, h2h
+
+    def _act(self, x):
+        return S.Activation(x, act_type=self._activation)
+
+
+class ConvRNNCell(BaseConvRNNCell):
+    """h' = act(conv(x) + conv(h)) (parity: rnn_cell.py ConvRNNCell)."""
+
+    def __init__(self, input_shape, num_hidden, h2h_kernel=(3, 3),
+                 i2h_kernel=(3, 3), activation="tanh",
+                 prefix="convrnn_", params=None):
+        super().__init__(input_shape, num_hidden, h2h_kernel, i2h_kernel,
+                         activation, prefix=prefix, params=params)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h, h2h = self._conv_gates(inputs, states[0], name)
+        out = self._act(i2h + h2h)
+        return out, [out]
+
+
+class ConvLSTMCell(BaseConvRNNCell):
+    """Shi et al. ConvLSTM (parity: rnn_cell.py ConvLSTMCell); state is
+    (h, c), both feature maps."""
+
+    _num_gates = 4
+
+    def __init__(self, input_shape, num_hidden, h2h_kernel=(3, 3),
+                 i2h_kernel=(3, 3), activation="tanh",
+                 prefix="convlstm_", params=None, forget_bias=1.0):
+        super().__init__(input_shape, num_hidden, h2h_kernel, i2h_kernel,
+                         activation, prefix=prefix, params=params)
+        self._forget_bias = forget_bias
+
+    @property
+    def state_info(self):
+        one = super().state_info[0]
+        return [one, dict(one)]
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h, h2h = self._conv_gates(inputs, states[0], name)
+        sliced = S.SliceChannel(i2h + h2h, num_outputs=4,
+                                name="%sslice" % name)
+        in_gate = S.Activation(sliced[0], act_type="sigmoid")
+        forget_gate = S.Activation(sliced[1] + self._forget_bias,
+                                   act_type="sigmoid")
+        in_transform = self._act(sliced[2])
+        out_gate = S.Activation(sliced[3], act_type="sigmoid")
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * self._act(next_c)
+        return next_h, [next_h, next_c]
+
+
+class ConvGRUCell(BaseConvRNNCell):
+    """Convolutional GRU (parity: rnn_cell.py ConvGRUCell)."""
+
+    _num_gates = 3
+
+    def __init__(self, input_shape, num_hidden, h2h_kernel=(3, 3),
+                 i2h_kernel=(3, 3), activation="tanh",
+                 prefix="convgru_", params=None):
+        super().__init__(input_shape, num_hidden, h2h_kernel, i2h_kernel,
+                         activation, prefix=prefix, params=params)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h, h2h = self._conv_gates(inputs, states[0], name)
+        isl = S.SliceChannel(i2h, num_outputs=3, name="%sislice" % name)
+        hsl = S.SliceChannel(h2h, num_outputs=3, name="%shslice" % name)
+        i_r, i_z, i_n = isl[0], isl[1], isl[2]
+        h_r, h_z, h_n = hsl[0], hsl[1], hsl[2]
+        reset = S.Activation(i_r + h_r, act_type="sigmoid")
+        update = S.Activation(i_z + h_z, act_type="sigmoid")
+        cand = self._act(i_n + reset * h_n)
+        next_h = (1.0 - update) * cand + update * states[0]
+        return next_h, [next_h]
