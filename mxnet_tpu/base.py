@@ -6,6 +6,7 @@ pure-Python + JAX stack (no C ABI marshalling needed).
 """
 from __future__ import annotations
 
+import ctypes
 import os
 import numpy as np
 
@@ -70,3 +71,155 @@ class classproperty:
 
     def __get__(self, obj, owner):
         return self.f(owner)
+
+
+# ---------------------------------------------------------------------------
+# reference-API compatibility surface (parity: base.py) — exceptions, the
+# ctypes helpers reference-era extension code calls, and doc utilities.
+# There is no libmxnet C handle here, so the ctypes helpers are generic
+# array/buffer conversions.
+# ---------------------------------------------------------------------------
+
+
+class NotImplementedForSymbol(MXNetError):
+    """An NDArray-only API was called on a Symbol (parity: base.py)."""
+
+    def __init__(self, function, alias=None, *args):
+        super().__init__()
+        self.function = getattr(function, "__name__", str(function))
+        self.alias = alias
+        self.args_rep = str(args)
+
+    def __str__(self):
+        msg = "Function %s is not implemented for Symbol" % self.function
+        if self.alias:
+            msg += " (use %s instead)" % self.alias
+        return msg
+
+
+class NotSupportedForSparseNDArray(MXNetError):
+    """A dense-only API was called on a sparse ndarray (parity: base.py)."""
+
+    def __init__(self, function, alias=None, *args):
+        super().__init__()
+        self.function = getattr(function, "__name__", str(function))
+        self.alias = alias
+
+    def __str__(self):
+        msg = "Function %s is not supported for sparse ndarray" \
+            % self.function
+        if self.alias:
+            msg += " (use %s instead)" % self.alias
+        return msg
+
+
+class MXCallbackList(ctypes.Structure):
+    """C callback-list struct layout (parity: base.py MXCallbackList);
+    kept for source compatibility with reference extension code."""
+    _fields_ = [("num_callbacks", ctypes.c_int),
+                ("callbacks", ctypes.POINTER(ctypes.CFUNCTYPE(
+                    ctypes.c_int))),
+                ("contexts", ctypes.POINTER(ctypes.c_void_p))]
+
+
+def c_str(string):
+    return ctypes.c_char_p(string.encode("utf-8"))
+
+
+def c_str_array(strings):
+    return (ctypes.c_char_p * len(strings))(
+        *[s.encode("utf-8") for s in strings])
+
+
+def c_array(ctype, values):
+    """Create a ctypes array from a Python sequence (parity: base.py)."""
+    out = (ctype * len(values))()
+    out[:] = values
+    return out
+
+
+def c_array_buf(ctype, buf):
+    """Create a ctypes array from a buffer (parity: base.py)."""
+    return (ctype * len(buf)).from_buffer(buf)
+
+
+def c_handle_array(objs):
+    """Array of the objects' .handle fields (parity: base.py); handles
+    here are opaque void pointers (may be None for pure-Python objects)."""
+    arr = (ctypes.c_void_p * len(objs))()
+    arr[:] = [getattr(o, "handle", None) for o in objs]
+    return arr
+
+
+def ctypes2buffer(cptr, length):
+    """Copy a ctypes char pointer to a Python bytearray (parity)."""
+    if not isinstance(cptr, ctypes.POINTER(ctypes.c_char)):
+        raise TypeError("expected char pointer")
+    res = bytearray(length)
+    rptr = (ctypes.c_char * length).from_buffer(res)
+    if not ctypes.memmove(rptr, cptr, length):
+        raise RuntimeError("memmove failed")
+    return res
+
+
+def ctypes2numpy_shared(cptr, shape):
+    """View a ctypes float pointer as a shared numpy array (parity)."""
+    import numpy as _np
+    if not isinstance(cptr, ctypes.POINTER(ctypes.c_float)):
+        raise TypeError("expected float pointer")
+    size = 1
+    for s in shape:
+        size *= s
+    dbuffer = (ctypes.c_float * size).from_address(
+        ctypes.addressof(cptr.contents))
+    return _np.frombuffer(dbuffer, dtype=_np.float32).reshape(shape)
+
+
+def build_param_doc(arg_names, arg_types, arg_descs, remove_dup=True):
+    """Assemble a numpydoc Parameters section (parity: base.py)."""
+    param_keys = set()
+    lines = ["Parameters", "----------"]
+    for name, ptype, desc in zip(arg_names, arg_types, arg_descs):
+        if name in param_keys and remove_dup:
+            continue
+        if name == "num_args":
+            continue
+        param_keys.add(name)
+        lines.append("%s : %s" % (name, ptype))
+        if desc:
+            lines.append("    " + desc)
+    return "\n".join(lines)
+
+
+def add_fileline_to_docstring(module, incursive=True):
+    """Append 'From:file:line' to the docstrings of a module's functions
+    (parity: base.py; best-effort — objects without source stay as-is)."""
+    import inspect
+
+    def _add(obj):
+        try:
+            fname = inspect.getsourcefile(obj)
+            _, line = inspect.getsourcelines(obj)
+        except (TypeError, OSError):
+            return
+        if obj.__doc__ and "From:" not in obj.__doc__:
+            obj.__doc__ += "\n\nFrom:%s:%d" % (fname, line)
+
+    if isinstance(module, str):
+        import sys as _sys
+        module = _sys.modules[module]
+    for _, obj in module.__dict__.items():
+        if inspect.isfunction(obj) and obj.__module__ == module.__name__:
+            _add(obj)
+        elif inspect.isclass(obj) and incursive:
+            for _, m in obj.__dict__.items():
+                if inspect.isfunction(m):
+                    _add(m)
+
+
+def with_metaclass(meta, *bases):
+    """py2/3 metaclass shim the reference API exposed (parity: base.py)."""
+    class _Meta(meta):
+        def __new__(cls, name, this_bases, d):
+            return meta(name, bases, d)
+    return type.__new__(_Meta, "temporary_class", (), {})

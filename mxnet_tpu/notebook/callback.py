@@ -167,3 +167,82 @@ def args_wrapper(*callbacks):
         for hook, fn in cb.callback_args().items():
             merged[hook].append(fn)
     return dict(merged)
+
+
+class LiveBokehChart:
+    """Live-updating bokeh chart base (parity: notebook/callback.py
+    LiveBokehChart). Bokeh is an optional dependency (as in the
+    reference); without it construction raises ImportError —
+    LiveLearningCurve is the matplotlib-rendered equivalent this package
+    provides out of the box."""
+
+    def __init__(self, pandas_logger, metric_name, display_freq=10,
+                 batch_size=None, frequent=50):
+        try:
+            import bokeh.io  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "LiveBokehChart needs bokeh (not installed here); use "
+                "LiveLearningCurve for the matplotlib equivalent") from e
+        self.pandas_logger = pandas_logger or PandasLogger(
+            batch_size=batch_size, frequent=frequent)
+        self.metric_name = metric_name
+        self.display_freq = display_freq
+        self.last_update = time.time()
+
+    def setup_chart(self):
+        raise NotImplementedError()
+
+    def update_chart_data(self):
+        raise NotImplementedError()
+
+    def interval_elapsed(self):
+        return time.time() - self.last_update > self.display_freq
+
+    def _push_render(self):
+        import bokeh.io
+        bokeh.io.push_notebook(handle=self.handle)
+        self.last_update = time.time()
+
+    def train_cb(self, param):
+        self.pandas_logger.train_cb(param)
+        if self.interval_elapsed():
+            self.update_chart_data()
+
+    def eval_cb(self, param):
+        self.pandas_logger.eval_cb(param)
+        self.update_chart_data()
+
+    def epoch_cb(self, *args):
+        self.pandas_logger.epoch_cb(*args)
+
+    def callback_args(self):
+        return {"batch_end_callback": self.train_cb,
+                "eval_end_callback": self.eval_cb,
+                "epoch_end_callback": self.epoch_cb}
+
+
+class LiveTimeSeries(LiveBokehChart):
+    """Streaming time-series bokeh chart (parity: LiveTimeSeries)."""
+
+    def __init__(self, batch_size=None, **fig_params):
+        # base init wires pandas_logger/last_update/display_freq — the
+        # inherited fit callbacks need them (and it performs the bokeh
+        # availability check)
+        super().__init__(None, None, batch_size=batch_size)
+        import bokeh.io
+        import bokeh.plotting
+        self.fig = bokeh.plotting.figure(**fig_params)
+        self.start_time = time.time()
+        self.x_axis_val = []
+        self.y_axis_val = []
+        self.handle = bokeh.io.show(self.fig, notebook_handle=True)
+
+    def setup_chart(self):
+        return self.fig
+
+    def add_point(self, y_val):
+        self.x_axis_val.append(time.time() - self.start_time)
+        self.y_axis_val.append(y_val)
+        self.fig.line(self.x_axis_val, self.y_axis_val)
+        self._push_render()

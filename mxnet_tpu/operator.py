@@ -127,3 +127,131 @@ def invoke(op_type, *inputs, **params):
 def Custom(*inputs, op_type=None, **params):
     assert op_type is not None, "op_type is required"
     return invoke(op_type, *inputs, **params)
+
+
+# ---------------------------------------------------------------------------
+# deprecated pre-CustomOp interfaces (parity: operator.py PythonOp:42,
+# NumpyOp:150, NDArrayOp:253) — kept working as thin adapters onto the
+# CustomOp machinery so reference-era op code runs unchanged.
+# ---------------------------------------------------------------------------
+
+_DEPRECATED_SEQ = [0]
+
+
+class PythonOp:
+    """Deprecated base (parity: operator.py:42). Subclass NumpyOp or
+    NDArrayOp; call get_symbol(*sym_args, name=...) to build the node."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def get_symbol(self, *args, **kwargs):
+        raise NotImplementedError("use NumpyOp or NDArrayOp")
+
+    def forward(self, in_data, out_data):
+        raise NotImplementedError
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs())
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def _register_as_custom(self, shim_cls):
+        """Register a CustomOpProp delegating to this instance; one
+        registration per op instance (repeated get_symbol calls — common
+        in sweep loops — must not grow the global registry unboundedly)."""
+        cached = getattr(self, "_custom_reg_name", None)
+        if cached is not None:
+            return cached
+        op = self
+        _DEPRECATED_SEQ[0] += 1
+        reg_name = "_deprecated_pyop_%d" % _DEPRECATED_SEQ[0]
+
+        class _Prop(CustomOpProp):
+            def __init__(self):
+                super().__init__(need_top_grad=op.need_top_grad())
+
+            def list_arguments(self):
+                return op.list_arguments()
+
+            def list_outputs(self):
+                return op.list_outputs()
+
+            def infer_shape(self, in_shape):
+                ins, outs = op.infer_shape(in_shape)
+                return ins, outs, []
+
+            def create_operator(self, ctx, shapes, dtypes):
+                return shim_cls()
+
+        register(reg_name)(_Prop)
+        self._custom_reg_name = reg_name
+        return reg_name
+
+    def _build(self, shim_cls, args, kwargs):
+        import mxnet_tpu.symbol as S
+        reg_name = self._register_as_custom(shim_cls)
+        kwargs.pop("name", None)  # naming is cosmetic here
+        return S.Custom(*args, op_type=reg_name, **kwargs)
+
+
+class NumpyOp(PythonOp):
+    """Deprecated numpy-operand custom op (parity: operator.py:150):
+    forward/backward receive numpy arrays and write outputs in place."""
+
+    def get_symbol(self, *args, **kwargs):
+        op = self
+
+        class _Shim(CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                ins = [d.asnumpy() for d in in_data]
+                outs = [np.zeros(d.shape, dtype=np.float32)
+                        for d in out_data]
+                op.forward(in_data=ins, out_data=outs)
+                for i, (dst, src) in enumerate(zip(out_data, outs)):
+                    self.assign(dst, req[i], NDArray(src))
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                grads = [np.zeros(g.shape, dtype=np.float32)
+                         for g in in_grad]
+                op.backward(out_grad=[g.asnumpy() for g in out_grad],
+                            in_data=[d.asnumpy() for d in in_data],
+                            out_data=[d.asnumpy() for d in out_data],
+                            in_grad=grads)
+                for i, (dst, src) in enumerate(zip(in_grad, grads)):
+                    self.assign(dst, req[i], NDArray(src))
+
+        return self._build(_Shim, args, kwargs)
+
+
+class NDArrayOp(PythonOp):
+    """Deprecated NDArray-operand custom op (parity: operator.py:253):
+    forward/backward receive NDArrays and write outputs in place with
+    framework ops (e.g. ``out[:] = ...``)."""
+
+    def get_symbol(self, *args, **kwargs):
+        op = self
+
+        class _Shim(CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                op.forward(in_data=list(in_data), out_data=list(out_data))
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                op.backward(out_grad=list(out_grad),
+                            in_data=list(in_data),
+                            out_data=list(out_data),
+                            in_grad=list(in_grad))
+
+        return self._build(_Shim, args, kwargs)

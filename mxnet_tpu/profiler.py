@@ -186,3 +186,51 @@ if os.environ.get("MXNET_PROFILER_MODE"):
     _state["config"]["mode"] = os.environ["MXNET_PROFILER_MODE"]
 if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":
     set_state("run")
+
+
+class Event(scope):
+    """User-timed duration event (parity: profiler.py Event): start/stop
+    pairs (or `with`) record one entry under the 'event' category. Rides
+    the shared `scope` timing so the clock/format lives in one place."""
+
+    def __init__(self, name):
+        super().__init__(name, category="event")
+        self.name = name
+        self._started = False
+
+    def start(self):
+        self.__enter__()
+        self._started = True
+
+    def stop(self):
+        if self._started:
+            self.__exit__()
+            self._started = False
+
+
+class Marker:
+    """Instant marker (parity: profiler.py Marker.mark): a zero-duration
+    point in the trace, scoped 'process'/'thread'/'global'."""
+
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+
+    def mark(self, scope="process"):
+        record_event(self.name, self.domain.name,
+                     time.perf_counter_ns() // 1000, 0, {"scope": scope})
+
+
+def dump_profile():
+    """Deprecated alias (parity: profiler.py dump_profile -> dump)."""
+    dump(True)
+
+
+def profiler_set_config(**kwargs):
+    """Deprecated alias (parity: profiler_set_config -> set_config)."""
+    set_config(**kwargs)
+
+
+def profiler_set_state(state="stop"):
+    """Deprecated alias (parity: profiler_set_state -> set_state)."""
+    set_state(state)

@@ -55,3 +55,14 @@ class CudaModule:
             "CudaModule targets CUDA GPUs; on TPU write a Pallas kernel and "
             "wrap it with mxnet_tpu.rtc.PallasModule (see "
             "/opt/skills/guides/pallas_guide.md for the kernel playbook)")
+
+
+class CudaKernel:
+    """Parity placeholder (rtc.py CudaKernel — handles returned by
+    CudaModule.get_kernel). Unconstructible here for the same reason as
+    CudaModule: the TPU-native kernel path is Pallas (PallasModule)."""
+
+    def __init__(self, *args, **kwargs):
+        raise MXNetError(
+            "CudaKernel targets CUDA GPUs; on TPU write a Pallas kernel "
+            "and wrap it with mxnet_tpu.rtc.PallasModule")

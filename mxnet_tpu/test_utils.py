@@ -356,3 +356,488 @@ def make_synthetic_det_dataset(path, num_images=40, size=48, num_classes=2,
                     cv2.cvtColor(img, cv2.COLOR_RGB2BGR))
         imglist.append([[2.0, 5.0] + objs, fname])
     return imglist
+
+
+# ---------------------------------------------------------------------------
+# round-5 parity fills (reference test_utils.py helpers reference-era test
+# code imports): tolerance helpers, statistical generator checks, sparse
+# factories, small utilities, and the data fetchers (hermetic synthetic
+# fallbacks in this zero-egress environment).
+# ---------------------------------------------------------------------------
+
+_RTOLS = {np.dtype(np.float16): 1e-2, np.dtype(np.float32): 1e-4,
+          np.dtype(np.float64): 1e-5}
+_ATOLS = {np.dtype(np.float16): 1e-1, np.dtype(np.float32): 1e-3,
+          np.dtype(np.float64): 1e-20}
+
+
+def get_rtol(rtol=None, dtype=np.float32):
+    """Default relative tolerance per dtype (parity: test_utils.py)."""
+    if rtol is not None:
+        return rtol
+    return _RTOLS.get(np.dtype(dtype), 1e-4)
+
+
+def get_atol(atol=None, dtype=np.float32):
+    if atol is not None:
+        return atol
+    return _ATOLS.get(np.dtype(dtype), 1e-3)
+
+
+def _to_np(a):
+    return a.asnumpy() if hasattr(a, "asnumpy") else np.asarray(a)
+
+
+def almost_equal_ignore_nan(a, b, rtol=None, atol=None):
+    """Elementwise closeness ignoring positions where EITHER side is NaN
+    (parity: test_utils.py)."""
+    a, b = _to_np(a).copy(), _to_np(b).copy()
+    nan_mask = np.logical_or(np.isnan(a), np.isnan(b))
+    a[nan_mask] = 0
+    b[nan_mask] = 0
+    return np.allclose(a, b, rtol=get_rtol(rtol, a.dtype),
+                       atol=get_atol(atol, a.dtype))
+
+
+def assert_almost_equal_ignore_nan(a, b, rtol=None, atol=None, names=()):
+    if not almost_equal_ignore_nan(a, b, rtol, atol):
+        raise AssertionError(
+            "arrays differ beyond tolerance (NaNs ignored)%s"
+            % (": %s" % (names,) if names else ""))
+
+
+def assert_exception(f, exception_type, *args, **kwargs):
+    """f(*args) must raise exception_type (parity: test_utils.py)."""
+    try:
+        f(*args, **kwargs)
+    except exception_type:
+        return
+    raise AssertionError("did not raise %s" % exception_type.__name__)
+
+
+def same_array(array1, array2):
+    """True when two NDArrays share storage — probed behaviorally, as the
+    reference does: bump one and see the other move (parity)."""
+    array1[:] = array1 + 1
+    if not np.array_equal(_to_np(array1), _to_np(array2)):
+        array1[:] = array1 - 1
+        return False
+    array1[:] = array1 - 1
+    return np.array_equal(_to_np(array1), _to_np(array2))
+
+
+def assign_each(input_arr, function):
+    """Apply a scalar function elementwise on host (parity)."""
+    out = np.vectorize(function)(_to_np(input_arr))
+    return nd.array(out)
+
+
+def assign_each2(input1, input2, function):
+    out = np.vectorize(function)(_to_np(input1), _to_np(input2))
+    return nd.array(out)
+
+
+def discard_stderr():
+    """Context manager silencing C-level stderr (parity: the reference
+    uses it around deliberately-noisy calls)."""
+    import contextlib
+    import sys
+
+    @contextlib.contextmanager
+    def _ctx():
+        with open(os.devnull, "w") as devnull:
+            old = os.dup(2)
+            os.dup2(devnull.fileno(), 2)
+            try:
+                yield
+            finally:
+                sys.stderr.flush()
+                os.dup2(old, 2)
+                os.close(old)
+    return _ctx()
+
+
+def retry(n):
+    """Decorator retrying a flaky test up to n times (parity)."""
+    if n <= 0:
+        raise ValueError("n must be positive")
+
+    def decorate(f):
+        import functools
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            for i in range(n):
+                try:
+                    return f(*args, **kwargs)
+                except AssertionError:
+                    if i == n - 1:
+                        raise
+        return wrapper
+    return decorate
+
+
+def random_arrays(*shapes):
+    """Random float32 arrays; scalar shape () gives a python float
+    (parity)."""
+    arrays = [np.array(np.random.randn(), dtype=np.float32) if not s
+              else np.random.randn(*s).astype(np.float32) for s in shapes]
+    return arrays[0] if len(arrays) == 1 else arrays
+
+
+def random_sample(population, k):
+    """Sample WITHOUT replacement, order preserved (parity)."""
+    import random as _random
+    assert k <= len(population)
+    picks = sorted(_random.sample(range(len(population)), k))
+    return [population[i] for i in picks]
+
+
+def shuffle_csr_column_indices(csr):
+    """Shuffle each row's column indices in place-order (parity: makes
+    unsorted-column csr fixtures)."""
+    indices = np.asarray(csr._indices).copy()
+    indptr = np.asarray(csr._indptr)
+    for i in range(len(indptr) - 1):
+        seg = indices[indptr[i]:indptr[i + 1]]
+        np.random.shuffle(seg)
+        indices[indptr[i]:indptr[i + 1]] = seg
+    import jax.numpy as jnp
+    return CSRNDArray(csr._values, jnp.asarray(indices), csr._indptr,
+                      csr.shape)
+
+
+def create_sparse_array(shape, stype, data_init=None, rsp_indices=None,
+                        dtype=None, modifier_func=None, density=0.5,
+                        shuffle_csr_indices=False):
+    """Random sparse ndarray factory (parity: test_utils.py).
+    rsp_indices pins WHICH rows of a row_sparse array are populated."""
+    if rsp_indices is not None:
+        if stype != "row_sparse":
+            raise ValueError("rsp_indices only applies to row_sparse")
+        import jax.numpy as jnp
+        idx = np.sort(np.asarray(rsp_indices).astype(np.int32))
+        vals = np.random.randn(len(idx), *shape[1:]).astype(
+            np.dtype(dtype) if dtype else np.float32)
+        arr = RowSparseNDArray(jnp.asarray(idx), jnp.asarray(vals), shape)
+    else:
+        arr = rand_ndarray(shape, stype=stype, density=density,
+                           dtype=dtype)
+    if data_init is not None:
+        d = _to_np(arr)
+        d[d != 0] = data_init
+        arr = nd.array(d).tostype(stype)
+    if modifier_func is not None:
+        d = np.vectorize(modifier_func)(_to_np(arr))
+        arr = nd.array(d).tostype(stype)
+    if stype == "csr" and shuffle_csr_indices:
+        arr = shuffle_csr_column_indices(arr)
+    return arr
+
+
+def create_sparse_array_zd(shape, stype, density, data_init=None,
+                           rsp_indices=None, dtype=None,
+                           modifier_func=None, shuffle_csr_indices=False):
+    """Sparse factory permitting all-zero (zero-density) arrays
+    (parity)."""
+    if density == 0:
+        from .ndarray import sparse as _sp
+        return _sp.zeros(stype, shape, dtype=dtype)
+    return create_sparse_array(shape, stype, data_init=data_init,
+                               rsp_indices=rsp_indices, dtype=dtype,
+                               modifier_func=modifier_func,
+                               density=density,
+                               shuffle_csr_indices=shuffle_csr_indices)
+
+
+class DummyIter(object):
+    """Infinitely repeat one real batch (parity: test_utils.py DummyIter
+    — benchmarking iterator that removes IO from the measurement)."""
+
+    def __init__(self, real_iter):
+        self.real_iter = real_iter
+        self.provide_data = real_iter.provide_data
+        self.provide_label = real_iter.provide_label
+        self.batch_size = real_iter.batch_size
+        self.the_batch = next(iter(real_iter))
+
+    def __iter__(self):
+        return self
+
+    def next(self):
+        return self.the_batch
+
+    __next__ = next
+
+    def reset(self):
+        """No-op: the loop's end-of-epoch reset must not crash (the
+        reference inherits this from DataIter)."""
+
+
+def check_speed(sym, location=None, ctx=None, N=20, grad_req=None,
+                typ="whole"):
+    """Wall-clock one executor forward(+backward) (parity). typ='whole'
+    times forward+backward, 'forward' only the forward pass."""
+    import time as _time
+    ctx = ctx or cpu()
+    if grad_req is None:
+        grad_req = "write"
+    if location is None:
+        arg_shapes, _, _ = sym.infer_shape()
+        location = {name: np.random.normal(size=shape, scale=1.0)
+                    for name, shape in zip(sym.list_arguments(),
+                                           arg_shapes)}
+    exe = sym.simple_bind(ctx, grad_req=grad_req,
+                          **{k: v.shape for k, v in location.items()})
+    for name, value in location.items():
+        if name in exe.arg_dict:
+            exe.arg_dict[name][:] = nd.array(value)
+    if typ == "whole":
+        def run():
+            exe.forward(is_train=True)
+            exe.backward(out_grads=exe.outputs)
+            for o in exe.outputs:
+                o.wait_to_read()
+    elif typ == "forward":
+        def run():
+            exe.forward(is_train=False)
+            for o in exe.outputs:
+                o.wait_to_read()
+    else:
+        raise ValueError("typ can only be whole or forward")
+    run()  # warmup/compile
+    tic = _time.time()
+    for _ in range(N):
+        run()
+    nd.waitall()
+    return (_time.time() - tic) / N
+
+
+def gen_buckets_probs_with_ppf(ppf, nbuckets):
+    """Equiprobable buckets from a quantile function (parity)."""
+    probs = [1.0 / nbuckets] * nbuckets
+    buckets = [(ppf(i / float(nbuckets)), ppf((i + 1) / float(nbuckets)))
+               for i in range(nbuckets)]
+    return buckets, probs
+
+
+def mean_check(generator, mu, sigma, nsamples=1000000):
+    """Sample mean within mu +- 3 sigma/sqrt(n) (parity)."""
+    samples = np.array(generator(nsamples))
+    sample_mean = samples.mean()
+    return (mu - 3 * sigma / np.sqrt(nsamples) < sample_mean <
+            mu + 3 * sigma / np.sqrt(nsamples))
+
+
+def var_check(generator, sigma, nsamples=1000000):
+    """Sample variance within the 3-sigma band of its own sampling
+    distribution (parity)."""
+    samples = np.array(generator(nsamples))
+    sample_var = samples.var(ddof=1)
+    band = 3 * np.sqrt(2 * sigma ** 4 / (nsamples - 1))
+    return sigma ** 2 - band < sample_var < sigma ** 2 + band
+
+
+def chi_square_check(generator, buckets, probs, nsamples=1000000):
+    """Chi-square goodness-of-fit of generator samples against bucket
+    probabilities; continuous buckets are (lo, hi) tuples, discrete
+    buckets are the category values (parity). Returns (p, obs_freq,
+    expected_freq)."""
+    from scipy import stats as _stats
+    if not buckets:
+        raise ValueError("buckets must be nonempty")
+    expected = np.array(probs) * nsamples
+    samples = np.asarray(generator(nsamples))
+    if isinstance(buckets[0], (list, tuple)):
+        edges = [b[0] for b in buckets] + [buckets[-1][1]]
+        obs, _ = np.histogram(samples, bins=np.array(edges))
+    else:
+        mapping = {v: i for i, v in enumerate(buckets)}
+        obs = np.zeros(len(buckets))
+        for v, c in zip(*np.unique(samples, return_counts=True)):
+            if v in mapping:
+                obs[mapping[v]] = c
+    # samples outside the bucket edges drop out of obs; rescale the
+    # expected counts to the observed total so scipy's sum check holds
+    if obs.sum() == 0:
+        raise AssertionError(
+            "chi_square_check: no sample landed in any bucket — the "
+            "generator's support does not overlap the bucket range "
+            "(sample range [%g, %g])" % (samples.min(), samples.max()))
+    expected = expected * (obs.sum() / expected.sum())
+    _, p = _stats.chisquare(f_obs=obs, f_exp=expected)
+    return p, obs, expected
+
+
+def verify_generator(generator, buckets, probs, nsamples=1000000,
+                     nrepeat=5, success_rate=0.15, alpha=0.05):
+    """Repeat the chi-square test; the fraction of runs with p > alpha
+    must reach success_rate (parity). Returns the p-value list."""
+    cs_ret_l = []
+    for _ in range(nrepeat):
+        p, _, _ = chi_square_check(generator, buckets, probs, nsamples)
+        cs_ret_l.append(p)
+    success = np.mean(np.array(cs_ret_l) > alpha)
+    if success < success_rate:
+        raise AssertionError(
+            "generator failed chi-square: success rate %.2f < %.2f "
+            "(p-values %s)" % (success, success_rate, cs_ret_l))
+    return cs_ret_l
+
+
+def numeric_grad(executor, location, aux_states=None, eps=1e-4,
+                 use_forward_train=True, dtype=np.float32):
+    """Finite-difference gradients of an executor's scalar-summed output
+    w.r.t. every argument (parity; the symbolic-level helper is
+    check_numeric_gradient)."""
+    for k, v in location.items():
+        if k in executor.arg_dict:
+            executor.arg_dict[k][:] = nd.array(v)
+    approx_grads = {k: np.zeros(v.shape, dtype=dtype)
+                    for k, v in location.items()}
+    for k, v in location.items():
+        if k not in executor.arg_dict:
+            continue
+        old_value = np.array(v, dtype=dtype).copy()
+        flat = old_value.reshape(-1)
+        grad_flat = approx_grads[k].reshape(-1)
+        for i in range(flat.size):
+            flat[i] += eps / 2.0
+            executor.arg_dict[k][:] = nd.array(old_value)
+            executor.forward(is_train=use_forward_train)
+            f_eps = sum(float(o.asnumpy().sum()) for o in executor.outputs)
+            flat[i] -= eps
+            executor.arg_dict[k][:] = nd.array(old_value)
+            executor.forward(is_train=use_forward_train)
+            f_neps = sum(float(o.asnumpy().sum())
+                         for o in executor.outputs)
+            grad_flat[i] = (f_eps - f_neps) / eps
+            flat[i] += eps / 2.0
+        executor.arg_dict[k][:] = nd.array(old_value)
+    return approx_grads
+
+
+# ---- data fetchers (hermetic synthetic fallbacks: zero-egress env) ------
+
+
+def download(url, fname=None, dirname=None, overwrite=False):
+    """Fetch a URL to a file (parity: test_utils.py download). In this
+    zero-egress environment real fetches fail; the function exists for
+    API compatibility and for images/networks that do have egress."""
+    import urllib.request
+    fname = fname or url.split("/")[-1]
+    if dirname is not None:
+        os.makedirs(dirname, exist_ok=True)
+        fname = os.path.join(dirname, fname)
+    if os.path.exists(fname) and not overwrite:
+        return fname
+    urllib.request.urlretrieve(url, fname)
+    return fname
+
+
+def get_mnist_pkl(data_dir="data"):
+    """mnist.pkl.gz in the reference layout, generated from the synthetic
+    MNIST (hermetic parity: the reference downloads it)."""
+    import gzip
+    import pickle
+    os.makedirs(data_dir, exist_ok=True)
+    path = os.path.join(data_dir, "mnist.pkl.gz")
+    if os.path.exists(path):
+        return path
+    m = get_mnist()
+    flat = m["train_data"].reshape(len(m["train_data"]), -1)
+    tflat = m["test_data"].reshape(len(m["test_data"]), -1)
+    n_val = len(tflat)
+    splits = ((flat, m["train_label"]), (tflat, m["test_label"]),
+              (tflat[:n_val], m["test_label"][:n_val]))
+    with gzip.open(path, "wb") as f:
+        pickle.dump(splits, f)
+    return path
+
+
+def get_mnist_ubyte(data_dir="data"):
+    """idx-ubyte MNIST files in the reference layout, generated from the
+    synthetic MNIST (hermetic parity)."""
+    import struct
+    os.makedirs(data_dir, exist_ok=True)
+    m = get_mnist()
+
+    def write_images(path, arr):
+        arr = (arr * 255).astype(np.uint8).reshape(len(arr), 28, 28)
+        with open(path, "wb") as f:
+            f.write(struct.pack(">IIII", 2051, len(arr), 28, 28))
+            f.write(arr.tobytes())
+
+    def write_labels(path, lab):
+        with open(path, "wb") as f:
+            f.write(struct.pack(">II", 2049, len(lab)))
+            f.write(lab.astype(np.uint8).tobytes())
+
+    names = {"train-images-idx3-ubyte": ("train_data", write_images),
+             "train-labels-idx1-ubyte": ("train_label", write_labels),
+             "t10k-images-idx3-ubyte": ("test_data", write_images),
+             "t10k-labels-idx1-ubyte": ("test_label", write_labels)}
+    for name, (key, writer) in names.items():
+        path = os.path.join(data_dir, name)
+        if not os.path.exists(path):
+            writer(path, m[key])
+    return data_dir
+
+
+def get_cifar10(data_dir="data"):
+    """cifar/train.rec + test.rec in the reference layout, packed from
+    synthetic 32x32 images (hermetic parity)."""
+    from . import recordio
+    import io as _pyio
+    from PIL import Image
+    cifar = os.path.join(data_dir, "cifar")
+    os.makedirs(cifar, exist_ok=True)
+    rng = np.random.RandomState(10)
+    for split, n in (("train.rec", 500), ("test.rec", 100)):
+        path = os.path.join(cifar, split)
+        if os.path.exists(path):
+            continue
+        w = recordio.MXRecordIO(path, "w")
+        for i in range(n):
+            img = rng.randint(0, 255, (32, 32, 3)).astype(np.uint8)
+            buf = _pyio.BytesIO()
+            Image.fromarray(img).save(buf, format="JPEG", quality=90)
+            w.write(recordio.pack(
+                recordio.IRHeader(0, float(i % 10), i, 0), buf.getvalue()))
+        w.close()
+    return cifar
+
+
+def get_im2rec_path(home_env="MXNET_HOME"):
+    """Path of the im2rec tool (parity: finds the in-tree script)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(os.path.dirname(here), "tools", "im2rec.py")
+    if os.path.isfile(path):
+        return path
+    raise IOError("tools/im2rec.py not found from %s" % here)
+
+
+def get_bz2_data(data_dir, data_name, url, data_origin_name):
+    """download + bunzip2 (parity); hermetic envs should ship the file."""
+    import bz2
+    os.makedirs(data_dir, exist_ok=True)
+    out = os.path.join(data_dir, data_name)
+    if os.path.exists(out):
+        return out
+    archive = download(url, fname=os.path.join(data_dir, data_origin_name))
+    with bz2.BZ2File(archive) as fi, open(out, "wb") as fo:
+        fo.write(fi.read())
+    os.remove(archive)
+    return out
+
+
+def get_zip_data(data_dir, url, data_origin_name):
+    """download + unzip (parity); hermetic envs should ship the file."""
+    import zipfile
+    os.makedirs(data_dir, exist_ok=True)
+    archive = os.path.join(data_dir, data_origin_name)
+    if not os.path.exists(archive):
+        download(url, fname=archive)
+    with zipfile.ZipFile(archive) as z:
+        z.extractall(data_dir)
+    return data_dir

@@ -400,3 +400,97 @@ def test_symbolic_conv_rnn_cells():
     # odd-kernel invariant is enforced
     with pytest.raises(ValueError):
         mx.rnn.ConvRNNCell((C, H, W), 4, h2h_kernel=(2, 2))
+
+
+def test_parity_fills_profiler_base_operator_testutils(tmp_path):
+    """Round-5 tail fills: profiler Event/Marker/deprecated aliases, base
+    ctypes/doc helpers, deprecated NumpyOp/NDArrayOp adapters, and the
+    test_utils helper battery."""
+    import ctypes
+    import mxnet_tpu as mx
+    from mxnet_tpu import base, profiler, test_utils as tu
+
+    # profiler: Event context + Marker + deprecated aliases
+    profiler.set_state("run")
+    with profiler.Event("unit_evt"):
+        pass
+    profiler.Marker(profiler.Domain("unit"), "m").mark()
+    profiler.profiler_set_state("stop")
+    assert "unit_evt" in profiler.dumps()
+
+    # base: ctypes helpers round-trip
+    arr = base.c_array(ctypes.c_int, [1, 2, 3])
+    assert list(arr) == [1, 2, 3]
+    import array as _array
+    assert list(base.c_array_buf(ctypes.c_int,
+                                 _array.array("i", [1, 2]))) == [1, 2]
+    f = (ctypes.c_float * 4)(1, 2, 3, 4)
+    shared = base.ctypes2numpy_shared(
+        ctypes.cast(f, ctypes.POINTER(ctypes.c_float)), (2, 2))
+    np.testing.assert_allclose(shared, [[1, 2], [3, 4]])
+    doc = base.build_param_doc(["a"], ["int"], ["the a"])
+    assert "a : int" in doc and "the a" in doc
+    with pytest.raises(base.MXNetError):
+        raise base.NotImplementedForSymbol(len, "nd_len")
+
+    # deprecated NumpyOp: a square op trains through a symbol graph
+    import mxnet_tpu.symbol as S
+    import mxnet_tpu.operator as op_mod
+
+    class SquareOp(op_mod.NumpyOp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def forward(self, in_data, out_data):
+            out_data[0][:] = in_data[0] ** 2
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            in_grad[0][:] = 2 * in_data[0] * out_grad[0]
+
+    sq = SquareOp().get_symbol(S.Variable("data"))
+    exe = sq.simple_bind(mx.cpu(), data=(2, 3), grad_req="write")
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    out = exe.forward(is_train=True, data=mx.nd.array(x))[0].asnumpy()
+    np.testing.assert_allclose(out, x ** 2, rtol=1e-6)
+    exe.backward(out_grads=[mx.nd.ones((2, 3))])
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(), 2 * x,
+                               rtol=1e-6)
+
+    # test_utils battery
+    assert tu.get_rtol(None, np.float16) == 1e-2
+    assert tu.almost_equal_ignore_nan([1.0, np.nan], [1.0, 5.0])
+    tu.assert_exception(lambda: 1 / 0, ZeroDivisionError)
+    a = mx.nd.ones((2,))
+    assert tu.same_array(a, a) and not tu.same_array(a, mx.nd.ones((2,)))
+    np.testing.assert_allclose(
+        tu.assign_each([1.0, 2.0], lambda v: v + 1).asnumpy(), [2, 3])
+    picks = tu.random_sample(list(range(10)), 4)
+    assert len(picks) == 4 and picks == sorted(picks)
+    sp = tu.create_sparse_array((4, 6), "csr", density=0.5)
+    assert sp.asnumpy().shape == (4, 6)
+    assert tu.create_sparse_array_zd((4, 6), "csr", 0).asnumpy().sum() == 0
+    # statistical checks on a known-good generator
+    rng = np.random.RandomState(0)
+    assert tu.mean_check(lambda n: rng.normal(0, 1, n), 0, 1,
+                         nsamples=200000)
+    assert tu.var_check(lambda n: rng.normal(0, 1, n), 1, nsamples=200000)
+    buckets, probs = tu.gen_buckets_probs_with_ppf(
+        lambda q: float(np.clip(2 * q - 1, -0.9999, 0.9999)), 4)
+    p, obs, exp = tu.chi_square_check(
+        lambda n: rng.uniform(-1, 1, n), buckets, probs, nsamples=50000)
+    # edges are clipped to +-0.9999, so a handful of samples fall outside
+    assert p > 1e-6 and 49000 < obs.sum() <= 50000
+    tu.verify_generator(lambda n: rng.uniform(-1, 1, n), buckets, probs,
+                        nsamples=50000, nrepeat=2)
+    # hermetic data fetchers produce the reference file layouts
+    d = str(tmp_path)
+    assert os.path.exists(os.path.join(tu.get_mnist_ubyte(d),
+                                       "train-images-idx3-ubyte"))
+    assert os.path.basename(tu.get_im2rec_path()) == "im2rec.py"
+    cif = tu.get_cifar10(d)
+    assert os.path.exists(os.path.join(cif, "train.rec"))
+    # DummyIter repeats one batch forever
+    it = mx.io.NDArrayIter(np.zeros((8, 4)), np.zeros(8), batch_size=4)
+    dummy = tu.DummyIter(it)
+    b1, b2 = next(dummy), next(dummy)
+    assert b1 is b2
