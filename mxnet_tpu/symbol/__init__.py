@@ -21,7 +21,7 @@ import numpy as np
 
 from ..ops import registry as _registry
 from ..ops.nn import rnn_param_size
-from ..base import MXNetError, dtype_np
+from ..base import MXNetError, NotImplementedForSymbol, dtype_np
 from .. import name as _name_mod
 from .. import attribute as _attr_mod
 
@@ -202,6 +202,52 @@ class Symbol:
     def _set_attr(self, **kwargs):
         node, _ = self._outputs[0]
         node.attr.update({k: str(v) for k, v in kwargs.items()})
+
+    def list_attr(self, recursive=False):
+        """This symbol's own attributes (parity: symbol.py list_attr;
+        recursive=True was removed in the reference too — use
+        attr_dict())."""
+        if recursive:
+            raise DeprecationWarning(
+                "list_attr(recursive=True) is deprecated; use attr_dict()")
+        node, _ = self._outputs[0]
+        return dict(node.attr)
+
+    def astype(self, dtype):
+        """Fluent cast (parity: symbol.py astype -> Cast)."""
+        return create("Cast", self, dtype=dtype_np(dtype).name)
+
+    def gradient(self, wrt):
+        """The reference's pre-autograd symbolic differentiation entry
+        point; disposition here: bind and use Executor.backward (or
+        autograd on the imperative path) — XLA computes gradients at
+        compile time from the same graph."""
+        raise MXNetError(
+            "Symbol.gradient is the deprecated pre-autograd API; bind() "
+            "the symbol and call backward(), or use mx.autograd")
+
+    # NDArray-only APIs raise with the standard exception so duck-typed
+    # code fails the same way it does on the reference (symbol.py:2381+)
+    def wait_to_read(self):
+        raise NotImplementedForSymbol(self.wait_to_read, None)
+
+    def asnumpy(self):
+        raise NotImplementedForSymbol(self.asnumpy, None)
+
+    def asscalar(self):
+        raise NotImplementedForSymbol(self.asscalar, None)
+
+    def copy(self):
+        raise NotImplementedForSymbol(self.copy, None)
+
+    def as_in_context(self):
+        raise NotImplementedForSymbol(self.as_in_context, None)
+
+    def detach(self):
+        raise NotImplementedForSymbol(self.detach, None)
+
+    def backward(self):
+        raise NotImplementedForSymbol(self.backward, None)
 
     # -- arithmetic ---------------------------------------------------------
     def _binary(self, other, op, scalar_op, swap=False):

@@ -494,3 +494,25 @@ def test_parity_fills_profiler_base_operator_testutils(tmp_path):
     dummy = tu.DummyIter(it)
     b1, b2 = next(dummy), next(dummy)
     assert b1 is b2
+
+
+def test_symbol_ndarray_only_methods_raise_and_fluent_astype():
+    """Symbol parity for the NDArray-mirror surface (reference
+    symbol.py:1789,2381+): astype is a fluent Cast, list_attr returns the
+    node's own attrs, and NDArray-only calls raise
+    NotImplementedForSymbol (duck-typed code must fail identically)."""
+    import mxnet_tpu as mx
+    import mxnet_tpu.symbol as S
+    from mxnet_tpu import base
+
+    v = S.Variable("v", attr={"grp": "7"})
+    assert v.list_attr() == {"grp": "7"}
+    exe = v.astype("float16").bind(mx.cpu(), {"v": mx.nd.array([1.5])},
+                                   grad_req="null")
+    assert str(exe.forward()[0].dtype) == "float16"
+    for m in ("asnumpy", "asscalar", "wait_to_read", "copy",
+              "as_in_context", "detach", "backward"):
+        with pytest.raises(base.NotImplementedForSymbol):
+            getattr(v, m)()
+    with pytest.raises(base.MXNetError):
+        v.gradient(["v"])
