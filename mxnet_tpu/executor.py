@@ -117,6 +117,33 @@ class Executor:
     def set_monitor_callback(self, callback, monitor_all=False):
         self._monitor_callback = callback
 
+    def debug_str(self):
+        """Human-readable program dump (parity: executor.py debug_str —
+        there it printed the graph + memory plan; here the honest
+        equivalent is the symbol's node list plus the traced jaxpr of the
+        compiled forward, which shows exactly what XLA receives)."""
+        lines = ["Symbol outputs: %s" % ", ".join(
+            self._symbol.list_outputs())]
+        for node in self._symbol._topo():
+            if node.op is None:
+                lines.append("  var %s%s" % (node.name,
+                                             " (aux)" if node.is_aux
+                                             else ""))
+            else:
+                lines.append("  %s %s(%s)" % (
+                    node.name, node.op.name,
+                    ", ".join(n.name for n, _ in node.inputs)))
+        try:
+            values = {n: a._data for n, a in self.arg_dict.items()}
+            aux = {n: a._data for n, a in self.aux_dict.items()}
+            jaxpr = jax.make_jaxpr(
+                lambda v, a, k: self._fwd(v, a, k, train=False))(
+                values, aux, _random.next_key())
+            lines.append("\nForward jaxpr:\n%s" % jaxpr)
+        except Exception as e:  # static dump must never fail
+            lines.append("\n(jaxpr unavailable: %s)" % e)
+        return "\n".join(lines)
+
     def forward(self, is_train=False, **kwargs):
         for k, v in kwargs.items():
             if k in self.arg_dict:

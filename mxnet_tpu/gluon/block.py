@@ -246,6 +246,23 @@ class HybridBlock(Block):
         """Finish deferred parameter init by probing with the given inputs."""
         self._deferred_infer(args)
 
+    def infer_type(self, *args):
+        """Infer parameter dtypes from the inputs (parity: block.py
+        infer_type). Dtype follows the probe inputs: run the deferred
+        probe, then cast parameters whose dtype disagrees with the
+        input's floating dtype."""
+        self._deferred_infer(args)
+        in_dtypes = {a.dtype for a in args
+                     if hasattr(a, "dtype") and
+                     np.issubdtype(np.dtype(a.dtype), np.floating)}
+        if len(in_dtypes) == 1:
+            want = next(iter(in_dtypes))
+            for p in self.collect_params().values():
+                if p._data is not None and \
+                        np.issubdtype(np.dtype(p.dtype), np.floating) and \
+                        np.dtype(p.dtype) != np.dtype(want):
+                    p.cast(want)
+
     def _deferred_infer(self, args):
         # run one abstract forward with eval_shape to trigger deferred inits
         try:

@@ -74,6 +74,14 @@ class BaseModule:
         self.forward(data_batch, is_train=True)
         self.backward()
 
+    def prepare(self, data_batch, sparse_row_id_fn=None):
+        """Pre-batch hook (parity: base_module.py prepare). The reference
+        used it to row_sparse-pull the rows a batch touches from the
+        kvstore before forward; here parameters live device-resident and
+        sparse gradients flow through the kvstore at update time, so
+        there is nothing to prefetch — the hook exists for API
+        compatibility and subclass extension."""
+
     def _require_ready(self):
         if not (self.binded and self.params_initialized):
             raise MXNetError("module is not ready: call bind() and "
@@ -180,6 +188,7 @@ class BaseModule:
             for nbatch, batch in enumerate(train_data):
                 if monitor is not None:
                     monitor.tic()
+                self.prepare(batch, sparse_row_id_fn=sparse_row_id_fn)
                 self.forward_backward(batch)
                 self.update()
                 self.update_metric(eval_metric, batch.label)

@@ -197,3 +197,28 @@ class BucketingModule(BaseModule):
     def install_monitor(self, mon):
         for mod in self._buckets.values():
             mod.install_monitor(mon)
+
+    def get_states(self, merge_multi_context=True):
+        """States of the current bucket's module (parity:
+        bucketing_module.py get_states)."""
+        assert self._curr_module is not None, "bind and forward first"
+        return self._curr_module.get_states(
+            merge_multi_context=merge_multi_context)
+
+    def set_states(self, states=None, value=None):
+        """Set states on the current bucket's module (parity:
+        bucketing_module.py set_states)."""
+        assert self._curr_module is not None, "bind and forward first"
+        self._curr_module.set_states(states=states, value=value)
+
+    def prepare(self, data_batch, sparse_row_id_fn=None):
+        """Ensure the batch's bucket executor exists, then RESTORE the
+        current bucket (parity: bucketing_module.py prepare — the
+        reference switches back so outputs of the in-flight bucket stay
+        readable; forward() performs the real switch)."""
+        original = self._curr_bucket_key
+        self.switch_bucket(data_batch.bucket_key,
+                           data_batch.provide_data,
+                           data_batch.provide_label)
+        if original is not None and original != data_batch.bucket_key:
+            self.switch_bucket(original, None, None)

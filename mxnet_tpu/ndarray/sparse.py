@@ -174,6 +174,33 @@ class CSRNDArray(BaseSparseNDArray):
             return CSRNDArray.from_dense(dense)
         raise NotImplementedError("csr indexing supports row slices")
 
+    def asscipy(self):
+        """This matrix as scipy.sparse.csr_matrix (parity: sparse.py
+        asscipy — zero-copy there, a host copy here)."""
+        import scipy.sparse as sps
+        return sps.csr_matrix(
+            (np.asarray(self._values), np.asarray(self._indices),
+             np.asarray(self._indptr)), shape=self._shape)
+
+    def copyto(self, other):
+        """Copy into `other` (parity: sparse.py copyto): a Context makes
+        a new csr there; a dense NDArray receives the densified values;
+        a CSRNDArray takes this matrix's buffers."""
+        from ..context import Context
+        if isinstance(other, CSRNDArray):
+            other._values = self._values
+            other._indices = self._indices
+            other._indptr = self._indptr
+            other._shape = self._shape
+            other._dtype = self._dtype
+            return other
+        if isinstance(other, NDArray):
+            return self.todense().copyto(other)
+        if isinstance(other, Context):
+            return CSRNDArray(self._values, self._indices, self._indptr,
+                              self._shape, ctx=other)
+        raise TypeError(type(other))
+
 
 # -- constructors (parity: mxnet.nd.sparse.row_sparse_array / csr_matrix) ---
 

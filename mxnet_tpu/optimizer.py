@@ -93,11 +93,22 @@ class Optimizer:
             self.update(index, weight, grad, state)
 
     # -- lr / wd bookkeeping (parity: optimizer.py Optimizer base) ---------
+    @property
+    def learning_rate(self):
+        """Current lr, scheduler-aware (parity: Optimizer.learning_rate)."""
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
     def set_learning_rate(self, lr):
         if self.lr_scheduler is not None:
             raise UserWarning("LRScheduler of the optimizer has already been "
                               "defined.")
         self.lr = lr
+
+    def set_lr_scale(self, args_lrscale):  # pylint: disable=unused-argument
+        """Deprecated reference API (parity: Optimizer.set_lr_scale)."""
+        raise DeprecationWarning("use set_lr_mult instead")
 
     def set_lr_mult(self, args_lr_mult):
         self.lr_mult = {}

@@ -62,6 +62,49 @@ class BaseRNNCell(object):
     def _gate_names(self):
         return ()
 
+    @property
+    def state_shape(self):
+        """Shapes of the states (parity: rnn_cell.py state_shape)."""
+        return [info["shape"] for info in self.state_info]
+
+    def unpack_weights(self, args):
+        """Split this cell's gate-concatenated i2h/h2h weight+bias into
+        per-gate entries (parity: rnn_cell.py unpack_weights — the
+        readable form of Module.get_params() for RNN cells)."""
+        args = dict(args)
+        if not self._gate_names:
+            return args
+        h = self._num_hidden
+        for group in ("i2h", "h2h"):
+            weight = args.pop("%s%s_weight" % (self._prefix, group))
+            bias = args.pop("%s%s_bias" % (self._prefix, group))
+            for j, gate in enumerate(self._gate_names):
+                args["%s%s%s_weight" % (self._prefix, group, gate)] = \
+                    weight[j * h:(j + 1) * h].copy()
+                args["%s%s%s_bias" % (self._prefix, group, gate)] = \
+                    bias[j * h:(j + 1) * h].copy()
+        return args
+
+    def pack_weights(self, args):
+        """Reverse of unpack_weights: concatenate per-gate entries back
+        into the fused i2h/h2h parameters (parity: pack_weights)."""
+        from .. import ndarray as _nd
+        args = dict(args)
+        if not self._gate_names:
+            return args
+        for group in ("i2h", "h2h"):
+            weight, bias = [], []
+            for gate in self._gate_names:
+                weight.append(args.pop(
+                    "%s%s%s_weight" % (self._prefix, group, gate)))
+                bias.append(args.pop(
+                    "%s%s%s_bias" % (self._prefix, group, gate)))
+            args["%s%s_weight" % (self._prefix, group)] = \
+                _nd.concatenate(weight)
+            args["%s%s_bias" % (self._prefix, group)] = \
+                _nd.concatenate(bias)
+        return args
+
     def begin_state(self, func=None, batch_size=0, **kwargs):
         """Initial states.
 

@@ -228,25 +228,25 @@ class Symbol:
 
     # NDArray-only APIs raise with the standard exception so duck-typed
     # code fails the same way it does on the reference (symbol.py:2381+)
-    def wait_to_read(self):
+    def wait_to_read(self, *args, **kwargs):
         raise NotImplementedForSymbol(self.wait_to_read, None)
 
-    def asnumpy(self):
+    def asnumpy(self, *args, **kwargs):
         raise NotImplementedForSymbol(self.asnumpy, None)
 
-    def asscalar(self):
+    def asscalar(self, *args, **kwargs):
         raise NotImplementedForSymbol(self.asscalar, None)
 
-    def copy(self):
+    def copy(self, *args, **kwargs):
         raise NotImplementedForSymbol(self.copy, None)
 
-    def as_in_context(self):
+    def as_in_context(self, *args, **kwargs):
         raise NotImplementedForSymbol(self.as_in_context, None)
 
-    def detach(self):
+    def detach(self, *args, **kwargs):
         raise NotImplementedForSymbol(self.detach, None)
 
-    def backward(self):
+    def backward(self, *args, **kwargs):
         raise NotImplementedForSymbol(self.backward, None)
 
     # -- arithmetic ---------------------------------------------------------

@@ -516,3 +516,55 @@ def test_symbol_ndarray_only_methods_raise_and_fluent_astype():
             getattr(v, m)()
     with pytest.raises(base.MXNetError):
         v.gradient(["v"])
+
+
+def test_class_method_parity_fills_round5():
+    """Method-level audit fills: Optimizer.learning_rate (scheduler-
+    aware), Executor.debug_str, HybridBlock.infer_type, Module.prepare,
+    BucketingModule state/prepare delegation, RNN cell pack/unpack
+    weights + state_shape, CSR asscipy/copyto."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+
+    opt = mx.optimizer.create("sgd", learning_rate=0.3)
+    assert opt.learning_rate == 0.3
+    with pytest.raises(DeprecationWarning):
+        opt.set_lr_scale({})
+    sched = mx.lr_scheduler.FactorScheduler(step=1, factor=0.5)
+    opt2 = mx.optimizer.create("sgd", learning_rate=1.0, lr_scheduler=sched)
+    assert opt2.learning_rate == sched(opt2.num_update)
+
+    mod = mx.mod.Module(mx.models.get_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 784))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.init.Xavier())
+    mod.prepare(mx.io.DataBatch(data=[nd.ones((4, 784))],
+                                label=[nd.zeros((4,))]))
+    dump = mod._exec.debug_str()
+    assert "FullyConnected" in dump and "var data" in dump
+
+    net = gluon.nn.Dense(3)
+    net.initialize()
+    net.infer_type(nd.zeros((1, 4), dtype="float16"))
+    assert str(net.weight.dtype) == "float16"
+
+    cell = mx.rnn.LSTMCell(4, prefix="l_")
+    rng = np.random.RandomState(0)
+    fused = {"l_%s_%s" % (g, k): nd.array(
+        rng.randn(16, 5 if (g, k) == ("i2h", "weight") else 4)
+        if k == "weight" else rng.randn(16))
+        for g in ("i2h", "h2h") for k in ("weight", "bias")}
+    unpacked = cell.unpack_weights(fused)
+    assert set(n for n in unpacked if "_i_" in n) == \
+        {"l_i2h_i_weight", "l_i2h_i_bias", "l_h2h_i_weight", "l_h2h_i_bias"}
+    repacked = cell.pack_weights(unpacked)
+    for k in fused:
+        np.testing.assert_allclose(repacked[k].asnumpy(),
+                                   fused[k].asnumpy())
+    assert cell.state_shape == [(0, 4), (0, 4)]
+
+    csr = nd.array([[1.0, 0], [0, 2]]).tostype("csr")
+    np.testing.assert_allclose(csr.asscipy().toarray(), [[1, 0], [0, 2]])
+    out = nd.zeros((2, 2))
+    csr.copyto(out)
+    np.testing.assert_allclose(out.asnumpy(), [[1, 0], [0, 2]])
