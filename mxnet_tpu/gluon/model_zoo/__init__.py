@@ -1,3 +1,4 @@
 """Gluon model zoo (parity: python/mxnet/gluon/model_zoo/)."""
+from . import model_store
 from . import vision
 from .vision import get_model

@@ -10,6 +10,21 @@ import os
 
 _DEFAULT_ROOT = os.path.join("~", ".mxnet", "models")
 
+# sha1 prefixes keyed by model name (parity: the reference's
+# _model_sha1 table keys the download cache; with no egress the table's
+# role here is the short_hash naming contract for cached files)
+_model_sha1 = {}
+
+
+def short_hash(name):
+    """8-char sha1 prefix for a model's cached filename (parity:
+    model_store.py short_hash)."""
+    if name not in _model_sha1:
+        raise ValueError(
+            "Pretrained model for %s is not available "
+            "(no published hash registered)" % name)
+    return _model_sha1[name][:8]
+
 
 def get_model_file(name, root=_DEFAULT_ROOT):
     root = os.path.expanduser(root or _DEFAULT_ROOT)
@@ -19,10 +34,17 @@ def get_model_file(name, root=_DEFAULT_ROOT):
     extra = os.environ.get("MXNET_GLUON_REPO")
     if extra and not extra.startswith(("http://", "https://")):
         search.append(os.path.expanduser(extra))
+    # resolve both this package's plain naming and the reference's
+    # hash-suffixed cache naming (name-<short_hash>.params) when a hash
+    # is registered
+    candidates = [name + ".params"]
+    if name in _model_sha1:
+        candidates.append("%s-%s.params" % (name, short_hash(name)))
     for base in search:
-        file_path = os.path.join(base, name + ".params")
-        if os.path.exists(file_path):
-            return file_path
+        for fname in candidates:
+            file_path = os.path.join(base, fname)
+            if os.path.exists(file_path):
+                return file_path
     raise IOError(
         "Pretrained weights %s.params not found under %s and cannot be "
         "downloaded (no network egress). Train from scratch or place the "

@@ -568,3 +568,26 @@ def test_class_method_parity_fills_round5():
     out = nd.zeros((2, 2))
     csr.copyto(out)
     np.testing.assert_allclose(out.asnumpy(), [[1, 0], [0, 2]])
+
+
+def test_model_store_short_hash_and_resolution(tmp_path, monkeypatch):
+    """model_store parity: short_hash errors clearly for unknown models,
+    and get_model_file resolves BOTH the plain naming and the reference's
+    name-<short_hash>.params cache naming when a hash is registered."""
+    from mxnet_tpu.gluon.model_zoo import model_store
+
+    with pytest.raises(ValueError):
+        model_store.short_hash("nonexistent_model")
+    monkeypatch.setitem(model_store._model_sha1, "tiny_net",
+                        "abcdef0123456789")
+    assert model_store.short_hash("tiny_net") == "abcdef01"
+    hashed = tmp_path / "tiny_net-abcdef01.params"
+    hashed.write_bytes(b"x")
+    assert model_store.get_model_file(
+        "tiny_net", root=str(tmp_path)) == str(hashed)
+    plain = tmp_path / "tiny_net.params"
+    plain.write_bytes(b"y")
+    assert model_store.get_model_file(
+        "tiny_net", root=str(tmp_path)) == str(plain)  # plain wins
+    with pytest.raises(IOError):
+        model_store.get_model_file("absent_model", root=str(tmp_path))
