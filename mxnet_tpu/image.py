@@ -417,6 +417,7 @@ class ImageIter:
         from .io import DataBatch, DataDesc
         assert path_imgrec or path_imglist or isinstance(imglist, list)
         self.batch_size = batch_size
+        self.check_data_shape(tuple(data_shape))
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
         self._data_name = data_name
@@ -476,7 +477,49 @@ class ImageIter:
         if self.shuffle:
             random.shuffle(self.seq)
 
+    # -- overridable pipeline hooks (parity: image.py ImageIter — users
+    # subclass and override these to customize decode/augment/layout) ----
+
+    def check_data_shape(self, data_shape):
+        """Validate the (C, H, W) shape argument (parity hook)."""
+        if len(data_shape) != 3:
+            raise ValueError("data_shape must be (channels, height, "
+                             "width), got %s" % (data_shape,))
+        if data_shape[0] not in (1, 3):
+            raise ValueError("data_shape channel dim must be 1 or 3")
+
+    def check_valid_image(self, data):
+        """Reject undecodable samples (parity hook)."""
+        if len(data[0].shape) == 0:
+            raise RuntimeError("Data shape is wrong")
+
+    def imdecode(self, s):
+        """Decode raw image bytes (parity hook; module-level imdecode)."""
+        return imdecode(s)
+
+    def read_image(self, fname):
+        """Raw bytes of an image under path_root (parity hook)."""
+        with open(os.path.join(self.path_root or "", fname), "rb") as f:
+            return f.read()
+
+    def augmentation_transform(self, data):
+        """Run the augmenter list (parity hook)."""
+        for aug in self.auglist:
+            data = aug(data)
+        return data
+
+    def postprocess_data(self, datum):
+        """Final per-sample layout transform HWC -> CHW (parity hook)."""
+        arr = datum.asnumpy() if isinstance(datum, NDArray) \
+            else np.asarray(datum)
+        if arr.shape[:2] != self.data_shape[1:]:
+            arr = cv2.resize(arr, (self.data_shape[2], self.data_shape[1]))
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr.transpose(2, 0, 1)
+
     def next_sample(self):
+        """(label, raw image bytes) of the next sample (parity hook)."""
         if self.cur >= len(self.seq):
             raise StopIteration
         idx = self.seq[self.cur]
@@ -485,9 +528,9 @@ class ImageIter:
             from . import recordio
             s = self.imgrec.read_idx(idx)
             header, img = recordio.unpack(s)
-            return header.label, imdecode(img)
+            return header.label, img
         label, fname = self.imglist[idx]
-        return label, imread(os.path.join(self.path_root or "", fname))
+        return label, self.read_image(fname)
 
     def next(self):
         from .io import DataBatch
@@ -498,16 +541,11 @@ class ImageIter:
         i = 0
         try:
             while i < self.batch_size:
-                label, img = self.next_sample()
-                for aug in self.auglist:
-                    img = aug(img)
-                arr = img.asnumpy()
-                if arr.shape[:2] != self.data_shape[1:]:
-                    arr = cv2.resize(arr, (self.data_shape[2],
-                                           self.data_shape[1]))
-                if arr.ndim == 2:
-                    arr = arr[:, :, None]
-                batch_data[i] = arr.transpose(2, 0, 1)
+                label, raw = self.next_sample()
+                img = self.imdecode(raw)
+                self.check_valid_image([img])
+                img = self.augmentation_transform(img)
+                batch_data[i] = self.postprocess_data(img)
                 batch_label[i] = np.atleast_1d(label)[:self.label_width]
                 i += 1
         except StopIteration:

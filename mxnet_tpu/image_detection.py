@@ -397,8 +397,10 @@ class ImageDetIter(ImageIter):
 
     def reshape(self, data_shape=None, label_shape=None):
         if data_shape is not None:
+            self.check_data_shape(tuple(data_shape))
             self.data_shape = tuple(data_shape)
         if label_shape is not None:
+            self.check_label_shape(tuple(label_shape))
             self.label_shape = tuple(label_shape)
 
     def sync_label_shape(self, it, verbose=False):
@@ -411,6 +413,51 @@ class ImageDetIter(ImageIter):
         it.reshape(label_shape=shape)
         return it
 
+    def augmentation_transform(self, data, label):
+        """Joint (image, boxes) augmentation (parity hook: detection.py
+        ImageDetIter.augmentation_transform)."""
+        for aug in self.auglist:
+            data, label = aug(data, label)
+        return data, label
+
+    def check_label_shape(self, label_shape):
+        """Validate a (max_objects, width) label shape (parity hook)."""
+        if len(label_shape) != 2:
+            raise ValueError("label_shape must be (max_objects, width)")
+        if label_shape[1] < 5:
+            raise ValueError("label width must be >= 5 (id + 4 coords)")
+
+    def draw_next(self, color=None, thickness=2, waitKey=None,
+                  window_name="draw_next"):
+        """Yield augmented images with their boxes drawn (parity:
+        detection.py draw_next — the visual-debugging generator).
+        Yields HWC uint8 numpy arrays; waitKey/window_name additionally
+        display via cv2 when a GUI is available."""
+        import cv2
+        while True:
+            try:
+                label, raw = self.next_sample()
+            except StopIteration:
+                return
+            try:
+                parsed = self._parse_label(label)
+            except MXNetError:
+                continue
+            img = self.imdecode(raw)
+            self.check_valid_image([img])
+            img, parsed = self.augmentation_transform(img, parsed)
+            arr = np.clip(img.asnumpy(), 0, 255).astype(np.uint8).copy()
+            h, w = arr.shape[:2]
+            for obj in parsed:
+                x0, y0 = int(obj[1] * w), int(obj[2] * h)
+                x1, y1 = int(obj[3] * w), int(obj[4] * h)
+                cv2.rectangle(arr, (x0, y0), (x1, y1),
+                              color or (255, 0, 0), thickness)
+            if waitKey is not None:
+                cv2.imshow(window_name, arr)
+                cv2.waitKey(waitKey)
+            yield arr
+
     def next(self):
         from .io import DataBatch
         B = self.batch_size
@@ -419,21 +466,15 @@ class ImageDetIter(ImageIter):
         i = 0
         try:
             while i < B:
-                label, img = self.next_sample()
+                label, raw = self.next_sample()
                 try:
                     parsed = self._parse_label(label)
                 except MXNetError:
                     continue
-                for aug in self.auglist:
-                    img, parsed = aug(img, parsed)
-                arr = img.asnumpy()
-                if arr.shape[:2] != self.data_shape[1:]:
-                    import cv2
-                    arr = cv2.resize(arr, (self.data_shape[2],
-                                           self.data_shape[1]))
-                if arr.ndim == 2:
-                    arr = arr[:, :, None]
-                batch_data[i] = arr.transpose(2, 0, 1)
+                img = self.imdecode(raw)
+                self.check_valid_image([img])
+                img, parsed = self.augmentation_transform(img, parsed)
+                batch_data[i] = self.postprocess_data(img)
                 n = min(parsed.shape[0], self.label_shape[0])
                 batch_label[i, :n, :parsed.shape[1]] = parsed[:n]
                 i += 1

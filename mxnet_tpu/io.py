@@ -41,6 +41,16 @@ class DataDesc(collections.namedtuple("DataDesc", ["name", "shape"])):
             return 0
         return layout.find("N")
 
+    @staticmethod
+    def get_list(shapes, types):
+        """(name, shape) + optional (name, dtype) lists -> DataDesc list
+        (parity: io.py DataDesc.get_list)."""
+        if types is not None:
+            type_dict = dict(types)
+            return [DataDesc(name, shape, type_dict[name])
+                    for name, shape in shapes]
+        return [DataDesc(name, shape) for name, shape in shapes]
+
 
 class DataBatch:
     def __init__(self, data, label=None, pad=None, index=None,
