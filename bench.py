@@ -766,6 +766,63 @@ def bench_e2e_train_io(smoke, dtype, device_kind):
             pass
 
 
+def bench_serving(smoke, dtype, device_kind, batch=None):
+    """Offline continuous-batching decode throughput (tokens/s) through
+    mxnet_tpu.serving's paged-KV engine — the serving trajectory line.
+    BENCH_SERVING_BATCH overrides the batch; the full run sweeps
+    {1, 8, 32} via _run_configs. Decode-only timing: prefill compiles
+    and the cache fill are excluded (reported separately), matching how
+    a steady-state server spends its time."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import serving
+    from mxnet_tpu.models.transformer import (TransformerConfig,
+                                              init_transformer_params)
+
+    if batch is None:
+        batch = int(os.environ.get("BENCH_SERVING_BATCH", "2" if smoke
+                                   else "8"))
+    cfg = TransformerConfig(vocab=128, d_model=32, n_heads=4, n_layers=2,
+                            d_ff=64, max_len=64) if smoke else \
+        TransformerConfig(vocab=8192, d_model=256, n_heads=8, n_layers=4,
+                          d_ff=1024, max_len=1024)
+    prompt_len = 8 if smoke else 64
+    gen = 8 if smoke else 128
+    params = init_transformer_params(jax.random.PRNGKey(0), cfg)
+    if dtype == "bfloat16":
+        params = {k: v.astype(jnp.bfloat16) for k, v in params.items()}
+    eng = serving.Engine(serving.TransformerLM(params, cfg),
+                         max_batch=batch, block_size=16)
+    rng = np.random.RandomState(0)
+    t0 = time.perf_counter()
+    seqs = [eng.start(list(rng.randint(1, cfg.vocab, prompt_len)),
+                      max_new=gen + 1) for _ in range(batch)]
+    t_prefill = time.perf_counter() - t0
+    eng.decode_step(seqs)  # decode-path compile + warmup
+    steps = 0
+    t0 = time.perf_counter()
+    for _ in range(gen - 1):
+        eng.decode_step(seqs)
+        steps += 1
+    # the loop runs synchronous host steps; the final per-step readback
+    # already forces completion, no extra sync needed
+    dt = time.perf_counter() - t0
+    for s in seqs:
+        eng.release(s)
+    return {"metric": ("smoke_serving_decode_tok_per_sec" if smoke
+                       else "serving_decode_tok_per_sec"),
+            "value": round(batch * steps / dt, 1), "unit": "tok/s",
+            "batch": batch, "prompt_len": prompt_len,
+            "seq_len": cfg.max_len,
+            "decode_ms_per_step": round(1e3 * dt / steps, 3),
+            "prefill_s": round(t_prefill, 3),
+            "decode_compilations": eng.decode_compilations,
+            "vs_baseline": None,
+            "baseline_note": "no serving path exists in the reference "
+                             "tree (c_predict_api is one-shot); this "
+                             "line tracks the trajectory from PR 1 on"}
+
+
 _CONFIGS = [
     ("resnet50_infer", bench_resnet50_infer),
     ("resnet50_int8_infer", bench_resnet50_int8_infer),
@@ -773,6 +830,7 @@ _CONFIGS = [
     ("transformer_flash", bench_transformer_flash),
     ("ssd_forward", bench_ssd_forward),
     ("sparse_linear", bench_sparse_linear),
+    ("serving", bench_serving),
     ("io_pipeline", bench_io_pipeline),
     ("e2e_train_io", bench_e2e_train_io),
     ("resnet50", bench_resnet50),   # headline LAST: the driver parses the
@@ -806,6 +864,10 @@ def _run_configs(smoke):
         runs = [{}]
         if name == "transformer_flash" and flash_seqs and not smoke:
             runs = [{"seq_len": s} for s in flash_seqs]
+        if name == "serving" and not smoke and \
+                os.environ.get("BENCH_SERVING_BATCH") is None:
+            # the serving trajectory is tracked at three batch points
+            runs = [{"batch": b} for b in (1, 8, 32)]
         for kw in runs:
             try:
                 r = table[name](smoke, dtype, device_kind, **kw)

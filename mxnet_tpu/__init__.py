@@ -74,6 +74,7 @@ from . import rtc
 from . import contrib
 from . import predict
 from .predict import Predictor
+from . import serving
 from . import rnn
 
 # Under tools/launch.py the DMLC_* worker env is present: join the

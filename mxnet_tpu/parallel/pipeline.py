@@ -26,7 +26,8 @@ def _gpipe_local(stage_fn, params_local, x_mb, axis_name):
     Returns (M, mb, ...) outputs of the final stage (replicated).
     """
     params = jax.tree_util.tree_map(lambda a: a[0], params_local)
-    n = lax.axis_size(axis_name)
+    from .collectives import axis_size
+    n = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     M = x_mb.shape[0]
     T = M + n - 1  # pipeline ticks: fill + drain
@@ -61,7 +62,7 @@ def gpipe_apply(stage_fn, stacked_params, x, n_microbatches, mesh,
     x: (B, ...) batch; split into n_microbatches along axis 0.
     Returns (B, ...) outputs of the last stage.
     """
-    from jax import shard_map
+    from .collectives import shard_map
 
     B = x.shape[0]
     assert B % n_microbatches == 0, "batch must divide into microbatches"

@@ -45,7 +45,8 @@ def _block_attn(q, k, v, m_prev, l_prev, o_prev, scale, mask=None):
 def _ring_body(axis_name, causal, scale, q, k0, v0, q_index):
     """Scan over ring steps; each step attends to the current K/V block then
     rotates it to the neighbour."""
-    n = lax.axis_size(axis_name)
+    from .collectives import axis_size
+    n = axis_size(axis_name)
     B, H, T, D = q.shape
     m0 = jnp.full((B, H, T), -jnp.inf, dtype=jnp.float32)
     l0 = jnp.zeros((B, H, T), dtype=jnp.float32)
@@ -89,7 +90,7 @@ def ring_attention_sharded(mesh, q, k, v, axis_name="sp", causal=False,
                            scale=None):
     """Convenience wrapper: shard the sequence axis over `axis_name` of
     `mesh` and run ring attention. q/k/v: [B, H, T, D] global arrays."""
-    from jax import shard_map
+    from .collectives import shard_map
 
     spec = P(None, None, axis_name, None)
 

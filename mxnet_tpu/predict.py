@@ -19,6 +19,7 @@ import zipfile
 
 import numpy as np
 import jax
+import jax.export  # noqa: F401 — jax.export is not re-exported by `import jax`
 import jax.numpy as jnp
 
 from .base import MXNetError
@@ -108,6 +109,67 @@ class Predictor:
 
     def get_output(self, index=0):
         return self._exec.outputs[index]
+
+    def predict(self, inputs):
+        """Batch helper over the bound signature: see `batch_predict`."""
+        name, sig = next(iter(self._input_shapes.items()))
+        if len(self._input_shapes) != 1:
+            raise MXNetError("predict(list) helps single-input models; "
+                             "this predictor has inputs %s"
+                             % sorted(self._input_shapes))
+        return batch_predict(
+            lambda x: self.forward(**{name: x})[0].asnumpy(), sig, inputs)
+
+
+def batch_predict(forward, sig_shape, inputs):
+    """Run a list of variable-length samples through a FIXED-shape
+    forward: pad each sample to the signature (zeros), group into chunks
+    of the signature batch, and trim outputs back per sample.
+
+    `forward(x)` takes exactly `sig_shape` = (B, *rest) and returns one
+    array (B, ...). Each sample may be shorter than `rest` along the
+    FIRST feature axis (the ragged axis — token sequences); all other
+    axes must match. Returns a list of per-sample outputs; when the
+    output's axis 1 mirrors the padded ragged axis it is trimmed to the
+    sample's true length, otherwise the row is returned whole.
+
+    This replaces the old behavior (shape mismatch -> error) with the
+    serving-friendly contract: any mix of lengths runs in
+    ceil(len/B) fixed-shape calls — no recompiles, no rebinding.
+    """
+    B, rest = sig_shape[0], tuple(sig_shape[1:])
+    arrs, lengths = [], []
+    for i, s in enumerate(inputs):
+        a = np.asarray(s)
+        if a.shape == rest:
+            arrs.append(a)
+            lengths.append(rest[0] if rest else None)
+            continue
+        if not rest or a.ndim != len(rest) or a.shape[1:] != rest[1:] \
+                or a.shape[0] > rest[0]:
+            raise MXNetError(
+                "sample %d shape %s doesn't fit signature %s (only the "
+                "first feature axis may be shorter)"
+                % (i, a.shape, (B,) + rest))
+        pad = np.zeros(rest, a.dtype)
+        pad[:a.shape[0]] = a
+        arrs.append(pad)
+        lengths.append(a.shape[0])
+    outs = []
+    for lo in range(0, len(arrs), B):
+        chunk = arrs[lo:lo + B]
+        batch = np.zeros((B,) + rest, chunk[0].dtype)
+        for j, a in enumerate(chunk):
+            batch[j] = a
+        out = np.asarray(forward(batch))
+        for j in range(len(chunk)):
+            row = out[j]
+            ln = lengths[lo + j]
+            if ln is not None and row.ndim >= 1 and rest \
+                    and row.shape[0] == rest[0]:
+                row = row[:ln]
+            outs.append(row)
+    return outs
 
 
 def _pure_fn_from(model, params=None):
@@ -321,6 +383,23 @@ class ExportedPredictor:
         if self._outputs is None:
             raise MXNetError("call forward() first")
         return NDArray(self._outputs[index])
+
+    def predict(self, inputs):
+        """Batch helper over the exported signature: see `batch_predict`.
+        Variable-length samples pad/bucket into the artifact's fixed
+        shape instead of erroring — every call replays the ONE compiled
+        program."""
+        if len(self._input_names) != 1:
+            raise MXNetError("predict(list) helps single-input artifacts; "
+                             "this one has inputs %s" % self._input_names)
+        desc = self._meta["inputs"][0]
+        sig = tuple(desc["shape"])
+
+        def fwd(x):
+            return np.asarray(self._exported.call(
+                jnp.asarray(x, jnp.dtype(desc["dtype"])))[0])
+
+        return batch_predict(fwd, sig, inputs)
 
 
 def load_exported(path):

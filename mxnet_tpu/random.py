@@ -36,10 +36,19 @@ _STATE = _RandomState()
 
 def _current_key():
     if _STATE.key is None:
-        if _STATE.seed_value is not None:
-            _STATE.key = jax.random.PRNGKey(_STATE.seed_value)
+        seed_val = _STATE.seed_value if _STATE.seed_value is not None \
+            else np.random.randint(0, 2**31 - 1)
+        key = jax.random.PRNGKey(seed_val)
+        # under omnistaging EVERY op inside an active jit trace is staged,
+        # so this key is a tracer when first use happens mid-trace (e.g. a
+        # functionalized eval-mode net drawing its lazy key) — caching it
+        # would poison the thread's eager stream. Keep the pending seed
+        # instead; the eager key materializes on the next eager call.
+        if jax.core.trace_state_clean():
+            _STATE.key = key
         else:
-            _STATE.key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+            _STATE.seed_value = seed_val
+            return key
     return _STATE.key
 
 
@@ -57,6 +66,15 @@ def next_key():
     if _STATE.trace_key is not None:
         _STATE.trace_counter += 1
         return jax.random.fold_in(_STATE.trace_key, _STATE.trace_counter)
+    if not jax.core.trace_state_clean():
+        # inside someone else's jit trace with no trace_key_scope
+        # installed (e.g. a functionalized eval-mode net being traced):
+        # splitting into _STATE.key would store a tracer and poison the
+        # NEXT trace (UnexpectedTracerError). Derive per-call keys off
+        # the eager key via the counter instead — distinct per call,
+        # eager stream untouched.
+        _STATE.trace_counter += 1
+        return jax.random.fold_in(_current_key(), _STATE.trace_counter)
     _STATE.key, sub = jax.random.split(_current_key())
     return sub
 

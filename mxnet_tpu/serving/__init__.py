@@ -1,0 +1,32 @@
+"""mxnet_tpu.serving — continuous-batching LM inference.
+
+The reference's serving story was the one-shot c_predict_api
+(Predictor.set_input/forward/get_output). This subsystem is the
+production-shape replacement for autoregressive models: a paged KV-cache
+(fixed-shape block pools, jit-stable decode), a prefill/decode engine
+with bucketed shapes, a continuous-batching scheduler with backpressure,
+serving metrics, and an in-process `serve()` API with a stdlib HTTP
+frontend (tools/serve.py).
+
+Quickstart::
+
+    from mxnet_tpu import serving
+    srv = serving.serve((params, cfg), max_batch=8)   # or "model.mxtpu"
+    out = srv.generate([1, 2, 3], max_new_tokens=16)
+    print(out, srv.snapshot()["throughput"])
+    srv.close()
+"""
+from .kv_cache import BlockPool, PagedKVCache, CacheOverflow
+from .engine import (Engine, Sequence, TransformerLM, BlockLM, ExportedLM,
+                     pow2_bucket)
+from .scheduler import Scheduler, Request, QueueFull, RequestTimeout
+from .metrics import ServingMetrics
+from .server import LMServer, serve
+
+__all__ = [
+    "BlockPool", "PagedKVCache", "CacheOverflow",
+    "Engine", "Sequence", "TransformerLM", "BlockLM", "ExportedLM",
+    "pow2_bucket",
+    "Scheduler", "Request", "QueueFull", "RequestTimeout",
+    "ServingMetrics", "LMServer", "serve",
+]

@@ -1,0 +1,447 @@
+"""Prefill + decode engine over the paged KV-cache.
+
+Two model families plug in behind one `Engine`:
+
+* `TransformerLM` — the functional transformer (models/transformer.py)
+  with a real paged-cache decode path: prefill runs the dense causal
+  forward once per request and writes each layer's K/V into the block
+  pool; `decode` then advances EVERY active sequence by one token with a
+  gather-by-block-table attention read (O(1) work per token, no O(T^2)
+  recompute).
+* `BlockLM` / `ExportedLM` — any Gluon causal LM (via
+  parallel.functional.functionalize) or a `.mxtpu` artifact from
+  `predict.export_model`. These have no cache hooks, so decode re-runs
+  the full forward over the (bucketed) token history — slower per token
+  but it makes the whole serving stack (scheduler, batching, HTTP)
+  available to every model the framework can express or export.
+
+jit stability: the engine never hands XLA a novel shape per request.
+Prompt lengths pad to power-of-two buckets, the decode batch pads to
+power-of-two buckets up to `max_batch`, and the cache pool/tables are
+fixed-shape (kv_cache.py) — so the number of distinct compilations is
+bounded by #length-buckets + #batch-buckets, not by traffic. The engine
+counts distinct signatures (`prefill_compilations` /
+`decode_compilations`); tests pin the bound.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .. import profiler
+from .kv_cache import (PagedKVCache, flat_slots, prompt_slots, write_kv,
+                       gather_kv)
+
+
+def pow2_bucket(n, lo=1, hi=None):
+    """Smallest power of two >= n (clamped to [lo, hi])."""
+    b = lo
+    while b < n:
+        b *= 2
+    return min(b, hi) if hi is not None else b
+
+
+class Sequence:
+    """One in-flight generation: prompt + generated tokens, cache blocks,
+    bookkeeping the engine and scheduler share."""
+
+    __slots__ = ("tokens", "prompt_len", "block_ids", "table_row",
+                 "max_total", "eos_id", "done", "last_logits", "request")
+
+    def __init__(self, prompt, max_total, eos_id=None):
+        self.tokens = list(prompt)
+        self.prompt_len = len(prompt)
+        self.block_ids = []
+        self.table_row = None
+        self.max_total = max_total
+        self.eos_id = eos_id
+        self.done = False
+        self.last_logits = None
+        self.request = None
+
+    @property
+    def generated(self):
+        return self.tokens[self.prompt_len:]
+
+
+# ---------------------------------------------------------------------------
+# paged-cache transformer adapter
+# ---------------------------------------------------------------------------
+
+
+def _ffn(params, pre, x, cfg):
+    """Position-wise FFN on (B, S, D); dense or dense-dispatch MoE. Both
+    are per-token maps, so padded positions cannot perturb real ones."""
+    from ..models.transformer import _moe_ffn
+    if cfg.n_experts:
+        return _moe_ffn(x, params[pre + "wg"], params[pre + "w1"],
+                        params[pre + "w2"])
+    return jax.nn.relu(x @ params[pre + "w1"]) @ params[pre + "w2"]
+
+
+def _tf_prefill(params, k_pool, v_pool, tokens, length, table_row, cfg,
+                block_size):
+    """Dense causal forward over one padded prompt (S,), writing every
+    layer's K/V into the pool and returning the logits at position
+    length-1. Padded positions (>= length) sit AFTER the real tokens, so
+    under the causal mask no real position ever attends to them; their
+    K/V writes land in not-yet-used or null-block slots and are
+    overwritten by decode before they can be read."""
+    from ..models.transformer import _layer_norm
+    from ..parallel.ring_attention import attention_reference
+
+    S = tokens.shape[0]
+    D, H = cfg.d_model, cfg.n_heads
+    Dh = D // H
+    x = params["embed"][tokens] + params["pos_embed"][:S]          # (S, D)
+    slots = prompt_slots(table_row, S, block_size)                 # (S,)
+    for i in range(cfg.n_layers):
+        pre = "layer%d_" % i
+        h = _layer_norm(x, params[pre + "ln1_g"], params[pre + "ln1_b"])
+        qkv = h @ params[pre + "wqkv"]
+        q, kk, vv = jnp.split(qkv, 3, axis=-1)
+        kh = kk.reshape(S, H, Dh)
+        vh = vv.reshape(S, H, Dh)
+        k_pool, v_pool = write_kv(k_pool, v_pool, i, slots, kh, vh)
+        att = attention_reference(
+            q.reshape(S, H, Dh).transpose(1, 0, 2)[None],
+            kh.transpose(1, 0, 2)[None],
+            vh.transpose(1, 0, 2)[None], causal=True)              # (1,H,S,Dh)
+        x = x + att[0].transpose(1, 0, 2).reshape(S, D) @ params[pre + "wo"]
+        h = _layer_norm(x, params[pre + "ln2_g"], params[pre + "ln2_b"])
+        x = x + _ffn(params, pre, h[None], cfg)[0]
+    h_last = _layer_norm(x[length - 1], params["lnf_g"], params["lnf_b"])
+    logits = (h_last @ params["head"]).astype(jnp.float32)         # (V,)
+    return k_pool, v_pool, logits
+
+
+def _tf_decode(params, k_pool, v_pool, tokens, positions, tables, cfg,
+               block_size):
+    """One decode step for a (padded) batch: tokens (B,) at positions
+    (B,), block tables (B, nblk). Writes the new K/V, gathers each
+    sequence's cache by table, masked-softmax attention, returns logits
+    (B, V) and the greedy next token. Padded rows carry the all-null
+    table — their writes hit the null block and their logits are
+    discarded by the caller."""
+    from ..models.transformer import _layer_norm
+
+    B = tokens.shape[0]
+    D, H = cfg.d_model, cfg.n_heads
+    Dh = D // H
+    scale = 1.0 / math.sqrt(Dh)
+    x = params["embed"][tokens] + params["pos_embed"][positions]   # (B, D)
+    slots = flat_slots(tables, positions, block_size)              # (B,)
+    T = tables.shape[1] * block_size
+    live = jnp.arange(T)[None, :] <= positions[:, None]            # (B, T)
+    for i in range(cfg.n_layers):
+        pre = "layer%d_" % i
+        h = _layer_norm(x, params[pre + "ln1_g"], params[pre + "ln1_b"])
+        qkv = h @ params[pre + "wqkv"]
+        q, kk, vv = jnp.split(qkv, 3, axis=-1)
+        qh = q.reshape(B, H, Dh)
+        k_pool, v_pool = write_kv(k_pool, v_pool, i,
+                                  slots, kk.reshape(B, H, Dh),
+                                  vv.reshape(B, H, Dh))
+        ks, vs = gather_kv(k_pool, v_pool, i, tables, block_size)  # (B,T,H,Dh)
+        # same masking/upcast semantics as attention_reference, with the
+        # length mask standing in for the causal mask (the query IS the
+        # newest position)
+        s = jnp.einsum("bhd,bthd->bht", qh, ks).astype(jnp.float32) * scale
+        s = jnp.where(live[:, None, :], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        att = jnp.einsum("bht,bthd->bhd", p, vs.astype(p.dtype))
+        x = x + att.astype(x.dtype).reshape(B, D) @ params[pre + "wo"]
+        h = _layer_norm(x, params[pre + "ln2_g"], params[pre + "ln2_b"])
+        x = x + _ffn(params, pre, h[:, None], cfg)[:, 0]
+    h = _layer_norm(x, params["lnf_g"], params["lnf_b"])
+    logits = (h @ params["head"]).astype(jnp.float32)              # (B, V)
+    return k_pool, v_pool, logits, jnp.argmax(logits, -1).astype(jnp.int32)
+
+
+class TransformerLM:
+    """Paged-cache adapter for the functional transformer
+    (models/transformer.py): params dict + TransformerConfig."""
+
+    uses_cache = True
+
+    def __init__(self, params, cfg):
+        if cfg.n_experts and cfg.moe_top_k:
+            raise MXNetError(
+                "serving: top-k MoE routing is capacity-dependent across "
+                "the token group, so padded decode batches would change "
+                "real tokens' routing; serve dense-FFN or dense-dispatch "
+                "MoE configs (moe_top_k=0)")
+        self.params = params
+        self.cfg = cfg
+        self.vocab = cfg.vocab
+        self.max_len = cfg.max_len
+        self._prefill_jit = None
+        self._decode_jit = None
+
+    def cache_spec(self):
+        dt = self.params["embed"].dtype
+        return (self.cfg.n_layers, self.cfg.n_heads,
+                self.cfg.d_model // self.cfg.n_heads, dt)
+
+    def bind(self, block_size):
+        cfg = self.cfg
+        self._prefill_jit = jax.jit(
+            lambda p, k, v, t, ln, tb: _tf_prefill(p, k, v, t, ln, tb,
+                                                   cfg, block_size))
+        self._decode_jit = jax.jit(
+            lambda p, k, v, t, pos, tb: _tf_decode(p, k, v, t, pos, tb,
+                                                   cfg, block_size))
+
+    def prefill(self, k, v, tokens, length, table_row):
+        return self._prefill_jit(self.params, k, v, tokens, length,
+                                 table_row)
+
+    def decode(self, k, v, tokens, positions, tables):
+        return self._decode_jit(self.params, k, v, tokens, positions,
+                                tables)
+
+
+# ---------------------------------------------------------------------------
+# full-forward adapters (no cache hooks): Gluon Blocks and .mxtpu artifacts
+# ---------------------------------------------------------------------------
+
+
+class BlockLM:
+    """Serve an initialized Gluon causal LM Block: tokens (B, S) ->
+    logits (B, S, V) (or time-major (S, B) -> (S*B, V) like
+    models.word_lm.RNNModel with time_major=True)."""
+
+    uses_cache = False
+
+    def __init__(self, block, vocab, max_len, time_major=False):
+        from ..parallel.functional import functionalize
+        apply_fn, _names, values = functionalize(block, train_mode=False)
+        self.vocab = vocab
+        self.max_len = max_len
+
+        def logits_fn(vals, toks):                       # toks (B, S) int32
+            B, S = toks.shape
+            if time_major:
+                out = apply_fn(vals, toks.T.astype(jnp.float32))
+                out = out.reshape(S, B, -1).transpose(1, 0, 2)
+            else:
+                out = apply_fn(vals, toks)
+            return out                                   # (B, S, V)
+
+        def step(vals, toks, lengths):
+            out = logits_fn(vals, toks)
+            rows = jnp.take_along_axis(
+                out, (lengths - 1)[:, None, None], axis=1)[:, 0]
+            return rows.astype(jnp.float32)              # (B, V)
+
+        self._values = values
+        self._step_jit = jax.jit(step)
+
+    def step_full(self, tokens, lengths):
+        return self._step_jit(self._values, tokens, lengths)
+
+
+class ExportedLM:
+    """Serve a `.mxtpu` artifact (predict.export_model) whose one input is
+    int token ids (B_sig, S_sig) and whose first output is logits
+    (B_sig, S_sig, V). The program shape is frozen at export, so serving
+    pads/chunks each decode batch to the exported signature — the
+    engine-side generalization of Predictor.predict's pad/bucket
+    helper."""
+
+    uses_cache = False
+
+    def __init__(self, artifact):
+        from ..predict import ExportedPredictor, load_exported
+        pred = (artifact if isinstance(artifact, ExportedPredictor)
+                else load_exported(artifact))
+        desc = pred.input_descs
+        if len(desc) != 1 or len(desc[0]["shape"]) != 2:
+            raise MXNetError(
+                "ExportedLM needs an artifact with ONE (batch, seq) token "
+                "input; got %r" % (desc,))
+        self._pred = pred
+        self.sig_batch, self.sig_len = desc[0]["shape"]
+        self.max_len = self.sig_len
+        self._dtype = desc[0]["dtype"]
+        self.vocab = None  # unknown until the first forward
+
+    def step_full(self, tokens, lengths):
+        """tokens (B, S<=sig_len) int -> f32 logits (B, V) at lengths-1,
+        chunking over the exported batch size."""
+        tokens = np.asarray(tokens)
+        lengths = np.asarray(lengths)
+        B, S = tokens.shape
+        if S > self.sig_len:
+            raise MXNetError("sequence length %d exceeds the exported "
+                             "signature %d" % (S, self.sig_len))
+        buf = np.zeros((self.sig_batch, self.sig_len), self._dtype)
+        out_rows = []
+        for lo in range(0, B, self.sig_batch):
+            chunk = tokens[lo:lo + self.sig_batch]
+            buf[:] = 0
+            buf[:len(chunk), :S] = chunk
+            logits = np.asarray(self._pred._exported.call(buf)[0],
+                                np.float32)              # (Bs, Ss, V)
+            self.vocab = logits.shape[-1]
+            take = lengths[lo:lo + self.sig_batch] - 1
+            out_rows.append(logits[np.arange(len(chunk)), take])
+        return np.concatenate(out_rows, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+
+class Engine:
+    """Owns the compiled step functions, the cache pool, and the shape
+    buckets. Thread-compatible, not thread-safe: all compute entry points
+    (`start`, `decode_step`) must be called from one serving thread (the
+    server loop); that keeps the functional cache update race-free."""
+
+    def __init__(self, model, max_batch=8, max_len=None, block_size=16,
+                 num_blocks=None, keep_logits=False):
+        self.model = model
+        self.max_batch = max_batch
+        self.max_len = int(max_len or model.max_len)
+        self.keep_logits = keep_logits
+        self.prefill_compilations = 0
+        self.decode_compilations = 0
+        self._sigs = set()
+        self.cache = None
+        if model.uses_cache:
+            nl, nh, dh, dt = model.cache_spec()
+            self._nblk = max(1, math.ceil(self.max_len / block_size))
+            if num_blocks is None:
+                num_blocks = max_batch * self._nblk + 1
+            self.cache = PagedKVCache(nl, nh, dh, block_size=block_size,
+                                      num_blocks=num_blocks, dtype=dt)
+            model.bind(block_size)
+
+    # -- admission accounting ------------------------------------------------
+
+    def blocks_needed(self, prompt_len, max_new):
+        if self.cache is None:
+            return 0
+        total = min(self.max_len, prompt_len + max_new)
+        return self.cache.blocks_for(total)
+
+    def can_admit(self, prompt_len, max_new):
+        if prompt_len > self.max_len:
+            raise MXNetError("prompt length %d exceeds max_len %d"
+                             % (prompt_len, self.max_len))
+        if self.cache is None:
+            return True
+        return self.blocks_needed(prompt_len, max_new) \
+            <= self.cache.pool.available
+
+    def cache_utilization(self):
+        return self.cache.utilization() if self.cache else None
+
+    def _count(self, kind, sig):
+        if (kind, sig) not in self._sigs:
+            self._sigs.add((kind, sig))
+            if kind == "prefill":
+                self.prefill_compilations += 1
+            else:
+                self.decode_compilations += 1
+
+    # -- prefill -------------------------------------------------------------
+
+    def start(self, prompt, max_new, eos_id=None):
+        """Admit one request: allocate blocks, run prefill, sample the
+        first token. Returns the live Sequence (caller keeps it in the
+        running set), or None if blocks ran out (transient)."""
+        L = len(prompt)
+        if L < 1:
+            raise MXNetError("empty prompt")
+        seq = Sequence(prompt, min(self.max_len, L + max_new), eos_id)
+        if self.cache is not None:
+            ids = self.cache.pool.try_alloc(self.blocks_needed(L, max_new))
+            if ids is None:
+                return None
+            seq.block_ids = ids
+            seq.table_row = self.cache.table_row(ids, self._nblk)
+        with profiler.scope("serving.prefill", "serving"):
+            if self.model.uses_cache:
+                s_pad = pow2_bucket(L, lo=min(8, self.max_len),
+                                    hi=self.max_len)
+                toks = np.zeros((s_pad,), np.int32)
+                toks[:L] = prompt
+                self._count("prefill", s_pad)
+                self.cache.k, self.cache.v, logits = self.model.prefill(
+                    self.cache.k, self.cache.v, jnp.asarray(toks),
+                    jnp.int32(L), jnp.asarray(seq.table_row))
+                logits = np.asarray(logits)
+            else:
+                s_pad = pow2_bucket(L, lo=1, hi=self.max_len)
+                toks = np.zeros((1, s_pad), np.int32)
+                toks[0, :L] = prompt
+                self._count("prefill", s_pad)
+                logits = np.asarray(self.model.step_full(
+                    jnp.asarray(toks), jnp.asarray([L], np.int32)))[0]
+        if self.keep_logits:
+            seq.last_logits = logits
+        self._append(seq, int(np.argmax(logits)))
+        return seq
+
+    # -- decode --------------------------------------------------------------
+
+    def decode_step(self, seqs):
+        """Advance every sequence in `seqs` by one token (one fused jit
+        call over the power-of-two padded batch)."""
+        seqs = [s for s in seqs if not s.done]
+        if not seqs:
+            return []
+        if len(seqs) > self.max_batch:
+            raise MXNetError("decode batch %d exceeds max_batch %d"
+                             % (len(seqs), self.max_batch))
+        bb = pow2_bucket(len(seqs), lo=1, hi=self.max_batch)
+        with profiler.scope("serving.decode", "serving"):
+            if self.model.uses_cache:
+                toks = np.zeros((bb,), np.int32)
+                pos = np.zeros((bb,), np.int32)
+                tabs = np.zeros((bb, self._nblk), np.int32)
+                for i, s in enumerate(seqs):
+                    toks[i] = s.tokens[-1]
+                    pos[i] = len(s.tokens) - 1
+                    tabs[i] = s.table_row
+                self._count("decode", bb)
+                self.cache.k, self.cache.v, logits, nxt = self.model.decode(
+                    self.cache.k, self.cache.v, jnp.asarray(toks),
+                    jnp.asarray(pos), jnp.asarray(tabs))
+                nxt = np.asarray(nxt)
+                logits = np.asarray(logits) if self.keep_logits else None
+            else:
+                s_pad = pow2_bucket(max(len(s.tokens) for s in seqs),
+                                    lo=1, hi=self.max_len)
+                toks = np.zeros((bb, s_pad), np.int32)
+                lens = np.ones((bb,), np.int32)
+                for i, s in enumerate(seqs):
+                    toks[i, :len(s.tokens)] = s.tokens
+                    lens[i] = len(s.tokens)
+                self._count("decode", (bb, s_pad))
+                logits = np.asarray(self.model.step_full(toks, lens))
+                nxt = np.argmax(logits, axis=-1)
+        for i, s in enumerate(seqs):
+            if self.keep_logits and logits is not None:
+                s.last_logits = logits[i]
+            self._append(s, int(nxt[i]))
+        return seqs
+
+    def _append(self, seq, token):
+        seq.tokens.append(token)
+        if (seq.eos_id is not None and token == seq.eos_id) \
+                or len(seq.tokens) >= seq.max_total:
+            seq.done = True
+
+    def release(self, seq):
+        """Recycle a finished sequence's cache blocks."""
+        if seq.block_ids:
+            self.cache.pool.free(seq.block_ids)
+            seq.block_ids = []

@@ -1,0 +1,160 @@
+"""Paged KV-cache: fixed-shape block pools for jit-stable decode.
+
+The serving problem with a naive per-sequence KV cache is shape churn:
+every admitted/evicted request changes the cache tensor shapes and XLA
+recompiles the decode step. Following the paged-attention design (Ragged
+Paged Attention, arxiv 2604.15464) the cache here is ONE fixed-shape pool
+of `num_blocks` blocks of `block_size` token slots per layer; a sequence
+owns an ordered list of block ids (its *block table*) and the attention
+read path gathers keys/values by table — so the compiled decode program
+only ever sees (pool, int32 tables, int32 lengths) of constant shape, no
+matter which sequences come and go (the compiler-visible O(1) cache
+argument of arxiv 2603.09555).
+
+Block 0 is the *null block*: never allocated, it absorbs every write from
+padded batch rows and padded table entries, so the jitted step needs no
+branches for inactive slots. Reads from it are masked by sequence length.
+
+Host side (`BlockPool`) is a plain free-list — allocation policy is a
+scheduling decision and lives outside the compiled program. Device side,
+the pool arrays are stored FLAT over (num_blocks * block_size) token
+slots so both the per-token scatter and the by-table gather are single
+advanced-indexing ops XLA lowers without data-dependent shapes.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..base import MXNetError
+
+
+class CacheOverflow(MXNetError):
+    """Raised when a reservation asks for more blocks than exist at all;
+    transient exhaustion (blocks held by running sequences) is reported by
+    ``try_alloc`` returning None so the scheduler can queue instead."""
+
+
+class BlockPool:
+    """Free-list over block ids 1..num_blocks-1 (0 is the null block).
+
+    Invariants (tested): a block is never handed out twice while live,
+    freeing a block not currently live raises, and freed blocks are reused
+    (LIFO — the hottest block stays cache-warm on the host bookkeeping
+    side; device placement is unaffected).
+    """
+
+    def __init__(self, num_blocks):
+        if num_blocks < 2:
+            raise MXNetError("BlockPool needs >= 2 blocks (block 0 is the "
+                             "reserved null block)")
+        self.num_blocks = num_blocks
+        self._free = list(range(num_blocks - 1, 0, -1))  # pop() -> 1 first
+        self._live = set()
+
+    @property
+    def available(self):
+        return len(self._free)
+
+    @property
+    def in_use(self):
+        return len(self._live)
+
+    def try_alloc(self, n):
+        """Reserve n blocks; None when the pool can't satisfy it right now
+        (backpressure), CacheOverflow when it never could."""
+        if n > self.num_blocks - 1:
+            raise CacheOverflow(
+                "requested %d blocks but the pool only has %d total"
+                % (n, self.num_blocks - 1))
+        if n > len(self._free):
+            return None
+        ids = [self._free.pop() for _ in range(n)]
+        self._live.update(ids)
+        return ids
+
+    def free(self, ids):
+        for b in ids:
+            if b not in self._live:
+                raise MXNetError("double-free or foreign block id %r" % b)
+            self._live.remove(b)
+            self._free.append(b)
+
+
+class PagedKVCache:
+    """Device-side K/V pools plus the host free-list.
+
+    Arrays: ``k``/``v`` of shape (n_layers, num_blocks * block_size,
+    n_heads, head_dim) — flat token-slot layout (see module docstring).
+    They are plain jax arrays threaded through the jitted engine functions
+    (functional update: each step returns the new pools).
+    """
+
+    def __init__(self, n_layers, n_heads, head_dim, block_size=16,
+                 num_blocks=64, dtype=jnp.float32):
+        self.n_layers = n_layers
+        self.n_heads = n_heads
+        self.head_dim = head_dim
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self.pool = BlockPool(num_blocks)
+        shape = (n_layers, num_blocks * block_size, n_heads, head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+
+    def blocks_for(self, n_tokens):
+        """Blocks needed to hold n_tokens KV entries."""
+        return max(1, math.ceil(n_tokens / self.block_size))
+
+    def table_row(self, block_ids, n_entries):
+        """Fixed-width int32 table row: allocated ids, null-padded."""
+        row = np.zeros((n_entries,), np.int32)
+        row[:len(block_ids)] = block_ids
+        return row
+
+    def utilization(self):
+        return self.pool.in_use / float(self.num_blocks - 1)
+
+
+# ---------------------------------------------------------------------------
+# pure ops used inside the jitted engine functions
+# ---------------------------------------------------------------------------
+
+
+def flat_slots(block_table, positions, block_size):
+    """Flat pool slot for each (row, position): the position'th token of a
+    sequence lives in its table's position//bs block at offset
+    position%bs. block_table (B, nblk), positions (B,) -> (B,)."""
+    blk = jnp.take_along_axis(block_table,
+                              positions[:, None] // block_size,
+                              axis=1)[:, 0]
+    return blk * block_size + positions % block_size
+
+
+def prompt_slots(table_row, length_cap, block_size):
+    """Flat slots for prompt positions 0..length_cap-1 of ONE sequence.
+    table_row (nblk,) -> (length_cap,). Positions past the allocated
+    blocks hit null-padded table entries -> the null block."""
+    pos = jnp.arange(length_cap)
+    return table_row[pos // block_size] * block_size + pos % block_size
+
+
+def write_kv(k_pool, v_pool, layer, slots, k_new, v_new):
+    """Scatter new K/V entries into one layer's flat slots.
+    slots (...,) int32; k_new/v_new (..., n_heads, head_dim)."""
+    k_pool = k_pool.at[layer, slots].set(k_new.astype(k_pool.dtype))
+    v_pool = v_pool.at[layer, slots].set(v_new.astype(v_pool.dtype))
+    return k_pool, v_pool
+
+
+def gather_kv(k_pool, v_pool, layer, block_table, block_size):
+    """Read one layer's K/V for a batch of sequences by block table.
+    block_table (B, nblk) -> k/v (B, nblk*block_size, n_heads, head_dim),
+    position-ordered; entries past each sequence's length are garbage and
+    must be masked by the caller (mask = arange(T) <= position)."""
+    B, nblk = block_table.shape
+    idx = (block_table[:, :, None] * block_size
+           + jnp.arange(block_size)[None, None, :]).reshape(B, -1)
+    return k_pool[layer][idx], v_pool[layer][idx]

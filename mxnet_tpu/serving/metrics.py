@@ -1,0 +1,139 @@
+"""Serving metrics: request latency, throughput, occupancy, cache use.
+
+Aggregates are plain counters/sums behind one lock — `snapshot()` is a
+cheap dict read for the HTTP /metrics endpoint and for tests. Phase
+timings also land in the framework profiler (profiler.scope around the
+engine's prefill/decode does the per-call events; this module records the
+per-request roll-ups) so a chrome trace of a serving run shows queue →
+prefill → decode alongside the op-level events.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from .. import profiler
+
+_DOMAIN = profiler.Domain("serving")
+
+
+class ServingMetrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+        self.submitted = 0
+        self.rejected = 0
+        self.expired = 0
+        self.completed = 0
+        self.failed = 0
+        self.tokens_generated = 0
+        self.decode_steps = 0
+        self._occupancy_sum = 0.0     # active/max_batch per decode step
+        self._batch_sum = 0           # active sequences per decode step
+        self._queue_s = 0.0
+        self._prefill_s = 0.0
+        self._decode_s = 0.0
+        self._total_s = 0.0
+        self._ttft_s = 0.0            # time to first token
+        self._cache_util_last = None
+        self._counter = _DOMAIN.new_counter("tokens_generated")
+
+    # -- recording -----------------------------------------------------------
+
+    def request_submitted(self):
+        with self._lock:
+            self.submitted += 1
+
+    def request_rejected(self):
+        with self._lock:
+            self.rejected += 1
+
+    def request_expired(self, req):
+        """Counts the expiry only; request_finished() (always called
+        after) does the failed/total accounting exactly once."""
+        with self._lock:
+            self.expired += 1
+
+    def request_prefilled(self, req, prefill_s):
+        with self._lock:
+            self._queue_s += req.t_admit - req.t_submit
+            self._prefill_s += prefill_s
+        req.t_first_token = time.perf_counter()
+        with self._lock:
+            self._ttft_s += req.t_first_token - req.t_submit
+
+    def decode_step(self, active, max_batch, step_s, cache_util=None):
+        with self._lock:
+            self.decode_steps += 1
+            self._batch_sum += active
+            self._occupancy_sum += active / float(max_batch)
+            self._decode_s += step_s
+            self.tokens_generated += active
+            if cache_util is not None:
+                self._cache_util_last = cache_util
+        self._counter.increment(active)
+
+    def request_finished(self, req):
+        with self._lock:
+            if req.error is None:
+                self.completed += 1
+            else:
+                self.failed += 1
+            if req.t_done is not None:
+                self._total_s += req.t_done - req.t_submit
+
+    # -- reading -------------------------------------------------------------
+
+    def snapshot(self, engine=None):
+        """One dict with everything: the HTTP /metrics body and the test
+        observable. Rates are lifetime averages; latencies are means in
+        milliseconds over finished/started requests."""
+        with self._lock:
+            elapsed = time.perf_counter() - self._t0
+            fin = max(1, self.completed + self.failed)
+            started = max(1, self.completed + self.failed - self.expired)
+            snap = {
+                "requests": {
+                    "submitted": self.submitted,
+                    "completed": self.completed,
+                    "failed": self.failed,
+                    "rejected": self.rejected,
+                    "expired": self.expired,
+                },
+                "latency_ms": {
+                    "queue_mean": 1e3 * self._queue_s / started,
+                    "prefill_mean": 1e3 * self._prefill_s / started,
+                    "time_to_first_token_mean": 1e3 * self._ttft_s / started,
+                    "total_mean": 1e3 * self._total_s / fin,
+                    "decode_per_token_mean": (
+                        1e3 * self._decode_s / self.tokens_generated
+                        if self.tokens_generated else None),
+                },
+                "throughput": {
+                    "tokens_generated": self.tokens_generated,
+                    "tokens_per_sec": (self.tokens_generated / elapsed
+                                       if elapsed > 0 else None),
+                    "decode_steps": self.decode_steps,
+                },
+                "batch": {
+                    "mean_active": (self._batch_sum / self.decode_steps
+                                    if self.decode_steps else None),
+                    "mean_occupancy": (
+                        self._occupancy_sum / self.decode_steps
+                        if self.decode_steps else None),
+                },
+                "cache": {"block_utilization": self._cache_util_last},
+            }
+        if engine is not None:
+            snap["engine"] = {
+                "prefill_compilations": engine.prefill_compilations,
+                "decode_compilations": engine.decode_compilations,
+                "max_batch": engine.max_batch,
+                "max_len": engine.max_len,
+            }
+            util = engine.cache_utilization()
+            if util is not None:
+                snap["cache"]["block_utilization"] = util
+                snap["cache"]["blocks_in_use"] = engine.cache.pool.in_use
+                snap["cache"]["blocks_total"] = engine.cache.num_blocks - 1
+        return snap

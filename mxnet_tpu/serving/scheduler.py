@@ -1,0 +1,158 @@
+"""Continuous-batching scheduler: admit, run, evict, recycle.
+
+The unit of scheduling is one engine *step*. Before every decode step the
+scheduler admits queued requests into free batch slots (FIFO — a late
+request is guaranteed the next slot that frees up, the fairness property
+tests pin), the engine advances the whole active batch one token, and
+finished sequences are evicted with their cache blocks recycled.
+
+Backpressure is two-level: `submit` rejects immediately once the queue
+holds `max_queue` requests (callers see the failure instead of unbounded
+buffering), and a queued request older than `queue_timeout` seconds is
+failed at admission time rather than served stale. Admission itself is
+head-of-line: if the oldest request's block reservation doesn't fit the
+pool, nothing behind it jumps ahead (no starvation of big requests).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+
+from ..base import MXNetError
+
+
+class QueueFull(MXNetError):
+    """submit() backpressure: the request queue is at max_queue."""
+
+
+class RequestTimeout(MXNetError):
+    """The request waited in the queue longer than queue_timeout."""
+
+
+_ids = itertools.count(1)
+
+QUEUED, RUNNING, DONE, FAILED = "queued", "running", "done", "failed"
+
+
+class Request:
+    """One generation request plus its completion handle. `wait`/`result`
+    make it a minimal future the in-process API and HTTP frontend share."""
+
+    def __init__(self, prompt, max_new_tokens=32, eos_id=None):
+        if not len(prompt):
+            raise MXNetError("empty prompt")
+        self.id = next(_ids)
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.state = QUEUED
+        self.error = None
+        self.tokens = None            # prompt + generated, set on DONE
+        self.t_submit = time.perf_counter()
+        self.t_admit = None
+        self.t_first_token = None
+        self.t_done = None
+        self._event = threading.Event()
+
+    def wait(self, timeout=None):
+        return self._event.wait(timeout)
+
+    def result(self, timeout=None):
+        """Block until finished; returns the generated tokens (prompt
+        excluded). Raises the request's error on failure."""
+        if not self._event.wait(timeout):
+            raise RequestTimeout("request %d still pending after %ss"
+                                 % (self.id, timeout))
+        if self.error is not None:
+            raise self.error
+        return self.tokens[len(self.prompt):]
+
+    def _finish(self, tokens=None, error=None):
+        self.t_done = time.perf_counter()
+        if error is not None:
+            self.state = FAILED
+            self.error = error
+        else:
+            self.state = DONE
+            self.tokens = tokens
+        self._event.set()
+
+
+class Scheduler:
+    """Owns the waiting queue and the running set. Thread-safe for
+    `submit` vs. the single serving thread driving `admit`/`evict`."""
+
+    def __init__(self, max_batch=8, max_queue=64, queue_timeout=None):
+        self.max_batch = max_batch
+        self.max_queue = max_queue
+        self.queue_timeout = queue_timeout
+        self._queue = deque()
+        self._lock = threading.Lock()
+        self.running = []             # serving-thread-only
+
+    def submit(self, req):
+        with self._lock:
+            if len(self._queue) >= self.max_queue:
+                raise QueueFull(
+                    "serving queue is full (%d requests); retry later"
+                    % self.max_queue)
+            self._queue.append(req)
+        return req
+
+    def pending(self):
+        with self._lock:
+            return len(self._queue)
+
+    def has_work(self):
+        return bool(self.running) or self.pending()
+
+    def admit(self, engine, now=None):
+        """Move queued requests into the running set while batch slots and
+        cache blocks allow; expire the ones that waited too long. Returns
+        (admitted, expired) — the caller prefills the admitted ones."""
+        admitted, expired = [], []
+        now = time.perf_counter() if now is None else now
+        while len(self.running) + len(admitted) < self.max_batch:
+            with self._lock:
+                req = self._queue[0] if self._queue else None
+                if req is None:
+                    break
+                if self.queue_timeout is not None and \
+                        now - req.t_submit > self.queue_timeout:
+                    self._queue.popleft()
+                    expired.append(req)
+                    continue
+                try:
+                    fits = engine.can_admit(len(req.prompt),
+                                            req.max_new_tokens)
+                except MXNetError as e:
+                    # can NEVER be served (e.g. prompt > max_len): fail
+                    # this request, don't let it wedge the whole queue
+                    self._queue.popleft()
+                    expired.append(req)
+                    req.error = e
+                    continue
+                if not fits:
+                    break             # head-of-line: preserve FIFO order
+                self._queue.popleft()
+            req.t_admit = now
+            admitted.append(req)
+        for req in expired:
+            req._finish(error=req.error or RequestTimeout(
+                "request %d expired after %.1fs in queue"
+                % (req.id, now - req.t_submit)))
+        return admitted, expired
+
+    def evict(self, engine):
+        """Remove finished sequences from the running set, recycle their
+        blocks, and complete their requests. Returns the finished list."""
+        finished = [s for s in self.running if s.done]
+        if finished:
+            self.running = [s for s in self.running if not s.done]
+            for seq in finished:
+                engine.release(seq)
+                if seq.request is not None:
+                    seq.request._finish(tokens=list(seq.tokens))
+        return finished

@@ -1,0 +1,265 @@
+"""The serving front doors: in-process `serve()` and a stdlib HTTP shim.
+
+`serve(model, ...)` accepts any of:
+  * a `(params, TransformerConfig)` pair — paged-KV continuous batching
+  * an adapter instance (TransformerLM / BlockLM / ExportedLM)
+  * a path to a `.mxtpu` artifact from `predict.export_model`
+  * an initialized Gluon Block (give `vocab` and `max_len`)
+
+and returns a started `LMServer`: a background thread runs the
+continuous-batching loop (admit → prefill → decode step → evict), callers
+submit token prompts and block on per-request futures. The HTTP frontend
+(`LMServer.serve_http` / tools/serve.py) is a thin stdlib
+ThreadingHTTPServer over the same object — one handler thread per
+connection, all of them funneling into the single serving thread, so the
+compiled-step single-writer invariant holds no matter how many clients
+connect.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from ..base import MXNetError
+from .engine import Engine, TransformerLM, BlockLM, ExportedLM
+from .scheduler import Scheduler, Request, QueueFull
+from .metrics import ServingMetrics
+
+
+def _resolve_model(model, vocab=None, max_len=None, time_major=False):
+    if isinstance(model, (TransformerLM, BlockLM, ExportedLM)):
+        return model
+    if isinstance(model, str):
+        return ExportedLM(model)
+    if isinstance(model, tuple) and len(model) == 2:
+        params, cfg = model
+        return TransformerLM(params, cfg)
+    if hasattr(model, "collect_params"):          # Gluon Block
+        if vocab is None or max_len is None:
+            raise MXNetError("serving a Gluon Block needs vocab= and "
+                             "max_len=")
+        return BlockLM(model, vocab, max_len, time_major=time_major)
+    raise MXNetError("don't know how to serve %r — pass (params, cfg), an "
+                     "adapter, a Gluon Block, or a .mxtpu path"
+                     % type(model))
+
+
+class LMServer:
+    """Continuous-batching server over one Engine. Start with
+    `serve(...)`; stop with `close()` (or use as a context manager)."""
+
+    def __init__(self, model, max_batch=8, max_len=None, block_size=16,
+                 num_blocks=None, max_queue=64, queue_timeout=None,
+                 keep_logits=False, vocab=None, time_major=False,
+                 idle_wait=0.005):
+        adapter = _resolve_model(model, vocab=vocab, max_len=max_len,
+                                 time_major=time_major)
+        self.engine = Engine(adapter, max_batch=max_batch, max_len=max_len,
+                             block_size=block_size, num_blocks=num_blocks,
+                             keep_logits=keep_logits)
+        self.scheduler = Scheduler(max_batch=max_batch, max_queue=max_queue,
+                                   queue_timeout=queue_timeout)
+        self.metrics = ServingMetrics()
+        self._idle_wait = idle_wait
+        self._work = threading.Event()
+        self._closed = False
+        self._thread = threading.Thread(target=self._loop,
+                                        name="mxtpu-serving", daemon=True)
+        self._httpd = None
+        self._thread.start()
+
+    # -- client API ----------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens=32, eos_id=None):
+        """Enqueue one request; returns it (a future: .result(timeout)).
+        Raises QueueFull immediately when backpressure kicks in."""
+        if self._closed:
+            raise MXNetError("server is closed")
+        if len(prompt) > self.engine.max_len:
+            raise MXNetError(
+                "prompt length %d exceeds the server's max_len %d"
+                % (len(prompt), self.engine.max_len))
+        req = Request(prompt, max_new_tokens=max_new_tokens, eos_id=eos_id)
+        try:
+            self.scheduler.submit(req)
+        except QueueFull:
+            self.metrics.request_rejected()
+            raise
+        self.metrics.request_submitted()
+        self._work.set()
+        return req
+
+    def generate(self, prompt, max_new_tokens=32, eos_id=None,
+                 timeout=None):
+        """Synchronous helper: submit and wait; returns generated tokens
+        (prompt excluded)."""
+        return self.submit(prompt, max_new_tokens=max_new_tokens,
+                           eos_id=eos_id).result(timeout)
+
+    def snapshot(self):
+        return self.metrics.snapshot(self.engine)
+
+    def close(self, drain=True, timeout=30.0):
+        """Stop the loop; with drain=True finish in-flight work first."""
+        if drain:
+            deadline = time.perf_counter() + timeout
+            while self.scheduler.has_work() and \
+                    time.perf_counter() < deadline:
+                time.sleep(0.01)
+        self._closed = True
+        self._work.set()
+        self._thread.join(timeout=timeout)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- the serving loop ----------------------------------------------------
+
+    def _loop(self):
+        try:
+            self._loop_inner()
+        except BaseException as e:  # noqa: BLE001 — a dead loop must not
+            # strand clients in result(): fail everything in flight
+            err = MXNetError("serving loop died: %s: %s"
+                             % (type(e).__name__, e))
+            for seq in self.scheduler.running:
+                if seq.request is not None and seq.request.error is None:
+                    seq.request._finish(error=err)
+            with self.scheduler._lock:
+                queued = list(self.scheduler._queue)
+                self.scheduler._queue.clear()
+            for req in queued:
+                req._finish(error=err)
+            self._closed = True
+            raise
+
+    def _loop_inner(self):
+        eng, sched, met = self.engine, self.scheduler, self.metrics
+        while not self._closed:
+            admitted, expired = sched.admit(eng)
+            for req in expired:
+                met.request_expired(req)
+                met.request_finished(req)
+            for i, req in enumerate(admitted):
+                t0 = time.perf_counter()
+                seq = eng.start(req.prompt, req.max_new_tokens,
+                                eos_id=req.eos_id)
+                if seq is None:       # transient block shortage: requeue
+                    # this one AND everything admitted behind it, in order
+                    with sched._lock:
+                        for r in reversed(admitted[i:]):
+                            sched._queue.appendleft(r)
+                    break
+                seq.request = req
+                req.state = "running"
+                sched.running.append(seq)
+                met.request_prefilled(req, time.perf_counter() - t0)
+            if sched.running:
+                t0 = time.perf_counter()
+                advanced = eng.decode_step(sched.running)
+                if advanced:  # count only sequences that really stepped
+                    met.decode_step(len(advanced), eng.max_batch,
+                                    time.perf_counter() - t0,
+                                    cache_util=eng.cache_utilization())
+                for req in (s.request for s in sched.evict(eng)
+                            if s.request is not None):
+                    met.request_finished(req)
+            elif not sched.pending():
+                self._work.clear()
+                self._work.wait(self._idle_wait * 20)
+            else:
+                time.sleep(self._idle_wait)
+
+    # -- HTTP frontend -------------------------------------------------------
+
+    def serve_http(self, host="127.0.0.1", port=8080, block=True):
+        """Start the stdlib HTTP frontend. Endpoints:
+        POST /v1/generate  {"tokens": [...], "max_new_tokens": N,
+                            "eos_id": id?}  -> {"tokens": [...], ...}
+        GET  /v1/metrics   -> the metrics snapshot
+        GET  /healthz      -> {"ok": true}
+        Returns the bound (host, port); with block=False the HTTP server
+        runs on a daemon thread (tests bind port 0)."""
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):   # keep stdout clean
+                pass
+
+            def _reply(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply(200, {"ok": True})
+                elif self.path in ("/v1/metrics", "/metrics"):
+                    self._reply(200, outer.snapshot())
+                else:
+                    self._reply(404, {"error": "unknown path %s"
+                                      % self.path})
+
+            def do_POST(self):
+                if self.path not in ("/v1/generate", "/generate"):
+                    self._reply(404, {"error": "unknown path %s"
+                                      % self.path})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    req = outer.submit(
+                        body["tokens"],
+                        max_new_tokens=int(body.get("max_new_tokens", 32)),
+                        eos_id=body.get("eos_id"))
+                except QueueFull as e:
+                    self._reply(429, {"error": str(e)})
+                    return
+                except (KeyError, ValueError, TypeError, MXNetError) as e:
+                    # submit-side failures are the CLIENT's fault
+                    # (malformed body, empty/oversized prompt)
+                    self._reply(400, {"error": "bad request: %s" % e})
+                    return
+                try:
+                    generated = req.result(
+                        timeout=float(body.get("timeout", 300)))
+                except MXNetError as e:
+                    self._reply(500, {"error": str(e)})
+                    return
+                self._reply(200, {
+                    "tokens": generated,
+                    "prompt_len": len(req.prompt),
+                    "latency_ms": 1e3 * (req.t_done - req.t_submit),
+                })
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        addr = self._httpd.server_address
+        if block:
+            try:
+                self._httpd.serve_forever()
+            except KeyboardInterrupt:
+                pass
+            finally:
+                self.close()
+        else:
+            threading.Thread(target=self._httpd.serve_forever,
+                             daemon=True).start()
+        return addr
+
+
+def serve(model, **kwargs):
+    """Build and start an LMServer over `model` (see module docstring for
+    accepted forms). Keyword args pass through to LMServer."""
+    return LMServer(model, **kwargs)

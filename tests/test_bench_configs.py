@@ -27,7 +27,7 @@ def test_bench_smoke_emits_every_config():
     errors = [m for m in metrics if m.endswith("_error")]
     assert not errors, (errors, lines)
     for want in ("infer", "int8_infer", "lstm", "transformer", "ssd",
-                 "sparse", "io_pipeline"):
+                 "sparse", "serving", "io_pipeline"):
         assert any(want in m for m in metrics), (want, metrics)
     # the driver parses the LAST stdout JSON line as the result
     assert metrics[-1] == "smoke_resnet18_train_img_per_sec"

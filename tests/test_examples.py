@@ -34,6 +34,7 @@ _CASES = [
     ("model_parallel_lstm.py", ["--steps", "50", "--batch-size", "8"]),
     ("train_transformer_lm.py", ["--steps", "40", "--d-model", "32",
                                  "--seq-len", "16"]),
+    ("serve_lm.py", ["--steps", "200", "--max-new", "6", "--clients", "3"]),
     ("dcgan.py", ["--iters", "4", "--batch-size", "16"]),
     ("adversary_fgsm.py", ["--epochs", "1"]),
     ("matrix_factorization.py", ["--steps", "60"]),

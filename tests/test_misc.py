@@ -591,3 +591,24 @@ def test_model_store_short_hash_and_resolution(tmp_path, monkeypatch):
         "tiny_net", root=str(tmp_path)) == str(plain)  # plain wins
     with pytest.raises(IOError):
         model_store.get_model_file("absent_model", root=str(tmp_path))
+
+
+def test_next_key_inside_foreign_trace():
+    """next_key() called inside someone else's jit trace (no
+    trace_key_scope) must (a) hand out DISTINCT keys per call, (b) not
+    poison the eager RNG state with a tracer — the second trace and the
+    following eager draw both used to die with UnexpectedTracerError."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import random as mxrand
+
+    def f(x):
+        u1 = jax.random.uniform(mxrand.next_key(), ())
+        u2 = jax.random.uniform(mxrand.next_key(), ())
+        return x + u1, u2
+
+    r1, u2 = jax.jit(f)(jnp.float32(0.0))
+    assert float(r1) != float(u2)           # distinct keys per call
+    jax.jit(f)(jnp.zeros((2,)))             # 2nd trace: no tracer leak
+    eager = jax.random.uniform(mxrand.next_key(), ())  # eager still fine
+    assert 0.0 <= float(eager) <= 1.0

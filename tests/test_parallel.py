@@ -38,7 +38,7 @@ def test_shard_batch_and_replicate():
 
 
 def test_collectives_psum_allgather():
-    from jax import shard_map
+    from mxnet_tpu.parallel.collectives import shard_map
     m = pmesh.build_mesh({"dp": 8})
     x = jnp.arange(8.0)
 
@@ -60,7 +60,7 @@ def test_collectives_psum_allgather():
 
 
 def test_ring_permute():
-    from jax import shard_map
+    from mxnet_tpu.parallel.collectives import shard_map
     m = pmesh.build_mesh({"dp": 8})
     x = jnp.arange(8.0)
     out = shard_map(lambda v: coll.ring_permute(v, "dp", shift=1), mesh=m,
@@ -70,7 +70,7 @@ def test_ring_permute():
 
 
 def test_reduce_scatter():
-    from jax import shard_map
+    from mxnet_tpu.parallel.collectives import shard_map
     m = pmesh.build_mesh({"dp": 8})
     x = jnp.asarray(rand(8, 8))
     # each device holds one row; psum_scatter leaves device i with element i

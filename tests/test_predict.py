@@ -88,6 +88,44 @@ def test_export_gluon_block(tmp_path):
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
 
 
+def test_predictor_predict_batches_and_pads(tmp_path):
+    """predict(list) pads/chunks to the bound (B, ...) signature instead
+    of raising on count mismatch; outputs match per-sample forwards."""
+    _sym, _params, _x, _ref = _mlp_checkpoint(tmp_path)
+    symbol_json = (tmp_path / "m-symbol.json").read_text()
+    pred = mx.Predictor(symbol_json, str(tmp_path / "m-0001.params"),
+                        {"data": (2, 5)})
+    rng = np.random.RandomState(3)
+    samples = [rng.randn(5).astype(np.float32) for _ in range(5)]
+    outs = pred.predict(samples)
+    assert len(outs) == 5
+    for s, o in zip(samples, outs):
+        ref = pred.forward(data=np.stack([s, s]))[0].asnumpy()[0]
+        np.testing.assert_allclose(o, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_exported_predict_variable_length(tmp_path):
+    """Variable-length inputs pad along the ragged axis to the exported
+    signature and outputs are trimmed back to each true length."""
+    net = gluon.nn.Dense(3, in_units=4, flatten=False)
+    net.initialize(mx.init.Xavier())
+    art = str(tmp_path / "v.mxtpu")
+    pred_mod.export_model(net, [("x", (2, 6, 4))], art)
+    served = pred_mod.load_exported(art)
+    rng = np.random.RandomState(5)
+    samples = [rng.randn(n, 4).astype(np.float32) for n in (3, 6, 2)]
+    outs = served.predict(samples)
+    assert [o.shape for o in outs] == [(3, 3), (6, 3), (2, 3)]
+    for s, o in zip(samples, outs):
+        ref = net(mx.nd.array(s[None])).asnumpy()[0]
+        np.testing.assert_allclose(o, ref[:s.shape[0]], rtol=1e-5,
+                                   atol=1e-6)
+    with pytest.raises(mx.MXNetError):
+        served.predict([rng.randn(7, 4).astype(np.float32)])  # too long
+    with pytest.raises(mx.MXNetError):
+        served.predict([rng.randn(3, 5).astype(np.float32)])  # bad width
+
+
 def test_exported_artifact_is_self_contained(tmp_path):
     """The artifact replays through jax alone — no symbol/op machinery."""
     import zipfile

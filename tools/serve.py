@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Serve a language model over HTTP with continuous batching.
+
+The stdlib-HTTP front door over mxnet_tpu.serving: load a `.mxtpu`
+artifact exported by `mxnet_tpu.predict.export_model` (one int token
+input (batch, seq) -> logits) and serve it, or run `--demo` to serve a
+randomly-initialized tiny transformer for smoke-testing the stack.
+
+    python tools/serve.py --model lm.mxtpu --port 8080
+    curl -X POST localhost:8080/v1/generate \
+         -d '{"tokens": [3, 1, 4, 1, 5], "max_new_tokens": 16}'
+    curl localhost:8080/v1/metrics
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default=None,
+                    help=".mxtpu artifact from predict.export_model")
+    ap.add_argument("--demo", action="store_true",
+                    help="serve a random tiny transformer (no artifact)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--max-queue", type=int, default=64)
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--queue-timeout", type=float, default=None,
+                    help="fail requests queued longer than this (s)")
+    args = ap.parse_args()
+
+    from mxnet_tpu import serving
+
+    if args.demo:
+        import jax
+        from mxnet_tpu.models.transformer import (TransformerConfig,
+                                                  init_transformer_params)
+        cfg = TransformerConfig(vocab=256, d_model=64, n_heads=4,
+                                n_layers=2, d_ff=128, max_len=128)
+        params = init_transformer_params(jax.random.PRNGKey(0), cfg)
+        model = (params, cfg)
+        print("serving DEMO transformer (random weights, vocab 256)")
+    elif args.model:
+        model = args.model
+        print("serving artifact %s" % args.model)
+    else:
+        ap.error("pass --model artifact.mxtpu or --demo")
+
+    srv = serving.serve(model, max_batch=args.max_batch,
+                        max_queue=args.max_queue,
+                        block_size=args.block_size,
+                        queue_timeout=args.queue_timeout)
+    print("listening on http://%s:%d  (POST /v1/generate, GET /v1/metrics)"
+          % (args.host, args.port))
+    srv.serve_http(host=args.host, port=args.port, block=True)
+
+
+if __name__ == "__main__":
+    main()
