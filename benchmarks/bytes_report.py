@@ -10,17 +10,28 @@ via checkpoint_name) + BN batch stats, recompute the cheap elementwise
 chains (BN normalize / relu / residual adds) in backward instead of
 writing them in forward and re-reading them.
 
-This script compiles the step under each remat mode and prints XLA's
-flops / bytes-accessed counts plus the implied bandwidth-floor step time.
+This script compiles the step under each mode and prints XLA's flops /
+bytes-accessed counts plus the implied bandwidth-floor step time. A mode
+is `<remat>[+fused]`: the remat policy (none/full/io) crossed with the
+Pallas fused BN/ReLU/residual epilogue (MXNET_FUSED_BN_EPILOGUE=1,
+ops/pallas_fused.py) — the four decision modes of the bytes ledger are
+none / io / fused / io+fused (BENCH_NOTES.md avenue 3).
+
 Run on TPU for the authoritative numbers (fusion decisions are
 backend-specific; XLA:CPU CSEs remat differently) — benchmarks/
-tpu_session.sh runs it there. A CPU run (BYTES_SMALL=1 recommended) still
-shows the program-level delta: saved-residual bytes move out of the
-forward/backward boundary.
+tpu_session.sh runs it there (step 2b/2c). A CPU run (BYTES_SMALL=1
+recommended) still shows the program-level delta: saved-residual bytes
+move out of the forward/backward boundary. Two disclosures on every CPU
+line: the numbers are DIRECTIONAL (backend-specific fusion), and in
+fused modes the kernels run under the Pallas interpreter, whose lowered
+HLO differs from the Mosaic kernel the TPU executes (each pallas_call
+declares a CostEstimate so the TPU cost model counts the custom call's
+real traffic instead of zero).
 
 Knobs: BENCH_BATCH (256), BENCH_DTYPE (bfloat16), BYTES_SMALL=1 (resnet18
-@ 64px, for CPU), BYTES_MODES (comma list, default none,full,io),
-BYTES_EXEC=1 (also time 5 real steps per mode).
+@ 64px, for CPU), BYTES_MODES (comma list, default
+none,full,io,fused,io+fused), BYTES_EXEC=1 (also time 5 real steps per
+mode).
 
 Output: one JSON line per mode + a summary table on stderr.
 """
@@ -30,6 +41,16 @@ import sys
 import time
 
 import numpy as np
+
+
+def parse_mode(mode):
+    """'io+fused' -> ('io', True); 'fused' -> ('none', True)."""
+    parts = [p for p in mode.strip().split("+") if p]
+    fused = "fused" in parts
+    parts = [p for p in parts if p != "fused"]
+    if len(parts) > 1:
+        raise ValueError("bad mode %r" % (mode,))
+    return (parts[0] if parts else "none"), fused
 
 
 def build_step(remat, dtype, batch, image, small):
@@ -83,7 +104,8 @@ def main():
     batch = int(os.environ.get("BENCH_BATCH", "32" if small else "256"))
     image = 64 if small else 224
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
-    modes = os.environ.get("BYTES_MODES", "none,full,io").split(",")
+    modes = os.environ.get("BYTES_MODES",
+                           "none,full,io,fused,io+fused").split(",")
     do_exec = os.environ.get("BYTES_EXEC", "0") == "1"
     try:
         from bench import _hbm_bw  # the maintained per-kind spec table
@@ -94,11 +116,29 @@ def main():
     rows = []
     for mode in modes:
         mode = mode.strip()
-        step, x, y = build_step(mode, dtype, batch, image, small)
-        t0 = time.perf_counter()
-        info, compiled, args = analyze(step, x, y)
+        remat, fused = parse_mode(mode)
+        # the fused flag is read at TRACE time (ops/nn.py), so it must be
+        # set for both the build and the lowering, and restored after
+        prior = os.environ.get("MXNET_FUSED_BN_EPILOGUE")
+        os.environ["MXNET_FUSED_BN_EPILOGUE"] = "1" if fused else "0"
+        try:
+            step, x, y = build_step(remat, dtype, batch, image, small)
+            t0 = time.perf_counter()
+            info, compiled, args = analyze(step, x, y)
+        finally:
+            if prior is None:
+                os.environ.pop("MXNET_FUSED_BN_EPILOGUE", None)
+            else:
+                os.environ["MXNET_FUSED_BN_EPILOGUE"] = prior
         info["compile_s"] = round(time.perf_counter() - t0, 1)
         info["mode"] = mode
+        info["remat"] = remat
+        info["fused_bn_epilogue"] = fused
+        if fused and dev.platform != "tpu":
+            info["note"] = ("fused kernels ran under the Pallas "
+                            "interpreter — directional; TPU lowers them "
+                            "as Mosaic custom calls with declared "
+                            "CostEstimates")
         info["batch"] = batch
         info["device"] = dev.device_kind
         if do_exec:
@@ -123,7 +163,7 @@ def main():
         print(json.dumps(info), flush=True)
 
     base = next((r for r in rows if r["mode"] == "none"), None)
-    print("\nmode   GB/step  GFLOP/step  temp GB  floor ms%s" %
+    print("\nmode       GB/step  GFLOP/step  temp GB  floor ms%s" %
           ("  step ms  img/s" if do_exec else ""), file=sys.stderr)
     for r in rows:
         gb = (r["bytes_accessed"] or 0) / 1e9
@@ -138,7 +178,7 @@ def main():
             delta = "  (bytes %+0.1f%%)" % (
                 100.0 * (r["bytes_accessed"] - base["bytes_accessed"])
                 / base["bytes_accessed"])
-        print("%-6s %7.2f  %10.1f  %7.2f  %8s%s%s" %
+        print("%-9s %7.2f  %10.1f  %7.2f  %8s%s%s" %
               (r["mode"], gb, gf, tg, r.get("roofline_floor_ms", "-"),
                extra, delta), file=sys.stderr)
 

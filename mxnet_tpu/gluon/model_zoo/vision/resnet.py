@@ -23,6 +23,58 @@ def _bn_axis(layout):
     return 3 if layout == "NHWC" else 1
 
 
+def _fuse_on(x):
+    """True when this block's forward should run through the fused
+    BN/ReLU/residual epilogue ops (MXNET_FUSED_BN_EPILOGUE=1; eager/
+    NDArray inputs only — symbolic traces keep the reference composition
+    so exported graphs stay flag-independent)."""
+    from ....ops.pallas_fused import fuse_enabled
+    if not fuse_enabled():
+        return False
+    from ....ndarray import NDArray
+    return isinstance(x, NDArray)
+
+
+def _is_relu(block):
+    return isinstance(block, nn.Activation) and block._act_type == "relu"
+
+
+def _fused_v1_forward(body, downsample, x):
+    """V1 residual-block forward through the fused epilogues: mid-body
+    (BatchNorm, ReLU) pairs collapse into one BatchNormAddRelu each, and
+    the trailing body BatchNorm consumes the residual add + final ReLU —
+    the BN-normalize/relu/add chain reads and writes each activation once
+    (ops/pallas_fused.py) instead of once per op. Parameter structure is
+    untouched: the same child layers run, only their composition fuses."""
+    blocks = list(body._children.values())
+    h = x
+    i = 0
+    last = len(blocks) - 1  # V1 bodies always end with a BatchNorm
+    while i < last:
+        b = blocks[i]
+        if isinstance(b, nn.BatchNorm) and i + 1 < last and \
+                _is_relu(blocks[i + 1]):
+            h = b.fused_call(h, act="relu")
+            i += 2
+        else:
+            h = b(h)
+            i += 1
+    residual = x
+    if downsample is not None:
+        for db in downsample._children.values():
+            residual = db.fused_call(residual) \
+                if isinstance(db, nn.BatchNorm) else db(residual)
+    return blocks[last].fused_call(h, act="relu", residual=residual)
+
+
+def _bn_relu(F, bn, x):
+    """BN followed by ReLU, fused into one op on the epilogue fast path
+    (the V2 pre-activation pattern)."""
+    if _fuse_on(x):
+        return bn.fused_call(x, act="relu")
+    return F.Activation(bn(x), act_type="relu")
+
+
 class BasicBlockV1(HybridBlock):
     def __init__(self, channels, stride, downsample=False, in_channels=0,
                  layout="NCHW", **kwargs):
@@ -45,6 +97,8 @@ class BasicBlockV1(HybridBlock):
             self.downsample = None
 
     def hybrid_forward(self, F, x):
+        if _fuse_on(x):
+            return _fused_v1_forward(self.body, self.downsample, x)
         residual = x
         x = self.body(x)
         if self.downsample:
@@ -80,6 +134,8 @@ class BottleneckV1(HybridBlock):
             self.downsample = None
 
     def hybrid_forward(self, F, x):
+        if _fuse_on(x):
+            return _fused_v1_forward(self.body, self.downsample, x)
         residual = x
         x = self.body(x)
         if self.downsample:
@@ -106,13 +162,11 @@ class BasicBlockV2(HybridBlock):
 
     def hybrid_forward(self, F, x):
         residual = x
-        x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
+        x = _bn_relu(F, self.bn1, x)
         if self.downsample:
             residual = self.downsample(x)
         x = self.conv1(x)
-        x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
+        x = _bn_relu(F, self.bn2, x)
         x = self.conv2(x)
         return x + residual
 
@@ -139,16 +193,13 @@ class BottleneckV2(HybridBlock):
 
     def hybrid_forward(self, F, x):
         residual = x
-        x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
+        x = _bn_relu(F, self.bn1, x)
         if self.downsample:
             residual = self.downsample(x)
         x = self.conv1(x)
-        x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
+        x = _bn_relu(F, self.bn2, x)
         x = self.conv2(x)
-        x = self.bn3(x)
-        x = F.Activation(x, act_type="relu")
+        x = _bn_relu(F, self.bn3, x)
         x = self.conv3(x)
         return x + residual
 

@@ -184,7 +184,6 @@ class BatchNorm(HybridBlock):
                 p._finish_deferred_init(p.shape)
 
     def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
-        from ... import autograd
         res = F.BatchNorm(
             x, gamma, beta, running_mean, running_var,
             eps=self._epsilon, momentum=self._momentum,
@@ -195,12 +194,39 @@ class BatchNorm(HybridBlock):
             # threading is the executor's job (symbol._eval aux_updates)
             return res
         out, mean, var = res
+        self._update_moving_stats(mean, var)
+        return out
+
+    def _update_moving_stats(self, mean, var):
+        from ... import autograd
         if autograd.is_training() and not self._use_global_stats:
             m = self._momentum
             self.running_mean.set_data(
                 m * self.running_mean.data() + (1 - m) * mean.detach())
             self.running_var.set_data(
                 m * self.running_var.data() + (1 - m) * var.detach())
+
+    def fused_call(self, x, act=None, residual=None):
+        """BN with the ReLU/residual epilogue folded into one op
+        (`_contrib_BatchNormAddRelu`; MXNET_FUSED_BN_EPILOGUE=1 routes it
+        through the Pallas kernels, off-flag it composes the same math in
+        XLA). Same deferred-init and moving-stat semantics as the plain
+        forward — the residual-block fast path in model_zoo resnet uses
+        this for the relu(BN(x) + residual) tails."""
+        from ...gluon.parameter import DeferredInitializationError
+        from ... import ndarray as F
+        try:
+            params = {n: p.data() for n, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self._finish_deferred_init(x)
+            params = {n: p.data() for n, p in self._reg_params.items()}
+        out, mean, var = F._contrib_BatchNormAddRelu(
+            x, params["gamma"], params["beta"], params["running_mean"],
+            params["running_var"], addend=residual, eps=self._epsilon,
+            momentum=self._momentum, fix_gamma=not self._scale,
+            use_global_stats=self._use_global_stats, axis=self._axis,
+            act_type=act)
+        self._update_moving_stats(mean, var)
         return out
 
 

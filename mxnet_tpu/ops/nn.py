@@ -477,6 +477,16 @@ def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     training = autograd.is_training() and not use_global_stats
     if training:
+        # MXNET_FUSED_BN_EPILOGUE=1: hand-fused Pallas kernels (one-pass
+        # stats + normalize in two HBM sweeps, custom VJP) — the bytes/step
+        # lever for the bandwidth-bound train step (BENCH_NOTES.md avenue
+        # 3). Ineligible shapes/layouts keep the XLA path below.
+        from . import pallas_fused as _pf
+        if _pf.fuse_enabled() and _pf.fuse_eligible(data, axis):
+            out, mean, var = _pf.fused_bn_act(data, g, beta, eps=eps)
+            mean = ad_checkpoint.checkpoint_name(mean, "bn_stats")
+            var = ad_checkpoint.checkpoint_name(var, "bn_stats")
+            return out, mean.astype(gamma.dtype), var.astype(gamma.dtype)
         # one-pass statistics, >=f32 accumulation: E[x] and E[x^2] reduce in
         # a single fused read of the activation (jnp.var would re-read it
         # after the mean lands — an extra full HBM pass per BN under bf16
@@ -501,6 +511,52 @@ def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     out = (data * scale.reshape(shape).astype(data.dtype)
            + offset.reshape(shape).astype(data.dtype))
     return out, mean.astype(gamma.dtype), var.astype(gamma.dtype)
+
+
+@register("_contrib_BatchNormAddRelu", num_outputs=3,
+          aliases=("BatchNormAddRelu",))
+def BatchNormAddRelu(data, gamma, beta, moving_mean, moving_var, addend=None,
+                     eps=1e-3, momentum=0.9, fix_gamma=True,
+                     use_global_stats=False, axis=1, act_type="relu"):
+    """act(BN(data) + addend): the BN epilogue of a residual block as ONE
+    op (parity: the reference's contrib BatchNormAddRelu fused kernel).
+
+    Returns (out, batch_mean, batch_var) like BatchNorm. With
+    MXNET_FUSED_BN_EPILOGUE=1 the training-mode chain runs as the Pallas
+    fused kernels (ops/pallas_fused.py) — each activation read once,
+    written once, forward and backward; otherwise (or for ineligible
+    shapes / eval mode) it composes the same math from the XLA ops, so the
+    op is always available and the env flag only switches implementation.
+    `addend` is optional (keyword tensor): without it the op is a fused
+    BN+activation. act_type: "relu" or None.
+    """
+    from .. import autograd
+    if act_type not in (None, "None", "relu"):
+        raise ValueError("BatchNormAddRelu supports act_type 'relu' or "
+                         "None, got %r" % (act_type,))
+    relu = act_type == "relu"
+    training = autograd.is_training() and not use_global_stats
+    if training:
+        from . import pallas_fused as _pf
+        if _pf.fuse_enabled() and _pf.fuse_eligible(data, axis) and \
+                (addend is None or addend.shape == data.shape):
+            g = jnp.ones_like(gamma) if fix_gamma else gamma
+            out, mean, var = _pf.fused_bn_act(
+                data, g, beta, eps=eps, act="relu" if relu else None,
+                residual=addend)
+            mean = ad_checkpoint.checkpoint_name(mean, "bn_stats")
+            var = ad_checkpoint.checkpoint_name(var, "bn_stats")
+            return (out, mean.astype(gamma.dtype),
+                    var.astype(gamma.dtype))
+    out, mean, var = BatchNorm(data, gamma, beta, moving_mean, moving_var,
+                               eps=eps, momentum=momentum,
+                               fix_gamma=fix_gamma,
+                               use_global_stats=use_global_stats, axis=axis)
+    if addend is not None:
+        out = out + addend.astype(out.dtype)
+    if relu:
+        out = jax.nn.relu(out)
+    return out, mean, var
 
 
 @register("LayerNorm")

@@ -53,6 +53,10 @@ from .. import optimizer_rules as _rules
 #: (BN normalize, relu, residual adds) in backward instead of writing them
 #: out in forward and re-reading them — the bandwidth-roofline lever for a
 #: step measured at 95% of the HBM floor (BENCH_NOTES roofline analysis).
+#: Composes with MXNET_FUSED_BN_EPILOGUE=1 (ops/pallas_fused.py): the
+#: fused op's custom-VJP residuals are exactly this save set (conv_out +
+#: bn_stats), so under "io" its relu outputs are never stored — backward
+#: replays the Pallas epilogue kernel from the saved conv output.
 _REMAT_POLICIES = {
     "full": lambda: None,  # jax.checkpoint default: nothing saveable
     "io": lambda: jax.checkpoint_policies.save_only_these_names(

@@ -66,6 +66,214 @@ def test_default_interpret_matches_backend():
     assert default_interpret() == (jax.default_backend() != "tpu")
 
 
+# ---------------------------------------------------------------------------
+# fused BN/ReLU/residual epilogue (ops/pallas_fused.py) — interpret mode;
+# the same kernels compile for the VPU on real TPU backends
+# ---------------------------------------------------------------------------
+
+from mxnet_tpu.ops import nn as ops_nn
+from mxnet_tpu.ops import pallas_fused as pf
+
+
+def _bn_chain_xla(x, gamma, beta, eps, act=None, residual=None):
+    """The composed XLA path the kernel must match: training-mode
+    BatchNorm (ops/nn.py, one-pass f32 stats) + residual add + relu."""
+    with mx.autograd.record():
+        out, mean, var = ops_nn.BatchNorm(
+            x, gamma, beta, jnp.zeros_like(gamma), jnp.ones_like(gamma),
+            eps=eps, fix_gamma=False)
+    if residual is not None:
+        out = out + residual.astype(out.dtype)
+    if act == "relu":
+        out = jax.nn.relu(out)
+    return out, mean, var
+
+
+def _fused_tols(dtype):
+    # bf16 differs from the XLA path by apply-precision (the kernel
+    # normalizes in f32 and rounds once; XLA rounds scale/offset to bf16
+    # first) — tolerance scales with the dtype's epsilon
+    if dtype == jnp.bfloat16:
+        return dict(rtol=3e-2, atol=3e-2)
+    return dict(rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("shape", [(3, 8, 6, 6), (2, 8, 56, 56)])
+def test_fused_bn_epilogue_forward_matches_xla(monkeypatch, dtype, shape):
+    """Forward equality vs the XLA path, f32 and bf16-with-f32-stats,
+    including a 56x56 residual-block shape (the profile's hot tensors)."""
+    monkeypatch.delenv("MXNET_FUSED_BN_EPILOGUE", raising=False)
+    N, C = shape[0], shape[1]
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(*shape).astype(np.float32)).astype(dtype)
+    r = jnp.asarray(rng.randn(*shape).astype(np.float32)).astype(dtype)
+    g = jnp.asarray(rng.rand(C).astype(np.float32) + 0.5)
+    b = jnp.asarray(rng.randn(C).astype(np.float32))
+    eps = 1e-3
+    y, mean, var = pf.fused_bn_act(x, g, b, eps=eps, act="relu",
+                                   residual=r, interpret=True)
+    yr, mr, vr = _bn_chain_xla(x, g, b, eps, act="relu", residual=r)
+    assert y.dtype == x.dtype
+    assert mean.dtype == jnp.float32 and var.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(yr, np.float32),
+                               **_fused_tols(dtype))
+    # stats vs a float64 numpy reference: the one-pass E[x]/E[x^2]
+    # accumulation must stay f32-accurate even from bf16 data
+    x64 = np.asarray(x, np.float32).astype(np.float64)
+    np.testing.assert_allclose(np.asarray(mean),
+                               x64.mean(axis=(0, 2, 3)), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(var),
+                               x64.var(axis=(0, 2, 3)), atol=1e-4)
+
+
+@pytest.mark.parametrize("act,with_res,dtype", [
+    ("relu", True, jnp.float32), ("relu", False, jnp.float32),
+    (None, True, jnp.float32), (None, False, jnp.float32),
+    ("relu", True, jnp.bfloat16),   # the headline trains bf16 through this
+])
+def test_fused_bn_epilogue_grads_match_xla(monkeypatch, act, with_res,
+                                           dtype):
+    """Custom-VJP equality vs jax.grad through the XLA chain for every
+    epilogue variant: d-input, d-gamma, d-beta, d-residual — f32 exact-ish,
+    bf16 (the headline's training dtype) at dtype tolerance."""
+    monkeypatch.delenv("MXNET_FUSED_BN_EPILOGUE", raising=False)
+    N, C, H, W = 2, 8, 12, 12
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(N, C, H, W).astype(np.float32)).astype(dtype)
+    r = jnp.asarray(rng.randn(N, C, H, W).astype(np.float32)).astype(dtype) \
+        if with_res else None
+    g = jnp.asarray(rng.rand(C).astype(np.float32) + 0.5)
+    b = jnp.asarray(rng.randn(C).astype(np.float32))
+    w = jnp.asarray(rng.randn(N, C, H, W).astype(np.float32)).astype(dtype)
+    eps = 1e-3
+    if dtype == jnp.bfloat16:
+        # elements whose pre-activation sits within bf16 rounding of 0
+        # legitimately flip the relu mask between the two implementations
+        # (the kernel applies in f32 and rounds once; XLA rounds
+        # scale/offset to bf16 first). Zero the loss weight there so mask
+        # flips contribute nothing to ANY gradient and the rest must
+        # agree at dtype tolerance.
+        with mx.autograd.record():
+            z, _, _ = _bn_chain_xla(x.astype(jnp.float32), g, b, eps,
+                                    act=None, residual=r)
+        w = (w.astype(jnp.float32)
+             * (jnp.abs(z) > 0.02)).astype(dtype)
+
+    def loss_fused(x, g, b, r):
+        y, mean, var = pf.fused_bn_act(x, g, b, eps=eps, act=act,
+                                       residual=r, interpret=True)
+        # mean/var terms exercise the statistic-output cotangents too;
+        # f32 sum so the comparison isn't dominated by loss rounding
+        return jnp.sum((y * w).astype(jnp.float32)) \
+            + jnp.sum(jnp.sin(mean) + jnp.cos(var))
+
+    def loss_xla(x, g, b, r):
+        y, mean, var = _bn_chain_xla(x, g, b, eps, act=act, residual=r)
+        return jnp.sum((y * w).astype(jnp.float32)) \
+            + jnp.sum(jnp.sin(mean) + jnp.cos(var))
+
+    argnums = (0, 1, 2, 3) if with_res else (0, 1, 2)
+    with mx.autograd.record():
+        gf = jax.grad(loss_fused, argnums=argnums)(x, g, b, r)
+        gr = jax.grad(loss_xla, argnums=argnums)(x, g, b, r)
+    # bf16 atol covers reduction rounding on near-cancelling channel sums
+    # (dz is a bf16 tensor in both implementations; ~300-element sums with
+    # O(1) terms carry ~1e-1 absolute noise). The f32 variants pin the
+    # backward math itself at 1e-4.
+    tols = dict(rtol=1e-4, atol=1e-4) if dtype == jnp.float32 \
+        else dict(rtol=5e-2, atol=2e-1)
+    for name, a, e in zip(("dx", "dgamma", "dbeta", "dres"), gf, gr):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(e, np.float32),
+                                   err_msg=name, **tols)
+
+
+def test_fused_bn_eligibility_gate():
+    x = jnp.zeros((2, 8, 6, 6), jnp.float32)
+    assert pf.fuse_eligible(x, axis=1)
+    assert not pf.fuse_eligible(x, axis=3)          # channels-last: XLA path
+    assert not pf.fuse_eligible(jnp.zeros((2, 8, 6, 6), jnp.int32), axis=1)
+    assert pf.fuse_eligible(jnp.zeros((4, 16), jnp.bfloat16), axis=1)
+
+
+def test_batchnorm_add_relu_op_flag_equivalence(monkeypatch):
+    """_contrib_BatchNormAddRelu: the env flag switches implementation
+    (Pallas kernels vs composed XLA), never semantics — same outputs and
+    same (out, mean, var) contract either way."""
+    N, C, H, W = 2, 8, 7, 7
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(N, C, H, W).astype(np.float32))
+    r = jnp.asarray(rng.randn(N, C, H, W).astype(np.float32))
+    g = jnp.asarray(rng.rand(C).astype(np.float32) + 0.5)
+    b = jnp.asarray(rng.randn(C).astype(np.float32))
+    mm, mv = jnp.zeros(C), jnp.ones(C)
+
+    def run():
+        with mx.autograd.record():
+            return ops_nn.BatchNormAddRelu(x, g, b, mm, mv, addend=r,
+                                           eps=1e-3, fix_gamma=False)
+
+    monkeypatch.setenv("MXNET_FUSED_BN_EPILOGUE", "0")
+    out0, mean0, var0 = run()
+    monkeypatch.setenv("MXNET_FUSED_BN_EPILOGUE", "1")
+    out1, mean1, var1 = run()
+    np.testing.assert_allclose(np.asarray(out0), np.asarray(out1),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(mean0), np.asarray(mean1),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(var0), np.asarray(var1),
+                               rtol=1e-5, atol=1e-5)
+    # eval mode: composed fallback regardless of the flag (no batch stats)
+    out_eval = ops_nn.BatchNormAddRelu(x, g, b, mm, mv, addend=r,
+                                       eps=1e-3, fix_gamma=False)[0]
+    inv = np.float32(1.0 / np.sqrt(1.0 + 1e-3))
+    expect = jax.nn.relu(x * inv * g[None, :, None, None]
+                         + b[None, :, None, None] + r)
+    np.testing.assert_allclose(np.asarray(out_eval), np.asarray(expect),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fused_trainstep_end_to_end(monkeypatch):
+    """MXNET_FUSED_BN_EPILOGUE=1 selects the kernels inside the fused
+    TrainStep end-to-end (resnet V1 block: mid-body BN+ReLU pairs and the
+    BN+add+ReLU tail), composes with remat='io', and matches the XLA step
+    bit-for-tolerance: losses, weights, and moving stats after 3 steps."""
+    from mxnet_tpu.gluon import loss as gloss, nn as gnn
+    from mxnet_tpu.gluon.model_zoo.vision.resnet import BasicBlockV1
+    from mxnet_tpu.parallel.trainer import TrainStep
+
+    def run(fused, remat=None):
+        monkeypatch.setenv("MXNET_FUSED_BN_EPILOGUE",
+                           "1" if fused else "0")
+        mx.random.seed(0)
+        np.random.seed(0)
+        net = gnn.HybridSequential()
+        net.add(BasicBlockV1(8, 1, downsample=True, in_channels=4))
+        net.add(gnn.GlobalAvgPool2D())
+        net.add(gnn.Flatten())
+        net.add(gnn.Dense(8))
+        net.initialize(mx.init.Xavier())
+        net(mx.nd.zeros((1, 4, 8, 8)))
+        step = TrainStep(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+                         {"learning_rate": 0.1, "momentum": 0.9},
+                         remat=remat)
+        rng = np.random.RandomState(0)
+        x = rng.uniform(-1, 1, (2, 4, 8, 8)).astype(np.float32)
+        y = rng.randint(0, 8, (2,)).astype(np.int32)
+        losses = [float(step(x, y)) for _ in range(3)]
+        step.sync_params()
+        return losses, [np.asarray(p.data().asnumpy())
+                        for p in net.collect_params().values()]
+
+    l_ref, p_ref = run(False)
+    l_fused, p_fused = run(True, remat="io")   # fused + io-remat stacked
+    np.testing.assert_allclose(l_fused, l_ref, rtol=1e-5, atol=1e-5)
+    for a, e in zip(p_fused, p_ref):
+        np.testing.assert_allclose(a, e, rtol=2e-5, atol=2e-5)
+
+
 def test_transformer_uses_flash(monkeypatch):
     """Transformer forward is identical with the Pallas path on and off."""
     from mxnet_tpu.models import transformer as tfm
