@@ -8,8 +8,10 @@ inference (benchmark_score.py role) in bf16 and through the int8
 quantize_model graph rewrite, LSTM word LM (example/rnn/word_lm),
 transformer LM with vs without the Pallas flash attention kernel, SSD
 forward (example/ssd), sparse linear (example/sparse/
-linear_classification), and the native C++ RecordIO+JPEG input pipeline
-(io_pipeline — host-side, accelerator-independent).
+linear_classification), the native C++ RecordIO+JPEG input pipeline
+(io_pipeline — host-side, accelerator-independent), and BENCH_RESILIENCE
+(checkpoint capture/publish/restore latency + steps-lost-per-simulated-
+preemption — the fault-tolerance runtime's overhead line).
 
 Timing methodology (BENCH_NOTES.md): every loop chains iterations through
 a data dependency (donated params feed the next step) and ends with a
@@ -268,7 +270,7 @@ def bench_resnet50(smoke, dtype, device_kind):
     flops, nbytes = _xla_cost(step._step_fn, step._grad_vals,
                               step._nograd_vals, step._opt_state, x, y,
                               jax.random.PRNGKey(0), jnp.float32(0.05),
-                              jnp.int32(1))
+                              jnp.int32(1), jnp.float32(0.0))
     flops_source = "xla_cost_model"
     if flops is None:
         # disclosed estimate — an undisclosed fallback here would make the
@@ -450,7 +452,7 @@ def bench_lstm_lm(smoke, dtype, device_kind):
     tok_s = bptt * batch * steps / dt
     flops, _ = _xla_cost(step._step_fn, step._grad_vals, step._nograd_vals,
                          step._opt_state, x, y, jax.random.PRNGKey(0),
-                         jnp.float32(0.1), jnp.int32(1))
+                         jnp.float32(0.1), jnp.int32(1), jnp.float32(0.0))
     peak = _peak_flops(device_kind, dtype)
     mfu = (flops * steps / dt / peak) if (peak and flops) else None
     return {"metric": "lstm_word_lm_train_tok_per_sec",
@@ -917,6 +919,90 @@ def bench_serving(smoke, dtype, device_kind, batch=None):
                              "line tracks the trajectory from PR 1 on"}
 
 
+def bench_resilience(smoke, dtype, device_kind):
+    """BENCH_RESILIENCE: fault-tolerance runtime overhead — checkpoint
+    state-capture (device->host copy, the only part that blocks the
+    train loop), async publish and restore latency, and steps lost per
+    simulated preemption (re-executed work after a kill at an
+    off-cadence step). Tracks the watcher's cost across PRs; the model
+    is an MLP sized so state volume, not compile time, dominates."""
+    import shutil
+    import tempfile
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel.trainer import TrainStep
+    from mxnet_tpu.parallel.resilient import ResilientLoop
+    from mxnet_tpu.utils.recovery import CheckpointManager
+
+    hidden = 64 if smoke else 1024
+    batch = 16 if smoke else 128
+    save_every, kill_at = (2, 5) if smoke else (8, 19)
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(hidden, in_units=hidden, activation="relu"))
+    net.add(gluon.nn.Dense(hidden, in_units=hidden, activation="relu"))
+    net.add(gluon.nn.Dense(10, in_units=hidden))
+    net.initialize(mx.init.Xavier())
+    step = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+                     {"learning_rate": 1e-3}, guard=True)
+
+    def batch_for(i):
+        r = np.random.RandomState(i)
+        return (r.randn(batch, hidden).astype(np.float32),
+                r.randint(0, 10, (batch,)).astype(np.float32))
+
+    d = tempfile.mkdtemp(prefix="bench_resil_")
+    try:
+        mgr = CheckpointManager(d, keep=3)
+        # cadence saves OFF in the loop (save_every=0): the bench times
+        # its own blocking saves below — a concurrent async save of the
+        # same state would make every timed publish first drain it
+        loop = ResilientLoop(step, mgr, save_every=0,
+                             policy="skip", watch_preemption=False,
+                             verbose=False)
+        capture_s = []
+        publish_s = []
+        while loop.t < kill_at:          # train to the simulated kill
+            loop.step(*batch_for(loop.t))
+            if loop.t % save_every == 0:
+                t0 = time.perf_counter()
+                state = loop.state_dict()      # device->host capture
+                capture_s.append(time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                mgr.save(loop.t, state, block=True)  # full publish
+                publish_s.append(time.perf_counter() - t0)
+        mgr.wait(_barrier=False)
+        t0 = time.perf_counter()
+        restored = mgr.restore_latest()        # the relaunch path
+        step0, tree = restored
+        loop.load_state_dict(tree)
+        restore_s = time.perf_counter() - t0
+        steps_lost = kill_at - step0
+        state_bytes = sum(np.asarray(v).nbytes
+                          for v in jax.tree.leaves(tree))
+        name = ("smoke_resilience_ckpt_publish_ms" if smoke
+                else "resilience_ckpt_publish_ms")
+        return {"metric": name,
+                "value": round(1e3 * float(np.mean(publish_s)), 3),
+                "unit": "ms",
+                "capture_ms": round(1e3 * float(np.mean(capture_s)), 3),
+                "restore_ms": round(1e3 * restore_s, 3),
+                "state_bytes": int(state_bytes),
+                "save_every": save_every,
+                "steps_lost_per_preemption": steps_lost,
+                "bad_step_guard": True,
+                "vs_baseline": None,
+                "baseline_note": "the reference has no in-tree recovery "
+                                 "(SURVEY §5.3: manual restart from epoch "
+                                 "checkpoints); this line tracks the "
+                                 "fault-tolerance runtime's overhead "
+                                 "from PR 3 on"}
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 _CONFIGS = [
     ("resnet50_infer", bench_resnet50_infer),
     ("resnet50_int8_infer", bench_resnet50_int8_infer),
@@ -925,6 +1011,7 @@ _CONFIGS = [
     ("ssd_forward", bench_ssd_forward),
     ("sparse_linear", bench_sparse_linear),
     ("serving", bench_serving),
+    ("resilience", bench_resilience),
     ("io_pipeline", bench_io_pipeline),
     ("e2e_train_io", bench_e2e_train_io),
     ("resnet50", bench_resnet50),   # headline LAST: the driver parses the

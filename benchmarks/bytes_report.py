@@ -82,7 +82,8 @@ def analyze(step, x, y):
     import jax.numpy as jnp
     step._build()
     args = (step._grad_vals, step._nograd_vals, step._opt_state, x, y,
-            jax.random.PRNGKey(0), jnp.float32(0.05), jnp.int32(1))
+            jax.random.PRNGKey(0), jnp.float32(0.05), jnp.int32(1),
+            jnp.float32(0.0))  # chaos grad-poison seam: 0.0 = disarmed
     compiled = step._step_fn.lower(*args).compile()
     cost = compiled.cost_analysis()
     if isinstance(cost, list):  # older jax returns [dict]

@@ -73,10 +73,22 @@ def prefetch_to_device(iterable, size=2, device=None):
 
 
 class DataLoader:
+    """Batched iteration with an optional resumable cursor.
+
+    Resume contract (fault-tolerant runtime, parallel/resilient.py): pass
+    `seed=` (or a seeded `RandomSampler`) and the loader exposes
+    `state_dict()/load_state_dict()` — a tiny `(epoch, batch, seed)`
+    cursor. After `load_state_dict`, the NEXT `__iter__` regenerates the
+    interrupted epoch's shuffle order and fast-forwards to the saved
+    batch index by skipping index lists only (no dataset reads, no
+    batchify work for the skipped prefix). The cursor counts batches the
+    consumer actually received — worker prefetch can't over-advance it.
+    """
+
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, prefetch=None,
-                 device_prefetch=0):
+                 device_prefetch=0, seed=None):
         self._dataset = dataset
         if batch_sampler is None:
             if batch_size is None:
@@ -84,7 +96,7 @@ class DataLoader:
                                  "batch_sampler is specified")
             if sampler is None:
                 if shuffle:
-                    sampler = RandomSampler(len(dataset))
+                    sampler = RandomSampler(len(dataset), seed=seed)
                 else:
                     sampler = SequentialSampler(len(dataset))
             elif shuffle:
@@ -103,19 +115,83 @@ class DataLoader:
                              else 2 * num_workers)
         self._device_prefetch = max(0, int(device_prefetch))
         self._batchify_fn = batchify_fn or default_batchify_fn
+        self._epoch = 0          # epoch index of the pass in progress
+        self._batch_cursor = 0   # batches YIELDED in the current pass
+        self._resume_skip = 0    # batches to fast-forward on next __iter__
 
     def _make_batch(self, indices):
         return self._batchify_fn([self._dataset[i] for i in indices])
 
+    # -- resumable cursor ---------------------------------------------------
+    def state_dict(self):
+        """Cursor of the pass in progress: safe to snapshot between
+        batches (the fault-tolerant loop checkpoints at step boundaries,
+        so `batch` counts exactly the batches already consumed)."""
+        if not hasattr(self._batch_sampler, "state_dict"):
+            # fail at the FIRST save with guidance, not with a silently
+            # wrong cursor at resume time
+            raise ValueError(
+                "this DataLoader's custom batch_sampler (%s) has no "
+                "state_dict()/load_state_dict() — it is not resumable. "
+                "Implement the cursor protocol (see gluon.data.sampler."
+                "BatchSampler) or construct the DataLoader with "
+                "batch_size/shuffle/seed." % type(self._batch_sampler)
+                .__name__)
+        return {"epoch": self._epoch, "batch": self._batch_cursor,
+                "batch_sampler": self._batch_sampler.state_dict()}
+
+    def load_state_dict(self, state):
+        """Restore a cursor; takes effect at the NEXT `__iter__`, which
+        re-derives the epoch's order and skips the consumed prefix."""
+        self._epoch = int(state["epoch"])
+        self._batch_cursor = int(state["batch"])
+        self._resume_skip = self._batch_cursor
+        bs_state = dict(state.get("batch_sampler", {}))
+        # the saved sampler epoch is where the INTERRUPTED pass started
+        # +1; rewind so the next pass regenerates that same permutation
+        if hasattr(self._batch_sampler, "load_state_dict"):
+            self._batch_sampler.load_state_dict(bs_state)
+        if hasattr(self._batch_sampler, "set_epoch"):
+            self._batch_sampler.set_epoch(self._epoch)
+        if self._batch_cursor > 0 and \
+                hasattr(self._batch_sampler, "rewind_to_pass_start"):
+            # mid-pass resume replays the interrupted pass from its
+            # start: restore the rollover carry that pass consumed
+            self._batch_sampler.rewind_to_pass_start()
+
     def __iter__(self):
+        skip = self._resume_skip
+        inner = self._iter_host()
         if self._device_prefetch:
-            return prefetch_to_device(self._iter_host(),
-                                      self._device_prefetch)
-        return self._iter_host()
+            inner = prefetch_to_device(inner, self._device_prefetch)
+        return self._tracked(inner, skip)
+
+    def _tracked(self, it, skip):
+        """Cursor bookkeeping at the SINGLE point batches reach the
+        consumer — worker pools and the device-prefetch window both pull
+        ahead of the training loop, and a cursor advanced at their pull
+        time would make a resume skip batches that were never trained
+        on."""
+        if skip == 0:
+            sampler = getattr(self._batch_sampler, "_sampler", None)
+            self._epoch = getattr(sampler, "epoch", self._epoch)
+            self._batch_cursor = 0
+        else:
+            self._batch_cursor = skip
+        for batch in it:
+            self._batch_cursor += 1
+            yield batch
+        self._epoch += 1
+        self._batch_cursor = 0
 
     def _iter_host(self):
+        skip, self._resume_skip = self._resume_skip, 0
+        index_iter = iter(self._batch_sampler)
+        for _ in range(skip):
+            # fast-forward: consume index lists only — no dataset access
+            next(index_iter, None)
         if self._num_workers == 0:
-            for batch in self._batch_sampler:
+            for batch in index_iter:
                 yield self._make_batch(batch)
             return
         # N-worker prefetching pool with ordered hand-off: batches are
@@ -126,7 +202,7 @@ class DataLoader:
         pool = ThreadPoolExecutor(self._num_workers)
         window = deque()
         try:
-            for batch in self._batch_sampler:
+            for batch in index_iter:
                 window.append(pool.submit(self._make_batch, batch))
                 if len(window) >= max(2, self._prefetch):
                     yield window.popleft().result()

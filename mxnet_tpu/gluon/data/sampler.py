@@ -1,4 +1,12 @@
-"""Samplers (parity: python/mxnet/gluon/data/sampler.py)."""
+"""Samplers (parity: python/mxnet/gluon/data/sampler.py).
+
+Beyond parity: samplers carry a resumable cursor for the fault-tolerant
+training runtime (parallel/resilient.py). A seeded `RandomSampler` draws
+each epoch's permutation from `(seed, epoch)` only, so after a preemption
+a relaunched worker that restores `state_dict()` regenerates the exact
+epoch order and fast-forwards to the batch it died at — index generation
+only, no dataset access.
+"""
 from __future__ import annotations
 
 import numpy as np
@@ -10,6 +18,17 @@ class Sampler:
 
     def __len__(self):
         raise NotImplementedError
+
+    # resumable-cursor protocol: stateless samplers return {} and ignore
+    # restores; epoch-aware samplers override (see RandomSampler)
+    def state_dict(self):
+        return {}
+
+    def load_state_dict(self, state):
+        pass
+
+    def set_epoch(self, epoch):
+        pass
 
 
 class SequentialSampler(Sampler):
@@ -24,13 +43,67 @@ class SequentialSampler(Sampler):
 
 
 class RandomSampler(Sampler):
-    def __init__(self, length):
+    """Shuffled indices. With `seed=None` (default) each pass draws from
+    the global numpy RNG (legacy behavior, not resumable). With an integer
+    seed the pass-`e` permutation is a pure function of `(seed, e)` —
+    the resume contract the fault-tolerant runtime needs."""
+
+    def __init__(self, length, seed=None):
         self._length = length
+        self._seed = seed
+        self._epoch = 0           # epoch index the NEXT __iter__ will use
+
+    @property
+    def epoch(self):
+        return self._epoch
+
+    def set_epoch(self, epoch):
+        self._epoch = int(epoch)
 
     def __iter__(self):
-        indices = np.arange(self._length)
-        np.random.shuffle(indices)
-        return iter(indices.tolist())
+        if self._seed is None:
+            self._epoch += 1
+            indices = np.arange(self._length)
+            np.random.shuffle(indices)
+            return iter(indices.tolist())
+        rs = np.random.RandomState((int(self._seed) + self._epoch)
+                                   & 0xFFFFFFFF)
+        self._epoch += 1
+        return iter(rs.permutation(self._length).tolist())
+
+    def state_dict(self):
+        if self._seed is None:
+            # fail at the FIRST checkpoint, not at restore time — a
+            # seedless shuffle draws from the global numpy RNG and its
+            # order is unrecoverable, so saved cursors would be unusable
+            raise ValueError(
+                "RandomSampler(seed=None) is not resumable — construct "
+                "it (or the DataLoader, via seed=) with an integer seed "
+                "to make the data cursor checkpointable")
+        return {"epoch": self._epoch, "seed": self._seed,
+                "length": self._length}
+
+    def load_state_dict(self, state):
+        if self._seed is None:
+            raise ValueError(
+                "RandomSampler(seed=None) is not resumable — construct it "
+                "with an integer seed to restore a data cursor")
+        if state.get("seed") is not None and \
+                int(state["seed"]) != int(self._seed):
+            raise ValueError(
+                "sampler seed mismatch: checkpoint has %r, sampler has %r "
+                "— resuming would replay a different shuffle order"
+                % (state["seed"], self._seed))
+        if state.get("length") is not None and \
+                int(state["length"]) != int(self._length):
+            # a grown/shrunk dataset regenerates an unrelated permutation;
+            # the cursor would silently land on different samples
+            raise ValueError(
+                "sampler length mismatch: checkpoint was taken over %s "
+                "samples but the dataset now has %d — the resumed shuffle "
+                "order would not match the interrupted run"
+                % (state["length"], self._length))
+        self._epoch = int(state["epoch"])
 
     def __len__(self):
         return self._length
@@ -42,8 +115,10 @@ class BatchSampler(Sampler):
         self._batch_size = batch_size
         self._last_batch = last_batch
         self._prev = []
+        self._pass_carry = []  # the carry the CURRENT pass started with
 
     def __iter__(self):
+        self._pass_carry = list(self._prev)
         batch, self._prev = self._prev, []
         for i in self._sampler:
             batch.append(i)
@@ -61,6 +136,30 @@ class BatchSampler(Sampler):
                 raise ValueError(
                     "last_batch must be one of 'keep', 'discard', or "
                     "'rollover', but got %s" % self._last_batch)
+
+    def state_dict(self):
+        """Sampler cursor + the rollover carries: `prev` is the partial
+        batch this pass hands the NEXT epoch; `pass_carry` is what the
+        CURRENT pass started with — a mid-pass resume must replay the
+        pass with the same starting carry or every batch boundary
+        shifts."""
+        return {"sampler": self._sampler.state_dict(),
+                "prev": [int(i) for i in self._prev],
+                "pass_carry": [int(i) for i in self._pass_carry]}
+
+    def load_state_dict(self, state):
+        self._sampler.load_state_dict(state.get("sampler", {}))
+        self._prev = [int(i) for i in state.get("prev", [])]
+        self._pass_carry = [int(i) for i in state.get("pass_carry", [])]
+
+    def rewind_to_pass_start(self):
+        """Re-arm the carry consumed at the interrupted pass's start so
+        the regenerated pass yields identical batch boundaries
+        (DataLoader.load_state_dict calls this for mid-pass cursors)."""
+        self._prev = list(self._pass_carry)
+
+    def set_epoch(self, epoch):
+        self._sampler.set_epoch(epoch)
 
     def __len__(self):
         if self._last_batch == "keep":

@@ -29,10 +29,16 @@ def short_hash(name):
 def get_model_file(name, root=_DEFAULT_ROOT):
     root = os.path.expanduser(root or _DEFAULT_ROOT)
     search = [root]
-    # parity: MXNET_GLUON_REPO overrides the model source; with no network
-    # egress it is honored as an extra local directory to resolve from
+    # parity: MXNET_GLUON_REPO overrides the model source. A local path is
+    # honored as an extra directory to resolve from; an http(s)/file URL
+    # becomes a download base fetched with retry+backoff (utils.retry via
+    # gluon.utils.download — transient repo hiccups must not fail a job
+    # that is about to train for hours).
     extra = os.environ.get("MXNET_GLUON_REPO")
-    if extra and not extra.startswith(("http://", "https://")):
+    repo_url = None
+    if extra and extra.startswith(("http://", "https://", "file://")):
+        repo_url = extra.rstrip("/")
+    elif extra:
         search.append(os.path.expanduser(extra))
     # resolve both this package's plain naming and the reference's
     # hash-suffixed cache naming (name-<short_hash>.params) when a hash
@@ -45,10 +51,16 @@ def get_model_file(name, root=_DEFAULT_ROOT):
             file_path = os.path.join(base, fname)
             if os.path.exists(file_path):
                 return file_path
+    if repo_url is not None:
+        from ..utils import download
+        sha1 = _model_sha1.get(name)
+        return download("%s/%s" % (repo_url, candidates[-1]),
+                        path=os.path.join(root, candidates[-1]),
+                        sha1_hash=sha1, retries=5)
     raise IOError(
         "Pretrained weights %s.params not found under %s and cannot be "
-        "downloaded (no network egress). Train from scratch or place the "
-        "file there." % (name, " or ".join(search)))
+        "downloaded (no MXNET_GLUON_REPO url configured). Train from "
+        "scratch or place the file there." % (name, " or ".join(search)))
 
 
 def purge(root=_DEFAULT_ROOT):

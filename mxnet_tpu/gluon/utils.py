@@ -75,17 +75,48 @@ def check_sha1(filename, sha1_hash):
     return sha1.hexdigest() == sha1_hash
 
 
-def download(url, path=None, overwrite=False, sha1_hash=None):
-    """Parity surface for model_zoo pretrained downloads. This environment
-    has no network egress; raises with guidance unless the file is present."""
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=3):
+    """Fetch `url` to `path` (parity: reference gluon/utils.py download).
+
+    Transient fetch failures retry with exponential backoff + jitter
+    (`utils.retry`, `retries` attempts total); the file lands via a
+    temp-write + atomic rename so a killed download never leaves a
+    truncated file at the final path. `file://` URLs work without any
+    network egress (the test path); in a zero-egress environment http(s)
+    fetches exhaust their retries and raise with guidance."""
     fname = path if path and not os.path.isdir(path) else os.path.join(
         path or ".", url.split("/")[-1])
     if os.path.exists(fname) and not overwrite and (
             not sha1_hash or check_sha1(fname, sha1_hash)):
         return fname
-    raise IOError(
-        "download(%s) unavailable: no network egress in this environment. "
-        "Place the file at %s manually." % (url, fname))
+    from ..utils import retry as _retry
+
+    def fetch():
+        import shutil
+        import urllib.request
+        d = os.path.dirname(os.path.abspath(fname))
+        os.makedirs(d, exist_ok=True)
+        tmp = fname + ".tmp-%d" % os.getpid()
+        try:
+            with urllib.request.urlopen(url, timeout=30) as src, \
+                    open(tmp, "wb") as dst:
+                shutil.copyfileobj(src, dst)
+            if sha1_hash and not check_sha1(tmp, sha1_hash):
+                raise IOError("downloaded %s fails its sha1 check" % url)
+            os.replace(tmp, fname)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        return fname
+
+    try:
+        return _retry(fetch, attempts=retries, backoff=0.2,
+                      retry_on=(OSError, IOError))
+    except (OSError, IOError, ValueError) as e:
+        raise IOError(
+            "download(%s) failed after %d attempts (%s). If this "
+            "environment has no network egress, place the file at %s "
+            "manually." % (url, retries, e, fname))
 
 
 def _indent(s_, numSpaces):

@@ -7,14 +7,32 @@ import math
 
 
 class LRScheduler:
+    #: mutable attributes that make a scheduler stateful across calls —
+    #: captured by state_dict() so a preempted-and-relaunched worker
+    #: resumes the schedule step-exactly instead of replaying the decay
+    #: from scratch (parallel/resilient.py resume contract). Subclasses
+    #: with extra mutable state extend this tuple.
+    _STATE_ATTRS = ("base_lr",)
+
     def __init__(self, base_lr=0.01):
         self.base_lr = base_lr
 
     def __call__(self, num_update):
         raise NotImplementedError
 
+    def state_dict(self):
+        """JSON-serializable snapshot of the schedule's mutable state."""
+        return {a: getattr(self, a) for a in self._STATE_ATTRS}
+
+    def load_state_dict(self, state):
+        for a in self._STATE_ATTRS:
+            if a in state:
+                setattr(self, a, state[a])
+
 
 class FactorScheduler(LRScheduler):
+    _STATE_ATTRS = ("base_lr", "count")
+
     def __init__(self, step, factor=1.0, stop_factor_lr=1e-8, base_lr=0.01):
         super().__init__(base_lr)
         if step < 1:
@@ -42,6 +60,8 @@ class FactorScheduler(LRScheduler):
 
 
 class MultiFactorScheduler(LRScheduler):
+    _STATE_ATTRS = ("base_lr", "count", "cur_step_ind")
+
     def __init__(self, step, factor=1.0, base_lr=0.01):
         super().__init__(base_lr)
         assert isinstance(step, list) and len(step) >= 1

@@ -37,9 +37,12 @@ def get_lenet(num_classes=10):
 
 
 class LeNet(HybridBlock):
-    """Gluon LeNet for the imperative path."""
+    """Gluon LeNet for the imperative path. `dropout>0` inserts a Dropout
+    between the dense layers — the classic regularized variant, and the
+    RNG-dependent fixture the fault-tolerance suite uses to prove that a
+    resumed run replays the exact per-step dropout masks."""
 
-    def __init__(self, num_classes=10, **kwargs):
+    def __init__(self, num_classes=10, dropout=0.0, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
             self.conv1 = nn.Conv2D(20, kernel_size=5, activation="tanh")
@@ -48,6 +51,7 @@ class LeNet(HybridBlock):
             self.pool2 = nn.MaxPool2D(pool_size=2, strides=2)
             self.flatten = nn.Flatten()
             self.fc1 = nn.Dense(500, activation="tanh")
+            self.drop = nn.Dropout(dropout) if dropout > 0 else None
             self.fc2 = nn.Dense(num_classes)
 
     def hybrid_forward(self, F, x):
@@ -55,6 +59,8 @@ class LeNet(HybridBlock):
         x = self.pool2(self.conv2(x))
         x = self.flatten(x)
         x = self.fc1(x)
+        if self.drop is not None:
+            x = self.drop(x)
         return self.fc2(x)
 
 

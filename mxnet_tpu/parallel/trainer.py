@@ -202,7 +202,8 @@ class TrainStep:
 
     def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
                  mesh=None, data_axis="dp", param_shardings=None,
-                 dtype="float32", remat=None, shard_optimizer_states=False):
+                 dtype="float32", remat=None, shard_optimizer_states=False,
+                 guard=False):
         from .. import optimizer as _opt_mod
         remat = _remat_mode(remat)
         self._net = net
@@ -225,6 +226,18 @@ class TrainStep:
         # state shards over the data axis, GSPMD turning the grad all-reduce
         # into reduce-scatter + the post-update all-gather automatically
         self._shard_opt = bool(shard_optimizer_states)
+        # bad-step guard (parallel/resilient.py): when on, the jitted step
+        # also computes the global grad norm + a finiteness flag and
+        # SELECTS the old (params, opt state, aux) when the step is bad —
+        # the state protection itself needs no host round-trip.
+        # Numerically transparent while every step is finite: the select
+        # picks the identical new values. Note the POLICY layer
+        # (ResilientLoop) reads last_step_ok on the host each step to
+        # react, which serializes dispatch; policy="off" keeps full
+        # async overlap, and BENCH_CONFIGS=resilience tracks the cost.
+        self._guard = bool(guard)
+        self.last_step_ok = None     # device bool of the latest step
+        self.last_grad_norm = None   # device f32 of the latest step
         self._lr_schedule = None
         self._t = 0
         self._step_fn = None
@@ -232,6 +245,11 @@ class TrainStep:
 
     def set_lr_schedule(self, fn):
         self._lr_schedule = fn
+
+    @property
+    def t(self):
+        """Completed optimizer steps (the checkpoint step number)."""
+        return self._t
 
     def _build(self):
         params = self._net.collect_params()
@@ -312,7 +330,10 @@ class TrainStep:
             # peak is unchanged, but recompute semantics are preserved)
             forward_loss = jax.checkpoint(forward_loss, policy=remat_policy)
 
-        def step(grad_vals, nograd_vals, opt_state, x, y, key, lr, t):
+        guard = self._guard
+
+        def step(grad_vals, nograd_vals, opt_state, x, y, key, lr, t,
+                 poison):
             # independent streams: forward-trace keys (dropout masks etc.)
             # derive from fwd_key; optimizer noise (SGLD) from noise_key —
             # fold_in on the SAME base key would collide with the trace keys
@@ -320,6 +341,15 @@ class TrainStep:
             (loss_val, aux_upd), grads = jax.value_and_grad(
                 forward_loss, has_aux=True)(grad_vals, nograd_vals, x, y,
                                             fwd_key)
+            # chaos seam: `poison` is 0.0 on every real step; the chaos
+            # harness passes NaN to fault a chosen step's gradients
+            # without retracing (utils/chaos.grad_poison)
+            grads = [g + poison.astype(g.dtype) for g in grads]
+            if guard:
+                gnorm = jnp.sqrt(sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in grads))
+                ok = jnp.isfinite(loss_val) & jnp.isfinite(gnorm)
             new_grad_vals, new_state = [], []
             for i, (w, g, s) in enumerate(zip(grad_vals, grads, opt_state)):
                 g = g.astype(w.dtype) * rescale
@@ -329,6 +359,12 @@ class TrainStep:
                     else None
                 w2, s2 = apply_rule(w, g, s, lr * lr_mults[i],
                                     base_wd * wd_mults[i], t, hyper, k)
+                if guard:
+                    # bad step -> drop the whole update: params AND
+                    # optimizer state stay exactly as they were
+                    w2 = jnp.where(ok, w2, w)
+                    s2 = jax.tree.map(lambda a, b: jnp.where(ok, a, b),
+                                      s2, s)
                 new_grad_vals.append(w2)
                 new_state.append(s2)
             new_nograd_vals = list(nograd_vals)
@@ -336,11 +372,16 @@ class TrainStep:
             for i, has_grad in enumerate(grad_mask):
                 if not has_grad:
                     if i in aux_upd:
-                        new_nograd_vals[ni] = aux_upd[i].astype(
-                            nograd_vals[ni].dtype)
+                        upd = aux_upd[i].astype(nograd_vals[ni].dtype)
+                        if guard:  # BN running stats also roll back
+                            upd = jnp.where(ok, upd, nograd_vals[ni])
+                        new_nograd_vals[ni] = upd
                     ni += 1
-            return (loss_val, tuple(new_grad_vals), tuple(new_nograd_vals),
-                    tuple(new_state))
+            out = (loss_val, tuple(new_grad_vals), tuple(new_nograd_vals),
+                   tuple(new_state))
+            if guard:
+                out = out + (ok, gnorm)
+            return out
 
         self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2))
         self._names = names
@@ -411,21 +452,30 @@ class TrainStep:
         else:
             lr = self._opt.lr
         key = _random.next_key()
+        from ..utils import chaos as _chaos
+        poison = jnp.float32(_chaos.grad_poison(self._t))
         if first_call:
             self._example_args = jax.tree.map(
                 lambda v: jax.ShapeDtypeStruct(jnp.shape(v),
                                                jnp.asarray(v).dtype),
                 (self._grad_vals, self._nograd_vals, self._opt_state, xv,
-                 yv, key, jnp.float32(0.0), jnp.int32(0)))
+                 yv, key, jnp.float32(0.0), jnp.int32(0),
+                 jnp.float32(0.0)))
         # compile vs run split in the profiler table: the first dispatch pays
         # XLA compilation, later ones are cached executions (parity with the
         # reference's symbolic bind-vs-run accounting)
         label = "TrainStep::compile" if first_call else "TrainStep::run"
         with _profiler.scope(label, "trainstep"):
-            loss, self._grad_vals, self._nograd_vals, self._opt_state = \
-                self._step_fn(self._grad_vals, self._nograd_vals,
-                              self._opt_state, xv, yv, key,
-                              jnp.float32(lr), jnp.int32(self._t))
+            out = self._step_fn(self._grad_vals, self._nograd_vals,
+                                self._opt_state, xv, yv, key,
+                                jnp.float32(lr), jnp.int32(self._t),
+                                poison)
+            if self._guard:
+                (loss, self._grad_vals, self._nograd_vals, self._opt_state,
+                 self.last_step_ok, self.last_grad_norm) = out
+            else:
+                loss, self._grad_vals, self._nograd_vals, self._opt_state \
+                    = out
             if _profiler.profile_sync():
                 jax.block_until_ready(loss)
         self._compiled = True
@@ -456,22 +506,48 @@ class TrainStep:
             raise RuntimeError("run at least one step first")
         return self._step_fn.lower(*self._example_args).as_text()
 
+    def _lr_sched_obj(self):
+        """The stateful schedule driving this step's lr, if any.
+        `_lr_schedule_base` (set by ResilientLoop when it wraps the
+        schedule with its rollback LR-scale) takes priority: the wrapper
+        lambda has no state, the underlying scheduler does."""
+        for cand in (getattr(self, "_lr_schedule_base", None),
+                     self._lr_schedule, self._opt.lr_scheduler):
+            if cand is not None and hasattr(cand, "state_dict"):
+                return cand
+        return None
+
     def state_dict(self):
         """Full resumable training state (params + optimizer state + step
-        counter) for utils.recovery.CheckpointManager. Materialized to host
-        arrays — the live device buffers get donated by the next step, so
-        handing out references would leave the caller with deleted arrays."""
+        counter + RNG key chain + LR-schedule state) for
+        utils.recovery.CheckpointManager. Materialized to host arrays —
+        the live device buffers get donated by the next step, so handing
+        out references would leave the caller with deleted arrays."""
         if self._step_fn is None:
             self._build()
-        host = jax.tree.map(np.asarray,
+        # np.array (not np.asarray): on the CPU backend asarray can be a
+        # ZERO-COPY view of the XLA buffer, and the next step DONATES
+        # that buffer — an async checkpoint writer would then serialize
+        # memory the t+1 update already overwrote (a checkpoint labeled
+        # step t with step t+1's params breaks step-exact resume)
+        host = jax.tree.map(lambda v: np.array(v),
                             (tuple(self._grad_vals),
                              tuple(self._nograd_vals),
                              tuple(self._opt_state)))
-        return {"t": np.int64(self._t), "grad_vals": host[0],
-                "nograd_vals": host[1], "opt_state": host[2],
-                # the global key stream feeds per-step dropout masks / SGLD
-                # noise — without it a resume would replay early-step keys
-                "rng_key": _random.get_state()}
+        out = {"t": np.int64(self._t), "grad_vals": host[0],
+               "nograd_vals": host[1], "opt_state": host[2],
+               # the global key stream feeds per-step dropout masks / SGLD
+               # noise — without it a resume would replay early-step keys
+               "rng_key": _random.get_state()}
+        sched = self._lr_sched_obj()
+        if sched is not None:
+            # stateful schedulers (FactorScheduler's decayed base_lr etc.)
+            # must not restart from scratch after a relaunch; JSON-encode
+            # the tiny state into the array tree
+            import json as _json
+            out["lr_sched"] = np.frombuffer(
+                _json.dumps(sched.state_dict()).encode(), np.uint8).copy()
+        return out
 
     def load_state_dict(self, state):
         if self._step_fn is None:
@@ -487,9 +563,19 @@ class TrainStep:
         self._t = int(state["t"])
         if "rng_key" in state:
             _random.set_state(state["rng_key"])
+        if "lr_sched" in state:
+            sched = self._lr_sched_obj()
+            if sched is not None:
+                import json as _json
+                sched.load_state_dict(_json.loads(
+                    bytes(bytearray(np.asarray(state["lr_sched"])
+                                    .astype(np.uint8))).decode()))
 
         def place(tmpl, v):
-            arr = jnp.asarray(np.asarray(v), dtype=jnp.asarray(tmpl).dtype)
+            # jnp.array (copy), not asarray: a zero-copy alias of the
+            # checkpoint's numpy buffer would be DONATED by the next
+            # step — XLA would scribble outputs over external memory
+            arr = jnp.array(np.asarray(v), dtype=jnp.asarray(tmpl).dtype)
             if self._mesh is not None:
                 arr = jax.device_put(arr, tmpl.sharding)
             return arr

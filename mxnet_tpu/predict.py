@@ -303,7 +303,12 @@ def export_train_step(step, example_x, example_y, path):
         n = state[n_g:n_g + n_n]
         o = jax.tree.unflatten(opt_def, state[n_g + n_n:])
         key = jax.random.PRNGKey(sd)
-        loss, g2, n2, o2 = raw_step(g, n, o, x, y, key, lr, t)
+        # poison pinned to 0.0: the chaos grad-injection seam is a live
+        # training concern, not part of the exported artifact. Guarded
+        # steps also return (ok, gnorm); the artifact keeps the plain
+        # (loss, state...) convention.
+        out = raw_step(g, n, o, x, y, key, lr, t, jnp.float32(0.0))
+        loss, g2, n2, o2 = out[:4]
         return (loss,) + tuple(g2) + tuple(n2) + \
             tuple(jax.tree.flatten(o2)[0])
 

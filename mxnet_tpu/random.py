@@ -102,9 +102,10 @@ class trace_key_scope:
 
 def get_state():
     """Snapshot the global PRNG key as a host array (for checkpoint/resume —
-    the reference's RandomGenerator state save)."""
+    the reference's RandomGenerator state save). An owned copy — asarray
+    on a jax CPU array may alias device memory."""
     import numpy as _np
-    return _np.asarray(_current_key())
+    return _np.array(_current_key())
 
 
 def set_state(key_data):

@@ -26,7 +26,9 @@ class ServingMetrics:
         self.expired = 0
         self.completed = 0
         self.failed = 0
-        self.tokens_generated = 0
+        self.engine_failures = 0      # engine exceptions absorbed by the
+        self.tokens_generated = 0     # serving loop (requests failed, loop
+                                      # kept alive)
         self.decode_steps = 0
         self._occupancy_sum = 0.0     # active/max_batch per decode step
         self._batch_sum = 0           # active sequences per decode step
@@ -47,6 +49,10 @@ class ServingMetrics:
     def request_rejected(self):
         with self._lock:
             self.rejected += 1
+
+    def engine_failure(self):
+        with self._lock:
+            self.engine_failures += 1
 
     def request_expired(self, req):
         """Counts the expiry only; request_finished() (always called
@@ -99,6 +105,7 @@ class ServingMetrics:
                     "failed": self.failed,
                     "rejected": self.rejected,
                     "expired": self.expired,
+                    "engine_failures": self.engine_failures,
                 },
                 "latency_ms": {
                     "queue_mean": 1e3 * self._queue_s / started,

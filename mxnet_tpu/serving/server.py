@@ -64,6 +64,14 @@ class LMServer:
         self._idle_wait = idle_wait
         self._work = threading.Event()
         self._closed = False
+        # liveness observables for /healthz: the loop thread beats every
+        # iteration; decode progress stamps separately
+        self._last_beat = time.perf_counter()
+        self._last_step_t = None
+        # HTTP submit-on-QueueFull retry budget (utils.retry): a briefly
+        # full queue absorbs a burst instead of bouncing clients to 429
+        self.submit_retries = 3
+        self.submit_backoff = 0.05
         self._thread = threading.Thread(target=self._loop,
                                         name="mxtpu-serving", daemon=True)
         self._httpd = None
@@ -71,9 +79,13 @@ class LMServer:
 
     # -- client API ----------------------------------------------------------
 
-    def submit(self, prompt, max_new_tokens=32, eos_id=None):
+    def submit(self, prompt, max_new_tokens=32, eos_id=None,
+               count_reject=True):
         """Enqueue one request; returns it (a future: .result(timeout)).
-        Raises QueueFull immediately when backpressure kicks in."""
+        Raises QueueFull immediately when backpressure kicks in.
+        `count_reject=False` suppresses the rejected-metric increment —
+        for retry wrappers that only count the FINAL failure (a request
+        that eventually lands is not a rejection)."""
         if self._closed:
             raise MXNetError("server is closed")
         if len(prompt) > self.engine.max_len:
@@ -84,7 +96,8 @@ class LMServer:
         try:
             self.scheduler.submit(req)
         except QueueFull:
-            self.metrics.request_rejected()
+            if count_reject:
+                self.metrics.request_rejected()
             raise
         self.metrics.request_submitted()
         self._work.set()
@@ -99,6 +112,23 @@ class LMServer:
 
     def snapshot(self):
         return self.metrics.snapshot(self.engine)
+
+    def health(self, max_beat_age=5.0):
+        """Loop-liveness summary for /healthz: `ok` requires the serving
+        thread alive AND beating recently (a wedged loop is as dead as a
+        crashed one). `last_step_age_s` is decode-progress age — None
+        until the first decode step, and allowed to grow while idle."""
+        now = time.perf_counter()
+        alive = self._thread.is_alive() and not self._closed
+        beat_age = now - self._last_beat
+        return {
+            "ok": bool(alive and beat_age < max_beat_age),
+            "loop_alive": bool(alive),
+            "last_beat_age_s": round(beat_age, 3),
+            "last_step_age_s": (round(now - self._last_step_t, 3)
+                                if self._last_step_t is not None else None),
+            "engine_failures": self.metrics.engine_failures,
+        }
 
     def close(self, drain=True, timeout=30.0):
         """Stop the loop; with drain=True finish in-flight work first."""
@@ -143,14 +173,23 @@ class LMServer:
     def _loop_inner(self):
         eng, sched, met = self.engine, self.scheduler, self.metrics
         while not self._closed:
+            self._last_beat = time.perf_counter()
             admitted, expired = sched.admit(eng)
             for req in expired:
                 met.request_expired(req)
                 met.request_finished(req)
             for i, req in enumerate(admitted):
                 t0 = time.perf_counter()
-                seq = eng.start(req.prompt, req.max_new_tokens,
-                                eos_id=req.eos_id)
+                try:
+                    seq = eng.start(req.prompt, req.max_new_tokens,
+                                    eos_id=req.eos_id)
+                except Exception as e:  # engine fault: fail THIS request,
+                    met.engine_failure()  # the loop (and the rest of the
+                    req._finish(error=MXNetError(  # batch) live on
+                        "engine prefill failed: %s: %s"
+                        % (type(e).__name__, e)))
+                    met.request_finished(req)
+                    continue
                 if seq is None:       # transient block shortage: requeue
                     # this one AND everything admitted behind it, in order
                     with sched._lock:
@@ -163,7 +202,26 @@ class LMServer:
                 met.request_prefilled(req, time.perf_counter() - t0)
             if sched.running:
                 t0 = time.perf_counter()
-                advanced = eng.decode_step(sched.running)
+                try:
+                    advanced = eng.decode_step(sched.running)
+                except Exception as e:
+                    # a decode fault poisons the whole active batch (we
+                    # cannot tell whose tokens are trustworthy): fail the
+                    # affected requests, recycle their blocks, keep serving
+                    met.engine_failure()
+                    err = MXNetError("engine decode failed: %s: %s"
+                                     % (type(e).__name__, e))
+                    for seq in sched.running:
+                        try:
+                            eng.release(seq)
+                        except Exception:
+                            pass
+                        if seq.request is not None:
+                            seq.request._finish(error=err)
+                            met.request_finished(seq.request)
+                    sched.running = []
+                    continue
+                self._last_step_t = time.perf_counter()
                 if advanced:  # count only sequences that really stepped
                     met.decode_step(len(advanced), eng.max_batch,
                                     time.perf_counter() - t0,
@@ -205,7 +263,8 @@ class LMServer:
 
             def do_GET(self):
                 if self.path == "/healthz":
-                    self._reply(200, {"ok": True})
+                    h = outer.health()
+                    self._reply(200 if h["ok"] else 503, h)
                 elif self.path in ("/v1/metrics", "/metrics"):
                     self._reply(200, outer.snapshot())
                 else:
@@ -220,11 +279,23 @@ class LMServer:
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(n) or b"{}")
-                    req = outer.submit(
-                        body["tokens"],
-                        max_new_tokens=int(body.get("max_new_tokens", 32)),
-                        eos_id=body.get("eos_id"))
+                    from ..utils import retry
+                    # a briefly-full queue drains in a few decode steps:
+                    # absorb the burst with bounded backoff before 429.
+                    # count_reject=False: only the FINAL failure below
+                    # counts as a rejection in the metrics
+                    req = retry(
+                        lambda: outer.submit(
+                            body["tokens"],
+                            max_new_tokens=int(
+                                body.get("max_new_tokens", 32)),
+                            eos_id=body.get("eos_id"),
+                            count_reject=False),
+                        attempts=outer.submit_retries,
+                        backoff=outer.submit_backoff,
+                        retry_on=QueueFull)
                 except QueueFull as e:
+                    outer.metrics.request_rejected()
                     self._reply(429, {"error": str(e)})
                     return
                 except (KeyError, ValueError, TypeError, MXNetError) as e:

@@ -1,12 +1,50 @@
-"""Utility subpackage: serialization, download, docs helpers.
+"""Utility subpackage: serialization, download, recovery, chaos, docs
+helpers.
 
 Parity: reference `python/mxnet/ndarray/utils.py` (save/load) and
 `src/ndarray/ndarray.cc` legacy binary serialization — replaced by a
-portable .npz-based container (see serialization.py).
+portable .npz-based container (see serialization.py). recovery.py and
+chaos.py are the fault-tolerance subsystem (async checkpointing +
+fault injection; see docs/FAULT_TOLERANCE.md).
 """
 from . import serialization
 from .serialization import save_ndarrays, load_ndarrays
 
+
 def makedirs(d):
     import os
     os.makedirs(d, exist_ok=True)
+
+
+def retry(fn, attempts=3, backoff=0.1, jitter=0.1, retry_on=(OSError,),
+          on_retry=None):
+    """Call `fn()` with exponential backoff on transient failures.
+
+    attempts  total tries (>=1); the last failure re-raises.
+    backoff   base delay in seconds; try i sleeps backoff * 2**i.
+    jitter    fraction of the delay randomized (decorrelates a fleet of
+              workers retrying the same overloaded endpoint).
+    retry_on  exception class or tuple caught as retryable; anything
+              else propagates immediately.
+    on_retry  optional callback (exc, attempt_index) before each sleep —
+              the logging/metrics hook.
+
+    Used by model-zoo downloads and the serving HTTP frontend's
+    submit-on-QueueFull path; deliberately tiny so any transient-failure
+    site can adopt it.
+    """
+    import random as _random
+    import time as _time
+    attempts = max(1, int(attempts))
+    for i in range(attempts):
+        try:
+            return fn()
+        except retry_on as e:
+            if i == attempts - 1:
+                raise
+            if on_retry is not None:
+                on_retry(e, i)
+            delay = backoff * (2 ** i)
+            delay *= 1.0 + jitter * _random.random()
+            if delay > 0:
+                _time.sleep(delay)

@@ -1,0 +1,129 @@
+"""Fault injection for the resilience test harness.
+
+Nothing in a codebase that never *simulates* a failure can claim to
+survive one. This module is the single place every injected fault flows
+through: production code calls the tiny hook functions below (no-ops
+when chaos is off), and tests / tools/chaos_train.py arm them either
+programmatically (`configure(...)`) or via environment variables — the
+env path is what lets a subprocess worker be faulted without any code
+changes:
+
+  MXNET_CHAOS_KILL_SAVE=<step>     hard-exit (os._exit) in the middle of
+                                   the checkpoint write for <step>, after
+                                   the temp file holds bytes but BEFORE
+                                   the atomic publish — a preemption
+                                   landing mid-save.
+  MXNET_CHAOS_CORRUPT_CKPT=<step>  after checkpoint <step> publishes,
+                                   truncate it to half its bytes (torn
+                                   write / bitrot on restore).
+  MXNET_CHAOS_NAN_STEP=<step>      poison step <step>'s gradients with
+                                   NaN inside the jitted train step (the
+                                   bad-step guard's quarry).
+  MXNET_CHAOS_SIGTERM_AT=<step>    deliver SIGTERM to this process after
+                                   step <step> completes (a preemption
+                                   notice mid-epoch).
+
+Steps are 1-based and compare against the trainer's post-increment step
+counter (`TrainStep._t`), i.e. the value `ResilientLoop` reports. Each
+fault fires at most once per process (`_fired` latch) so a relaunched
+worker with a stale environment does not re-kill itself — relaunch
+scripts should still scrub `MXNET_CHAOS_*` when they can.
+"""
+from __future__ import annotations
+
+import os
+import signal
+
+
+_FAULTS = ("kill_save", "corrupt_ckpt", "nan_step", "sigterm_at")
+
+_conf = {}          # fault name -> step (int)
+_fired = set()      # fault names that already triggered in this process
+_env_loaded = False
+
+
+def _load_env():
+    global _env_loaded
+    if _env_loaded:
+        return
+    _env_loaded = True
+    for name in _FAULTS:
+        val = os.environ.get("MXNET_CHAOS_" + name.upper())
+        if val:
+            try:
+                _conf.setdefault(name, int(val))
+            except ValueError:
+                raise ValueError("MXNET_CHAOS_%s must be an integer step, "
+                                 "got %r" % (name.upper(), val))
+
+
+def configure(**faults):
+    """Arm faults programmatically: configure(nan_step=7, sigterm_at=12).
+    A value of None disarms. Returns the active config."""
+    _load_env()
+    for name, step in faults.items():
+        if name not in _FAULTS:
+            raise ValueError("unknown chaos fault %r (know %s)"
+                             % (name, ", ".join(_FAULTS)))
+        if step is None:
+            _conf.pop(name, None)
+            _fired.discard(name)
+        else:
+            _conf[name] = int(step)
+    return dict(_conf)
+
+
+def reset():
+    """Disarm everything (test teardown)."""
+    global _env_loaded
+    _conf.clear()
+    _fired.clear()
+    _env_loaded = False
+
+
+def active():
+    _load_env()
+    return dict(_conf)
+
+
+def _should(name, step):
+    _load_env()
+    if name in _fired or _conf.get(name) != int(step):
+        return False
+    _fired.add(name)
+    return True
+
+
+# -- hooks (called from production code; no-ops when disarmed) --------------
+
+def maybe_kill_during_save(step):
+    """recovery.CheckpointManager._write calls this between writing the
+    temp file and the atomic os.replace publish."""
+    if _should("kill_save", step):
+        os._exit(43)  # hard exit: no atexit, no flush — a real preemption
+
+
+def maybe_corrupt_checkpoint(step, path):
+    """recovery.CheckpointManager._write calls this after publishing
+    ckpt for `step`; truncates the published file to half its size."""
+    if _should("corrupt_ckpt", step):
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(max(1, size // 2))
+
+
+def grad_poison(step):
+    """TrainStep threads this scalar into the jitted step as `g + poison`
+    on every gradient: 0.0 normally, NaN on the armed step. Passing it as
+    a runtime argument keeps the injection retrace-free."""
+    return float("nan") if _should("nan_step", step) else 0.0
+
+
+def maybe_sigterm(step):
+    """ResilientLoop calls this at each step boundary; delivers SIGTERM
+    to this very process on the armed step — the preemption watcher must
+    catch it, checkpoint, and exit with the relaunch code."""
+    if _should("sigterm_at", step):
+        os.kill(os.getpid(), signal.SIGTERM)
+        return True
+    return False

@@ -9,18 +9,44 @@ orbax-style CheckpointManager with
   * async saves — the host serializes on a background thread while the
     accelerator keeps training (device→host copy happens on the caller
     thread, write+fsync+rename off it);
-  * atomic publication — write to a temp file then os.replace, so a
-    preemption mid-save never corrupts the latest checkpoint;
+  * atomic publication — write to a temp file then os.replace, then
+    fsync the *directory* so the rename itself is durable across power
+    loss (POSIX: a rename is only on disk once its directory entry is);
+  * integrity manifest — every checkpoint publishes a sidecar
+    `ckpt-<step>.manifest.json` carrying the npz's size + sha256 and the
+    array-entry names; `restore()` verifies it before deserializing, so
+    a truncated or bit-rotted file is detected up front and
+    `restore_latest()` falls back to the previous intact checkpoint
+    (per-array CRC32s inside the zip guard each entry during the read
+    itself);
   * retention — keep the newest `keep` checkpoints, prune older;
-  * `restore_latest()` — the auto-resume entry a relaunched worker calls.
+  * `restore_latest()` — the auto-resume entry a relaunched worker calls;
+  * single-writer protocol — in a multi-process (jax.distributed) run
+    every process computes identical replicated state, so only process 0
+    performs checkpoint IO; `save()` on other processes returns without
+    touching the directory. The BARRIER POINT is `wait()`: call it on
+    every process at the same program point (e.g. before exiting after a
+    preemption) — on the writer it blocks until the checkpoint has
+    published, on non-writers it is a cheap no-op, and when
+    `jax.distributed` is initialized it then synchronizes all processes
+    so no worker can exit (and be relaunched) before the checkpoint
+    exists.
 
 TrainStep integration: `TrainStep.state_dict()/load_state_dict()` capture
 parameters, optimizer state, and the step counter, so
 `manager.save(step.t, step.state_dict())` + `step.load_state_dict(...)`
-is a complete resume.
+is a complete resume. `parallel.resilient.ResilientLoop` layers the full
+fault lifecycle (preemption watcher, bad-step policies, data cursor) on
+top of this manager.
+
+Fault injection: `utils.chaos` hooks fire inside `_write` when armed
+(kill mid-save before publication, corrupt a published file) — the
+chaos-test harness proves the atomicity/fallback claims above.
 """
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import re
 import threading
@@ -28,7 +54,24 @@ import threading
 import numpy as np
 import jax
 
+from . import chaos as _chaos
+
 _CKPT_RE = re.compile(r"^ckpt-(\d+)\.npz$")
+
+
+def _fsync_dir(path):
+    """fsync a directory so a just-published rename survives power loss.
+    Best-effort on platforms without O_DIRECTORY semantics."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 class CheckpointManager:
@@ -47,14 +90,29 @@ class CheckpointManager:
             train_step.load_state_dict(tree)
     """
 
-    def __init__(self, directory, keep=3, async_save=True):
+    def __init__(self, directory, keep=3, async_save=True,
+                 process_index=None):
         self.directory = directory
         self.keep = keep
         self.async_save = async_save
+        self._process_index = process_index
         self._worker = None
         self._lock = threading.Lock()
         self._error = None
-        os.makedirs(directory, exist_ok=True)
+        if self.is_writer:
+            os.makedirs(directory, exist_ok=True)
+
+    @property
+    def is_writer(self):
+        """Single-writer protocol: only process 0 performs checkpoint IO
+        (data-parallel state is replicated — every process holds the same
+        values, so N writers would just race on the directory)."""
+        if self._process_index is None:
+            try:
+                self._process_index = jax.process_index()
+            except Exception:
+                self._process_index = 0
+        return self._process_index == 0
 
     # -- save ---------------------------------------------------------------
     def save(self, step, tree, block=False):
@@ -62,17 +120,34 @@ class CheckpointManager:
 
         The device→host transfer happens here (values are frozen against
         further training); file IO runs on a background thread unless
-        async_save=False or block=True.
+        async_save=False or block=True. On non-writer processes this is
+        a no-op (see the single-writer protocol in the module docstring).
         """
+        if not self.is_writer:
+            return
         self._raise_pending()
-        host = {k: np.asarray(v) for k, v in _flatten(tree).items()}
-        self.wait()  # one save at a time: bounded memory, no write races
-        if self.async_save and not block:
+
+        def own(v):
+            # the async writer must OWN every buffer: np.asarray on a jax
+            # CPU array can alias the device buffer, which the next
+            # (donating) train step then overwrites under the writer.
+            # Arrays that already own their memory (TrainStep.state_dict
+            # output) pass through — no second full-state memcpy.
+            if isinstance(v, np.ndarray) and v.base is None:
+                return v
+            return np.array(v)
+
+        host = {k: own(v) for k, v in _flatten(tree).items()}
+        self.wait(_barrier=False)  # one save at a time: bounded memory,
+        if self.async_save and not block:  # no write races
             self._worker = threading.Thread(
                 target=self._write, args=(step, host), daemon=True)
             self._worker.start()
         else:
             self._write(step, host)
+
+    def _manifest_path(self, step):
+        return os.path.join(self.directory, "ckpt-%d.manifest.json" % step)
 
     def _write(self, step, host):
         try:
@@ -92,7 +167,27 @@ class CheckpointManager:
                         z.writestr(k + ".npy", buf.getvalue())
                 f.flush()
                 os.fsync(f.fileno())
+            digest = hashlib.sha256()
+            with open(tmp, "rb") as f:
+                for block in iter(lambda: f.read(1 << 20), b""):
+                    digest.update(block)
+            manifest = {"step": int(step),
+                        "file": os.path.basename(final),
+                        "size": os.path.getsize(tmp),
+                        "sha256": digest.hexdigest(),
+                        "arrays": sorted(host.keys())}
+            _chaos.maybe_kill_during_save(step)
             os.replace(tmp, final)  # atomic publication
+            mtmp = self._manifest_path(step) + ".tmp-%d" % os.getpid()
+            with open(mtmp, "w") as f:
+                json.dump(manifest, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(mtmp, self._manifest_path(step))
+            # rename durability: the publication is only real once the
+            # directory entry itself is on disk
+            _fsync_dir(self.directory)
+            _chaos.maybe_corrupt_checkpoint(step, final)
             self._prune()
         except Exception as e:  # surfaced on the next save()/wait()
             with self._lock:
@@ -101,17 +196,32 @@ class CheckpointManager:
     def _prune(self):
         steps = sorted(self.all_steps())
         for s in steps[:-self.keep] if self.keep else []:
-            try:
-                os.remove(os.path.join(self.directory, "ckpt-%d.npz" % s))
-            except OSError:
-                pass
+            for path in (os.path.join(self.directory, "ckpt-%d.npz" % s),
+                         self._manifest_path(s)):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
 
-    def wait(self):
-        """Block until the in-flight async save (if any) has published."""
+    def wait(self, _barrier=True):
+        """Block until the in-flight async save (if any) has published.
+
+        This is the multi-process BARRIER POINT: every process calls it
+        at the same program point; when jax.distributed is active the
+        processes then synchronize, so none can proceed (or exit for
+        relaunch) before process 0's checkpoint is durably on disk."""
         if self._worker is not None:
             self._worker.join()
             self._worker = None
         self._raise_pending()
+        if _barrier:
+            try:
+                nproc = jax.process_count()
+            except Exception:
+                nproc = 1
+            if nproc > 1:
+                from jax.experimental import multihost_utils
+                multihost_utils.sync_global_devices("mxtpu-ckpt-wait")
 
     def _raise_pending(self):
         with self._lock:
@@ -122,7 +232,11 @@ class CheckpointManager:
     # -- restore ------------------------------------------------------------
     def all_steps(self):
         out = []
-        for name in os.listdir(self.directory):
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        for name in names:
             m = _CKPT_RE.match(name)
             if m:
                 out.append(int(m.group(1)))
@@ -132,16 +246,44 @@ class CheckpointManager:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
+    def _verify_manifest(self, step, path):
+        """Integrity gate before deserialization. A missing manifest is
+        tolerated (pre-manifest checkpoints stay restorable); a corrupt
+        or mismatching one raises ValueError, which restore_latest()
+        treats as corruption-shaped (falls back to an older step)."""
+        mpath = self._manifest_path(step)
+        if not os.path.exists(mpath):
+            return
+        with open(mpath) as f:
+            manifest = json.load(f)  # corrupt JSON -> ValueError
+        if not isinstance(manifest, dict) or "sha256" not in manifest:
+            raise ValueError("manifest %s is missing the checksum" % mpath)
+        size = os.path.getsize(path)
+        if manifest.get("size") not in (None, size):
+            raise ValueError(
+                "checkpoint ckpt-%d.npz is %d bytes but its manifest "
+                "recorded %d — truncated write" % (step, size,
+                                                   manifest["size"]))
+        digest = hashlib.sha256()
+        with open(path, "rb") as f:
+            for block in iter(lambda: f.read(1 << 20), b""):
+                digest.update(block)
+        if digest.hexdigest() != manifest["sha256"]:
+            raise ValueError("checkpoint ckpt-%d.npz fails its manifest "
+                             "sha256 — corrupt" % step)
+
     def restore(self, step):
         path = os.path.join(self.directory, "ckpt-%d.npz" % step)
+        self._verify_manifest(step, path)
         archive = np.load(path, allow_pickle=False)
         return _unflatten({k: archive[k] for k in archive.files})
 
     def restore_latest(self):
         """(step, tree) of the newest intact checkpoint, or None. A
-        corrupt file falls back (with a warning) to the previous one —
-        only corruption-shaped errors are treated as fallback-able, so a
-        systematic restore bug cannot silently become a cold start."""
+        corrupt file or manifest falls back (with a warning) to the
+        previous one — only corruption-shaped errors are treated as
+        fallback-able, so a systematic restore bug cannot silently
+        become a cold start."""
         import warnings
         import zipfile
         for step in reversed(self.all_steps()):

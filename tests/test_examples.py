@@ -65,3 +65,25 @@ def test_example_runs(script, args):
     assert proc.returncode == 0, (
         "%s failed:\nstdout: %s\nstderr: %s"
         % (script, proc.stdout[-2000:], proc.stderr[-2000:]))
+
+
+# CLI tools that are themselves end-to-end drills (CPU backend). The
+# chaos drill trains LeNet through SIGTERM preemption, a mid-save kill,
+# and an injected-NaN rollback, asserting the final state is
+# bit-identical to an undisturbed run.
+_TOOL_CASES = [
+    ("chaos_train.py", []),
+]
+
+
+@pytest.mark.parametrize("script,args", _TOOL_CASES,
+                         ids=[c[0] for c in _TOOL_CASES])
+def test_tool_runs(script, args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", script)] + args,
+        capture_output=True, text=True, timeout=900, env=env, cwd=_REPO)
+    assert proc.returncode == 0, (
+        "%s failed:\nstdout: %s\nstderr: %s"
+        % (script, proc.stdout[-2000:], proc.stderr[-2000:]))

@@ -339,8 +339,11 @@ def test_http_frontend(tiny_lm):
         met = json.loads(urllib.request.urlopen(
             url + "/v1/metrics", timeout=10).read())
         assert met["requests"]["completed"] == 1
-        assert json.loads(urllib.request.urlopen(
-            url + "/healthz", timeout=10).read()) == {"ok": True}
+        health = json.loads(urllib.request.urlopen(
+            url + "/healthz", timeout=10).read())
+        assert health["ok"] is True and health["loop_alive"] is True
+        assert health["last_beat_age_s"] < 5.0
+        assert health["engine_failures"] == 0
     finally:
         srv.close()
 
@@ -371,3 +374,77 @@ def test_serving_metrics_snapshot(tiny_lm):
         assert snap["engine"]["decode_compilations"] >= 1
     finally:
         srv.close()
+
+
+# ---------------------------------------------------------------------------
+# fault isolation: an engine exception fails requests, never the loop
+# ---------------------------------------------------------------------------
+
+
+def test_engine_prefill_exception_fails_request_not_loop(tiny_lm):
+    params, cfg = tiny_lm
+    srv = serving.serve((params, cfg), max_batch=2, block_size=8)
+    try:
+        real_start = srv.engine.start
+        boom = {"armed": True}
+
+        def flaky_start(*a, **kw):
+            if boom.pop("armed", None):
+                raise RuntimeError("injected prefill fault")
+            return real_start(*a, **kw)
+
+        srv.engine.start = flaky_start
+        req = srv.submit(arith_prompt(2, 1, 5), max_new_tokens=4)
+        with pytest.raises(mx.MXNetError, match="prefill failed"):
+            req.result(timeout=60)
+        # the loop survived: the next request completes normally
+        out = srv.generate(arith_prompt(3, 1, 5), max_new_tokens=4,
+                           timeout=120)
+        assert len(out) == 4
+        snap = srv.snapshot()
+        assert snap["requests"]["engine_failures"] == 1
+        assert snap["requests"]["failed"] == 1
+        assert snap["requests"]["completed"] == 1
+        assert srv.health()["ok"] is True
+    finally:
+        srv.close()
+
+
+def test_engine_decode_exception_fails_batch_not_loop(tiny_lm):
+    params, cfg = tiny_lm
+    srv = serving.serve((params, cfg), max_batch=2, block_size=8)
+    try:
+        real_decode = srv.engine.decode_step
+        boom = {"armed": True}
+
+        def flaky_decode(seqs):
+            if boom.pop("armed", None):
+                raise RuntimeError("injected decode fault")
+            return real_decode(seqs)
+
+        srv.engine.decode_step = flaky_decode
+        req = srv.submit(arith_prompt(4, 1, 5), max_new_tokens=4)
+        with pytest.raises(mx.MXNetError, match="decode failed"):
+            req.result(timeout=60)
+        # blocks recycled, loop alive: a fresh request decodes fine and
+        # /healthz stays green
+        out = srv.generate(arith_prompt(5, 1, 5), max_new_tokens=4,
+                           timeout=120)
+        assert len(out) == 4
+        h = srv.health()
+        assert h["ok"] is True and h["engine_failures"] == 1
+        pool = srv.engine.cache.pool
+        assert pool.in_use == 0  # everything released despite the fault
+    finally:
+        srv.close()
+
+
+def test_health_reports_closed_loop(tiny_lm):
+    params, cfg = tiny_lm
+    srv = serving.serve((params, cfg), max_batch=2, block_size=8)
+    srv.generate(arith_prompt(6, 1, 5), max_new_tokens=2, timeout=120)
+    h = srv.health()
+    assert h["ok"] and h["last_step_age_s"] is not None
+    srv.close()
+    assert srv.health()["ok"] is False
+    assert srv.health()["loop_alive"] is False

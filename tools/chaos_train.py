@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""Chaos drill: train LeNet through three injected faults and prove the
+final state is bit-identical to an undisturbed run.
+
+Orchestrator mode (default) runs four subprocess workers:
+
+  1. a CLEAN run — the reference trajectory;
+  2. the FAULTED sequence on a second checkpoint directory:
+     a. SIGTERM delivered mid-epoch (``MXNET_CHAOS_SIGTERM_AT``): the
+        preemption watcher checkpoints at the step boundary and exits
+        with the relaunch code 83;
+     b. relaunch, then a hard kill in the middle of a checkpoint write
+        (``MXNET_CHAOS_KILL_SAVE``, exit 43): the torn temp file must
+        not shadow the last published checkpoint;
+     c. relaunch with a NaN injected into one step's gradients
+        (``MXNET_CHAOS_NAN_STEP``) under the ``rollback`` policy: the
+        bad-step guard drops the update in-graph, the loop restores the
+        last checkpoint and replays — the fault is one-shot, so the
+        replay is clean and the trajectory rejoins the reference.
+
+Because every checkpoint captures the RNG key chain, LR-schedule state
+and the step counter, and every batch is a pure function of its step
+index, the faulted run's FINAL line (step, eval loss, param hash) must
+EQUAL the clean run's — which this tool asserts.
+
+Worker mode (``--worker``) is the training loop itself: build the net,
+`ResilientLoop(TrainStep, CheckpointManager)`, `restore()`, train. All
+fault behavior comes from the environment — the worker has no
+fault-specific code, which is the point.
+
+Usage:
+    python tools/chaos_train.py                  # LeNet drill
+    python tools/chaos_train.py --net mlp        # fast CI config
+"""
+import argparse
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def build_net(kind):
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    if kind == "lenet":
+        from mxnet_tpu.models.lenet import LeNet
+        net = LeNet(num_classes=10, dropout=0.25)
+    else:
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(32, in_units=64, activation="relu"))
+        net.add(gluon.nn.Dropout(0.25))
+        net.add(gluon.nn.Dense(10, in_units=32))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def batch_for(kind, step, batch_size=8):
+    rng = np.random.RandomState(10_000 + step)
+    if kind == "lenet":
+        x = rng.randn(batch_size, 1, 28, 28).astype(np.float32)
+    else:
+        x = rng.randn(batch_size, 64).astype(np.float32)
+    y = rng.randint(0, 10, (batch_size,)).astype(np.float32)
+    return x, y
+
+
+def worker(args):
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import ResilientLoop, TrainStep
+    from mxnet_tpu.utils.recovery import CheckpointManager
+
+    mx.random.seed(0)
+    np.random.seed(0)
+    net = build_net(args.net)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x0, y0 = batch_for(args.net, 0)
+    net(mx.nd.array(x0))  # materialize deferred shapes before TrainStep
+    step_fn = TrainStep(net, loss_fn, "adam", {"learning_rate": 0.01},
+                        guard=True)
+    mgr = CheckpointManager(args.ckpt_dir, keep=3)
+    loop = ResilientLoop(step_fn, mgr, save_every=args.save_every,
+                         policy=args.policy, rollback_after=1,
+                         lr_shrink=1.0)
+    loop.restore()
+    # drive batches off the CURRENT step counter: after a rollback the
+    # trainer rewinds and the replayed steps must re-see their batches
+    while loop.t < args.steps:
+        loop.step(*batch_for(args.net, loop.t))
+    loop.finish()
+    step_fn.sync_params()
+    # deterministic eval: dropout off outside training, fixed batch
+    xe, ye = batch_for(args.net, 999)
+    out = net(mx.nd.array(xe))
+    eval_loss = float(np.mean(loss_fn(out, mx.nd.array(ye)).asnumpy()))
+    flat = np.concatenate([p.data().asnumpy().ravel()
+                           for p in net.collect_params().values()])
+    print("FINAL step=%d loss=%.6f hash=%.8f"
+          % (args.steps, eval_loss, float(np.sum(flat * flat))), flush=True)
+    return 0
+
+
+def run_worker(args, ckpt_dir, chaos=None, tag=""):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("MXNET_CHAOS_")}
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(chaos or {})
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker",
+           "--net", args.net, "--steps", str(args.steps),
+           "--save-every", str(args.save_every),
+           "--policy", args.policy, "--ckpt-dir", ckpt_dir]
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=600)
+    print("-- %s: exit %d" % (tag or "worker", proc.returncode))
+    for line in proc.stdout.splitlines():
+        if line.startswith(("FINAL", "[resilient]")):
+            print("   " + line)
+    if proc.returncode not in (0, 43, 83):
+        print(proc.stdout[-1500:])
+        print(proc.stderr[-1500:])
+    return proc
+
+
+def final_line(proc):
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("FINAL")]
+    return lines[-1] if lines else None
+
+
+def orchestrate(args):
+    import tempfile
+    from mxnet_tpu.parallel.resilient import EXIT_PREEMPTED
+    base = args.work_dir or tempfile.mkdtemp(prefix="chaos_train_")
+    clean_dir = os.path.join(base, "clean")
+    fault_dir = os.path.join(base, "faulted")
+    k_sigterm = args.steps // 4            # mid-epoch, off cadence
+    k_killsave = (args.steps // 2 // args.save_every) * args.save_every
+    k_nan = k_killsave + 2
+
+    print("== chaos drill: %s, %d steps, save every %d (faults: SIGTERM@%d,"
+          " kill-during-save@%d, NaN@%d)"
+          % (args.net, args.steps, args.save_every, k_sigterm, k_killsave,
+             k_nan))
+    clean = run_worker(args, clean_dir, tag="clean reference")
+    assert clean.returncode == 0, "clean run failed"
+
+    p1 = run_worker(args, fault_dir,
+                    {"MXNET_CHAOS_SIGTERM_AT": str(k_sigterm)},
+                    tag="fault 1: SIGTERM@%d" % k_sigterm)
+    assert p1.returncode == EXIT_PREEMPTED, (
+        "expected preemption exit %d, got %d" % (EXIT_PREEMPTED,
+                                                 p1.returncode))
+    p2 = run_worker(args, fault_dir,
+                    {"MXNET_CHAOS_KILL_SAVE": str(k_killsave)},
+                    tag="fault 2: kill-during-save@%d" % k_killsave)
+    assert p2.returncode == 43, (
+        "expected chaos hard-kill exit 43, got %d" % p2.returncode)
+    p3 = run_worker(args, fault_dir,
+                    {"MXNET_CHAOS_NAN_STEP": str(k_nan)},
+                    tag="fault 3: NaN grads@%d (rollback) + finish" % k_nan)
+    assert p3.returncode == 0, "faulted run did not complete"
+
+    want, got = final_line(clean), final_line(p3)
+    print("== clean:   %s" % want)
+    print("== faulted: %s" % got)
+    assert want is not None and want == got, (
+        "faulted trajectory diverged from the clean run")
+    print("== OK: three faults survived, final state bit-identical")
+    return 0
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--net", choices=("lenet", "mlp"), default="lenet")
+    ap.add_argument("--steps", type=int, default=24)
+    ap.add_argument("--save-every", type=int, default=4)
+    ap.add_argument("--policy", default="rollback")
+    ap.add_argument("--ckpt-dir", default="")
+    ap.add_argument("--work-dir", default="")
+    args = ap.parse_args()
+    if args.worker:
+        assert args.ckpt_dir, "--worker needs --ckpt-dir"
+        return worker(args)
+    return orchestrate(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
