@@ -867,8 +867,12 @@ def bench_serving(smoke, dtype, device_kind, batch=None):
     mxnet_tpu.serving's paged-KV engine — the serving trajectory line.
     BENCH_SERVING_BATCH overrides the batch; the full run sweeps
     {1, 8, 32} via _run_configs. Decode-only timing: prefill compiles
-    and the cache fill are excluded (reported separately), matching how
-    a steady-state server spends its time."""
+    and the cache fill are excluded (reported separately, now with
+    per-request time-to-first-token p50/p95 and prefill tok/s), matching
+    how a steady-state server spends its time. `paged_attention: on|off`
+    (MXNET_PAGED_ATTENTION, the ragged Pallas kernel + chunked prefill
+    of ops/pallas_paged.py) labels every line so A/B runs pair up —
+    tpu_session.sh step 2d emits both legs."""
     import jax
     import jax.numpy as jnp
     from mxnet_tpu import serving
@@ -878,10 +882,12 @@ def bench_serving(smoke, dtype, device_kind, batch=None):
     if batch is None:
         batch = int(os.environ.get("BENCH_SERVING_BATCH", "2" if smoke
                                    else "8"))
+    # r6: d_model 256->512, heads 8->4 (head_dim 32->128) so the Mosaic
+    # paged kernel is tile-eligible on TPU; trajectory comparable r6 on
     cfg = TransformerConfig(vocab=128, d_model=32, n_heads=4, n_layers=2,
                             d_ff=64, max_len=64) if smoke else \
-        TransformerConfig(vocab=8192, d_model=256, n_heads=8, n_layers=4,
-                          d_ff=1024, max_len=1024)
+        TransformerConfig(vocab=8192, d_model=512, n_heads=4, n_layers=4,
+                          d_ff=2048, max_len=1024)
     prompt_len = 8 if smoke else 64
     gen = 8 if smoke else 128
     params = init_transformer_params(jax.random.PRNGKey(0), cfg)
@@ -890,9 +896,19 @@ def bench_serving(smoke, dtype, device_kind, batch=None):
     eng = serving.Engine(serving.TransformerLM(params, cfg),
                          max_batch=batch, block_size=16)
     rng = np.random.RandomState(0)
+    prompts = [list(rng.randint(1, cfg.vocab, prompt_len))
+               for _ in range(batch)]
+    # prefill-path compile warmup (same signature as the timed starts),
+    # so TTFT percentiles measure the steady-state path
+    warm = eng.start(list(prompts[0]), max_new=2)
+    eng.release(warm)
+    ttft_s = []
+    seqs = []
     t0 = time.perf_counter()
-    seqs = [eng.start(list(rng.randint(1, cfg.vocab, prompt_len)),
-                      max_new=gen + 1) for _ in range(batch)]
+    for p in prompts:
+        t1 = time.perf_counter()
+        seqs.append(eng.start(list(p), max_new=gen + 1))
+        ttft_s.append(time.perf_counter() - t1)
     t_prefill = time.perf_counter() - t0
     eng.decode_step(seqs)  # decode-path compile + warmup
     steps = 0
@@ -912,11 +928,22 @@ def bench_serving(smoke, dtype, device_kind, batch=None):
             "seq_len": cfg.max_len,
             "decode_ms_per_step": round(1e3 * dt / steps, 3),
             "prefill_s": round(t_prefill, 3),
+            "prefill_tok_per_sec": round(batch * prompt_len / t_prefill,
+                                         1),
+            "ttft_ms_p50": round(1e3 * float(np.percentile(ttft_s, 50)),
+                                 3),
+            "ttft_ms_p95": round(1e3 * float(np.percentile(ttft_s, 95)),
+                                 3),
+            "paged_attention": "on" if eng.paged else "off",
+            "prefill_chunk": eng.prefill_chunk or None,
             "decode_compilations": eng.decode_compilations,
+            "prefill_compilations": eng.prefill_compilations,
             "vs_baseline": None,
             "baseline_note": "no serving path exists in the reference "
                              "tree (c_predict_api is one-shot); this "
-                             "line tracks the trajectory from PR 1 on"}
+                             "line tracks the trajectory from PR 1 on "
+                             "(config widened r6 for kernel tile "
+                             "eligibility)"}
 
 
 def bench_resilience(smoke, dtype, device_kind):

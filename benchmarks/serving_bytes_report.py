@@ -1,0 +1,184 @@
+"""Decode-step bytes report: A/B the serving engine's gather vs paged
+attention read via XLA's own cost model.
+
+The claim under test (ISSUE 4 acceptance): the bytes one decode step
+moves on the PAGED path (ops/pallas_paged.py — block-table walk,
+width-bucketed tables) are independent of the padded history length T,
+while the GATHER path (PR 1 — dense (B, T, H, Dh) materialization per
+layer) grows linearly with T.
+
+Methodology: the padded history length enters the compiled decode step
+through ONE variable — the block-table width. The gather engine's width
+is structurally tied to capacity (`_nblk` = max_len/block_size); the
+paged engine's is bucketed to the longest TRUE length in the batch
+(serving/engine.py decode_step). So the instrument holds everything
+else constant — one pool sized for T_max, fixed true lengths — and
+compiles each path's decode at the table width its engine would hand
+XLA for each T: gather at T/block_size, paged at the (T-independent)
+true-length bucket. Pinning the pool operand isolates the attention
+read from a scatter-copy artifact: XLA's cost model charges the
+`write_kv` pool update (identical on both paths) proportionally to the
+pool operand, which would add the same linear-in-T noise to both legs
+and hide the signal being measured.
+
+On TPU each pallas_call is an opaque custom call whose declared
+CostEstimate feeds the cost model — without it the paged mode would
+count zero bytes. On CPU the kernel lowers through the Pallas
+INTERPRETER, whose staging copies inflate the paged path's absolute
+bytes (disclosed on every CPU line, same caveat as bytes_report.py);
+the decision signals on CPU are the flat-vs-linear byte/flop curves in
+T, not the absolute paged bytes.
+
+Knobs: SERVING_BYTES_T (comma list, default 128,512,2048),
+SERVING_BYTES_BATCH (4), SERVING_BYTES_EXEC=1 (also time 20 real decode
+steps per leg). Output: one JSON line per (path, T) + a summary table
+on stderr. tpu_session.sh step 2d runs it on TPU; the committed CPU run
+is BENCH_BYTES_SERVING_CPU.txt.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def build_engine(paged, max_len, batch, cfg_kw, block_size=16):
+    import jax
+    from mxnet_tpu import serving
+    from mxnet_tpu.models.transformer import (TransformerConfig,
+                                              init_transformer_params)
+    cfg = TransformerConfig(max_len=max_len, **cfg_kw)
+    params = init_transformer_params(jax.random.PRNGKey(0), cfg)
+    model = serving.TransformerLM(params, cfg)
+    eng = serving.Engine(model, max_batch=batch, block_size=block_size,
+                         paged=paged)
+    return eng, model
+
+
+def decode_args(eng, true_lens, width):
+    """The exact (tokens, positions, tables) the engine's decode_step
+    would build for sequences at `true_lens`, at table width `width` —
+    allocation only, no compute (Engine.begin)."""
+    from mxnet_tpu.serving.engine import pow2_bucket
+    seqs = [eng.begin(list(range(1, l + 1)), 4) for l in true_lens]
+    bb = pow2_bucket(len(seqs), lo=1, hi=eng.max_batch)
+    toks = np.zeros((bb,), np.int32)
+    pos = np.zeros((bb,), np.int32)
+    tabs = np.zeros((bb, width), np.int32)
+    for i, s in enumerate(seqs):
+        toks[i] = s.tokens[-1]
+        pos[i] = len(s.tokens) - 1
+        tabs[i] = s.table_row[:width]
+    for s in seqs:
+        eng.release(s)
+    return toks, pos, tabs
+
+
+def paged_width(eng, true_lens):
+    """The width bucket the paged decode_step computes — covers the
+    longest TRUE length, independent of max_len."""
+    from mxnet_tpu.serving.engine import pow2_bucket
+    return pow2_bucket(max(eng.cache.blocks_for(l) for l in true_lens),
+                       lo=1, hi=eng._nblk)
+
+
+def analyze(eng, model, padded_T, width, true_lens):
+    import jax.numpy as jnp
+    toks, pos, tabs = decode_args(eng, true_lens, width)
+    fn = model._decode_paged_jit if eng.paged else model._decode_jit
+    args = (model.params, eng.cache.k, eng.cache.v, jnp.asarray(toks),
+            jnp.asarray(pos), jnp.asarray(tabs))
+    t0 = time.perf_counter()
+    compiled = fn.lower(*args).compile()
+    cost = compiled.cost_analysis()
+    if isinstance(cost, list):  # older jax returns [dict]
+        cost = cost[0] if cost else {}
+    info = {
+        "path": "paged" if eng.paged else "gather",
+        "padded_T": padded_T,
+        "table_width": width,
+        "true_lens": list(true_lens),
+        "flops": cost.get("flops"),
+        "bytes_accessed": cost.get("bytes accessed"),
+        "compile_s": round(time.perf_counter() - t0, 1),
+    }
+    if os.environ.get("SERVING_BYTES_EXEC", "0") == "1":
+        k, v, logits, nxt = fn(*args)          # warmup (jit cache hot)
+        np.asarray(nxt)
+        t0 = time.perf_counter()
+        n = 20
+        for _ in range(n):
+            k, v, logits, nxt = fn(model.params, k, v, args[3], args[4],
+                                   args[5])
+        np.asarray(nxt)
+        info["decode_ms_per_step"] = round(
+            1e3 * (time.perf_counter() - t0) / n, 3)
+    return info
+
+
+def main():
+    import jax
+    dev = jax.devices()[0]
+    batch = int(os.environ.get("SERVING_BYTES_BATCH", "4"))
+    ts = [int(t) for t in os.environ.get("SERVING_BYTES_T",
+                                         "128,512,2048").split(",")]
+    # fixed true lengths — the raggedness the paged path exploits; all
+    # well under the smallest padded T so every T shares them
+    true_lens = [100, 40, 7, 1][:batch]
+    cfg_kw = dict(vocab=512, d_model=128, n_heads=4, n_layers=2, d_ff=256)
+    interp = dev.platform != "tpu"
+    block_size = 16
+
+    # ONE pool per path, sized for T_max (see module docstring: pins the
+    # write_kv scatter artifact so the T sweep varies only the table
+    # width — the variable that carries the padded history length)
+    t_max = max(ts)
+    eng_g, model_g = build_engine(False, t_max, batch, cfg_kw, block_size)
+    eng_p, model_p = build_engine(True, t_max, batch, cfg_kw, block_size)
+    w_paged = paged_width(eng_p, true_lens)
+
+    rows = []
+    for T in ts:
+        for eng, model in ((eng_g, model_g), (eng_p, model_p)):
+            width = w_paged if eng.paged else T // block_size
+            info = analyze(eng, model, T, width, true_lens)
+            info["batch"] = batch
+            info["device"] = getattr(dev, "device_kind", dev.platform)
+            if eng.paged and interp:
+                info["note"] = ("paged kernel ran under the Pallas "
+                                "interpreter — absolute bytes inflated "
+                                "by staging copies; the flat-vs-linear "
+                                "shape in T is the decision signal on "
+                                "CPU, absolute bytes are TPU-only "
+                                "(declared CostEstimates)")
+            rows.append(info)
+            print(json.dumps(info), flush=True)
+
+    print("\npath    padded_T  width  MB/step  MFLOP/step", file=sys.stderr)
+    base = {}
+    for r in rows:
+        mb = (r["bytes_accessed"] or 0) / 1e6
+        mf = (r["flops"] or 0) / 1e6
+        key = r["path"]
+        delta = ""
+        if key in base and base[key]:
+            delta = "  (bytes %+.1f%% vs T=%d)" % (
+                100.0 * ((r["bytes_accessed"] or 0) - base[key][1])
+                / base[key][1], base[key][0])
+        else:
+            base[key] = (r["padded_T"], r["bytes_accessed"])
+        print("%-7s %8d  %5d  %7.2f  %10.1f%s"
+              % (r["path"], r["padded_T"], r["table_width"], mb, mf,
+                 delta), file=sys.stderr)
+    gather = [r["bytes_accessed"] for r in rows if r["path"] == "gather"]
+    paged = [r["bytes_accessed"] for r in rows if r["path"] == "paged"]
+    if len(gather) >= 2 and all(gather) and all(paged):
+        print("\ngather bytes T-max/T-min: %.2fx   paged: %.2fx "
+              "(flat == independent of padded history)"
+              % (max(gather) / min(gather), max(paged) / min(paged)),
+              file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -44,6 +44,24 @@ timeout -k 30 1800 env BENCH_CONFIGS=headline BENCH_FUSED=1 \
 timeout -k 30 1800 env BENCH_CONFIGS=headline BENCH_FUSED=1 BENCH_REMAT=io \
   python bench.py | tee /tmp/bench_iofused.out
 
+echo "=== 2d. serving ragged paged-attention A/B (bytes + tok/s + TTFT) ==="
+# ISSUE 4 measurement: (a) XLA cost-model bytes for one decode step —
+# paged must stay flat across padded T while gather grows (the committed
+# CPU shape is BENCH_BYTES_SERVING_CPU.txt; this is the on-chip leg with
+# real CostEstimate-declared kernel traffic); (b) decode tok/s + TTFT
+# p50/p95 with the kernel off/on at batch {1,8,32}. Predicted deltas are
+# registered in BENCH_NOTES.md round 6 BEFORE this runs. timeout-bounded:
+# a Mosaic compile hang must not stall the session.
+: > BENCH_BYTES_SERVING_TPU.txt   # truncate: reruns must not interleave
+timeout -k 30 1800 env SERVING_BYTES_EXEC=1 PYTHONPATH=. \
+  python benchmarks/serving_bytes_report.py \
+  2> >(tee -a BENCH_BYTES_SERVING_TPU.txt >&2) \
+  | tee -a BENCH_BYTES_SERVING_TPU.txt
+for P in 0 1; do
+  timeout -k 30 1800 env BENCH_CONFIGS=serving MXNET_PAGED_ATTENTION=$P \
+    python bench.py
+done | tee BENCH_SERVING_AB.jsonl
+
 echo "=== 3. flash attention seq sweep (1024/2048/4096) ==="
 BENCH_CONFIGS=transformer_flash BENCH_FLASH_SEQ=1024,2048,4096,8192 \
   python bench.py | tee BENCH_FLASH_SWEEP.jsonl

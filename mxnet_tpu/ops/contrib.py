@@ -777,3 +777,50 @@ def khatri_rao(*args):
     for m in args[1:]:
         out = (out[:, None, :] * m[None, :, :]).reshape(-1, out.shape[-1])
     return out
+
+
+@register("_contrib_PagedAttention", aliases=("PagedAttention",),
+          differentiable=False)
+def PagedAttention(query, k_pool, v_pool, block_table, q_start,
+                   block_size=16, scale=None):
+    """Ragged paged attention over a block-pooled KV-cache — the serving
+    decode/prefill read (ops/pallas_paged.py) as a public operator.
+
+    query [B, Tq, H, Dh]; k_pool/v_pool [num_blocks, block_size, H, Dh]
+    (ONE layer of serving.PagedKVCache's contiguous-per-layer pools);
+    block_table [B, w] int32; q_start [B] int32 true position of each
+    row's first query token. Keys past position q_start+i are masked per
+    row (ragged; doubles as the causal mask for prefill chunks).
+
+    With MXNET_PAGED_ATTENTION=1 (and Mosaic-tileable shapes on real
+    TPUs) the read runs as the Pallas kernel — block-table walk in VMEM,
+    online f32 softmax, no dense gather; otherwise the same math
+    composes from gather-by-table + masked softmax in XLA, so the op is
+    always available and the env flag only switches implementation.
+    Inference-only (decode serving path), like the reference's
+    data-dependent contrib kernels."""
+    import math as _math
+    from . import pallas_paged as _pp
+    from .pallas_attention import default_interpret
+
+    B, Tq, H, Dh = query.shape
+    if scale is None:
+        scale = 1.0 / _math.sqrt(Dh)
+    interpret = default_interpret()
+    if _pp.paged_enabled() and _pp.paged_eligible(Dh, block_size, Tq,
+                                                 interpret):
+        return _pp.paged_attention(query, k_pool, v_pool, block_table,
+                                   q_start, block_size, scale=scale,
+                                   interpret=interpret)
+    w = block_table.shape[1]
+    ks = k_pool[block_table].reshape(B, w * block_size, H, Dh)
+    vs = v_pool[block_table].reshape(B, w * block_size, H, Dh)
+    s = jnp.einsum("bqhd,bthd->bhqt", query.astype(jnp.float32),
+                   ks.astype(jnp.float32)) * scale
+    kp = jnp.arange(w * block_size)[None, None, None, :]
+    qp = (q_start[:, None, None, None]
+          + jnp.arange(Tq)[None, None, :, None])
+    s = jnp.where(kp <= qp, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqt,bthd->bqhd", p, vs.astype(p.dtype))
+    return out.astype(query.dtype)

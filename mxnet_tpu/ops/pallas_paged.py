@@ -1,0 +1,209 @@
+"""Pallas ragged paged-attention: decode/prefill reads straight off the
+block pool.
+
+Why a hand kernel: the PR 1 serving engine decodes by GATHERING each
+sequence's K/V blocks into a dense (B, T, H, Dh) tensor per layer
+(serving/kv_cache.gather_kv) and then running a masked softmax over the
+full padded width — every decoded token pays O(padded-history) HBM reads
+plus a fully materialized copy of the cache. Following "Ragged Paged
+Attention" (arxiv 2604.15464, PAPERS.md) the decode read should instead
+be ONE kernel that walks the block table in place: the grid iterates
+(batch row, head, table slot), a scalar-prefetched block table drives the
+BlockSpec index map so each grid step DMAs exactly one (block_size, Dh)
+pool block into VMEM, and an online-softmax accumulator (running max +
+denominator in VMEM scratch, the flash-attention formulation of
+ops/pallas_attention.py) folds the block in — no dense gather is ever
+materialized and scores never leave the chip.
+
+Raggedness: every sequence carries its TRUE last position (`q_start`).
+Table slots past a row's live blocks are dead — the kernel skips their
+compute entirely (`pl.when`) and the index map clamps them to the row's
+last live block, so Pallas's revisit-elision skips their DMA too. The
+caller additionally buckets the table WIDTH to the longest live sequence
+in the batch (serving/engine.py), so the bytes a decode step moves track
+true lengths, never the padded pool capacity — the compiler-visible O(1)
+per-token cache read of arxiv 2603.09555.
+
+One kernel serves both phases: decode is Tq=1 (one query row per
+sequence), chunked prefill is Tq=chunk (a fixed-shape query block whose
+K/V were appended to the pool just before the call; the ragged mask
+`key_pos <= q_start + i` doubles as the causal mask within the chunk).
+
+Every pallas_call declares a CostEstimate: on TPU the kernel is an opaque
+custom call, and without declared flops/bytes the XLA cost model — the
+A/B instrument of benchmarks/serving_bytes_report.py — would count it as
+moving zero bytes.
+
+On CPU the kernel runs in Pallas interpreter mode; the parity tests
+(tests/test_pallas_paged.py) prove it equal to the dense gather path
+there, so the TPU run is a pure measurement question (tpu_session.sh).
+"""
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+
+from .pallas_attention import default_interpret
+from .pallas_fused import _cost
+
+
+def paged_enabled():
+    """MXNET_PAGED_ATTENTION=1 — read when an Engine is constructed
+    (docs/ENV_VARS.md)."""
+    return os.environ.get("MXNET_PAGED_ATTENTION", "0") == "1"
+
+
+def paged_eligible(head_dim, block_size, n_queries, interpret):
+    """Gate for the compiled (Mosaic) kernel; interpreter mode takes any
+    shape. On real hardware stay off the (8, 128) VMEM tiling grid's bad
+    cases: the lane dim (head_dim) must be a multiple of 128 and the
+    sublane dims (block_size, and the query block for prefill chunks)
+    multiples of 8 — callers fall back to the XLA gather path otherwise.
+    """
+    if interpret:
+        return True
+    return (head_dim % 128 == 0 and block_size % 8 == 0
+            and (n_queries == 1 or n_queries % 8 == 0))
+
+
+def _kernel(tab_ref, qs_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
+            acc_scr, *, scale, block_size, nw, tq):
+    """One (batch row b, head h, table slot j) grid step: fold pool block
+    `tab[b, j]` into row b's online softmax. Scratch carries the
+    accumulator across the innermost (j) dimension."""
+    from jax.experimental import pallas as pl
+
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # table slots whose first key position lies beyond the row's last
+    # query position hold nothing any query may attend to: skip the MXU
+    # work (their DMA is already elided by the clamped index map)
+    live = j * block_size <= qs_ref[b] + tq - 1
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0, :, 0].astype(jnp.float32)            # [tq, Dh]
+        k = k_ref[0, :, 0].astype(jnp.float32)            # [bs, Dh]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        # ragged mask: key at table position j*bs+t is live for query i
+        # iff it is at or before that query's true position qs+i (for
+        # prefill chunks this IS the causal mask within the chunk)
+        kp = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (tq, block_size), 1)
+        qp = qs_ref[b] + jax.lax.broadcasted_iota(
+            jnp.int32, (tq, block_size), 0)
+        s = jnp.where(kp <= qp, s, -jnp.inf)
+
+        m_prev = m_scr[...]                               # [tq, 1]
+        l_prev = l_scr[...]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - m_safe), 0.0)
+        alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe),
+                          0.0)
+        v = v_ref[0, :, 0].astype(jnp.float32)            # [bs, Dh]
+        acc_scr[...] = alpha * acc_scr[...] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = m_new
+        l_scr[...] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+
+    @pl.when(j == nw - 1)
+    def _emit():
+        o_ref[0, :, 0] = (acc_scr[...] / jnp.maximum(l_scr[...], 1e-20)) \
+            .astype(o_ref.dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_paged(scale, block_size, interpret):
+    """Build the traced kernel entry for one (scale, block_size) static
+    configuration — cached so every layer of every decode/prefill
+    signature shares one traced op (the _make_flash pattern)."""
+
+    def call(q, k_pool, v_pool, tables, q_start):
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        B, Tq, H, Dh = q.shape
+        w = tables.shape[1]
+        itemsize = jnp.dtype(k_pool.dtype).itemsize
+
+        def kv_idx(b, h, j, tab_ref, qs_ref):
+            # dead slots re-read the row's last live block: Pallas skips
+            # the DMA when consecutive grid steps map to the same block
+            last = jnp.maximum(qs_ref[b] + Tq - 1, 0) // block_size
+            return (tab_ref[b, jnp.minimum(j, last)], 0, h, 0)
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, H, w),
+            in_specs=[
+                pl.BlockSpec((1, Tq, 1, Dh),
+                             lambda b, h, j, t, s: (b, 0, h, 0)),
+                pl.BlockSpec((1, block_size, 1, Dh), kv_idx),
+                pl.BlockSpec((1, block_size, 1, Dh), kv_idx),
+            ],
+            out_specs=pl.BlockSpec((1, Tq, 1, Dh),
+                                   lambda b, h, j, t, s: (b, 0, h, 0)),
+            scratch_shapes=[pltpu.VMEM((Tq, 1), jnp.float32),
+                            pltpu.VMEM((Tq, 1), jnp.float32),
+                            pltpu.VMEM((Tq, Dh), jnp.float32)],
+        )
+        kern = functools.partial(_kernel, scale=scale,
+                                 block_size=block_size, nw=w, tq=Tq)
+        nk = B * H * w * block_size           # pool tokens touched
+        return pl.pallas_call(
+            kern,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+            interpret=interpret,
+            # 2 MACs/flop-pair per element for each of the QK and PV
+            # matmuls; bytes = K+V blocks walked + q/out + the tables
+            **_cost(4 * nk * Tq * Dh,
+                    2 * nk * Dh * itemsize
+                    + 2 * B * Tq * H * Dh * jnp.dtype(q.dtype).itemsize
+                    + tables.size * 4 + q_start.size * 4),
+        )(tables, q_start, q, k_pool, v_pool)
+
+    return call
+
+
+def paged_attention(q, k_pool, v_pool, tables, q_start, block_size,
+                    scale=None, interpret=None):
+    """Ragged paged attention against a contiguous-per-layer block pool.
+
+    q:       (B, Tq, H, Dh) query block — Tq=1 for decode, Tq=chunk for
+             chunked prefill (whose K/V are already written to the pool).
+    k_pool:  (num_blocks, block_size, H, Dh) one layer's key pool.
+    v_pool:  same shape, values.
+    tables:  (B, w) int32 block table, width w bucketed by the caller to
+             the longest live sequence (null-padded past each row's
+             blocks).
+    q_start: (B,) int32 true position of each row's FIRST query token
+             (for decode: the sequence's current last position).
+
+    Returns (B, Tq, H, Dh) attention outputs; per-sequence keys past
+    position q_start+i are masked, so padded table entries and pool
+    garbage never leak into real rows. Softmax statistics accumulate in
+    f32 regardless of pool dtype.
+    """
+    if interpret is None:
+        interpret = default_interpret()
+    B, Tq, H, Dh = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(Dh)
+    return _make_paged(float(scale), int(block_size), bool(interpret))(
+        q, k_pool, v_pool, tables, q_start)

@@ -4,9 +4,12 @@ The reference's serving story was the one-shot c_predict_api
 (Predictor.set_input/forward/get_output). This subsystem is the
 production-shape replacement for autoregressive models: a paged KV-cache
 (fixed-shape block pools, jit-stable decode), a prefill/decode engine
-with bucketed shapes, a continuous-batching scheduler with backpressure,
-serving metrics, and an in-process `serve()` API with a stdlib HTTP
-frontend (tools/serve.py).
+with bucketed shapes — and, under `MXNET_PAGED_ATTENTION=1`, a ragged
+paged-attention Pallas kernel that reads the cache in place plus
+chunked prefill (ops/pallas_paged.py) — a continuous-batching scheduler
+with backpressure and a per-iteration token budget, serving metrics,
+and an in-process `serve()` API with a stdlib HTTP frontend
+(tools/serve.py).
 
 Quickstart::
 

@@ -3,11 +3,27 @@
 Two model families plug in behind one `Engine`:
 
 * `TransformerLM` — the functional transformer (models/transformer.py)
-  with a real paged-cache decode path: prefill runs the dense causal
-  forward once per request and writes each layer's K/V into the block
-  pool; `decode` then advances EVERY active sequence by one token with a
-  gather-by-block-table attention read (O(1) work per token, no O(T^2)
-  recompute).
+  with a real paged-cache decode path. Two implementations of that path
+  coexist:
+
+  - the GATHER path (PR 1, the fallback and parity oracle): decode
+    gathers each sequence's K/V blocks into a dense (B, T, H, Dh)
+    tensor per layer and masked-softmaxes over the full padded width;
+    prefill runs the dense causal forward once per request over a
+    power-of-two length bucket.
+  - the PAGED path (`MXNET_PAGED_ATTENTION=1`, or `Engine(paged=True)`):
+    decode attention runs as ONE Pallas kernel per layer that walks the
+    block table in place with per-sequence true lengths
+    (ops/pallas_paged.py) — no dense gather is ever materialized, and
+    the table WIDTH handed to the kernel is bucketed to the longest
+    live sequence, so the bytes per decoded token track true lengths
+    rather than the padded pool capacity. Prefill is CHUNKED: long
+    prompts stream through a fixed-shape chunk kernel that appends K/V
+    into the pool chunk-by-chunk — one compiled chunk shape replaces
+    the per-length-bucket dense prefill lattice, and the serving loop
+    co-schedules pending chunks with decode steps under the
+    scheduler's token budget (a long prompt cannot starve decode).
+
 * `BlockLM` / `ExportedLM` — any Gluon causal LM (via
   parallel.functional.functionalize) or a `.mxtpu` artifact from
   `predict.export_model`. These have no cache hooks, so decode re-runs
@@ -16,12 +32,12 @@ Two model families plug in behind one `Engine`:
   available to every model the framework can express or export.
 
 jit stability: the engine never hands XLA a novel shape per request.
-Prompt lengths pad to power-of-two buckets, the decode batch pads to
-power-of-two buckets up to `max_batch`, and the cache pool/tables are
-fixed-shape (kv_cache.py) — so the number of distinct compilations is
-bounded by #length-buckets + #batch-buckets, not by traffic. The engine
-counts distinct signatures (`prefill_compilations` /
-`decode_compilations`); tests pin the bound.
+Prompt lengths pad to power-of-two buckets (gather path) or one fixed
+chunk shape (paged path), the decode batch and the paged table width pad
+to power-of-two buckets, and the cache pool is fixed-shape (kv_cache.py)
+— so the number of distinct compilations is bounded by #buckets, not by
+traffic. The engine counts distinct signatures (`prefill_compilations` /
+`decode_compilations`); tests pin the bounds for both paths.
 """
 from __future__ import annotations
 
@@ -47,10 +63,14 @@ def pow2_bucket(n, lo=1, hi=None):
 
 class Sequence:
     """One in-flight generation: prompt + generated tokens, cache blocks,
-    bookkeeping the engine and scheduler share."""
+    bookkeeping the engine and scheduler share. `prefilled` counts prompt
+    tokens already written to the cache (chunked prefill advances it one
+    chunk per `prefill_step`); `prefill_s` accumulates prefill wall time
+    across chunks for the metrics roll-up."""
 
     __slots__ = ("tokens", "prompt_len", "block_ids", "table_row",
-                 "max_total", "eos_id", "done", "last_logits", "request")
+                 "max_total", "eos_id", "done", "last_logits", "request",
+                 "prefilled", "prefill_s")
 
     def __init__(self, prompt, max_total, eos_id=None):
         self.tokens = list(prompt)
@@ -62,6 +82,8 @@ class Sequence:
         self.done = False
         self.last_logits = None
         self.request = None
+        self.prefilled = 0
+        self.prefill_s = 0.0
 
     @property
     def generated(self):
@@ -162,6 +184,90 @@ def _tf_decode(params, k_pool, v_pool, tokens, positions, tables, cfg,
     return k_pool, v_pool, logits, jnp.argmax(logits, -1).astype(jnp.int32)
 
 
+def _tf_decode_paged(params, k_pool, v_pool, tokens, positions, tables,
+                     cfg, block_size):
+    """One decode step via the ragged paged-attention kernel: same
+    contract as `_tf_decode`, but the per-layer cache read is a single
+    Pallas kernel walking the block table in place (ops/pallas_paged.py)
+    — no dense (B, T, H, Dh) gather is materialized. `tables` is
+    width-bucketed by the caller to the longest live sequence, so the
+    compiled program's bytes track true lengths, not max_len."""
+    from ..models.transformer import _layer_norm
+    from ..ops.pallas_paged import paged_attention
+
+    B = tokens.shape[0]
+    D, H = cfg.d_model, cfg.n_heads
+    Dh = D // H
+    x = params["embed"][tokens] + params["pos_embed"][positions]   # (B, D)
+    slots = flat_slots(tables, positions, block_size)              # (B,)
+    for i in range(cfg.n_layers):
+        pre = "layer%d_" % i
+        h = _layer_norm(x, params[pre + "ln1_g"], params[pre + "ln1_b"])
+        qkv = h @ params[pre + "wqkv"]
+        q, kk, vv = jnp.split(qkv, 3, axis=-1)
+        k_pool, v_pool = write_kv(k_pool, v_pool, i,
+                                  slots, kk.reshape(B, H, Dh),
+                                  vv.reshape(B, H, Dh))
+        att = paged_attention(q.reshape(B, 1, H, Dh), k_pool[i],
+                              v_pool[i], tables, positions,
+                              block_size)[:, 0]                    # (B,H,Dh)
+        x = x + att.reshape(B, D) @ params[pre + "wo"]
+        h = _layer_norm(x, params[pre + "ln2_g"], params[pre + "ln2_b"])
+        x = x + _ffn(params, pre, h[:, None], cfg)[:, 0]
+    h = _layer_norm(x, params["lnf_g"], params["lnf_b"])
+    logits = (h @ params["head"]).astype(jnp.float32)              # (B, V)
+    return k_pool, v_pool, logits, jnp.argmax(logits, -1).astype(jnp.int32)
+
+
+def _tf_prefill_chunk(params, k_pool, v_pool, toks, qs, length, last_idx,
+                      table_row, cfg, block_size):
+    """One fixed-shape prefill chunk for ONE sequence: toks (C,) are the
+    prompt tokens at positions qs..qs+C-1 (zero-padded past the true
+    prompt `length`), table_row (w,) is the sequence's width-bucketed
+    block table. Writes the chunk's K/V into the pool and attends via the
+    ragged paged kernel — the mask `key_pos <= qs+i` is exactly the
+    causal mask within the chunk and the full-history mask across earlier
+    chunks. Returns logits at chunk index `last_idx` (the prompt's final
+    token when this is the last chunk; earlier chunks' logits are
+    discarded by the caller).
+
+    Padded positions (>= length) write their garbage K/V into the null
+    block — NOT into their table slot, which belongs to a future decode
+    position: the decode step that later owns that slot writes its own
+    K/V before anything can read it, and real queries never attend past
+    position length-1 anyway."""
+    from ..models.transformer import _layer_norm
+    from ..ops.pallas_paged import paged_attention
+
+    C = toks.shape[0]
+    D, H = cfg.d_model, cfg.n_heads
+    Dh = D // H
+    pos = qs + jnp.arange(C)                                       # (C,)
+    x = params["embed"][toks] + params["pos_embed"][pos]           # (C, D)
+    slots = jnp.take(table_row, pos // block_size) * block_size \
+        + pos % block_size
+    slots = jnp.where(pos < length, slots, pos % block_size)       # null blk
+    tables = table_row[None]                                       # (1, w)
+    qs_row = jnp.reshape(qs, (1,)).astype(jnp.int32)
+    for i in range(cfg.n_layers):
+        pre = "layer%d_" % i
+        h = _layer_norm(x, params[pre + "ln1_g"], params[pre + "ln1_b"])
+        qkv = h @ params[pre + "wqkv"]
+        q, kk, vv = jnp.split(qkv, 3, axis=-1)
+        k_pool, v_pool = write_kv(k_pool, v_pool, i,
+                                  slots, kk.reshape(C, H, Dh),
+                                  vv.reshape(C, H, Dh))
+        att = paged_attention(q.reshape(C, H, Dh)[None], k_pool[i],
+                              v_pool[i], tables, qs_row,
+                              block_size)[0]                       # (C,H,Dh)
+        x = x + att.reshape(C, D) @ params[pre + "wo"]
+        h = _layer_norm(x, params[pre + "ln2_g"], params[pre + "ln2_b"])
+        x = x + _ffn(params, pre, h[None], cfg)[0]
+    h_last = _layer_norm(x[last_idx], params["lnf_g"], params["lnf_b"])
+    logits = (h_last @ params["head"]).astype(jnp.float32)         # (V,)
+    return k_pool, v_pool, logits
+
+
 class TransformerLM:
     """Paged-cache adapter for the functional transformer
     (models/transformer.py): params dict + TransformerConfig."""
@@ -181,6 +287,8 @@ class TransformerLM:
         self.max_len = cfg.max_len
         self._prefill_jit = None
         self._decode_jit = None
+        self._decode_paged_jit = None
+        self._prefill_chunk_jit = None
 
     def cache_spec(self):
         dt = self.params["embed"].dtype
@@ -195,6 +303,12 @@ class TransformerLM:
         self._decode_jit = jax.jit(
             lambda p, k, v, t, pos, tb: _tf_decode(p, k, v, t, pos, tb,
                                                    cfg, block_size))
+        self._decode_paged_jit = jax.jit(
+            lambda p, k, v, t, pos, tb: _tf_decode_paged(
+                p, k, v, t, pos, tb, cfg, block_size))
+        self._prefill_chunk_jit = jax.jit(
+            lambda p, k, v, t, qs, ln, li, tb: _tf_prefill_chunk(
+                p, k, v, t, qs, ln, li, tb, cfg, block_size))
 
     def prefill(self, k, v, tokens, length, table_row):
         return self._prefill_jit(self.params, k, v, tokens, length,
@@ -203,6 +317,15 @@ class TransformerLM:
     def decode(self, k, v, tokens, positions, tables):
         return self._decode_jit(self.params, k, v, tokens, positions,
                                 tables)
+
+    def decode_paged(self, k, v, tokens, positions, tables):
+        return self._decode_paged_jit(self.params, k, v, tokens,
+                                      positions, tables)
+
+    def prefill_chunk(self, k, v, tokens, q_start, length, last_idx,
+                      table_row):
+        return self._prefill_chunk_jit(self.params, k, v, tokens, q_start,
+                                       length, last_idx, table_row)
 
 
 # ---------------------------------------------------------------------------
@@ -305,7 +428,10 @@ class Engine:
     server loop); that keeps the functional cache update race-free."""
 
     def __init__(self, model, max_batch=8, max_len=None, block_size=16,
-                 num_blocks=None, keep_logits=False):
+                 num_blocks=None, keep_logits=False, paged=None,
+                 prefill_chunk=None):
+        from ..ops.pallas_paged import paged_enabled, paged_eligible
+        from ..ops.pallas_attention import default_interpret
         self.model = model
         self.max_batch = max_batch
         self.max_len = int(max_len or model.max_len)
@@ -314,6 +440,13 @@ class Engine:
         self.decode_compilations = 0
         self._sigs = set()
         self.cache = None
+        # paged path: env default (MXNET_PAGED_ATTENTION), explicit
+        # `paged=` overrides; shapes the Mosaic kernel can't tile fall
+        # back to the gather path (interpret mode takes anything)
+        self.paged_requested = paged_enabled() if paged is None \
+            else bool(paged)
+        self.paged = False
+        self.prefill_chunk = 0
         if model.uses_cache:
             nl, nh, dh, dt = model.cache_spec()
             self._nblk = max(1, math.ceil(self.max_len / block_size))
@@ -322,6 +455,13 @@ class Engine:
             self.cache = PagedKVCache(nl, nh, dh, block_size=block_size,
                                       num_blocks=num_blocks, dtype=dt)
             model.bind(block_size)
+            if self.paged_requested:
+                self.prefill_chunk = min(self.max_len,
+                                         int(prefill_chunk
+                                             or 2 * block_size))
+                self.paged = paged_eligible(dh, block_size,
+                                            self.prefill_chunk,
+                                            default_interpret())
 
     # -- admission accounting ------------------------------------------------
 
@@ -353,10 +493,11 @@ class Engine:
 
     # -- prefill -------------------------------------------------------------
 
-    def start(self, prompt, max_new, eos_id=None):
-        """Admit one request: allocate blocks, run prefill, sample the
-        first token. Returns the live Sequence (caller keeps it in the
-        running set), or None if blocks ran out (transient)."""
+    def begin(self, prompt, max_new, eos_id=None):
+        """Admit one request: allocate its cache blocks, no compute.
+        Prefill is advanced by `prefill_step` (one chunk per call on the
+        paged path; the whole prompt in one call otherwise). Returns the
+        Sequence, or None if blocks ran out (transient)."""
         L = len(prompt)
         if L < 1:
             raise MXNetError("empty prompt")
@@ -367,8 +508,43 @@ class Engine:
                 return None
             seq.block_ids = ids
             seq.table_row = self.cache.table_row(ids, self._nblk)
+        return seq
+
+    def prefill_tokens_per_step(self, prompt_len):
+        """Tokens one `prefill_step` call will process — the scheduler's
+        token-budget admission cost. Fixed chunk on the paged path; the
+        whole (bucketed) prompt in one shot on the others."""
+        if self.model.uses_cache and self.paged:
+            return self.prefill_chunk
+        return pow2_bucket(prompt_len, lo=1, hi=self.max_len)
+
+    def prefill_step(self, seq):
+        """Advance one sequence's prefill. Paged path: run ONE
+        fixed-shape chunk (appending its K/V to the pool); other paths:
+        run the whole prompt. Returns True when the prompt is fully
+        prefilled and the first token has been sampled."""
+        L = seq.prompt_len
+        prompt = seq.tokens[:L]
         with profiler.scope("serving.prefill", "serving"):
-            if self.model.uses_cache:
+            if self.model.uses_cache and self.paged:
+                C = self.prefill_chunk
+                qs = seq.prefilled
+                toks = np.zeros((C,), np.int32)
+                toks[:min(C, L - qs)] = prompt[qs:qs + C]
+                w = pow2_bucket(self.cache.blocks_for(qs + C),
+                                lo=1, hi=self._nblk)
+                self._count("prefill", (C, w))
+                self.cache.k, self.cache.v, logits = \
+                    self.model.prefill_chunk(
+                        self.cache.k, self.cache.v, jnp.asarray(toks),
+                        jnp.int32(qs), jnp.int32(L),
+                        jnp.int32(min(L - 1 - qs, C - 1)),
+                        jnp.asarray(seq.table_row[:w]))
+                seq.prefilled = min(L, qs + C)
+                if seq.prefilled < L:
+                    return False
+                logits = np.asarray(logits)
+            elif self.model.uses_cache:
                 s_pad = pow2_bucket(L, lo=min(8, self.max_len),
                                     hi=self.max_len)
                 toks = np.zeros((s_pad,), np.int32)
@@ -377,6 +553,7 @@ class Engine:
                 self.cache.k, self.cache.v, logits = self.model.prefill(
                     self.cache.k, self.cache.v, jnp.asarray(toks),
                     jnp.int32(L), jnp.asarray(seq.table_row))
+                seq.prefilled = L
                 logits = np.asarray(logits)
             else:
                 s_pad = pow2_bucket(L, lo=1, hi=self.max_len)
@@ -385,9 +562,25 @@ class Engine:
                 self._count("prefill", s_pad)
                 logits = np.asarray(self.model.step_full(
                     jnp.asarray(toks), jnp.asarray([L], np.int32)))[0]
+                seq.prefilled = L
         if self.keep_logits:
             seq.last_logits = logits
         self._append(seq, int(np.argmax(logits)))
+        return True
+
+    def start(self, prompt, max_new, eos_id=None):
+        """Admit one request and run its whole prefill: allocate blocks,
+        prefill (chunk-by-chunk on the paged path), sample the first
+        token. Returns the live Sequence (caller keeps it in the running
+        set), or None if blocks ran out (transient). The serving loop
+        uses begin/prefill_step instead so chunks interleave with decode
+        steps; `start` is the synchronous convenience for direct Engine
+        users (bench.py, tests)."""
+        seq = self.begin(prompt, max_new, eos_id=eos_id)
+        if seq is None:
+            return None
+        while not self.prefill_step(seq):
+            pass
         return seq
 
     # -- decode --------------------------------------------------------------
@@ -404,15 +597,29 @@ class Engine:
         bb = pow2_bucket(len(seqs), lo=1, hi=self.max_batch)
         with profiler.scope("serving.decode", "serving"):
             if self.model.uses_cache:
+                # paged path: the table width handed to the kernel is
+                # bucketed to the longest LIVE sequence, so a decode
+                # step's bytes track true lengths, not max_len; the
+                # gather path always sees the full-capacity table
+                w = self._nblk
+                if self.paged:
+                    w = pow2_bucket(
+                        max(self.cache.blocks_for(len(s.tokens))
+                            for s in seqs), lo=1, hi=self._nblk)
                 toks = np.zeros((bb,), np.int32)
                 pos = np.zeros((bb,), np.int32)
-                tabs = np.zeros((bb, self._nblk), np.int32)
+                tabs = np.zeros((bb, w), np.int32)
                 for i, s in enumerate(seqs):
                     toks[i] = s.tokens[-1]
                     pos[i] = len(s.tokens) - 1
-                    tabs[i] = s.table_row
-                self._count("decode", bb)
-                self.cache.k, self.cache.v, logits, nxt = self.model.decode(
+                    tabs[i] = s.table_row[:w]
+                step_fn = self.model.decode
+                if self.paged:
+                    step_fn = self.model.decode_paged
+                    self._count("decode", (bb, w))
+                else:
+                    self._count("decode", bb)
+                self.cache.k, self.cache.v, logits, nxt = step_fn(
                     self.cache.k, self.cache.v, jnp.asarray(toks),
                     jnp.asarray(pos), jnp.asarray(tabs))
                 nxt = np.asarray(nxt)

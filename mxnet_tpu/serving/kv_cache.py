@@ -17,9 +17,14 @@ branches for inactive slots. Reads from it are masked by sequence length.
 
 Host side (`BlockPool`) is a plain free-list — allocation policy is a
 scheduling decision and lives outside the compiled program. Device side,
-the pool arrays are stored FLAT over (num_blocks * block_size) token
-slots so both the per-token scatter and the by-table gather are single
-advanced-indexing ops XLA lowers without data-dependent shapes.
+the pool arrays are CONTIGUOUS PER LAYER with an explicit block axis —
+(n_layers, num_blocks, block_size, n_heads, head_dim) — so a block-table
+entry indexes a whole (block_size, n_heads, head_dim) block directly:
+that is the unit the ragged paged-attention kernel
+(ops/pallas_paged.py) DMAs per grid step, and the per-token scatter and
+the by-table gather both remain single advanced-indexing ops XLA lowers
+without data-dependent shapes (`write_kv` splits a flat slot into
+(block, offset) with one divmod).
 """
 from __future__ import annotations
 
@@ -43,7 +48,8 @@ class BlockPool:
     Invariants (tested): a block is never handed out twice while live,
     freeing a block not currently live raises, and freed blocks are reused
     (LIFO — the hottest block stays cache-warm on the host bookkeeping
-    side; device placement is unaffected).
+    side; device placement is unaffected). `high_water` tracks the peak
+    in-use count for the serving metrics snapshot.
     """
 
     def __init__(self, num_blocks):
@@ -53,6 +59,7 @@ class BlockPool:
         self.num_blocks = num_blocks
         self._free = list(range(num_blocks - 1, 0, -1))  # pop() -> 1 first
         self._live = set()
+        self.high_water = 0
 
     @property
     def available(self):
@@ -73,6 +80,7 @@ class BlockPool:
             return None
         ids = [self._free.pop() for _ in range(n)]
         self._live.update(ids)
+        self.high_water = max(self.high_water, len(self._live))
         return ids
 
     def free(self, ids):
@@ -86,10 +94,11 @@ class BlockPool:
 class PagedKVCache:
     """Device-side K/V pools plus the host free-list.
 
-    Arrays: ``k``/``v`` of shape (n_layers, num_blocks * block_size,
-    n_heads, head_dim) — flat token-slot layout (see module docstring).
-    They are plain jax arrays threaded through the jitted engine functions
-    (functional update: each step returns the new pools).
+    Arrays: ``k``/``v`` of shape (n_layers, num_blocks, block_size,
+    n_heads, head_dim) — contiguous-per-layer block layout (see module
+    docstring). They are plain jax arrays threaded through the jitted
+    engine functions (functional update: each step returns the new
+    pools).
     """
 
     def __init__(self, n_layers, n_heads, head_dim, block_size=16,
@@ -100,12 +109,15 @@ class PagedKVCache:
         self.block_size = block_size
         self.num_blocks = num_blocks
         self.pool = BlockPool(num_blocks)
-        shape = (n_layers, num_blocks * block_size, n_heads, head_dim)
+        shape = (n_layers, num_blocks, block_size, n_heads, head_dim)
         self.k = jnp.zeros(shape, dtype)
         self.v = jnp.zeros(shape, dtype)
 
     def blocks_for(self, n_tokens):
-        """Blocks needed to hold n_tokens KV entries."""
+        """Blocks needed to hold n_tokens KV entries — by construction
+        the kernel-side table width for a sequence of that length:
+        position n_tokens-1 lives in block (n_tokens-1)//block_size, the
+        table's last occupied slot."""
         return max(1, math.ceil(n_tokens / self.block_size))
 
     def table_row(self, block_ids, n_entries):
@@ -142,10 +154,13 @@ def prompt_slots(table_row, length_cap, block_size):
 
 
 def write_kv(k_pool, v_pool, layer, slots, k_new, v_new):
-    """Scatter new K/V entries into one layer's flat slots.
-    slots (...,) int32; k_new/v_new (..., n_heads, head_dim)."""
-    k_pool = k_pool.at[layer, slots].set(k_new.astype(k_pool.dtype))
-    v_pool = v_pool.at[layer, slots].set(v_new.astype(v_pool.dtype))
+    """Scatter new K/V entries into one layer's flat slots (block id *
+    block_size + offset). slots (...,) int32; k_new/v_new (..., n_heads,
+    head_dim)."""
+    bs = k_pool.shape[2]
+    blk, off = slots // bs, slots % bs
+    k_pool = k_pool.at[layer, blk, off].set(k_new.astype(k_pool.dtype))
+    v_pool = v_pool.at[layer, blk, off].set(v_new.astype(v_pool.dtype))
     return k_pool, v_pool
 
 
@@ -155,6 +170,7 @@ def gather_kv(k_pool, v_pool, layer, block_table, block_size):
     position-ordered; entries past each sequence's length are garbage and
     must be masked by the caller (mask = arange(T) <= position)."""
     B, nblk = block_table.shape
-    idx = (block_table[:, :, None] * block_size
-           + jnp.arange(block_size)[None, None, :]).reshape(B, -1)
-    return k_pool[layer][idx], v_pool[layer][idx]
+    ks = k_pool[layer][block_table]       # (B, nblk, bs, H, Dh)
+    vs = v_pool[layer][block_table]
+    return (ks.reshape(B, nblk * block_size, *ks.shape[3:]),
+            vs.reshape(B, nblk * block_size, *vs.shape[3:]))

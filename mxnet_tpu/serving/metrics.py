@@ -30,6 +30,10 @@ class ServingMetrics:
         self.tokens_generated = 0     # serving loop (requests failed, loop
                                       # kept alive)
         self.decode_steps = 0
+        self.decode_steps_paged = 0   # per-path decode counters: which
+        self.decode_steps_gather = 0  # attention read served each step
+        self.prefill_chunks = 0       # chunked-prefill kernel calls
+        self._prefill_depth_last = 0  # sequences mid-prefill, last seen
         self._occupancy_sum = 0.0     # active/max_batch per decode step
         self._batch_sum = 0           # active sequences per decode step
         self._queue_s = 0.0
@@ -68,9 +72,21 @@ class ServingMetrics:
         with self._lock:
             self._ttft_s += req.t_first_token - req.t_submit
 
-    def decode_step(self, active, max_batch, step_s, cache_util=None):
+    def prefill_chunk(self, queue_depth):
+        """One chunked-prefill kernel call ran; `queue_depth` is the
+        number of sequences still mid-prefill after it."""
+        with self._lock:
+            self.prefill_chunks += 1
+            self._prefill_depth_last = queue_depth
+
+    def decode_step(self, active, max_batch, step_s, cache_util=None,
+                    paged=False):
         with self._lock:
             self.decode_steps += 1
+            if paged:
+                self.decode_steps_paged += 1
+            else:
+                self.decode_steps_gather += 1
             self._batch_sum += active
             self._occupancy_sum += active / float(max_batch)
             self._decode_s += step_s
@@ -90,7 +106,7 @@ class ServingMetrics:
 
     # -- reading -------------------------------------------------------------
 
-    def snapshot(self, engine=None):
+    def snapshot(self, engine=None, scheduler=None):
         """One dict with everything: the HTTP /metrics body and the test
         observable. Rates are lifetime averages; latencies are means in
         milliseconds over finished/started requests."""
@@ -129,6 +145,12 @@ class ServingMetrics:
                         self._occupancy_sum / self.decode_steps
                         if self.decode_steps else None),
                 },
+                "paths": {
+                    "paged_decode_steps": self.decode_steps_paged,
+                    "gather_decode_steps": self.decode_steps_gather,
+                    "prefill_chunks": self.prefill_chunks,
+                    "prefill_queue_depth": self._prefill_depth_last,
+                },
                 "cache": {"block_utilization": self._cache_util_last},
             }
         if engine is not None:
@@ -137,10 +159,21 @@ class ServingMetrics:
                 "decode_compilations": engine.decode_compilations,
                 "max_batch": engine.max_batch,
                 "max_len": engine.max_len,
+                "paged_attention": bool(engine.paged),
+                "prefill_chunk": engine.prefill_chunk,
             }
             util = engine.cache_utilization()
             if util is not None:
+                pool = engine.cache.pool
                 snap["cache"]["block_utilization"] = util
-                snap["cache"]["blocks_in_use"] = engine.cache.pool.in_use
+                snap["cache"]["blocks_in_use"] = pool.in_use
+                snap["cache"]["blocks_available"] = pool.available
+                snap["cache"]["blocks_high_water"] = pool.high_water
                 snap["cache"]["blocks_total"] = engine.cache.num_blocks - 1
+        if scheduler is not None:
+            snap["scheduler"] = {
+                "token_budget": scheduler.token_budget,
+                "queued": scheduler.pending(),
+                "prefilling": len(scheduler.prefilling),
+            }
         return snap

@@ -12,10 +12,21 @@ buffering), and a queued request older than `queue_timeout` seconds is
 failed at admission time rather than served stale. Admission itself is
 head-of-line: if the oldest request's block reservation doesn't fit the
 pool, nothing behind it jumps ahead (no starvation of big requests).
+
+Token budget: with chunked prefill (engine.paged) one loop iteration
+processes `len(running)` decode tokens plus one fixed-shape prefill
+chunk per sequence still prefilling. `token_budget`
+(MXNET_SERVING_TOKEN_BUDGET) caps that sum at admission time: a new
+request is only admitted while the decode batch plus every pending
+chunk fits the budget, bounding per-iteration latency — the knob that
+trades time-to-first-token for decode tail latency. Admission always
+makes progress (the budget never blocks the only candidate when nothing
+is running or prefilling).
 """
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
 from collections import deque
@@ -84,13 +95,20 @@ class Scheduler:
     """Owns the waiting queue and the running set. Thread-safe for
     `submit` vs. the single serving thread driving `admit`/`evict`."""
 
-    def __init__(self, max_batch=8, max_queue=64, queue_timeout=None):
+    def __init__(self, max_batch=8, max_queue=64, queue_timeout=None,
+                 token_budget=None):
         self.max_batch = max_batch
         self.max_queue = max_queue
         self.queue_timeout = queue_timeout
+        if token_budget is None:
+            env = os.environ.get("MXNET_SERVING_TOKEN_BUDGET")
+            token_budget = int(env) if env else None
+        self.token_budget = token_budget
         self._queue = deque()
         self._lock = threading.Lock()
         self.running = []             # serving-thread-only
+        self.prefilling = []          # serving-thread-only: chunked
+                                      # prefill in flight (paged path)
 
     def submit(self, req):
         with self._lock:
@@ -106,15 +124,27 @@ class Scheduler:
             return len(self._queue)
 
     def has_work(self):
-        return bool(self.running) or self.pending()
+        return bool(self.running) or bool(self.prefilling) or \
+            self.pending()
+
+    def spent_tokens(self, engine):
+        """Tokens the NEXT loop iteration is already committed to: one
+        decode token per running sequence plus one prefill chunk per
+        sequence still prefilling."""
+        return len(self.running) + sum(
+            engine.prefill_tokens_per_step(s.prompt_len)
+            for s in self.prefilling)
 
     def admit(self, engine, now=None):
-        """Move queued requests into the running set while batch slots and
-        cache blocks allow; expire the ones that waited too long. Returns
-        (admitted, expired) — the caller prefills the admitted ones."""
+        """Move queued requests into the running set while batch slots,
+        cache blocks, and the token budget allow; expire the ones that
+        waited too long. Returns (admitted, expired) — the caller
+        prefills the admitted ones."""
         admitted, expired = [], []
         now = time.perf_counter() if now is None else now
-        while len(self.running) + len(admitted) < self.max_batch:
+        spent = self.spent_tokens(engine)
+        while len(self.running) + len(self.prefilling) + len(admitted) \
+                < self.max_batch:
             with self._lock:
                 req = self._queue[0] if self._queue else None
                 if req is None:
@@ -136,7 +166,14 @@ class Scheduler:
                     continue
                 if not fits:
                     break             # head-of-line: preserve FIFO order
+                cost = engine.prefill_tokens_per_step(len(req.prompt))
+                if self.token_budget is not None \
+                        and spent + cost > self.token_budget \
+                        and (spent > 0 or admitted):
+                    break             # budget full this iteration; the
+                                      # head keeps its place (FIFO)
                 self._queue.popleft()
+            spent += cost
             req.t_admit = now
             admitted.append(req)
         for req in expired:

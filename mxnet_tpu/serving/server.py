@@ -52,14 +52,17 @@ class LMServer:
     def __init__(self, model, max_batch=8, max_len=None, block_size=16,
                  num_blocks=None, max_queue=64, queue_timeout=None,
                  keep_logits=False, vocab=None, time_major=False,
-                 idle_wait=0.005):
+                 idle_wait=0.005, paged=None, prefill_chunk=None,
+                 token_budget=None):
         adapter = _resolve_model(model, vocab=vocab, max_len=max_len,
                                  time_major=time_major)
         self.engine = Engine(adapter, max_batch=max_batch, max_len=max_len,
                              block_size=block_size, num_blocks=num_blocks,
-                             keep_logits=keep_logits)
+                             keep_logits=keep_logits, paged=paged,
+                             prefill_chunk=prefill_chunk)
         self.scheduler = Scheduler(max_batch=max_batch, max_queue=max_queue,
-                                   queue_timeout=queue_timeout)
+                                   queue_timeout=queue_timeout,
+                                   token_budget=token_budget)
         self.metrics = ServingMetrics()
         self._idle_wait = idle_wait
         self._work = threading.Event()
@@ -111,7 +114,7 @@ class LMServer:
                            eos_id=eos_id).result(timeout)
 
     def snapshot(self):
-        return self.metrics.snapshot(self.engine)
+        return self.metrics.snapshot(self.engine, self.scheduler)
 
     def health(self, max_beat_age=5.0):
         """Loop-liveness summary for /healthz: `ok` requires the serving
@@ -159,7 +162,8 @@ class LMServer:
             # strand clients in result(): fail everything in flight
             err = MXNetError("serving loop died: %s: %s"
                              % (type(e).__name__, e))
-            for seq in self.scheduler.running:
+            for seq in (self.scheduler.running
+                        + self.scheduler.prefilling):
                 if seq.request is not None and seq.request.error is None:
                     seq.request._finish(error=err)
             with self.scheduler._lock:
@@ -178,28 +182,13 @@ class LMServer:
             for req in expired:
                 met.request_expired(req)
                 met.request_finished(req)
-            for i, req in enumerate(admitted):
-                t0 = time.perf_counter()
-                try:
-                    seq = eng.start(req.prompt, req.max_new_tokens,
-                                    eos_id=req.eos_id)
-                except Exception as e:  # engine fault: fail THIS request,
-                    met.engine_failure()  # the loop (and the rest of the
-                    req._finish(error=MXNetError(  # batch) live on
-                        "engine prefill failed: %s: %s"
-                        % (type(e).__name__, e)))
-                    met.request_finished(req)
-                    continue
-                if seq is None:       # transient block shortage: requeue
-                    # this one AND everything admitted behind it, in order
-                    with sched._lock:
-                        for r in reversed(admitted[i:]):
-                            sched._queue.appendleft(r)
-                    break
-                seq.request = req
-                req.state = "running"
-                sched.running.append(seq)
-                met.request_prefilled(req, time.perf_counter() - t0)
+            if eng.paged:
+                # chunked prefill: allocate now, stream the prompt
+                # through fixed-shape chunks co-scheduled with decode
+                self._admit_paged(admitted)
+                self._prefill_chunks()
+            else:
+                self._admit_dense(admitted)
             if sched.running:
                 t0 = time.perf_counter()
                 try:
@@ -225,15 +214,112 @@ class LMServer:
                 if advanced:  # count only sequences that really stepped
                     met.decode_step(len(advanced), eng.max_batch,
                                     time.perf_counter() - t0,
-                                    cache_util=eng.cache_utilization())
+                                    cache_util=eng.cache_utilization(),
+                                    paged=eng.paged)
                 for req in (s.request for s in sched.evict(eng)
                             if s.request is not None):
                     met.request_finished(req)
+            elif sched.prefilling:
+                pass      # chunk work ran this iteration; no decode to
+                          # pace against, so loop straight into the next
+                          # chunk round (sleeping here would throttle
+                          # TTFT on an otherwise-idle server)
             elif not sched.pending():
                 self._work.clear()
                 self._work.wait(self._idle_wait * 20)
             else:
                 time.sleep(self._idle_wait)
+
+    def _admit_dense(self, admitted):
+        """PR 1 admission: each admitted request runs its WHOLE prefill
+        before the decode step — the gather path's one-shot prefill."""
+        eng, sched, met = self.engine, self.scheduler, self.metrics
+        for i, req in enumerate(admitted):
+            t0 = time.perf_counter()
+            try:
+                seq = eng.start(req.prompt, req.max_new_tokens,
+                                eos_id=req.eos_id)
+            except Exception as e:  # engine fault: fail THIS request,
+                met.engine_failure()  # the loop (and the rest of the
+                req._finish(error=MXNetError(  # batch) live on
+                    "engine prefill failed: %s: %s"
+                    % (type(e).__name__, e)))
+                met.request_finished(req)
+                continue
+            if seq is None:       # transient block shortage: requeue
+                # this one AND everything admitted behind it, in order
+                with sched._lock:
+                    for r in reversed(admitted[i:]):
+                        sched._queue.appendleft(r)
+                break
+            seq.request = req
+            req.state = "running"
+            sched.running.append(seq)
+            met.request_prefilled(req, time.perf_counter() - t0)
+
+    def _admit_paged(self, admitted):
+        """Paged admission: allocate cache blocks only; the prompt
+        streams through `_prefill_chunks` across loop iterations."""
+        eng, sched, met = self.engine, self.scheduler, self.metrics
+        for i, req in enumerate(admitted):
+            try:
+                seq = eng.begin(req.prompt, req.max_new_tokens,
+                                eos_id=req.eos_id)
+            except Exception as e:
+                met.engine_failure()
+                req._finish(error=MXNetError(
+                    "engine prefill failed: %s: %s"
+                    % (type(e).__name__, e)))
+                met.request_finished(req)
+                continue
+            if seq is None:       # transient block shortage: requeue
+                with sched._lock:
+                    for r in reversed(admitted[i:]):
+                        sched._queue.appendleft(r)
+                break
+            seq.request = req
+            req.state = "running"
+            sched.prefilling.append(seq)
+
+    def _prefill_chunks(self):
+        """Advance every mid-prefill sequence by ONE chunk (FIFO),
+        bounded by the scheduler's token budget net of the decode batch
+        — then the decode step runs: a long prompt prefilling in chunks
+        can never starve in-flight decode sequences, and a tight budget
+        spreads a multi-chunk prompt over several iterations. At least
+        one chunk always runs when nothing is decoding (progress)."""
+        eng, sched, met = self.engine, self.scheduler, self.metrics
+        budget = sched.token_budget
+        spent = len(sched.running)
+        for seq in list(sched.prefilling):
+            cost = eng.prefill_tokens_per_step(seq.prompt_len)
+            if budget is not None and spent + cost > budget \
+                    and spent > 0:
+                break             # rest keep their place for next round
+            t0 = time.perf_counter()
+            try:
+                done = eng.prefill_step(seq)
+            except Exception as e:  # chunk fault: fail THIS request,
+                met.engine_failure()  # free its blocks, keep serving
+                sched.prefilling.remove(seq)
+                try:
+                    eng.release(seq)
+                except Exception:
+                    pass
+                if seq.request is not None:
+                    seq.request._finish(error=MXNetError(
+                        "engine prefill failed: %s: %s"
+                        % (type(e).__name__, e)))
+                    met.request_finished(seq.request)
+                continue
+            seq.prefill_s += time.perf_counter() - t0
+            spent += cost
+            if done:
+                sched.prefilling.remove(seq)
+                sched.running.append(seq)
+                if seq.request is not None:
+                    met.request_prefilled(seq.request, seq.prefill_s)
+            met.prefill_chunk(len(sched.prefilling))
 
     # -- HTTP frontend -------------------------------------------------------
 

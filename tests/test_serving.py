@@ -448,3 +448,243 @@ def test_health_reports_closed_loop(tiny_lm):
     srv.close()
     assert srv.health()["ok"] is False
     assert srv.health()["loop_alive"] is False
+
+
+# ---------------------------------------------------------------------------
+# paged-attention path: contiguous-per-layer pool, chunked prefill,
+# token-budget co-scheduling (MXNET_PAGED_ATTENTION / Engine(paged=True))
+# ---------------------------------------------------------------------------
+
+
+def test_block_pool_high_water_and_layout():
+    """Contiguous-per-layer layout invariants: the pool carries an
+    explicit (num_blocks, block_size) split, a write through flat slots
+    lands in the block a table gather reads back, and the free list's
+    high-water mark tracks peak in-use across alloc/free cycles."""
+    pool = kv_cache.BlockPool(8)
+    assert pool.high_water == 0
+    a = pool.try_alloc(5)
+    assert pool.high_water == 5
+    pool.free(a[:3])
+    assert pool.high_water == 5             # high water survives frees
+    b = pool.try_alloc(4)
+    assert pool.high_water == 6
+    pool.free(a[3:] + b)
+    assert pool.in_use == 0 and pool.high_water == 6
+
+    cache = kv_cache.PagedKVCache(n_layers=2, n_heads=2, head_dim=4,
+                                  block_size=4, num_blocks=6)
+    assert cache.k.shape == (2, 6, 4, 2, 4)     # (L, nb, bs, H, Dh)
+    # write positions 0..5 of a sequence whose table is [3, 1] and read
+    # them back by table: position order must round-trip exactly
+    table = np.asarray([3, 1], np.int32)
+    pos = jnp.arange(6)
+    slots = jnp.asarray(table)[pos // 4] * 4 + pos % 4
+    kv = jnp.arange(6 * 2 * 4, dtype=jnp.float32).reshape(6, 2, 4)
+    k, v = kv_cache.write_kv(cache.k, cache.v, 1, slots, kv, 2 * kv)
+    ks, vs = kv_cache.gather_kv(k, v, 1, jnp.asarray(table[None]), 4)
+    np.testing.assert_array_equal(np.asarray(ks[0, :6]), np.asarray(kv))
+    np.testing.assert_array_equal(np.asarray(vs[0, :6]), 2 * np.asarray(kv))
+    # layer 0 untouched
+    assert float(jnp.abs(k[0]).sum()) == 0.0
+
+
+def test_paged_decode_recompile_bound_mixed_lengths(tiny_lm):
+    """The paged-path analogue of the decode-recompile-bound test: three
+    staggered clients with prompt lengths 5/9/17. Chunked prefill must
+    stay within <= 2 distinct prefill signatures (ONE chunk shape x two
+    table-width buckets — down from one dense signature per length
+    bucket), and the width-bucketed decode step within <= 6 (batch
+    buckets x width buckets, both bounded by traffic-independent
+    powers of two)."""
+    params, cfg = tiny_lm
+    srv = serving.serve((params, cfg), max_batch=4, block_size=8,
+                        paged=True)
+    try:
+        assert srv.engine.paged
+        results = {}
+
+        def client(i, delay, plen):
+            time.sleep(delay)
+            results[i] = srv.generate(arith_prompt(i, 1, plen),
+                                      max_new_tokens=10, timeout=120)
+
+        threads = [threading.Thread(target=client, args=(i, 0.05 * i, p))
+                   for i, p in enumerate((5, 9, 17))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(len(results[i]) == 10 for i in range(3))
+        eng = srv.engine
+        assert eng.prefill_compilations <= 2, (
+            "chunked prefill compiled %d signatures: %r"
+            % (eng.prefill_compilations, sorted(eng._sigs)))
+        assert eng.decode_compilations <= 6, (
+            "paged decode compiled %d signatures: %r"
+            % (eng.decode_compilations, sorted(eng._sigs)))
+    finally:
+        srv.close()
+
+
+def test_chunked_prefill_does_not_starve_decode(tiny_lm):
+    """Fairness: a long prompt streaming through prefill chunks under a
+    token budget cannot starve in-flight decode sequences — the loop
+    runs a decode step between chunk batches, so the short request keeps
+    generating while the long prompt prefills."""
+    params, cfg = tiny_lm
+    srv = serving.serve((params, cfg), max_batch=2, block_size=8,
+                        paged=True, prefill_chunk=8, token_budget=9)
+    try:
+        events = []
+        real_chunk = srv.engine.prefill_step
+        real_decode = srv.engine.decode_step
+
+        def chunk_spy(seq):
+            events.append(("chunk", seq.request.id
+                           if seq.request else None))
+            return real_chunk(seq)
+
+        def decode_spy(seqs):
+            events.append(("decode", None))
+            return real_decode(seqs)
+
+        srv.engine.prefill_step = chunk_spy
+        srv.engine.decode_step = decode_spy
+        # the short request decodes while the long prompt prefills
+        short = srv.submit(arith_prompt(1, 1, 4), max_new_tokens=60)
+        deadline = time.perf_counter() + 60
+        while srv.snapshot()["throughput"]["tokens_generated"] < 2:
+            assert time.perf_counter() < deadline
+            time.sleep(0.01)
+        long_req = srv.submit(arith_prompt(2, 1, 40), max_new_tokens=2)
+        out = long_req.result(timeout=120)
+        assert len(out) == 2
+        # budget 9 = 1 decode token + 1 chunk: the 5 chunks of the long
+        # prompt spread across iterations with decode steps in between
+        chunk_idx = [i for i, (kind, rid) in enumerate(events)
+                     if kind == "chunk" and rid == long_req.id]
+        assert len(chunk_idx) == 5, events
+        decodes_between = sum(
+            1 for i in range(chunk_idx[0], chunk_idx[-1])
+            if events[i][0] == "decode")
+        assert decodes_between >= 2, events
+        assert len(short.result(timeout=120)) == 60
+    finally:
+        srv.close()
+
+
+def test_token_budget_bounds_admission():
+    """Scheduler unit test: admission stops once the decode batch plus
+    pending prefill chunks would exceed the token budget, FIFO order
+    preserved; with nothing running the head is always admitted
+    (progress)."""
+
+    class FakeEngine:
+        def can_admit(self, plen, max_new):
+            return True
+
+        def prefill_tokens_per_step(self, plen):
+            return 8
+
+    sched = serving.Scheduler(max_batch=8, token_budget=16)
+    reqs = [serving.Request([1, 2, 3]) for _ in range(4)]
+    for r in reqs:
+        sched.submit(r)
+    sched.running = [object(), object()]     # 2 decode tokens committed
+    admitted, expired = sched.admit(FakeEngine())
+    assert not expired
+    assert [r.id for r in admitted] == [reqs[0].id]  # 2+8=10; +8 > 16
+    assert sched.pending() == 3
+    # progress guarantee: an over-budget head is admitted when idle
+    sched2 = serving.Scheduler(max_batch=8, token_budget=4)
+    r = serving.Request([1, 2, 3])
+    sched2.submit(r)
+    admitted, _ = sched2.admit(FakeEngine())
+    assert [a.id for a in admitted] == [r.id]
+
+
+def test_paged_prefill_fault_releases_blocks(tiny_lm):
+    """A fault inside a prefill CHUNK fails that request, recycles its
+    already-allocated blocks, and leaves the loop serving (the paged
+    analogue of the dense prefill fault-isolation test)."""
+    params, cfg = tiny_lm
+    srv = serving.serve((params, cfg), max_batch=2, block_size=8,
+                        paged=True, prefill_chunk=8)
+    try:
+        real_step = srv.engine.prefill_step
+        boom = {"armed": True}
+
+        def flaky_step(seq):
+            if boom.pop("armed", None):
+                raise RuntimeError("injected chunk fault")
+            return real_step(seq)
+
+        srv.engine.prefill_step = flaky_step
+        req = srv.submit(arith_prompt(3, 1, 20), max_new_tokens=4)
+        with pytest.raises(mx.MXNetError, match="prefill failed"):
+            req.result(timeout=60)
+        out = srv.generate(arith_prompt(4, 1, 5), max_new_tokens=4,
+                           timeout=120)
+        assert len(out) == 4
+        snap = srv.snapshot()
+        assert snap["requests"]["engine_failures"] == 1
+        assert snap["requests"]["failed"] == 1
+        assert snap["cache"]["blocks_in_use"] == 0   # fault-path recycle
+        assert srv.health()["ok"] is True
+    finally:
+        srv.close()
+
+
+def test_paged_metrics_in_http_output(tiny_lm):
+    """The /metrics HTTP body carries the new observables: per-path
+    decode counters, prefill-chunk count and queue depth, block-pool
+    in-use/available/high-water, and the scheduler's token budget."""
+    import json
+    import urllib.request
+    params, cfg = tiny_lm
+    srv = serving.serve((params, cfg), max_batch=2, block_size=8,
+                        paged=True, prefill_chunk=8, token_budget=32)
+    try:
+        host, port = srv.serve_http(port=0, block=False)
+        url = "http://%s:%d" % (host, port)
+        req = urllib.request.Request(
+            url + "/v1/generate",
+            data=json.dumps({"tokens": arith_prompt(4, 1, 12),
+                             "max_new_tokens": 5}).encode(),
+            headers={"Content-Type": "application/json"})
+        body = json.loads(urllib.request.urlopen(req, timeout=60).read())
+        assert len(body["tokens"]) == 5
+        met = json.loads(urllib.request.urlopen(
+            url + "/v1/metrics", timeout=10).read())
+        assert met["paths"]["paged_decode_steps"] >= 4
+        assert met["paths"]["gather_decode_steps"] == 0
+        assert met["paths"]["prefill_chunks"] >= 2    # 12 tokens, chunk 8
+        assert met["paths"]["prefill_queue_depth"] == 0
+        assert met["cache"]["blocks_in_use"] == 0
+        assert met["cache"]["blocks_high_water"] >= 1
+        assert met["cache"]["blocks_available"] >= 1
+        assert met["scheduler"]["token_budget"] == 32
+        assert met["engine"]["paged_attention"] is True
+        assert met["engine"]["prefill_chunk"] == 8
+    finally:
+        srv.close()
+
+
+def test_paged_off_env_restores_gather_path(tiny_lm, monkeypatch):
+    """MXNET_PAGED_ATTENTION=0 (or unset) keeps the PR 1 gather decode:
+    no paged steps, no chunked prefill, dense prefill signatures."""
+    params, cfg = tiny_lm
+    monkeypatch.setenv("MXNET_PAGED_ATTENTION", "0")
+    srv = serving.serve((params, cfg), max_batch=2, block_size=8)
+    try:
+        assert srv.engine.paged is False
+        out = srv.generate(arith_prompt(8, 1, 9), max_new_tokens=3,
+                           timeout=120)
+        assert len(out) == 3
+        snap = srv.snapshot()
+        assert snap["paths"]["paged_decode_steps"] == 0
+        assert snap["paths"]["gather_decode_steps"] >= 2
+        assert snap["paths"]["prefill_chunks"] == 0
+    finally:
+        srv.close()

@@ -31,6 +31,17 @@ def main():
     ap.add_argument("--block-size", type=int, default=16)
     ap.add_argument("--queue-timeout", type=float, default=None,
                     help="fail requests queued longer than this (s)")
+    ap.add_argument("--paged", action="store_true", default=None,
+                    help="decode via the ragged paged-attention Pallas "
+                         "kernel + chunked prefill (default: the "
+                         "MXNET_PAGED_ATTENTION env var)")
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    help="prefill chunk length in tokens (paged path; "
+                         "default 2 * block-size)")
+    ap.add_argument("--token-budget", type=int, default=None,
+                    help="per-iteration token budget: decode tokens + "
+                         "prefill chunks (default: "
+                         "MXNET_SERVING_TOKEN_BUDGET or unbounded)")
     args = ap.parse_args()
 
     from mxnet_tpu import serving
@@ -53,7 +64,10 @@ def main():
     srv = serving.serve(model, max_batch=args.max_batch,
                         max_queue=args.max_queue,
                         block_size=args.block_size,
-                        queue_timeout=args.queue_timeout)
+                        queue_timeout=args.queue_timeout,
+                        paged=args.paged,
+                        prefill_chunk=args.prefill_chunk,
+                        token_budget=args.token_budget)
     print("listening on http://%s:%d  (POST /v1/generate, GET /v1/metrics)"
           % (args.host, args.port))
     srv.serve_http(host=args.host, port=args.port, block=True)
