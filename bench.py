@@ -65,6 +65,8 @@ def _merge_results(path, new, key=lambda r: (r.get("metric"),
                                             r.get("batch"),
                                             r.get("remat") or "none",
                                             bool(r.get("fused_bn_epilogue")),
+                                            r.get("fused_rnn") or "off",
+                                            r.get("hidden"),
                                             r.get("num_features"),
                                             r.get("device"))):
     """Merge `new` result lines into the JSON list at `path`.
@@ -413,22 +415,18 @@ def bench_resnet50_int8_infer(smoke, dtype, device_kind):
             "batch": batch, "quantized_dtype": "int8"}
 
 
-def bench_lstm_lm(smoke, dtype, device_kind):
-    """Word LM: 2-layer LSTM-200 over vocab 10k, bptt 35 (the reference
-    example/rnn/word_lm defaults); fused TrainStep, tokens/s."""
+def _run_word_lm(smoke, dtype, device_kind, batch, hid, emb):
+    """Shared word-LM TrainStep harness behind the lstm_lm and lstm_sweep
+    configs: build, warm, time, cost-model MFU. Returns (tok/s, mfu,
+    bptt) — one timing loop so the two A/B instruments cannot drift."""
     import jax
     import jax.numpy as jnp
     import mxnet_tpu as mx
     from mxnet_tpu.gluon import loss as gloss
     from mxnet_tpu.parallel.trainer import TrainStep
 
-    vocab, emb, hid, layers = (200, 32, 32, 1) if smoke else \
-        (10000, 200, 200, 2)
-    bptt, batch = (8, 4) if smoke else (35, 32)
-    # BENCH_LSTM_BATCH: batch sweep knob (32 = reference-parity default;
-    # larger batches amortize the scan's per-step latency — the word-LM
-    # utilization question from the r4 verdict)
-    batch = int(os.environ.get("BENCH_LSTM_BATCH", batch))
+    vocab, layers = (200, 1) if smoke else (10000, 2)
+    bptt = 8 if smoke else 35
     steps = 3 if smoke else 20
 
     net = mx.models.RNNModel(mode="lstm", vocab_size=vocab, num_embed=emb,
@@ -455,6 +453,19 @@ def bench_lstm_lm(smoke, dtype, device_kind):
                          jnp.float32(0.1), jnp.int32(1), jnp.float32(0.0))
     peak = _peak_flops(device_kind, dtype)
     mfu = (flops * steps / dt / peak) if (peak and flops) else None
+    return tok_s, mfu, bptt
+
+
+def bench_lstm_lm(smoke, dtype, device_kind):
+    """Word LM: 2-layer LSTM-200 over vocab 10k, bptt 35 (the reference
+    example/rnn/word_lm defaults); fused TrainStep, tokens/s."""
+    # BENCH_LSTM_BATCH: batch sweep knob (32 = reference-parity default;
+    # larger batches amortize the scan's per-step latency — the word-LM
+    # utilization question from the r4 verdict)
+    batch = int(os.environ.get("BENCH_LSTM_BATCH", "4" if smoke else "32"))
+    hid, emb = (32, 32) if smoke else (200, 200)
+    tok_s, mfu, bptt = _run_word_lm(smoke, dtype, device_kind, batch, hid,
+                                    emb)
     return {"metric": "lstm_word_lm_train_tok_per_sec",
             "value": round(tok_s, 1), "unit": "tok/s",
             "batch": batch, "bptt": bptt,
@@ -462,6 +473,49 @@ def bench_lstm_lm(smoke, dtype, device_kind):
             "baseline_note": "no published throughput in the reference "
                              "tree (example/rnn/word_lm README reports "
                              "perplexity only)",
+            "mfu": round(mfu, 4) if mfu is not None else None}
+
+
+def bench_lstm_sweep(smoke, dtype, device_kind, batch=None, fused=False):
+    """Word-LM LSTM batch sweep x fused-RNN A/B — the ADVICE round-5
+    artifact adjudicating latency-bound vs bandwidth-bound
+    (BENCH_LSTM_SWEEP.jsonl, tpu_session.sh step 2e). Each line is one
+    (batch, fused) point: `fused_rnn: on` routes the recurrence through
+    the persistent Pallas scan kernel (MXNET_FUSED_RNN,
+    ops/pallas_rnn.py — one launch per sequence, h/c resident in VMEM);
+    `off` is today's lax.scan path. Hidden is widened 200->256 so the
+    kernel is Mosaic-tile eligible on TPU (H % 128 == 0) — disclosed on
+    the line; the canonical `lstm_lm` config keeps reference parity at
+    200. BENCH_LSTM_SWEEP_FULL=1 runs the full batch {32,64,128,256}
+    sweep; default emits the batch-32 A/B pair only."""
+    emb, hid = (32, 32) if smoke else (256, 256)
+    hid = int(os.environ.get("BENCH_LSTM_HIDDEN", hid))
+    if batch is None:
+        batch = int(os.environ.get("BENCH_LSTM_BATCH", "4" if smoke
+                                   else "32"))
+
+    # the flag is read at TRACE time (ops/nn.py _scan_layer), so it must
+    # cover the TrainStep build; restored after (bytes_report discipline)
+    prior = os.environ.get("MXNET_FUSED_RNN")
+    os.environ["MXNET_FUSED_RNN"] = "1" if fused else "0"
+    try:
+        tok_s, mfu, bptt = _run_word_lm(smoke, dtype, device_kind, batch,
+                                        hid, emb)
+    finally:
+        if prior is None:
+            os.environ.pop("MXNET_FUSED_RNN", None)
+        else:
+            os.environ["MXNET_FUSED_RNN"] = prior
+    return {"metric": ("smoke_lstm_sweep_train_tok_per_sec" if smoke
+                       else "lstm_sweep_train_tok_per_sec"),
+            "value": round(tok_s, 1), "unit": "tok/s",
+            "batch": batch, "bptt": bptt, "hidden": hid,
+            "fused_rnn": "on" if fused else "off",
+            "vs_baseline": None,
+            "baseline_note": "in-line fused-off leg is the comparison; "
+                             "hidden widened 200->256 for Mosaic tile "
+                             "eligibility (H%128) — the canonical "
+                             "lstm_lm line keeps reference parity",
             "mfu": round(mfu, 4) if mfu is not None else None}
 
 
@@ -1034,6 +1088,7 @@ _CONFIGS = [
     ("resnet50_infer", bench_resnet50_infer),
     ("resnet50_int8_infer", bench_resnet50_int8_infer),
     ("lstm_lm", bench_lstm_lm),
+    ("lstm_sweep", bench_lstm_sweep),
     ("transformer_flash", bench_transformer_flash),
     ("ssd_forward", bench_ssd_forward),
     ("sparse_linear", bench_sparse_linear),
@@ -1076,6 +1131,15 @@ def _run_configs(smoke):
                 os.environ.get("BENCH_SERVING_BATCH") is None:
             # the serving trajectory is tracked at three batch points
             runs = [{"batch": b} for b in (1, 8, 32)]
+        if name == "lstm_sweep":
+            # always a paired A/B; the full batch sweep (the round-7
+            # latency-vs-bandwidth adjudicator) is opt-in — 8 TrainStep
+            # compiles would dominate an all-configs session
+            batches = ((32, 64, 128, 256)
+                       if os.environ.get("BENCH_LSTM_SWEEP_FULL") == "1"
+                       and not smoke else (None,))
+            runs = [{**({} if b is None else {"batch": b}), "fused": f}
+                    for b in batches for f in (False, True)]
         for kw in runs:
             try:
                 r = check_line(table[name](smoke, dtype, device_kind, **kw))

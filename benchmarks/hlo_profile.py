@@ -53,6 +53,9 @@ def build_lstm_step(smoke, dtype, batch):
 
     vocab, emb, hid, layers = (200, 32, 32, 1) if smoke else \
         (10000, 200, 200, 2)
+    # BENCH_LSTM_HIDDEN: match the lstm_sweep config (256, Mosaic-tile
+    # eligible) so a MXNET_FUSED_RNN=1 profile exercises the fused kernel
+    hid = int(os.environ.get("BENCH_LSTM_HIDDEN", hid))
     bptt = 8 if smoke else 35
     net = mx.models.RNNModel(mode="lstm", vocab_size=vocab, num_embed=emb,
                              num_hidden=hid, num_layers=layers, dropout=0.0)
@@ -97,6 +100,44 @@ def conv_table(hlo_text, batch):
     return rows
 
 
+def scan_attribution(rows, us):
+    """Split self time into while-loop SELF (per-iteration scan overhead:
+    loop bookkeeping, condition, carry shuffling — the ops whose name or
+    category carries `while`), matmul work (dot/convolution, wherever it
+    sits), and everything else. This is the (2)-vs-(3) tiebreaker of the
+    round-5 word-LM analysis (BENCH_NOTES.md): if the while bucket
+    dominates the step, the scan is latency-bound and the persistent
+    fused kernel (MXNET_FUSED_RNN, ops/pallas_rnn.py) is the lever; if
+    the dot bucket dominates, the loop body itself is the cost and a
+    bigger batch is. hlo_stats reports SELF time, so a while row never
+    double-counts its body fusions — they have their own rows."""
+    while_self = dot_self = other_self = 0.0
+    for r in rows:
+        cat = (r.get("category") or "").lower()
+        name = (r.get("hlo_op_name") or "").lower()
+        expr = (r.get("hlo_op_expression") or "").lower()
+        t = us(r)
+        if "while" in cat or name.startswith("while") \
+                or " while(" in expr or expr.startswith("while"):
+            while_self += t
+        elif ("dot" in cat or "conv" in cat or "dot(" in expr
+              or "convolution(" in expr):
+            dot_self += t
+        else:
+            other_self += t
+    total = (while_self + dot_self + other_self) or 1.0
+    print("\n== scan-overhead vs matmul attribution (self time) ==")
+    for label, t in (("while-loop self (scan overhead)", while_self),
+                     ("dot/convolution (incl. loop-body matmuls)",
+                      dot_self),
+                     ("everything else", other_self)):
+        print("  %-42s %10.0f us  %5.1f%%" % (label, t, 100 * t / total))
+    if dot_self:
+        print("  while-self : dot ratio = %.2f  (>1 => latency-bound "
+              "loop; the fused-kernel lever applies)"
+              % (while_self / dot_self))
+
+
 def xplane_summary(logdir, top=20):
     """Per-op wall times from the captured XPlane via xprof's hlo_stats
     table: category totals (where does the step go) + the heaviest ops
@@ -137,6 +178,7 @@ def xplane_summary(logdir, top=20):
         print("\n== self time by HLO category ==")
         for cat, t in sorted(by_cat.items(), key=lambda kv: -kv[1]):
             print("  %-28s %10.0f us  %5.1f%%" % (cat, t, 100 * t / total))
+        scan_attribution(rows, us)
         rows.sort(key=us, reverse=True)
         print("\n== top %d ops by self time ==" % top)
         for r in rows[:top]:

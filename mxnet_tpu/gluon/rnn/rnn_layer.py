@@ -23,7 +23,7 @@ class _RNNLayer(HybridBlock):
     def __init__(self, hidden_size, num_layers, layout, dropout,
                  bidirectional, input_size, i2h_weight_initializer,
                  h2h_weight_initializer, i2h_bias_initializer,
-                 h2h_bias_initializer, mode, **kwargs):
+                 h2h_bias_initializer, mode, fused=None, **kwargs):
         self._mode = mode  # before super(): _alias() needs it
         super().__init__(**kwargs)
         assert layout in ("TNC", "NTC"), \
@@ -32,6 +32,9 @@ class _RNNLayer(HybridBlock):
         self._num_layers = num_layers
         self._layout = layout
         self._dropout = dropout
+        # None = honor MXNET_FUSED_RNN at trace time; True/False pin the
+        # persistent Pallas scan kernel (ops/pallas_rnn.py) per layer
+        self._fused = fused
         self._dir = 2 if bidirectional else 1
         self._input_size = input_size
         self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
@@ -122,7 +125,9 @@ class _RNNLayer(HybridBlock):
                     state_size=self._hidden_size,
                     num_layers=self._num_layers, mode=self._mode,
                     bidirectional=self._dir == 2, p=self._dropout,
-                    state_outputs=in_states is not None)
+                    state_outputs=in_states is not None,
+                    **({} if self._fused is None
+                       else {"fused": self._fused}))
         if in_states is not None:
             out = rnn[0]
             out_states = [rnn[i] for i in range(1, len(states) + 1)]
@@ -162,7 +167,9 @@ class _RNNLayer(HybridBlock):
         ret = F.RNN(*rnn_args, state_size=self._hidden_size,
                     num_layers=self._num_layers, mode=self._mode,
                     bidirectional=self._dir == 2, p=self._dropout,
-                    state_outputs=True)
+                    state_outputs=True,
+                    **({} if self._fused is None
+                       else {"fused": self._fused}))
         if self._mode == "lstm":
             outputs, state_h, state_c = ret
             out_states = [state_h, state_c]
@@ -181,11 +188,12 @@ class RNN(_RNNLayer):
                  layout="TNC", dropout=0, bidirectional=False,
                  i2h_weight_initializer=None, h2h_weight_initializer=None,
                  i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
-                 input_size=0, **kwargs):
+                 input_size=0, fused=None, **kwargs):
         super().__init__(hidden_size, num_layers, layout, dropout,
                          bidirectional, input_size, i2h_weight_initializer,
                          h2h_weight_initializer, i2h_bias_initializer,
-                         h2h_bias_initializer, "rnn_" + activation, **kwargs)
+                         h2h_bias_initializer, "rnn_" + activation,
+                         fused=fused, **kwargs)
 
     def state_info(self, batch_size=0):
         return [{"shape": (self._num_layers * self._dir, batch_size,
@@ -197,11 +205,11 @@ class LSTM(_RNNLayer):
                  bidirectional=False, input_size=0,
                  i2h_weight_initializer=None, h2h_weight_initializer=None,
                  i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
-                 **kwargs):
+                 fused=None, **kwargs):
         super().__init__(hidden_size, num_layers, layout, dropout,
                          bidirectional, input_size, i2h_weight_initializer,
                          h2h_weight_initializer, i2h_bias_initializer,
-                         h2h_bias_initializer, "lstm", **kwargs)
+                         h2h_bias_initializer, "lstm", fused=fused, **kwargs)
 
     def state_info(self, batch_size=0):
         return [{"shape": (self._num_layers * self._dir, batch_size,
@@ -215,11 +223,14 @@ class GRU(_RNNLayer):
                  bidirectional=False, input_size=0,
                  i2h_weight_initializer=None, h2h_weight_initializer=None,
                  i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
-                 **kwargs):
+                 fused=None, **kwargs):
+        # gru currently always falls back to the scan path (the hidden
+        # bias feeds the reset-gate product); the kwarg is accepted so the
+        # gate decision stays in one place (ops/pallas_rnn.fused_eligible)
         super().__init__(hidden_size, num_layers, layout, dropout,
                          bidirectional, input_size, i2h_weight_initializer,
                          h2h_weight_initializer, i2h_bias_initializer,
-                         h2h_bias_initializer, "gru", **kwargs)
+                         h2h_bias_initializer, "gru", fused=fused, **kwargs)
 
     def state_info(self, batch_size=0):
         return [{"shape": (self._num_layers * self._dir, batch_size,

@@ -8,22 +8,24 @@ from ..gluon import nn, rnn, HybridBlock
 class RNNModel(HybridBlock):
     def __init__(self, mode="lstm", vocab_size=10000, num_embed=200,
                  num_hidden=200, num_layers=2, dropout=0.5, tie_weights=False,
-                 **kwargs):
+                 fused=None, **kwargs):
         super().__init__(**kwargs)
         self._mode = mode
         self._num_hidden = num_hidden
         with self.name_scope():
             self.drop = nn.Dropout(dropout)
             self.encoder = nn.Embedding(vocab_size, num_embed)
+            # fused: None honors MXNET_FUSED_RNN; True/False pin the
+            # persistent Pallas scan kernel (ops/pallas_rnn.py)
             if mode == "lstm":
                 self.rnn = rnn.LSTM(num_hidden, num_layers, dropout=dropout,
-                                    input_size=num_embed)
+                                    input_size=num_embed, fused=fused)
             elif mode == "gru":
                 self.rnn = rnn.GRU(num_hidden, num_layers, dropout=dropout,
-                                   input_size=num_embed)
+                                   input_size=num_embed, fused=fused)
             else:
                 self.rnn = rnn.RNN(num_hidden, num_layers, dropout=dropout,
-                                   input_size=num_embed,
+                                   input_size=num_embed, fused=fused,
                                    activation="relu" if mode == "rnn_relu"
                                    else "tanh")
             if tie_weights:

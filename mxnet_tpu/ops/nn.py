@@ -728,7 +728,8 @@ def _cell_step(mode, px, h, c, wh, bh):
     raise ValueError(mode)
 
 
-def _scan_layer(mode, xs, h0, c0, wi, wh, bi, bh, reverse=False):
+def _scan_layer(mode, xs, h0, c0, wi, wh, bi, bh, reverse=False,
+                fused=None):
     """One (direction of one) RNN layer over [T, N, C].
 
     The input projection for ALL timesteps is hoisted out of the scan as
@@ -736,12 +737,26 @@ def _scan_layer(mode, xs, h0, c0, wi, wh, bi, bh, reverse=False):
     (reference src/operator/cudnn_rnn-inl.h): at word-LM shapes the
     per-step x @ wi.T is a tiny latency-bound matmul repeated T times;
     batched it runs at MXU efficiency, and the sequential scan carries
-    only the irreducible h @ wh.T recurrence."""
+    only the irreducible h @ wh.T recurrence.
+
+    With `MXNET_FUSED_RNN=1` (or `RNN(..., fused=True)`) and a
+    Mosaic-tileable shape, that remaining recurrence runs as ONE
+    persistent Pallas kernel per sequence (ops/pallas_rnn.py) — weights
+    VMEM-resident, h/c carried in VMEM scratch — instead of T XLA
+    while-loop iterations; ineligible shapes and gru keep this scan,
+    which stays the parity oracle either way (the flag switches the
+    kernel, never the semantics)."""
     T, N = xs.shape[0], xs.shape[1]
     # input-side bias folds into the hoisted projection; for gru the
     # hidden-side bias stays inside (it feeds the reset gate product)
     bias = bi if mode == "gru" else bi + bh
     pxs = (xs.reshape(T * N, -1) @ wi.T + bias).reshape(T, N, -1)
+
+    from . import pallas_rnn
+    if pallas_rnn.use_fused(fused) and pallas_rnn.fused_eligible(
+            mode, T, N, h0.shape[-1], pxs.dtype, wh.dtype, h0.dtype):
+        return pallas_rnn.fused_scan_layer(mode, pxs, h0, c0, wh,
+                                           reverse=reverse)
 
     def step(carry, px):
         h, c = carry
@@ -755,8 +770,13 @@ def _scan_layer(mode, xs, h0, c0, wi, wh, bi, bh, reverse=False):
 def RNN(data, parameters, state, state_cell=None, state_size=0, num_layers=1,
         mode="lstm", bidirectional=False, p=0.0, state_outputs=False,
         projection_size=None, lstm_state_clip_min=None,
-        lstm_state_clip_max=None, lstm_state_clip_nan=False):
-    """Fused multi-layer (bi)RNN over time-major [T, N, C] input."""
+        lstm_state_clip_max=None, lstm_state_clip_nan=False, fused=None):
+    """Fused multi-layer (bi)RNN over time-major [T, N, C] input.
+
+    `fused`: None (default) = honor MXNET_FUSED_RNN; True/False force the
+    persistent Pallas scan kernel on/off per call (ops/pallas_rnn.py).
+    Either way ineligible shapes fall back to the lax.scan path — the
+    knob selects a kernel, never different semantics."""
     from .. import autograd
     T, N, C = data.shape
     dirs = 2 if bidirectional else 1
@@ -772,7 +792,7 @@ def RNN(data, parameters, state, state_cell=None, state_size=0, num_layers=1,
             wi, wh, bi, bh = layer_params[di]
             idx = li * dirs + di
             ys, hT, cT = _scan_layer(mode, xs, h0[idx], c0[idx], wi, wh, bi, bh,
-                                     reverse=(di == 1))
+                                     reverse=(di == 1), fused=fused)
             outs.append(ys)
             hTs.append(hT)
             cTs.append(cT)

@@ -111,16 +111,16 @@ def fuse_eligible(x, axis=1):
     return (C // bc) * (S // bs) <= _MAX_GRID
 
 
-def _cost(flops, bytes_accessed):
+def _cost(flops, bytes_accessed, transcendentals=0):
     """cost_estimate kwarg for pallas_call when this jax version supports
     it — on TPU the kernel is an opaque custom call, and without a declared
     cost the XLA cost model (bytes_report.py's A/B instrument) would count
-    it as zero bytes."""
+    it as zero bytes. Shared with pallas_rnn.py."""
     try:
         from jax.experimental import pallas as pl
         est = pl.CostEstimate(flops=int(flops),
                               bytes_accessed=int(bytes_accessed),
-                              transcendentals=0)
+                              transcendentals=int(transcendentals))
         return {"cost_estimate": est}
     except Exception:
         return {}

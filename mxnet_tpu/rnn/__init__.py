@@ -41,11 +41,14 @@ class BucketSentenceIter:
             buck = np.searchsorted(buckets, len(s))
             if buck == len(buckets):
                 continue  # longer than the largest bucket: dropped
+            # buffers honor the constructor dtype end to end: staging in
+            # float32 would silently round int tokens above 2**24 before
+            # the final cast in next()
             padded = np.full((buckets[buck],), invalid_label,
-                             dtype=np.float32)
+                             dtype=self._dtype)
             padded[:len(s)] = s
             self.data[buck].append(padded)
-        self.data = [np.asarray(x, dtype=np.float32) for x in self.data]
+        self.data = [np.asarray(x, dtype=self._dtype) for x in self.data]
         self.buckets = buckets
         self.batch_size = batch_size
         self.invalid_label = invalid_label

@@ -63,3 +63,19 @@ def test_bucket_iter_layout_and_dtype():
     batch = next(it)
     d = batch.data[0].asnumpy()
     assert d.shape == (3, 2) and d.dtype == np.int32
+
+
+def test_bucket_iter_int32_exact():
+    """Regression: the padded sentence buffers used to stage in float32
+    regardless of the dtype argument, silently rounding int tokens above
+    2**24 before the final cast in next()."""
+    big = 2**24 + 1  # not representable in float32 (rounds to 2**24)
+    sents = [[big, big + 2], [7, 8]]
+    it = mx.rnn.BucketSentenceIter(sents, batch_size=2, buckets=[2],
+                                   invalid_label=-1, dtype="int32")
+    assert all(d.dtype == np.int32 for d in it.data)
+    batch = next(it)
+    d = batch.data[0].asnumpy()
+    assert d.dtype == np.int32
+    assert sorted(d[:, 0].tolist()) == [7, big]
+    assert sorted(d[:, 1].tolist()) == [8, big + 2]
