@@ -83,6 +83,22 @@ timeout -k 30 1800 env MXNET_FUSED_RNN=1 BENCH_LSTM_HIDDEN=256 \
   BENCH_TRACE_DIR=/tmp/mxtpu_trace_lstm_fused \
   python benchmarks/hlo_profile.py 2>&1 | tee BENCH_LSTM_PROFILE_FUSED.txt
 
+echo "=== 2f. pod-scale resilience: sharded-ckpt A/B + multi-host chaos drill (ISSUE 6) ==="
+# (a) the resilience config now carries the sharded_ckpt sub-line:
+# per-host sharded checkpoints (ZeRO-1 sharded update, N = min(4,
+# devices) emulated hosts) vs the single-writer baseline at equal state
+# size — bytes-per-host must land at ~total/N (BENCH_NOTES.md round 8
+# predictions registered BEFORE this runs). (b) the multi-host chaos
+# drill runs on VIRTUAL CPU devices even during the TPU session (it
+# drills process death + shared-filesystem checkpoint semantics, not
+# chip kernels) — timeout-bounded so a wedged subprocess cannot stall
+# the session.
+timeout -k 30 900 env BENCH_CONFIGS=resilience python bench.py \
+  | tee BENCH_RESILIENCE_SHARDED.jsonl
+timeout -k 30 1200 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python tools/chaos_train.py --multihost --net mlp --steps 16 \
+  --save-every 4 2>&1 | tee BENCH_MULTIHOST_DRILL.txt
+
 echo "=== 3. flash attention seq sweep (1024/2048/4096) ==="
 BENCH_CONFIGS=transformer_flash BENCH_FLASH_SEQ=1024,2048,4096,8192 \
   python bench.py | tee BENCH_FLASH_SWEEP.jsonl

@@ -195,18 +195,35 @@ class ResilientLoop:
         Install the SIGTERM/SIGINT watcher.
     grace_secs : float, optional
         Overrides MXNET_PREEMPT_GRACE_SECS.
+    elastic_dp : str, optional
+        'raise' (default) or 'rescale' — what `restore()` does when the
+        checkpoint was written under a DIFFERENT data-parallel size and
+        a DataLoader cursor is attached. The cursor counts GLOBAL
+        batches, so a dp resize is only loss-curve-preserving when the
+        driver holds the global batch size constant (per-chip batch =
+        global/dp): 'rescale' proceeds under that documented contract
+        (with a warning), 'raise' refuses the silently-lossy resume.
+        Default from MXNET_ELASTIC_DP_POLICY.
     """
 
     def __init__(self, step, manager, loader=None, save_every=100,
                  policy=None, rollback_after=3, lr_shrink=1.0,
                  epochs=1, watch_preemption=True, grace_secs=None,
-                 verbose=True):
+                 elastic_dp=None, verbose=True):
         if policy is None:
             policy = os.environ.get("MXNET_BAD_STEP_POLICY", "off") or "off"
         policy = policy.lower()
         if policy not in _POLICIES:
             raise ValueError("bad-step policy must be one of %s, got %r"
                              % ("/".join(_POLICIES), policy))
+        if elastic_dp is None:
+            elastic_dp = os.environ.get("MXNET_ELASTIC_DP_POLICY",
+                                        "raise") or "raise"
+        elastic_dp = elastic_dp.lower()
+        if elastic_dp not in ("raise", "rescale"):
+            raise ValueError("elastic_dp policy must be raise or rescale, "
+                             "got %r" % (elastic_dp,))
+        self.elastic_dp = elastic_dp
         self._step = step
         self._manager = manager
         self._loader = loader
@@ -258,26 +275,63 @@ class ResilientLoop:
             lambda t: self._base_lr_fn(t) * self._lr_scale)
 
     # -- state --------------------------------------------------------------
-    def state_dict(self):
+    def _dp_size(self):
+        """The step's data-parallel world size (1 off-mesh): part of the
+        checkpoint so an elastic relaunch can tell whether the data-
+        cursor math still holds (the cursor counts GLOBAL batches)."""
+        step = self._step
+        mesh = getattr(step, "_mesh", None)
+        axis = getattr(step, "_data_axis", None)
+        if mesh is None or not axis:
+            return 1
+        return int(mesh.shape.get(axis, 1)) or 1
+
+    def state_dict(self, device=False):
         """Composite checkpoint tree: TrainStep state + the loop's own
-        lifecycle state (data cursor, bad-step counters, LR scale)."""
+        lifecycle state (data cursor, bad-step counters, LR scale).
+        device=True keeps the TrainStep leaves as live device arrays
+        (shardings intact — the sharded-checkpoint path; see
+        TrainStep.state_dict)."""
         loop = {"consecutive_bad": self.consecutive_bad,
                 "bad_steps": self.bad_steps,
                 "rollbacks": self.rollbacks,
                 "lr_scale": self._lr_scale,
-                "epoch": self._epoch}
+                "epoch": self._epoch,
+                "dp_size": self._dp_size()}
         if self._loader is not None and hasattr(self._loader, "state_dict"):
             loop["loader"] = self._loader.state_dict()
         blob = np.frombuffer(json.dumps(loop).encode(), np.uint8).copy()
-        return {"train": self._step.state_dict(), "loop": blob}
+        return {"train": self._step.state_dict(device=device), "loop": blob}
 
     def load_state_dict(self, tree):
         if "train" not in tree:      # a bare TrainStep checkpoint
             self._step.load_state_dict(tree)
             return
-        self._step.load_state_dict(tree["train"])
         loop = json.loads(bytes(bytearray(
             np.asarray(tree["loop"]).astype(np.uint8))).decode())
+        saved_dp = int(loop.get("dp_size", 0) or 0)
+        cur_dp = self._dp_size()
+        if saved_dp and saved_dp != cur_dp and "loader" in loop \
+                and self._loader is not None:
+            # elastic resume rail: the loader cursor counts GLOBAL
+            # batches, so it only stays meaningful across a dp resize if
+            # the driver keeps the global batch size constant
+            if self.elastic_dp == "raise":
+                raise MXNetError(
+                    "checkpoint was written at dp=%d but this run is "
+                    "dp=%d with a DataLoader cursor attached — a resize "
+                    "silently breaks the data-cursor math unless the "
+                    "GLOBAL batch size is held constant. Pass "
+                    "ResilientLoop(elastic_dp='rescale') (or "
+                    "MXNET_ELASTIC_DP_POLICY=rescale) to accept that "
+                    "contract, or restart the data cursor."
+                    % (saved_dp, cur_dp))
+            warnings.warn(
+                "elastic resume across dp=%d -> dp=%d: keeping the "
+                "global-batch data cursor (rescale policy) — the driver "
+                "must hold the global batch size constant"
+                % (saved_dp, cur_dp))
+        self._step.load_state_dict(tree["train"])
         self.consecutive_bad = int(loop.get("consecutive_bad", 0))
         self.bad_steps = int(loop.get("bad_steps", 0))
         self.rollbacks = int(loop.get("rollbacks", 0))
@@ -295,9 +349,13 @@ class ResilientLoop:
         Multi-process: every process reads the (shared-filesystem)
         checkpoint directory; the processes must agree on the restored
         step or the data-parallel replicas would mix parameters from
-        different steps. A disagreement (e.g. per-host local directories
-        where only process 0 ever wrote) raises instead of silently
-        cold-starting the non-writers."""
+        different steps. `restore_latest()` already allgathers and
+        intersects the per-host intact-step sets (so hosts cannot fall
+        back past DIFFERENT corrupt checkpoints), and this rail then
+        cross-checks the chosen step itself: a residual disagreement
+        (e.g. per-host local directories where only process 0 ever
+        wrote) raises instead of silently cold-starting the
+        non-writers."""
         state = self._manager.restore_latest()
         step0 = 0
         if state is not None:
@@ -326,7 +384,13 @@ class ResilientLoop:
         return step0
 
     def save(self, block=False):
-        self._manager.save(self._step.t, self.state_dict(), block=block)
+        # device=True keeps shardings on the TrainStep leaves so the
+        # manager can select sharded mode and copy out only the shards
+        # this host owns; the manager's host copies happen synchronously
+        # inside save(), before the next (donating) step can run. In
+        # single-writer mode non-writers return before copying anything.
+        self._manager.save(self._step.t, self.state_dict(device=True),
+                           block=block)
 
     # -- the lifecycle ------------------------------------------------------
     @property
@@ -362,6 +426,9 @@ class ResilientLoop:
             self.save()
         _chaos.maybe_sigterm(t)
         self._check_preempt()
+        # after the preemption drain: a SIGKILL'd host gets no drain at
+        # all (the multi-host chaos drill's dead-host fault)
+        _chaos.maybe_sigkill(t)
         return loss
 
     def _on_bad_step(self, t):

@@ -203,7 +203,8 @@ class TrainStep:
     def __init__(self, net, loss_fn, optimizer="sgd", optimizer_params=None,
                  mesh=None, data_axis="dp", param_shardings=None,
                  dtype="float32", remat=None, shard_optimizer_states=False,
-                 guard=False):
+                 sharded_update=None, guard=False):
+        import os as _os
         from .. import optimizer as _opt_mod
         remat = _remat_mode(remat)
         self._net = net
@@ -225,7 +226,23 @@ class TrainStep:
         # ZeRO-style weight-update sharding (arXiv:2004.13336): optimizer
         # state shards over the data axis, GSPMD turning the grad all-reduce
         # into reduce-scatter + the post-update all-gather automatically
-        self._shard_opt = bool(shard_optimizer_states)
+        if sharded_update is None:
+            sharded_update = _os.environ.get("MXNET_SHARDED_UPDATE",
+                                             "0") == "1"
+        # sharded_update goes further than state *placement*: the step
+        # itself pins the ZeRO-1 dataflow with sharding constraints —
+        # grads reduce-scatter over dp, the optimizer applies to the
+        # local 1/N shard of (weight, grad, state), updated params
+        # all-gather back to replicated. Semantically identical to the
+        # unsharded step (the constraints only re-place the same global
+        # values), which tests pin bit-for-bit against the unsharded
+        # oracle; per-chip it trades the full optimizer-state footprint
+        # for 1/N + an all-gather. Implies sharded state placement, and
+        # makes per-host sharded checkpoints (utils/recovery.py) the
+        # natural way to save the now per-host optimizer state.
+        self._sharded_update = bool(sharded_update)
+        self._shard_opt = bool(shard_optimizer_states) or \
+            self._sharded_update
         # bad-step guard (parallel/resilient.py): when on, the jitted step
         # also computes the global grad norm + a finiteness flag and
         # SELECTS the old (params, opt state, aux) when the step is bad —
@@ -287,6 +304,29 @@ class TrainStep:
         remat_on = self._remat != "none"
         remat_policy = _REMAT_POLICIES[self._remat]() if remat_on else None
         remat_blocks = _remat_segments(net) if remat_on else []
+        # ZeRO-1 (arXiv:2004.13336) shard specs, one per grad param: the
+        # first dp-divisible axis of each REPLICATED weight (tensor-
+        # parallel params already shard their own way; scalars and
+        # indivisible shapes stay replicated). Used both to place the
+        # optimizer state and to pin the in-step dataflow below.
+        mesh_obj = self._mesh
+        dp_ax = self._data_axis
+        dp_size = mesh_obj.shape.get(dp_ax, 0) \
+            if (mesh_obj is not None and dp_ax) else 0
+        zero_specs = []
+        for n, p in gparams:
+            pspec = self._param_shardings.get(n, P())
+            replicated = all(ax is None for ax in pspec)
+            w0 = p._data._data
+            z = None
+            if dp_size > 1 and replicated and np.ndim(w0) > 0:
+                for axis in range(np.ndim(w0)):
+                    if w0.shape[axis] % dp_size == 0:
+                        z = P(*([None] * axis + [dp_ax]))
+                        break
+            zero_specs.append(z)
+        szd = self._sharded_update and dp_size > 1 and \
+            any(z is not None for z in zero_specs)
 
         def forward_loss(grad_vals, nograd_vals, x, y, key):
             """Trace the eager net with tracer-backed parameter buffers.
@@ -357,8 +397,27 @@ class TrainStep:
                     g = jnp.clip(g, -clip, clip)
                 k = jax.random.fold_in(noise_key, i) if stochastic_rule \
                     else None
-                w2, s2 = apply_rule(w, g, s, lr * lr_mults[i],
+                # ZeRO-1 dataflow (sharded_update): the grad's allreduce
+                # becomes reduce-scatter (constrain it dp-sharded — XLA
+                # materializes only the 1/N shard per device), the
+                # optimizer applies to the local shard of (w, g, state),
+                # and only the UPDATED param all-gathers back. The
+                # constraints re-place, never re-value: the unsharded
+                # step is the bit-exact parity oracle (tests pin it).
+                z = zero_specs[i] if szd else None
+                w_in = w
+                if z is not None:
+                    zs = NamedSharding(mesh_obj, z)
+                    g = jax.lax.with_sharding_constraint(g, zs)
+                    w_in = jax.lax.with_sharding_constraint(w, zs)
+                w2, s2 = apply_rule(w_in, g, s, lr * lr_mults[i],
                                     base_wd * wd_mults[i], t, hyper, k)
+                if z is not None:
+                    w2 = jax.lax.with_sharding_constraint(
+                        w2, NamedSharding(mesh_obj, P()))
+                    s2 = jax.tree.map(
+                        lambda a: jax.lax.with_sharding_constraint(a, zs)
+                        if jnp.shape(a) == jnp.shape(w) else a, s2)
                 if guard:
                     # bad step -> drop the whole update: params AND
                     # optimizer state stay exactly as they were
@@ -517,12 +576,20 @@ class TrainStep:
                 return cand
         return None
 
-    def state_dict(self):
+    def state_dict(self, device=False):
         """Full resumable training state (params + optimizer state + step
         counter + RNG key chain + LR-schedule state) for
         utils.recovery.CheckpointManager. Materialized to host arrays —
         the live device buffers get donated by the next step, so handing
-        out references would leave the caller with deleted arrays."""
+        out references would leave the caller with deleted arrays.
+
+        device=True returns the LIVE device arrays instead (shardings
+        intact — what sharded checkpointing needs to know which shards
+        this host owns). The caller must copy out everything it keeps
+        BEFORE the next step runs: CheckpointManager.save() does its
+        host copies synchronously, so `mgr.save(t, step.state_dict(
+        device=True))` is safe; holding the tree across a step is not.
+        """
         if self._step_fn is None:
             self._build()
         # np.array (not np.asarray): on the CPU backend asarray can be a
@@ -530,10 +597,9 @@ class TrainStep:
         # that buffer — an async checkpoint writer would then serialize
         # memory the t+1 update already overwrote (a checkpoint labeled
         # step t with step t+1's params breaks step-exact resume)
-        host = jax.tree.map(lambda v: np.array(v),
-                            (tuple(self._grad_vals),
-                             tuple(self._nograd_vals),
-                             tuple(self._opt_state)))
+        live = (tuple(self._grad_vals), tuple(self._nograd_vals),
+                tuple(self._opt_state))
+        host = live if device else jax.tree.map(lambda v: np.array(v), live)
         out = {"t": np.int64(self._t), "grad_vals": host[0],
                "nograd_vals": host[1], "opt_state": host[2],
                # the global key stream feeds per-step dropout masks / SGLD
@@ -560,6 +626,18 @@ class TrainStep:
                     "checkpoint %s has %d entries but the model expects %d "
                     "— wrong or since-modified model" %
                     (name, len(state[name]), len(tmpl)))
+            # logical-shape gate for elastic resume: a checkpoint written
+            # under ANY mesh shape holds the same GLOBAL arrays, so a
+            # shape mismatch means a different model, never a different
+            # mesh — refuse rather than let device_put fail cryptically
+            # (or broadcast silently) mid-restore
+            for t, v in zip(jax.tree.leaves(tuple(tmpl)),
+                            jax.tree.leaves(tuple(state[name]))):
+                if tuple(np.shape(v)) != tuple(jnp.shape(t)):
+                    raise ValueError(
+                        "checkpoint %s entry has shape %s but the model "
+                        "expects %s — wrong model or a lossy resume"
+                        % (name, tuple(np.shape(v)), tuple(jnp.shape(t))))
         self._t = int(state["t"])
         if "rng_key" in state:
             _random.set_state(state["rng_key"])

@@ -22,6 +22,11 @@ changes:
   MXNET_CHAOS_SIGTERM_AT=<step>    deliver SIGTERM to this process after
                                    step <step> completes (a preemption
                                    notice mid-epoch).
+  MXNET_CHAOS_SIGKILL_AT=<step>    deliver SIGKILL to this process after
+                                   step <step> completes — a host DYING
+                                   with no drain, no checkpoint, no
+                                   cleanup (the multi-host chaos drill
+                                   kills one host of a pod this way).
 
 Steps are 1-based and compare against the trainer's post-increment step
 counter (`TrainStep._t`), i.e. the value `ResilientLoop` reports. Each
@@ -35,7 +40,8 @@ import os
 import signal
 
 
-_FAULTS = ("kill_save", "corrupt_ckpt", "nan_step", "sigterm_at")
+_FAULTS = ("kill_save", "corrupt_ckpt", "nan_step", "sigterm_at",
+           "sigkill_at")
 
 _conf = {}          # fault name -> step (int)
 _fired = set()      # fault names that already triggered in this process
@@ -125,5 +131,18 @@ def maybe_sigterm(step):
     catch it, checkpoint, and exit with the relaunch code."""
     if _should("sigterm_at", step):
         os.kill(os.getpid(), signal.SIGTERM)
+        return True
+    return False
+
+
+def maybe_sigkill(step):
+    """ResilientLoop calls this at each step boundary; delivers SIGKILL
+    on the armed step — uncatchable, so the process dies with NO drain
+    checkpoint and NO cleanup. This is the dead-host fault of the
+    multi-host chaos drill: the surviving hosts' next complete
+    checkpoint step must exclude everything the dead host never
+    published."""
+    if _should("sigkill_at", step):
+        os.kill(os.getpid(), signal.SIGKILL)
         return True
     return False

@@ -31,6 +31,32 @@ orbax-style CheckpointManager with
     `jax.distributed` is initialized it then synchronizes all processes
     so no worker can exit (and be relaunched) before the checkpoint
     exists.
+  * per-host SHARDED checkpoints — the pod-scale mode (ROADMAP item 4):
+    when the state tree holds mesh-sharded `jax.Array`s (ZeRO-style
+    optimizer-state sharding, tensor-parallel params), funnelling the
+    full state through process 0 is both the scalability ceiling and
+    the single point of failure. In sharded mode EVERY process writes
+    `ckpt-<step>.shard<i>of<n>.npz` holding only the logical shards it
+    owns (each distinct shard of each array is written exactly once
+    globally; fully-replicated arrays round-robin across hosts so the
+    bytes balance at ~total/n per host), plus a self-certifying per-host
+    manifest (size + sha256 + the global index of every entry). Process
+    0 additionally publishes the global `ckpt-<step>.manifest.json`
+    recording the format, process count, mesh axes, every array's
+    global shape/dtype/sharding spec, and the shard-file roster — the
+    per-file sha256s live in the per-host manifests it points at, so no
+    cross-host communication happens on the write path. `restore()`
+    reassembles global logical arrays from whichever shard files cover
+    them, which is what makes ELASTIC resume work: a relaunch onto a
+    different process count (or a different mesh shape entirely) loads
+    the same global arrays and re-places them under its own shardings
+    (`TrainStep.load_state_dict` device_puts against the live mesh).
+    Mode is selected automatically per save — a tree containing
+    non-fully-addressable arrays must shard; `sharded=True/False`
+    forces it, and `process_index`/`process_count` may be overridden to
+    EMULATE a multi-host run from single-process workers (the
+    jax.distributed-free chaos-drill fallback: each emulated host owns
+    a contiguous block of the mesh's devices).
 
 TrainStep integration: `TrainStep.state_dict()/load_state_dict()` capture
 parameters, optimizer state, and the step counter, so
@@ -57,6 +83,17 @@ import jax
 from . import chaos as _chaos
 
 _CKPT_RE = re.compile(r"^ckpt-(\d+)\.npz$")
+_SHARD_RE = re.compile(r"^ckpt-(\d+)\.shard(\d+)of(\d+)\.npz$")
+
+
+def _norm_index(idx, shape):
+    """Normalize a shard index (tuple of slices) to a hashable, JSON-able
+    ((start, stop), ...) against the array's global shape."""
+    out = []
+    for s, dim in zip(idx, shape):
+        start, stop, _ = s.indices(dim)
+        out.append((int(start), int(stop)))
+    return tuple(out)
 
 
 def _fsync_dir(path):
@@ -91,41 +128,87 @@ class CheckpointManager:
     """
 
     def __init__(self, directory, keep=3, async_save=True,
-                 process_index=None):
+                 process_index=None, process_count=None, sharded=None):
         self.directory = directory
         self.keep = keep
         self.async_save = async_save
         self._process_index = process_index
+        self._process_count = process_count
+        #: True = always shard, False = always single-writer, None = auto
+        #: (shard iff the saved tree holds non-fully-addressable arrays).
+        #: Overriding process_index/process_count past jax's own values
+        #: EMULATES a multi-host run from independent single-process
+        #: workers (the jax.distributed-free chaos-drill fallback).
+        self._sharded = sharded
+        #: bounded-backoff attempts for each filesystem publish operation
+        #: (a transient NFS/GCS-fuse hiccup must not kill an async save)
+        self.io_retries = 3
         self._worker = None
         self._lock = threading.Lock()
         self._error = None
-        if self.is_writer:
+        if self.is_writer or sharded:
             os.makedirs(directory, exist_ok=True)
 
     @property
-    def is_writer(self):
-        """Single-writer protocol: only process 0 performs checkpoint IO
-        (data-parallel state is replicated — every process holds the same
-        values, so N writers would just race on the directory)."""
+    def process_index(self):
         if self._process_index is None:
             try:
                 self._process_index = jax.process_index()
             except Exception:
                 self._process_index = 0
-        return self._process_index == 0
+        return self._process_index
+
+    @property
+    def process_count(self):
+        if self._process_count is None:
+            try:
+                self._process_count = jax.process_count()
+            except Exception:
+                self._process_count = 1
+        return self._process_count
+
+    @property
+    def is_writer(self):
+        """Single-writer protocol: only process 0 performs checkpoint IO
+        (data-parallel state is replicated — every process holds the same
+        values, so N writers would just race on the directory). In
+        sharded mode every process writes its own shard file; process 0
+        additionally owns the global manifest."""
+        return self.process_index == 0
 
     # -- save ---------------------------------------------------------------
+    def _resolve_sharded(self, flat):
+        if self._sharded is not None:
+            return bool(self._sharded)
+        return any(isinstance(v, jax.Array) and not v.is_fully_addressable
+                   for v in flat.values())
+
     def save(self, step, tree, block=False):
         """Snapshot `tree` (a dict of name -> array-like) at `step`.
 
         The device→host transfer happens here (values are frozen against
         further training); file IO runs on a background thread unless
-        async_save=False or block=True. On non-writer processes this is
-        a no-op (see the single-writer protocol in the module docstring).
+        async_save=False or block=True. In single-writer mode this is a
+        no-op on non-writer processes; in sharded mode (forced, or auto
+        when the tree holds non-fully-addressable arrays) EVERY process
+        copies out and writes only the shards it owns.
         """
+        self._raise_pending()
+        flat = _flatten(tree)
+        if self._resolve_sharded(flat):
+            os.makedirs(self.directory, exist_ok=True)
+            host, entries, gmeta = self._extract_shards(step, flat)
+            self.wait(_barrier=False)
+            if self.async_save and not block:
+                self._worker = threading.Thread(
+                    target=self._write_sharded,
+                    args=(step, host, entries, gmeta), daemon=True)
+                self._worker.start()
+            else:
+                self._write_sharded(step, host, entries, gmeta)
+            return
         if not self.is_writer:
             return
-        self._raise_pending()
 
         def own(v):
             # the async writer must OWN every buffer: np.asarray on a jax
@@ -137,7 +220,7 @@ class CheckpointManager:
                 return v
             return np.array(v)
 
-        host = {k: own(v) for k, v in _flatten(tree).items()}
+        host = {k: own(v) for k, v in flat.items()}
         self.wait(_barrier=False)  # one save at a time: bounded memory,
         if self.async_save and not block:  # no write races
             self._worker = threading.Thread(
@@ -149,16 +232,123 @@ class CheckpointManager:
     def _manifest_path(self, step):
         return os.path.join(self.directory, "ckpt-%d.manifest.json" % step)
 
-    def _write(self, step, host):
+    def _shard_basename(self, step, index=None):
+        return "ckpt-%d.shard%dof%d" % (
+            step, self.process_index if index is None else index,
+            self.process_count)
+
+    # -- sharded save: ownership plan + host extraction ---------------------
+    def _device_owner_fn(self, devices):
+        """Map a mesh device to the process that writes its shards.
+        Real multi-host: the device's own process. Emulated multi-host
+        (process_count overriding jax's): contiguous blocks of the
+        device list, so emulated host i stands in for the i-th slice of
+        a real pod."""
+        n = self.process_count
         try:
-            import io
-            import zipfile
-            final = os.path.join(self.directory, "ckpt-%d.npz" % step)
-            tmp = final + ".tmp-%d" % os.getpid()
-            with open(tmp, "wb") as f:
-                # npz written by hand: np.savez(**host) would collide with
-                # its own 'file'/'allow_pickle' parameter names for user
-                # keys, and we need the fd for fsync anyway
+            real = jax.process_count()
+        except Exception:
+            real = 1
+        if n == real:
+            return lambda d: d.process_index
+        order = {d: i for i, d in
+                 enumerate(sorted(devices, key=lambda d: d.id))}
+        ndev = len(order)
+        return lambda d: (order[d] * n) // ndev
+
+    def _extract_shards(self, step, flat):
+        """Host-copy every entry THIS process owns (synchronously — the
+        next train step donates the device buffers) and build the
+        per-host + global manifest metadata. The ownership plan is a
+        pure function of the tree's shardings, so every process computes
+        the same global plan without communicating:
+
+          * a mesh-sharded array's distinct logical shards each get
+            exactly one writer (the process holding that shard; replica
+            groups rotate deterministically for balance);
+          * fully-replicated / host-local leaves round-robin whole
+            arrays across processes, so checkpoint bytes land at
+            ~total/n per host instead of all on process 0.
+        """
+        me, n = self.process_index, self.process_count
+        host, entries, arrays = {}, {}, {}
+        mesh_axes = None
+        for seq, key in enumerate(sorted(flat)):
+            v = flat[key]
+            groups = imap = None
+            if isinstance(v, jax.Array):
+                sharding = getattr(v, "sharding", None)
+                if sharding is not None:
+                    mesh = getattr(sharding, "mesh", None)
+                    if mesh_axes is None and mesh is not None and \
+                            getattr(mesh, "shape", None):
+                        mesh_axes = {str(a): int(s)
+                                     for a, s in dict(mesh.shape).items()}
+                    try:
+                        imap = sharding.devices_indices_map(v.shape)
+                    except Exception:
+                        imap = None
+                    if imap and len(imap) > 1:
+                        groups = {}
+                        for d, idx in imap.items():
+                            groups.setdefault(_norm_index(idx, v.shape),
+                                              []).append(d)
+            if groups and len(groups) > 1:
+                spec = getattr(v.sharding, "spec", None)
+                idx_sorted = sorted(groups)
+                arrays[key] = {"shape": [int(s) for s in v.shape],
+                               "dtype": str(np.dtype(v.dtype)),
+                               "spec": None if spec is None else str(spec),
+                               "shards": len(idx_sorted)}
+                owner_of = self._device_owner_fn(list(imap.keys()))
+                local = None
+                for j, idx in enumerate(idx_sorted):
+                    devs = sorted(groups[idx], key=lambda d: d.id)
+                    # replicas of one logical shard rotate by (array,
+                    # shard) so replicated-over-an-axis state balances
+                    owner = owner_of(devs[(seq + j) % len(devs)])
+                    if owner != me:
+                        continue
+                    if local is None:
+                        local = {_norm_index(sh.index, v.shape): sh
+                                 for sh in v.addressable_shards}
+                    entry = "%s@s%d" % (key, j)
+                    host[entry] = np.array(local[idx].data)
+                    entries[entry] = {"key": key,
+                                      "index": [list(p) for p in idx]}
+            else:
+                dt = v.dtype if hasattr(v, "dtype") else np.asarray(v).dtype
+                arrays[key] = {"shape": [int(s) for s in np.shape(v)],
+                               "dtype": str(np.dtype(dt)),
+                               "spec": None, "shards": 1}
+                if seq % n == me:
+                    host[key] = np.array(v)
+                    entries[key] = {"key": key, "index": None}
+        gmeta = {"step": int(step), "format": "sharded",
+                 "process_count": n,
+                 "mesh": {"axes": mesh_axes or {}},
+                 "files": [self._shard_basename(step, i) + ".npz"
+                           for i in range(n)],
+                 "arrays": arrays,
+                 "note": "per-file sha256: each shard's .manifest.json "
+                         "sidecar certifies its own file"}
+        return host, entries, gmeta
+
+    # -- IO primitives (each publish operation retries transients) ----------
+    def _io_retry(self, fn):
+        from mxnet_tpu.utils import retry
+        return retry(fn, attempts=self.io_retries, backoff=0.05,
+                     jitter=0.5, retry_on=OSError)
+
+    def _write_npz(self, path, host):
+        import io
+        import zipfile
+
+        def go():
+            with open(path, "wb") as f:
+                # npz written by hand: np.savez(**host) would collide
+                # with its own 'file'/'allow_pickle' parameter names for
+                # user keys, and we need the fd for fsync anyway
                 with zipfile.ZipFile(f, "w", zipfile.ZIP_STORED) as z:
                     for k, v in host.items():
                         buf = io.BytesIO()
@@ -167,25 +357,68 @@ class CheckpointManager:
                         z.writestr(k + ".npy", buf.getvalue())
                 f.flush()
                 os.fsync(f.fileno())
-            digest = hashlib.sha256()
-            with open(tmp, "rb") as f:
-                for block in iter(lambda: f.read(1 << 20), b""):
-                    digest.update(block)
-            manifest = {"step": int(step),
-                        "file": os.path.basename(final),
-                        "size": os.path.getsize(tmp),
-                        "sha256": digest.hexdigest(),
-                        "arrays": sorted(host.keys())}
-            _chaos.maybe_kill_during_save(step)
-            os.replace(tmp, final)  # atomic publication
-            mtmp = self._manifest_path(step) + ".tmp-%d" % os.getpid()
-            with open(mtmp, "w") as f:
-                json.dump(manifest, f)
+        self._io_retry(go)
+
+    def _sha_size(self, path):
+        digest = hashlib.sha256()
+        with open(path, "rb") as f:
+            for block in iter(lambda: f.read(1 << 20), b""):
+                digest.update(block)
+        return digest.hexdigest(), os.path.getsize(path)
+
+    def _publish_json(self, obj, final_path):
+        tmp = final_path + ".tmp-%d" % os.getpid()
+
+        def go():
+            with open(tmp, "w") as f:
+                json.dump(obj, f)
                 f.flush()
                 os.fsync(f.fileno())
-            os.replace(mtmp, self._manifest_path(step))
+            os.replace(tmp, final_path)
+        self._io_retry(go)
+
+    def _write(self, step, host):
+        try:
+            final = os.path.join(self.directory, "ckpt-%d.npz" % step)
+            tmp = final + ".tmp-%d" % os.getpid()
+            self._write_npz(tmp, host)
+            sha, size = self._sha_size(tmp)
+            manifest = {"step": int(step),
+                        "file": os.path.basename(final),
+                        "size": size,
+                        "sha256": sha,
+                        "arrays": sorted(host.keys())}
+            _chaos.maybe_kill_during_save(step)
+            self._io_retry(lambda: os.replace(tmp, final))  # atomic publish
+            self._publish_json(manifest, self._manifest_path(step))
             # rename durability: the publication is only real once the
             # directory entry itself is on disk
+            _fsync_dir(self.directory)
+            _chaos.maybe_corrupt_checkpoint(step, final)
+            self._prune()
+        except Exception as e:  # surfaced on the next save()/wait()
+            with self._lock:
+                self._error = e
+
+    def _write_sharded(self, step, host, entries, gmeta):
+        try:
+            base = self._shard_basename(step)
+            final = os.path.join(self.directory, base + ".npz")
+            tmp = final + ".tmp-%d" % os.getpid()
+            self._write_npz(tmp, host)
+            sha, size = self._sha_size(tmp)
+            _chaos.maybe_kill_during_save(step)
+            self._io_retry(lambda: os.replace(tmp, final))
+            manifest = {"step": int(step), "file": base + ".npz",
+                        "size": size, "sha256": sha,
+                        "process_index": self.process_index,
+                        "process_count": self.process_count,
+                        "entries": entries}
+            self._publish_json(manifest,
+                               os.path.join(self.directory,
+                                            base + ".manifest.json"))
+            if self.is_writer:
+                self._publish_json(gmeta, self._manifest_path(step))
             _fsync_dir(self.directory)
             _chaos.maybe_corrupt_checkpoint(step, final)
             self._prune()
@@ -196,10 +429,23 @@ class CheckpointManager:
     def _prune(self):
         steps = sorted(self.all_steps())
         for s in steps[:-self.keep] if self.keep else []:
-            for path in (os.path.join(self.directory, "ckpt-%d.npz" % s),
-                         self._manifest_path(s)):
+            names = [self._shard_basename(s) + ".npz",
+                     self._shard_basename(s) + ".manifest.json"]
+            if self.is_writer:
+                names += ["ckpt-%d.npz" % s,
+                          os.path.basename(self._manifest_path(s))]
+                # the writer also sweeps shard files of OTHER process
+                # counts (an elastic relaunch must not leak the old
+                # world's files forever)
+                prefix = "ckpt-%d.shard" % s
                 try:
-                    os.remove(path)
+                    names += [nm for nm in os.listdir(self.directory)
+                              if nm.startswith(prefix)]
+                except OSError:
+                    pass
+            for nm in set(names):
+                try:
+                    os.remove(os.path.join(self.directory, nm))
                 except OSError:
                     pass
 
@@ -231,15 +477,15 @@ class CheckpointManager:
 
     # -- restore ------------------------------------------------------------
     def all_steps(self):
-        out = []
+        out = set()
         try:
             names = os.listdir(self.directory)
         except OSError:
-            return out
+            return []
         for name in names:
-            m = _CKPT_RE.match(name)
+            m = _CKPT_RE.match(name) or _SHARD_RE.match(name)
             if m:
-                out.append(int(m.group(1)))
+                out.add(int(m.group(1)))
         return sorted(out)
 
     def latest_step(self):
@@ -258,39 +504,207 @@ class CheckpointManager:
             manifest = json.load(f)  # corrupt JSON -> ValueError
         if not isinstance(manifest, dict) or "sha256" not in manifest:
             raise ValueError("manifest %s is missing the checksum" % mpath)
-        size = os.path.getsize(path)
+        sha, size = self._sha_size(path)
         if manifest.get("size") not in (None, size):
             raise ValueError(
                 "checkpoint ckpt-%d.npz is %d bytes but its manifest "
                 "recorded %d — truncated write" % (step, size,
                                                    manifest["size"]))
-        digest = hashlib.sha256()
-        with open(path, "rb") as f:
-            for block in iter(lambda: f.read(1 << 20), b""):
-                digest.update(block)
-        if digest.hexdigest() != manifest["sha256"]:
+        if sha != manifest["sha256"]:
             raise ValueError("checkpoint ckpt-%d.npz fails its manifest "
                              "sha256 — corrupt" % step)
 
+    def global_manifest(self, step):
+        """The step's global manifest dict, or None when absent. A
+        sharded step's manifest carries format/process_count/mesh/arrays
+        (the metadata elastic resume validates against)."""
+        mpath = self._manifest_path(step)
+        if not os.path.exists(mpath):
+            return None
+        with open(mpath) as f:
+            g = json.load(f)  # corrupt JSON -> ValueError
+        if not isinstance(g, dict):
+            raise ValueError("manifest %s is not an object" % mpath)
+        return g
+
+    def _verify_shard(self, path):
+        """Integrity gate for one shard file: its sidecar manifest must
+        exist and its size + sha256 must match. Returns the manifest."""
+        mpath = path[:-len(".npz")] + ".manifest.json"
+        if not os.path.exists(mpath):
+            raise ValueError("shard %s has no sidecar manifest" % path)
+        with open(mpath) as f:
+            m = json.load(f)
+        if not isinstance(m, dict) or "sha256" not in m:
+            raise ValueError("shard manifest %s is missing the checksum"
+                             % mpath)
+        size = os.path.getsize(path)
+        if m.get("size") not in (None, size):
+            raise ValueError("shard %s is %d bytes but its manifest "
+                             "recorded %d — truncated write"
+                             % (path, size, m["size"]))
+        sha, _ = self._sha_size(path)
+        if sha != m["sha256"]:
+            raise ValueError("shard %s fails its manifest sha256 — corrupt"
+                             % path)
+        return m
+
+    def _verify_step(self, step):
+        """Raise ValueError/OSError when `step` is not fully intact ON
+        THIS HOST'S VIEW of the directory: for a sharded step EVERY
+        shard file in the global manifest's roster must exist and verify
+        (a host that died mid-save leaves the step incomplete — it must
+        not be chosen), for a single-file step the existing manifest
+        check applies."""
+        g = self.global_manifest(step)
+        if g is not None and g.get("format") == "sharded":
+            for fname in g.get("files", []):
+                path = os.path.join(self.directory, fname)
+                if not os.path.exists(path):
+                    raise ValueError(
+                        "sharded checkpoint step %d is missing %s — "
+                        "incomplete save (a host died before publishing)"
+                        % (step, fname))
+                self._verify_shard(path)
+            return
+        path = os.path.join(self.directory, "ckpt-%d.npz" % step)
+        if not os.path.exists(path):
+            raise ValueError("step %d has shard files but no global "
+                             "manifest — the manifest writer never "
+                             "published" % step)
+        self._verify_manifest(step, path)
+
+    def intact_steps(self):
+        """Steps whose checkpoints fully verify on this host (sharded:
+        every shard file present + checksummed). Corrupt/incomplete
+        steps are skipped with a warning."""
+        import warnings
+        import zipfile
+        out = []
+        for step in self.all_steps():
+            try:
+                self._verify_step(step)
+                out.append(step)
+            except (OSError, ValueError, zipfile.BadZipFile, EOFError,
+                    KeyError) as e:
+                warnings.warn("skipping corrupt checkpoint step %d: %s"
+                              % (step, e))
+        return out
+
+    def _common_steps(self, steps):
+        """Multi-process agreement: the set of steps intact on EVERY
+        host. Without this, each host independently falls back past its
+        own corrupt files and different hosts can deserialize different
+        'latest intact' steps — mixed-step replicas. No-op when jax runs
+        single-process (the emulated-multi-host drill shares one
+        directory, so per-host views already agree)."""
+        try:
+            nproc = jax.process_count()
+        except Exception:
+            nproc = 1
+        if nproc <= 1:
+            return list(steps)
+        from jax.experimental import multihost_utils
+        mine = np.asarray(sorted(steps), np.int64)
+        width = int(np.asarray(multihost_utils.process_allgather(
+            np.int64(mine.size))).max())
+        pad = np.full(max(width, 1), -1, np.int64)
+        pad[:mine.size] = mine
+        rows = np.asarray(multihost_utils.process_allgather(pad))
+        common = set(int(s) for s in rows[0] if s >= 0)
+        for r in rows[1:]:
+            common &= set(int(s) for s in r if s >= 0)
+        return sorted(common)
+
+    def _restore_sharded(self, step, g):
+        """Reassemble global logical arrays from whichever shard files
+        cover them. Mesh-shape agnostic: the shard index ranges recorded
+        in the per-host manifests are global coordinates, so a 4-host
+        checkpoint restores under 8 hosts (or 1) identically — the
+        caller re-places the arrays under its own live shardings."""
+        arrays = g.get("arrays", {})
+        out, covered = {}, {}
+        for fname in g.get("files", []):
+            path = os.path.join(self.directory, fname)
+            if not os.path.exists(path):
+                raise ValueError(
+                    "sharded checkpoint step %d is missing %s — "
+                    "incomplete save (a host died before publishing)"
+                    % (step, fname))
+            m = self._verify_shard(path)
+            archive = np.load(path, allow_pickle=False)
+            for entry, info in m.get("entries", {}).items():
+                key = info["key"]
+                data = archive[entry]
+                if info.get("index") is None:
+                    out[key] = data
+                    continue
+                meta = arrays.get(key)
+                if meta is None:
+                    raise ValueError("shard entry %r is not in the "
+                                     "global manifest" % entry)
+                if key not in out:
+                    out[key] = np.empty([int(s) for s in meta["shape"]],
+                                        np.dtype(meta["dtype"]))
+                    covered[key] = 0
+                slices = tuple(slice(int(a), int(b))
+                               for a, b in info["index"])
+                out[key][slices] = data
+                covered[key] += int(data.size)
+        for key, meta in arrays.items():
+            if key not in out:
+                raise ValueError("sharded checkpoint step %d never wrote "
+                                 "%r — shard files incomplete" % (step, key))
+            if int(meta.get("shards", 1)) > 1:
+                want = int(np.prod(meta["shape"])) if meta["shape"] else 1
+                if covered.get(key, 0) != want:
+                    raise ValueError(
+                        "array %r covered %d of %d elements — shard "
+                        "files incomplete" % (key, covered.get(key, 0),
+                                              want))
+        return _unflatten(out)
+
     def restore(self, step):
+        g = self.global_manifest(step)
+        if g is not None and g.get("format") == "sharded":
+            return self._restore_sharded(step, g)
         path = os.path.join(self.directory, "ckpt-%d.npz" % step)
         self._verify_manifest(step, path)
         archive = np.load(path, allow_pickle=False)
         return _unflatten({k: archive[k] for k in archive.files})
 
     def restore_latest(self):
-        """(step, tree) of the newest intact checkpoint, or None. A
-        corrupt file or manifest falls back (with a warning) to the
-        previous one — only corruption-shaped errors are treated as
-        fallback-able, so a systematic restore bug cannot silently
-        become a cold start."""
+        """(step, tree) of the newest checkpoint intact on EVERY host,
+        or None. A corrupt file or manifest falls back (with a warning)
+        to the previous one — only corruption-shaped errors are treated
+        as fallback-able, so a systematic restore bug cannot silently
+        become a cold start.
+
+        Multi-process, the per-host intact-step sets are allgathered
+        and intersected BEFORE deserializing, so hosts can never fall
+        back past *different* corrupt checkpoints onto different steps;
+        the up-front verification of every retained step is the price
+        of that agreement without a coordinator. Single-process (and
+        the emulated-multi-host drill, whose hosts share one directory
+        view), verification stays LAZY newest-first — `restore()`
+        itself is the integrity gate, so the hot relaunch path reads
+        each candidate once."""
         import warnings
         import zipfile
-        for step in reversed(self.all_steps()):
+        try:
+            nproc = jax.process_count()
+        except Exception:
+            nproc = 1
+        if nproc > 1:
+            candidates = self._common_steps(self.intact_steps())
+        else:
+            candidates = self.all_steps()
+        for step in reversed(candidates):
             try:
                 return step, self.restore(step)
-            except (OSError, ValueError, zipfile.BadZipFile, EOFError) as e:
-                warnings.warn("skipping corrupt checkpoint ckpt-%d.npz: %s"
+            except (OSError, ValueError, zipfile.BadZipFile, EOFError,
+                    KeyError) as e:
+                warnings.warn("skipping corrupt checkpoint step %d: %s"
                               % (step, e))
                 continue
         return None

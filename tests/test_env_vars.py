@@ -88,3 +88,37 @@ def test_cpu_worker_nthreads(monkeypatch):
              write_vars=(v,))
     eng.wait_all()
     assert token["done"]
+
+
+def test_sharded_update_env_default(monkeypatch, tmp_path):
+    """MXNET_SHARDED_UPDATE=1 flips TrainStep's ZeRO-1 default (and
+    implies sharded optimizer-state placement); explicit arg wins."""
+    from mxnet_tpu.parallel.trainer import TrainStep
+    assert not TrainStep(None, None)._sharded_update
+    monkeypatch.setenv("MXNET_SHARDED_UPDATE", "1")
+    step = TrainStep(None, None)
+    assert step._sharded_update and step._shard_opt
+    assert not TrainStep(None, None, sharded_update=False)._sharded_update
+    monkeypatch.delenv("MXNET_SHARDED_UPDATE")
+    assert not TrainStep(None, None)._sharded_update
+
+
+def test_elastic_dp_policy_env_default(monkeypatch, tmp_path):
+    """MXNET_ELASTIC_DP_POLICY feeds ResilientLoop's elastic_dp default;
+    unknown values fail loudly."""
+    from mxnet_tpu.parallel.resilient import ResilientLoop
+    from mxnet_tpu.parallel.trainer import TrainStep
+    from mxnet_tpu.utils.recovery import CheckpointManager
+
+    def loop(**kw):
+        return ResilientLoop(TrainStep(None, None),
+                             CheckpointManager(str(tmp_path)),
+                             watch_preemption=False, verbose=False, **kw)
+
+    assert loop().elastic_dp == "raise"
+    monkeypatch.setenv("MXNET_ELASTIC_DP_POLICY", "rescale")
+    assert loop().elastic_dp == "rescale"
+    assert loop(elastic_dp="raise").elastic_dp == "raise"
+    monkeypatch.setenv("MXNET_ELASTIC_DP_POLICY", "explode")
+    with pytest.raises(ValueError):
+        loop()

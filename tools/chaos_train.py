@@ -28,14 +28,38 @@ Worker mode (``--worker``) is the training loop itself: build the net,
 fault behavior comes from the environment — the worker has no
 fault-specific code, which is the point.
 
+Multi-host mode (``--multihost``) is the POD-SCALE drill: N emulated
+hosts (subprocesses, each a single-process jax CPU runtime with
+`XLA_FLAGS=--xla_force_host_platform_device_count=D` virtual devices —
+the jax.distributed-free local fallback) train the same dp mesh with the
+ZeRO-1 sharded update and PER-HOST SHARDED checkpoints into one shared
+directory. The drill then
+
+  a. SIGKILLs one host mid-run (``MXNET_CHAOS_SIGKILL_AT``): no drain,
+     no checkpoint — its shard files simply stop; the survivors are
+     preempted (pod teardown) and their later per-host saves leave
+     INCOMPLETE steps that restore must refuse;
+  b. relaunches the SAME world shape: every host restores the newest
+     step whose shards are complete on all hosts, and the finished run
+     is bit-identical to an undisturbed reference;
+  c. relaunches a SMALLER world (fewer hosts AND a smaller dp mesh) from
+     the same checkpoint: elastic resume reassembles the global arrays
+     from the old world's shard files, reshards onto the new mesh, and
+     — with the global batch size held constant — finishes
+     loss-curve-identical (equal up to collective reduction order).
+
 Usage:
     python tools/chaos_train.py                  # LeNet drill
     python tools/chaos_train.py --net mlp        # fast CI config
+    python tools/chaos_train.py --multihost      # pod-scale drill
 """
 import argparse
 import os
+import re
+import signal
 import subprocess
 import sys
+import tempfile
 
 import numpy as np
 
@@ -69,6 +93,16 @@ def batch_for(kind, step, batch_size=8):
 
 
 def worker(args):
+    if args.devices:
+        # must land BEFORE the first jax import (backend reads it once)
+        flags = os.environ.get("XLA_FLAGS", "")
+        want = "--xla_force_host_platform_device_count=%d" % args.devices
+        if "xla_force_host_platform_device_count" in flags:
+            flags = re.sub(r"--xla_force_host_platform_device_count=\d+",
+                           want, flags)
+        else:
+            flags = (flags + " " + want).strip()
+        os.environ["XLA_FLAGS"] = flags
     import mxnet_tpu as mx
     from mxnet_tpu import gluon
     from mxnet_tpu.parallel import ResilientLoop, TrainStep
@@ -80,9 +114,28 @@ def worker(args):
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
     x0, y0 = batch_for(args.net, 0)
     net(mx.nd.array(x0))  # materialize deferred shapes before TrainStep
+    mesh = None
+    if args.devices:
+        import jax
+        from mxnet_tpu.parallel.mesh import build_mesh
+        mesh = build_mesh({"dp": args.devices},
+                          jax.devices()[:args.devices])
     step_fn = TrainStep(net, loss_fn, "adam", {"learning_rate": 0.01},
-                        guard=True)
-    mgr = CheckpointManager(args.ckpt_dir, keep=3)
+                        guard=True, mesh=mesh,
+                        sharded_update=bool(mesh))
+    # hosts > 0 = one emulated host of a pod: per-host sharded
+    # checkpoints into the SHARED directory (each host writes only the
+    # shards it owns; host 0 publishes the global manifest). Cadence
+    # saves publish SYNCHRONOUSLY in pod mode so the drill's SIGKILL
+    # step deterministically decides which steps are complete — the
+    # async kill-during-save race has its own dedicated drills
+    # (MXNET_CHAOS_KILL_SAVE, test_kill_during_save_subprocess).
+    mgr = CheckpointManager(args.ckpt_dir, keep=3,
+                            async_save=not args.hosts,
+                            sharded=True if args.hosts else None,
+                            process_index=args.host_index
+                            if args.hosts else None,
+                            process_count=args.hosts or None)
     loop = ResilientLoop(step_fn, mgr, save_every=args.save_every,
                          policy=args.policy, rollback_after=1,
                          lr_shrink=1.0)
@@ -104,19 +157,34 @@ def worker(args):
     return 0
 
 
-def run_worker(args, ckpt_dir, chaos=None, tag=""):
-    env = {k: v for k, v in os.environ.items()
-           if not k.startswith("MXNET_CHAOS_")}
-    env.setdefault("JAX_PLATFORMS", "cpu")
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env.update(chaos or {})
+def _worker_cmd(args, ckpt_dir, host_index=None, hosts=None):
     cmd = [sys.executable, os.path.abspath(__file__), "--worker",
            "--net", args.net, "--steps", str(args.steps),
            "--save-every", str(args.save_every),
            "--policy", args.policy, "--ckpt-dir", ckpt_dir]
-    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
-                          timeout=600)
+    if hosts:
+        cmd += ["--hosts", str(hosts), "--host-index", str(host_index),
+                "--devices", str(args.devices)]
+    return cmd
+
+
+def _worker_env(chaos=None):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("MXNET_CHAOS_")}
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    # the worker re-pins the virtual-device count itself from --devices;
+    # drop any inherited value so a pytest parent's conftest flag can't
+    # leak a different mesh size into the drill
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(chaos or {})
+    return env
+
+
+def run_worker(args, ckpt_dir, chaos=None, tag=""):
+    proc = subprocess.run(_worker_cmd(args, ckpt_dir), env=_worker_env(chaos),
+                          capture_output=True, text=True, timeout=600)
     print("-- %s: exit %d" % (tag or "worker", proc.returncode))
     for line in proc.stdout.splitlines():
         if line.startswith(("FINAL", "[resilient]")):
@@ -132,8 +200,150 @@ def final_line(proc):
     return lines[-1] if lines else None
 
 
+class _Host:
+    """One emulated pod host: a Popen + its captured stdout."""
+
+    def __init__(self, args, ckpt_dir, host_index, hosts, chaos=None):
+        self.index = host_index
+        self.out = tempfile.NamedTemporaryFile(
+            mode="w+", prefix="chaos_host%d_" % host_index, suffix=".log",
+            delete=False)
+        self.proc = subprocess.Popen(
+            _worker_cmd(args, ckpt_dir, host_index, hosts),
+            env=_worker_env(chaos), stdout=self.out,
+            stderr=subprocess.STDOUT, text=True)
+
+    def wait(self, timeout=600):
+        rc = self.proc.wait(timeout=timeout)
+        self.out.flush()
+        self.out.seek(0)
+        self.stdout = self.out.read()
+        self.out.close()
+        try:
+            os.unlink(self.out.name)
+        except OSError:
+            pass
+        return rc
+
+    def sigterm(self):
+        self.proc.send_signal(signal.SIGTERM)
+
+    def report(self, tag):
+        print("-- %s: exit %s" % (tag, self.proc.returncode))
+        for line in self.stdout.splitlines():
+            if line.startswith(("FINAL", "[resilient]")):
+                print("   host%d %s" % (self.index, line))
+
+
+def _parse_final(line):
+    m = re.search(r"step=(\d+) loss=([-\d.eE]+) hash=([-\d.eE]+)", line or "")
+    assert m, "no FINAL line: %r" % (line,)
+    return int(m.group(1)), float(m.group(2)), float(m.group(3))
+
+
+def _final_of(host):
+    lines = [l for l in host.stdout.splitlines() if l.startswith("FINAL")]
+    return lines[-1] if lines else None
+
+
+def multihost(args):
+    """The pod-scale drill (see the module docstring, Multi-host mode)."""
+    import shutil
+    base = args.work_dir or tempfile.mkdtemp(prefix="chaos_pod_")
+    clean_dir = os.path.join(base, "clean")
+    fault_dir = os.path.join(base, "faulted")
+    elastic_dir = os.path.join(base, "elastic")
+    hosts, devices = args.hosts or 2, args.devices
+    k_kill = (args.steps // 2) + 1          # off the save cadence
+    if k_kill % args.save_every == 0:
+        k_kill += 1
+    print("== multi-host chaos drill: %s, %d steps, save every %d, "
+          "%d hosts x %d virtual devices (dp mesh, ZeRO-1 sharded "
+          "update, per-host sharded checkpoints); SIGKILL host %d at "
+          "step %d" % (args.net, args.steps, args.save_every, hosts,
+                       devices, hosts - 1, k_kill))
+
+    # 1. undisturbed reference: one host over the SAME dp mesh (emulated
+    # hosts are trajectory replicas — IO partitioning is their only
+    # difference, so one clean host pins the whole pod's trajectory)
+    ref = _Host(args, clean_dir, 0, 1)
+    rc = ref.wait()
+    ref.report("clean reference")
+    assert rc == 0, "clean run failed:\n" + ref.stdout[-2000:]
+    want = _final_of(ref)
+    assert want is not None
+
+    # 2. the pod, one host dying hard mid-run. The emulated hosts do not
+    # step in lockstep (no real cross-host collectives in the local
+    # fallback), so the pod-teardown preemption is chaos-armed in each
+    # survivor (a real SIGTERM, delivered at a deterministic step AFTER
+    # the victim died) instead of racing an orchestrator-sent signal
+    # against the survivors' progress. The survivors' drain checkpoints
+    # land at a step the dead host never sharded -> incomplete, and the
+    # relaunch must refuse it.
+    k_drain = k_kill + 2
+    crew = [_Host(args, fault_dir, i, hosts,
+                  chaos={"MXNET_CHAOS_SIGKILL_AT": str(k_kill)}
+                  if i == hosts - 1 else
+                  {"MXNET_CHAOS_SIGTERM_AT": str(k_drain)})
+            for i in range(hosts)]
+    victim = crew[-1]
+    rc = victim.wait()
+    victim.report("fault: SIGKILL host %d @%d" % (hosts - 1, k_kill))
+    assert rc == -signal.SIGKILL, "expected SIGKILL death, got %r" % rc
+    from mxnet_tpu.parallel.resilient import EXIT_PREEMPTED
+    for h in crew[:-1]:
+        rc = h.wait()
+        h.report("survivor host %d preempted @%d" % (h.index, k_drain))
+        assert rc == EXIT_PREEMPTED, \
+            "survivor did not drain cleanly (%r):\n%s" % (rc,
+                                                          h.stdout[-2000:])
+
+    shutil.copytree(fault_dir, elastic_dir)   # snapshot for leg 4
+
+    # 3. relaunch, SAME world shape: all hosts agree on the newest step
+    # whose shards are complete everywhere, resume step-exactly, and the
+    # finished pod is bit-identical to the undisturbed reference
+    crew = [_Host(args, fault_dir, i, hosts) for i in range(hosts)]
+    finals = []
+    for h in crew:
+        rc = h.wait()
+        h.report("relaunch host %d" % h.index)
+        assert rc == 0, "relaunch failed:\n" + h.stdout[-2000:]
+        assert "resumed from step" in h.stdout, "host %d cold-started" \
+            % h.index
+        finals.append(_final_of(h))
+    print("== clean:    %s" % want)
+    for i, got in enumerate(finals):
+        print("== host %d:  %s" % (i, got))
+        assert got == want, "host %d diverged from the clean run" % i
+    print("== same-shape relaunch: bit-identical on all %d hosts" % hosts)
+
+    # 4. ELASTIC relaunch: fewer hosts AND a smaller mesh (dp halves,
+    # global batch constant -> per-chip batch doubles). The single
+    # survivor reassembles the old world's shard files into global
+    # arrays, reshards, and finishes loss-curve-identical (equal up to
+    # collective reduction order).
+    el_args = argparse.Namespace(**vars(args))
+    el_args.devices = max(1, devices // 2)
+    el = _Host(el_args, elastic_dir, 0, 1)
+    rc = el.wait()
+    el.report("elastic relaunch (1 host x %d devices)" % el_args.devices)
+    assert rc == 0, "elastic relaunch failed:\n" + el.stdout[-2000:]
+    assert "resumed from step" in el.stdout, "elastic relaunch cold-started"
+    s_w, l_w, h_w = _parse_final(want)
+    s_e, l_e, h_e = _parse_final(_final_of(el))
+    print("== elastic:  %s" % _final_of(el))
+    assert s_e == s_w
+    assert abs(l_e - l_w) <= 5e-4, (l_w, l_e)
+    assert abs(h_e - h_w) <= 1e-3 * max(1.0, abs(h_w)), (h_w, h_e)
+    print("== OK: dead host survived; same-shape resume bit-identical; "
+          "elastic resume (dp %d -> %d) loss-curve-identical"
+          % (devices, el_args.devices))
+    return 0
+
+
 def orchestrate(args):
-    import tempfile
     from mxnet_tpu.parallel.resilient import EXIT_PREEMPTED
     base = args.work_dir or tempfile.mkdtemp(prefix="chaos_train_")
     clean_dir = os.path.join(base, "clean")
@@ -177,16 +387,31 @@ def orchestrate(args):
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--multihost", action="store_true",
+                    help="pod-scale drill: emulated hosts, sharded "
+                         "checkpoints, SIGKILL one host, elastic resume")
     ap.add_argument("--net", choices=("lenet", "mlp"), default="lenet")
     ap.add_argument("--steps", type=int, default=24)
     ap.add_argument("--save-every", type=int, default=4)
     ap.add_argument("--policy", default="rollback")
     ap.add_argument("--ckpt-dir", default="")
     ap.add_argument("--work-dir", default="")
+    ap.add_argument("--hosts", type=int, default=0,
+                    help="emulated pod size (worker: my process_count)")
+    ap.add_argument("--host-index", type=int, default=0)
+    ap.add_argument("--devices", type=int, default=0,
+                    help="virtual devices per host (dp mesh width); 0 = "
+                         "no mesh")
     args = ap.parse_args()
     if args.worker:
         assert args.ckpt_dir, "--worker needs --ckpt-dir"
         return worker(args)
+    if args.multihost:
+        if not args.devices:
+            args.devices = 4
+        if not args.hosts:
+            args.hosts = 2
+        return multihost(args)
     return orchestrate(args)
 
 
