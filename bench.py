@@ -956,6 +956,16 @@ def bench_serving(smoke, dtype, device_kind, batch=None):
     # so TTFT percentiles measure the steady-state path
     warm = eng.start(list(prompts[0]), max_new=2)
     eng.release(warm)
+    # telemetry histograms ride the emitted line (the `telemetry` field
+    # added by _run_configs): full TTFT/step distributions, not just the
+    # p50/p95 the headline carries
+    from mxnet_tpu import telemetry as _telemetry
+    h_ttft = _telemetry.histogram(
+        "serving_bench_ttft_seconds",
+        help="per-request time to first token (bench harness)")
+    h_step = _telemetry.histogram(
+        "serving_bench_decode_step_seconds",
+        help="per decode step, synchronous host timing (bench harness)")
     ttft_s = []
     seqs = []
     t0 = time.perf_counter()
@@ -963,12 +973,15 @@ def bench_serving(smoke, dtype, device_kind, batch=None):
         t1 = time.perf_counter()
         seqs.append(eng.start(list(p), max_new=gen + 1))
         ttft_s.append(time.perf_counter() - t1)
+        h_ttft.observe(ttft_s[-1])
     t_prefill = time.perf_counter() - t0
     eng.decode_step(seqs)  # decode-path compile + warmup
     steps = 0
     t0 = time.perf_counter()
     for _ in range(gen - 1):
+        t1 = time.perf_counter()
         eng.decode_step(seqs)
+        h_step.observe(time.perf_counter() - t1)
         steps += 1
     # the loop runs synchronous host steps; the final per-step readback
     # already forces completion, no extra sync needed
@@ -1170,6 +1183,26 @@ _CONFIGS = [
 ]                                   # final stdout JSON line
 
 
+def _telemetry_config_snapshot():
+    """Compact view of the process-global telemetry registry for ONE
+    config: histograms as count/mean/p50/p95/p99 (the step-time/TTFT
+    distributions the means on the line can't carry), counters/gauges
+    as values. Resets the registry afterwards so configs don't bleed
+    into each other's lines. Returns None when nothing was recorded."""
+    from mxnet_tpu import telemetry
+    snap = telemetry.snapshot()
+    out = {}
+    for name, m in snap["metrics"].items():
+        if m["kind"] == "histogram":
+            if m["count"]:
+                out[name] = {k: m[k] for k in
+                             ("count", "mean", "p50", "p95", "p99")}
+        elif m["value"]:
+            out[name] = m["value"]
+    telemetry.default_registry().reset()
+    return out or None
+
+
 def _run_configs(smoke):
     dtype = os.environ.get("BENCH_DTYPE",
                            "float32" if smoke else "bfloat16")
@@ -1217,6 +1250,9 @@ def _run_configs(smoke):
                 r = {"metric": name + "_error", "value": None, "unit": "",
                      "error": "%s: %s" % (type(e).__name__, e), **kw}
             r.update(device=device_kind, dtype=dtype)
+            snap = _telemetry_config_snapshot()
+            if snap:
+                r["telemetry"] = snap
             results.append(r)
             print(json.dumps(r))
             sys.stdout.flush()

@@ -91,12 +91,20 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    from .pallas_fused import _cost
+
     BH, T, D = q.shape
     Tk = k.shape[1]
     nq = T // block_q
     nk = Tk // block_k
     kern = functools.partial(_kernel, scale=scale, causal=causal,
                              block_q=block_q, block_k=block_k, nk=nk)
+    itemsize = q.dtype.itemsize
+    # declared cost (house invariant: every pallas_call under ops/ says
+    # what the TPU cost model should count for the opaque custom call):
+    # 2 MACs/element for each of the QK^T and PV matmuls; bytes = q/out
+    # streamed once per (b, i) row, K/V blocks re-walked once per query
+    # row (the j grid), plus the f32 softmax stats
     return pl.pallas_call(
         kern,
         out_shape=[jax.ShapeDtypeStruct((BH, T, D), q.dtype),
@@ -119,6 +127,11 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
         interpret=interpret,
+        **_cost(4 * BH * T * Tk * D,
+                2 * BH * T * D * itemsize
+                + 2 * BH * nq * Tk * D * itemsize
+                + 2 * BH * T * 4,
+                transcendentals=BH * T * Tk),
     )(q, k, v)
 
 

@@ -66,6 +66,7 @@ import warnings
 import numpy as np
 
 from ..base import MXNetError
+from .. import telemetry
 
 #: distinct exit code meaning "preemption drained cleanly — relaunch me".
 #: Chosen outside the usual 0/1/2 and shell-builtin ranges.
@@ -141,11 +142,20 @@ class PreemptionWatcher:
             os._exit(EXIT_PREEMPTED)
         self.signal_time = time.monotonic()
         self._event.set()
+        # arm the grace deadline BEFORE anything else in the handler: if
+        # the flight dump itself wedges (filesystem stall), the timer
+        # still force-exits inside the grace window
         if self.grace_secs and self.grace_secs > 0:
             self._timer = threading.Timer(self.grace_secs, os._exit,
                                           args=(EXIT_PREEMPTED,))
             self._timer.daemon = True
             self._timer.start()
+        # black box next, before the drain even starts: a drain that
+        # wedges (and gets force-exited by the grace timer) still leaves
+        # a record of the last N spans before the signal
+        telemetry.flight().record("fault", "train.preemption_signal",
+                                  signum=signum)
+        telemetry.flight().dump("sigterm")
 
     @property
     def triggered(self):
@@ -242,6 +252,33 @@ class ResilientLoop:
                     "the step already compiled without the guard — "
                     "construct the TrainStep with guard=True or build the "
                     "ResilientLoop before the first step" % policy)
+        # telemetry: the training loop's standing instruments (process-
+        # global registry — one training loop per process)
+        reg = telemetry.default_registry()
+        self._m_step = reg.histogram(
+            "train_step_seconds",
+            help="host-observed train step time (dispatch + boundary)")
+        self._m_data_wait = reg.histogram(
+            "train_data_wait_seconds",
+            help="time the loop waited on the data pipeline per batch")
+        self._m_samples = reg.gauge(
+            "train_samples_per_sec",
+            help="batch items per second, last step")
+        self._m_tokens = reg.gauge(
+            "train_tokens_per_sec",
+            help="tokens per second, last step (rank-2 inputs only)")
+        self._m_gnorm = reg.gauge(
+            "train_grad_norm",
+            help="global gradient norm, last guarded step")
+        self._m_bad = reg.counter(
+            "train_bad_steps_total", flight=True,
+            help="steps dropped by the NaN/Inf guard")
+        self._m_rollbacks = reg.counter(
+            "train_rollbacks_total", flight=True,
+            help="checkpoint rollbacks taken by the bad-step policy")
+        self._m_preempt = reg.counter(
+            "train_preemptions_total", flight=True,
+            help="preemption notices drained to a checkpoint")
         # fault-lifecycle counters (part of the checkpoint so a relaunch
         # keeps the history — e.g. rollback LR shrink must persist)
         self.consecutive_bad = 0
@@ -389,8 +426,12 @@ class ResilientLoop:
         # this host owns; the manager's host copies happen synchronously
         # inside save(), before the next (donating) step can run. In
         # single-writer mode non-writers return before copying anything.
-        self._manager.save(self._step.t, self.state_dict(device=True),
-                           block=block)
+        # (The span times host capture + hand-off; the write itself is
+        # timed inside the manager, async or not.)
+        with telemetry.span("train.checkpoint_publish", category="train",
+                            step=self._step.t, block=block):
+            self._manager.save(self._step.t, self.state_dict(device=True),
+                               block=block)
 
     # -- the lifecycle ------------------------------------------------------
     @property
@@ -409,21 +450,38 @@ class ResilientLoop:
         cursor one batch ahead and silently drop that batch on
         resume)."""
         from ..utils import chaos as _chaos
-        loss = self._step(x, y)
-        t = self._step.t
-        ok = True
-        if self.policy != "off":
-            ok = bool(np.asarray(self._step.last_step_ok))
-            if ok:
-                self.consecutive_bad = 0
-            else:
-                self._on_bad_step(t)
-        # cadence save only on GOOD steps: after a bad step (or a
-        # rollback) the state no longer corresponds to `t`, and a
-        # checkpoint labeled with the wrong step poisons every later
-        # restore
-        if ok and self.save_every and t % self.save_every == 0:
-            self.save()
+        t_wall = time.perf_counter()
+        with telemetry.span("train.step", category="train",
+                            step=self._step.t + 1):
+            with telemetry.span("train.device_step", category="train",
+                                step=self._step.t + 1):
+                loss = self._step(x, y)
+            t = self._step.t
+            ok = True
+            if self.policy != "off":
+                ok = bool(np.asarray(self._step.last_step_ok))
+                if ok:
+                    self.consecutive_bad = 0
+                else:
+                    self._on_bad_step(t)
+            dt = time.perf_counter() - t_wall
+            self._m_step.observe(dt)
+            shape = getattr(x, "shape", None)
+            if shape and dt > 0:
+                self._m_samples.set(shape[0] / dt)
+                if len(shape) == 2:
+                    # token-id matrices (N, T) / time-major (T, N): the
+                    # element count is the token count either way
+                    self._m_tokens.set(shape[0] * shape[1] / dt)
+            if self.policy != "off":
+                self._m_gnorm.set(
+                    float(np.asarray(self._step.last_grad_norm)))
+            # cadence save only on GOOD steps: after a bad step (or a
+            # rollback) the state no longer corresponds to `t`, and a
+            # checkpoint labeled with the wrong step poisons every later
+            # restore
+            if ok and self.save_every and t % self.save_every == 0:
+                self.save()
         _chaos.maybe_sigterm(t)
         self._check_preempt()
         # after the preemption drain: a SIGKILL'd host gets no drain at
@@ -434,6 +492,7 @@ class ResilientLoop:
     def _on_bad_step(self, t):
         self.bad_steps += 1
         self.consecutive_bad += 1
+        self._m_bad.inc(step=t)
         gnorm = float(np.asarray(self._step.last_grad_norm))
         if self.verbose:
             print("[resilient] bad step %d (non-finite loss/grads, "
@@ -452,6 +511,7 @@ class ResilientLoop:
         self._manager.wait(_barrier=False)  # don't race the async save
         state = self._manager.restore_latest()
         self.rollbacks += 1
+        self._m_rollbacks.inc(step=self._step.t)
         self.consecutive_bad = 0
         if state is None:
             warnings.warn("rollback requested but no checkpoint exists — "
@@ -484,6 +544,7 @@ class ResilientLoop:
             return
         self.preempted = True
         t = self._step.t
+        self._m_preempt.inc(step=t)
         if self.verbose:
             print("[resilient] preemption notice — checkpointing step %d "
                   "(%.1fs grace left)" % (t, w.remaining_grace() or 0),
@@ -517,11 +578,27 @@ class ResilientLoop:
                              "batches()")
         while self._epoch < self.epochs:
             self._iter_invalid = False
-            for batch in self._loader:
+            it = iter(self._loader)
+            exhausted = False
+            while True:
+                # data wait: how long the loop sat blocked on the
+                # pipeline before the next batch arrived
+                t0_us = time.perf_counter_ns() // 1000
+                t0 = time.perf_counter()
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    exhausted = True
+                    break
+                dt = time.perf_counter() - t0
+                self._m_data_wait.observe(dt)
+                telemetry.record_span("train.data_wait", t0_us,
+                                      time.perf_counter_ns() // 1000
+                                      - t0_us, category="train")
                 yield batch
                 if self._iter_invalid:
                     break
-            else:
+            if exhausted:
                 self._epoch += 1
 
     def finish(self):

@@ -30,7 +30,11 @@ _state = {"running": False, "config": {"filename": "profile.json",
                                        # profiler.h:85-159, measures the
                                        # kernel, not the push)
                                        "profile_sync": True},
-          "events": [], "lock": threading.Lock(), "jax_trace_dir": None}
+          "events": [], "lock": threading.Lock(), "jax_trace_dir": None,
+          # dump bookkeeping: events move to "flushed" once written (so a
+          # re-dump never re-emits them into a fresh file) and files we
+          # wrote this process are merged into, not clobbered
+          "flushed": [], "dumped_to": set()}
 
 
 def profile_sync():
@@ -80,12 +84,36 @@ def record_event(name, category, start_us, dur_us, args=None):
 
 
 def dump(finished=True, profile_process="worker"):
-    """Write chrome://tracing JSON (parity: MXDumpProfile)."""
+    """Write chrome://tracing JSON (parity: MXDumpProfile).
+
+    Append-safe across multiple dump calls in one process: each call
+    DRAINS the pending events (they move to the aggregate-only
+    `flushed` list, so `dumps()` keeps seeing them) and merges them
+    into the target file's existing traceEvents when this process wrote
+    that file before — a re-dump never re-emits already-flushed events
+    into a fresh file, and repeated dumps to one filename accumulate
+    instead of duplicating. Events are written sorted by `ts` (the
+    recording order can interleave across threads)."""
     fname = _state["config"].get("filename", "profile.json")
+    # the whole read-merge-write runs under the lock: concurrent dump()
+    # calls serialize (neither can discard the other's pending batch),
+    # and events are only marked flushed AFTER the write succeeded — a
+    # failed write leaves them pending for the next dump
     with _state["lock"]:
-        events = list(_state["events"])
-    with open(fname, "w") as f:
-        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+        pending = _state["events"]
+        existing = []
+        if fname in _state["dumped_to"] and os.path.exists(fname):
+            try:
+                with open(fname) as f:
+                    existing = json.load(f).get("traceEvents", [])
+            except (OSError, ValueError):
+                existing = []
+        events = sorted(existing + pending, key=lambda e: e.get("ts", 0))
+        with open(fname, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+        _state["events"] = []
+        _state["flushed"].extend(pending)
+        _state["dumped_to"].add(fname)
     return fname
 
 
@@ -93,7 +121,7 @@ def dumps(reset=False):
     """Aggregate per-op summary table (parity: aggregate_stats.cc)."""
     agg = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])
     with _state["lock"]:
-        for e in _state["events"]:
+        for e in _state["flushed"] + _state["events"]:
             s = agg[e["name"]]
             s[0] += 1
             s[1] += e["dur"] / 1000.0
@@ -101,6 +129,7 @@ def dumps(reset=False):
             s[3] = max(s[3], e["dur"] / 1000.0)
         if reset:
             _state["events"] = []
+            _state["flushed"] = []
     lines = ["%-40s %8s %12s %12s %12s %12s" % (
         "Name", "Calls", "Total(ms)", "Min(ms)", "Max(ms)", "Avg(ms)")]
     for name, (calls, total, mn, mx) in sorted(agg.items(),

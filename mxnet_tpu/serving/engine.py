@@ -42,13 +42,14 @@ traffic. The engine counts distinct signatures (`prefill_compilations` /
 from __future__ import annotations
 
 import math
+import time
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
 from ..base import MXNetError
-from .. import profiler
+from .. import telemetry
 from .kv_cache import (PagedKVCache, flat_slots, prompt_slots, write_kv,
                        gather_kv)
 
@@ -525,7 +526,10 @@ class Engine:
         prefilled and the first token has been sampled."""
         L = seq.prompt_len
         prompt = seq.tokens[:L]
-        with profiler.scope("serving.prefill", "serving"):
+        rid = seq.request.id if seq.request is not None else None
+        with telemetry.span("serving.prefill", trace=rid,
+                            category="serving", prompt_len=L,
+                            chunk_start=seq.prefilled):
             if self.model.uses_cache and self.paged:
                 C = self.prefill_chunk
                 qs = seq.prefilled
@@ -595,7 +599,9 @@ class Engine:
             raise MXNetError("decode batch %d exceeds max_batch %d"
                              % (len(seqs), self.max_batch))
         bb = pow2_bucket(len(seqs), lo=1, hi=self.max_batch)
-        with profiler.scope("serving.decode", "serving"):
+        t0_us = time.perf_counter_ns() // 1000
+        with telemetry.span("serving.decode", category="serving",
+                            batch=len(seqs)):
             if self.model.uses_cache:
                 # paged path: the table width handed to the kernel is
                 # bucketed to the longest LIVE sequence, so a decode
@@ -635,10 +641,21 @@ class Engine:
                 self._count("decode", (bb, s_pad))
                 logits = np.asarray(self.model.step_full(toks, lens))
                 nxt = np.argmax(logits, axis=-1)
+        # fan the batch-level decode interval out to every request it
+        # advanced, so each request's trace row stays connected through
+        # its decode steps (ring-only: the batch span above already
+        # covers the interval in the chrome trace)
+        dur_us = time.perf_counter_ns() // 1000 - t0_us
         for i, s in enumerate(seqs):
             if self.keep_logits and logits is not None:
                 s.last_logits = logits[i]
             self._append(s, int(nxt[i]))
+            if s.request is not None:
+                telemetry.record_span("serving.decode", t0_us, dur_us,
+                                      trace=s.request.id,
+                                      category="serving",
+                                      to_profiler=False, to_flight=False,
+                                      position=len(s.tokens) - 1)
         return seqs
 
     def _append(self, seq, token):

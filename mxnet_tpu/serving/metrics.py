@@ -1,11 +1,23 @@
 """Serving metrics: request latency, throughput, occupancy, cache use.
 
-Aggregates are plain counters/sums behind one lock — `snapshot()` is a
-cheap dict read for the HTTP /metrics endpoint and for tests. Phase
-timings also land in the framework profiler (profiler.scope around the
-engine's prefill/decode does the per-call events; this module records the
-per-request roll-ups) so a chrome trace of a serving run shows queue →
-prefill → decode alongside the op-level events.
+Since ISSUE 7 the counters live on a `telemetry.MetricsRegistry` (one
+PRIVATE registry per ServingMetrics, so parallel servers and tests never
+share state): every request/token/step counter is a registry Counter,
+the latency sums are fixed-bucket Histograms (p50/p95/p99 without
+per-sample storage), and the scheduler/block-pool observables are Gauges
+refreshed on read. Two read paths share that one source of truth:
+
+  * `snapshot()` — the SAME dict shape as before the migration (the
+    HTTP JSON `/metrics` body and the test observable; means are derived
+    from histogram sum/count);
+  * `prometheus_text()` — Prometheus text exposition, what the HTTP
+    endpoint serves under `Accept: text/plain`.
+
+Phase timings also land in the framework profiler via the telemetry span
+layer (engine spans carry the request id as the trace id), so a chrome
+trace or Perfetto export of a serving run shows one request's queue →
+prefill → decode life as a single connected row alongside the op-level
+events.
 """
 from __future__ import annotations
 
@@ -13,146 +25,268 @@ import threading
 import time
 
 from .. import profiler
+from .. import telemetry
 
 _DOMAIN = profiler.Domain("serving")
 
+#: decode/prefill batch-size buckets (powers of two up to a big pod batch)
+_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+_OCCUPANCY_BUCKETS = (0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
+
 
 class ServingMetrics:
-    def __init__(self):
+    def __init__(self, registry=None):
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
-        self.submitted = 0
-        self.rejected = 0
-        self.expired = 0
-        self.completed = 0
-        self.failed = 0
-        self.engine_failures = 0      # engine exceptions absorbed by the
-        self.tokens_generated = 0     # serving loop (requests failed, loop
-                                      # kept alive)
-        self.decode_steps = 0
-        self.decode_steps_paged = 0   # per-path decode counters: which
-        self.decode_steps_gather = 0  # attention read served each step
-        self.prefill_chunks = 0       # chunked-prefill kernel calls
-        self._prefill_depth_last = 0  # sequences mid-prefill, last seen
-        self._occupancy_sum = 0.0     # active/max_batch per decode step
-        self._batch_sum = 0           # active sequences per decode step
-        self._queue_s = 0.0
-        self._prefill_s = 0.0
-        self._decode_s = 0.0
-        self._total_s = 0.0
-        self._ttft_s = 0.0            # time to first token
+        self.registry = registry or telemetry.MetricsRegistry()
+        reg = self.registry
+        c, g, h = reg.counter, reg.gauge, reg.histogram
+        self._submitted = c("serving_requests_submitted_total",
+                            help="requests accepted by submit()")
+        self._rejected = c("serving_requests_rejected_total",
+                           help="requests bounced by queue backpressure")
+        self._expired = c("serving_requests_expired_total",
+                          help="requests failed at admission (timeout "
+                               "or unservable)")
+        self._completed = c("serving_requests_completed_total",
+                            help="requests finished successfully")
+        self._failed = c("serving_requests_failed_total",
+                         help="requests finished with an error")
+        self._engine_failures = c(
+            "serving_engine_failures_total", flight=True,
+            help="engine exceptions absorbed by the serving loop "
+                 "(requests failed, loop kept alive)")
+        self._tokens = c("serving_tokens_generated_total",
+                         help="decode tokens emitted")
+        self._steps = c("serving_decode_steps_total",
+                        help="decode engine steps")
+        self._steps_paged = c("serving_decode_steps_paged_total",
+                              help="decode steps served by the paged "
+                                   "Pallas kernel")
+        self._steps_gather = c("serving_decode_steps_gather_total",
+                               help="decode steps served by the dense "
+                                    "gather path")
+        self._chunks = c("serving_prefill_chunks_total",
+                         help="chunked-prefill kernel calls")
+        # paged-serving observables (PR 4) as gauges, so they appear in
+        # the Prometheus exposition, not just the JSON snapshot
+        self._g_queue = g("serving_queue_depth",
+                          help="requests waiting for admission")
+        self._g_prefill_backlog = g("serving_prefill_queue_depth",
+                                    help="sequences mid-chunked-prefill")
+        self._g_token_budget = g("serving_token_budget",
+                                 help="scheduler per-iteration token "
+                                      "budget (0 = unbounded)")
+        self._g_in_use = g("serving_blocks_in_use",
+                           help="KV-cache pool blocks allocated")
+        self._g_available = g("serving_blocks_available",
+                              help="KV-cache pool blocks free")
+        self._g_high_water = g("serving_blocks_high_water",
+                               help="max pool blocks ever in use")
+        self._g_util = g("serving_block_utilization",
+                         help="pool blocks in use / total")
+        self._h_queue = h("serving_queue_seconds",
+                          help="submit -> admission wait")
+        self._h_prefill = h("serving_prefill_seconds",
+                            help="per-request prefill compute (all "
+                                 "chunks)")
+        self._h_ttft = h("serving_ttft_seconds",
+                         help="submit -> first token")
+        self._h_total = h("serving_request_seconds",
+                          help="submit -> completion")
+        self._h_step = h("serving_decode_step_seconds",
+                         help="one batched decode step")
+        self._h_batch = h("serving_decode_batch",
+                          buckets=_BATCH_BUCKETS,
+                          help="live sequences per decode step")
+        self._h_occupancy = h("serving_decode_occupancy",
+                              buckets=_OCCUPANCY_BUCKETS,
+                              help="decode batch fill fraction "
+                                   "(active/max_batch)")
         self._cache_util_last = None
+        self._prefill_depth_last = 0
         self._counter = _DOMAIN.new_counter("tokens_generated")
+
+    # -- legacy attribute surface (health(), tests) --------------------------
+
+    @property
+    def submitted(self):
+        return int(self._submitted.value)
+
+    @property
+    def rejected(self):
+        return int(self._rejected.value)
+
+    @property
+    def expired(self):
+        return int(self._expired.value)
+
+    @property
+    def completed(self):
+        return int(self._completed.value)
+
+    @property
+    def failed(self):
+        return int(self._failed.value)
+
+    @property
+    def engine_failures(self):
+        return int(self._engine_failures.value)
+
+    @property
+    def tokens_generated(self):
+        return int(self._tokens.value)
+
+    @property
+    def decode_steps(self):
+        return int(self._steps.value)
+
+    @property
+    def decode_steps_paged(self):
+        return int(self._steps_paged.value)
+
+    @property
+    def decode_steps_gather(self):
+        return int(self._steps_gather.value)
+
+    @property
+    def prefill_chunks(self):
+        return int(self._chunks.value)
 
     # -- recording -----------------------------------------------------------
 
     def request_submitted(self):
-        with self._lock:
-            self.submitted += 1
+        self._submitted.inc()
 
     def request_rejected(self):
-        with self._lock:
-            self.rejected += 1
+        self._rejected.inc()
 
     def engine_failure(self):
-        with self._lock:
-            self.engine_failures += 1
+        self._engine_failures.inc()
 
     def request_expired(self, req):
         """Counts the expiry only; request_finished() (always called
         after) does the failed/total accounting exactly once."""
-        with self._lock:
-            self.expired += 1
+        self._expired.inc()
 
     def request_prefilled(self, req, prefill_s):
-        with self._lock:
-            self._queue_s += req.t_admit - req.t_submit
-            self._prefill_s += prefill_s
+        self._h_queue.observe(req.t_admit - req.t_submit)
+        self._h_prefill.observe(prefill_s)
         req.t_first_token = time.perf_counter()
-        with self._lock:
-            self._ttft_s += req.t_first_token - req.t_submit
+        self._h_ttft.observe(req.t_first_token - req.t_submit)
 
     def prefill_chunk(self, queue_depth):
         """One chunked-prefill kernel call ran; `queue_depth` is the
         number of sequences still mid-prefill after it."""
+        self._chunks.inc()
         with self._lock:
-            self.prefill_chunks += 1
             self._prefill_depth_last = queue_depth
+        self._g_prefill_backlog.set(queue_depth)
 
     def decode_step(self, active, max_batch, step_s, cache_util=None,
                     paged=False):
-        with self._lock:
-            self.decode_steps += 1
-            if paged:
-                self.decode_steps_paged += 1
-            else:
-                self.decode_steps_gather += 1
-            self._batch_sum += active
-            self._occupancy_sum += active / float(max_batch)
-            self._decode_s += step_s
-            self.tokens_generated += active
-            if cache_util is not None:
+        self._steps.inc()
+        (self._steps_paged if paged else self._steps_gather).inc()
+        self._h_batch.observe(active)
+        self._h_occupancy.observe(active / float(max_batch))
+        self._h_step.observe(step_s)
+        self._tokens.inc(active)
+        if cache_util is not None:
+            with self._lock:
                 self._cache_util_last = cache_util
+            self._g_util.set(cache_util)
         self._counter.increment(active)
 
     def request_finished(self, req):
-        with self._lock:
-            if req.error is None:
-                self.completed += 1
-            else:
-                self.failed += 1
-            if req.t_done is not None:
-                self._total_s += req.t_done - req.t_submit
+        if req.error is None:
+            self._completed.inc()
+        else:
+            self._failed.inc()
+        if req.t_done is not None:
+            self._h_total.observe(req.t_done - req.t_submit)
 
     # -- reading -------------------------------------------------------------
+
+    def _refresh_gauges(self, engine=None, scheduler=None):
+        """Pull the point-in-time observables (queue depth, pool state)
+        onto their gauges so BOTH read paths see current values."""
+        if scheduler is not None:
+            self._g_queue.set(scheduler.pending())
+            self._g_prefill_backlog.set(len(scheduler.prefilling))
+            self._g_token_budget.set(scheduler.token_budget or 0)
+        if engine is not None and engine.cache is not None:
+            pool = engine.cache.pool
+            self._g_in_use.set(pool.in_use)
+            self._g_available.set(pool.available)
+            self._g_high_water.set(pool.high_water)
+            util = engine.cache_utilization()
+            if util is not None:
+                self._g_util.set(util)
+
+    def prometheus_text(self, engine=None, scheduler=None):
+        """Prometheus text exposition (format 0.0.4) of the server's
+        registry — the `/metrics` body under `Accept: text/plain`."""
+        self._refresh_gauges(engine, scheduler)
+        return self.registry.prometheus_text()
 
     def snapshot(self, engine=None, scheduler=None):
         """One dict with everything: the HTTP /metrics body and the test
         observable. Rates are lifetime averages; latencies are means in
-        milliseconds over finished/started requests."""
-        with self._lock:
-            elapsed = time.perf_counter() - self._t0
-            fin = max(1, self.completed + self.failed)
-            started = max(1, self.completed + self.failed - self.expired)
-            snap = {
-                "requests": {
-                    "submitted": self.submitted,
-                    "completed": self.completed,
-                    "failed": self.failed,
-                    "rejected": self.rejected,
-                    "expired": self.expired,
-                    "engine_failures": self.engine_failures,
-                },
-                "latency_ms": {
-                    "queue_mean": 1e3 * self._queue_s / started,
-                    "prefill_mean": 1e3 * self._prefill_s / started,
-                    "time_to_first_token_mean": 1e3 * self._ttft_s / started,
-                    "total_mean": 1e3 * self._total_s / fin,
-                    "decode_per_token_mean": (
-                        1e3 * self._decode_s / self.tokens_generated
-                        if self.tokens_generated else None),
-                },
-                "throughput": {
-                    "tokens_generated": self.tokens_generated,
-                    "tokens_per_sec": (self.tokens_generated / elapsed
-                                       if elapsed > 0 else None),
-                    "decode_steps": self.decode_steps,
-                },
-                "batch": {
-                    "mean_active": (self._batch_sum / self.decode_steps
-                                    if self.decode_steps else None),
-                    "mean_occupancy": (
-                        self._occupancy_sum / self.decode_steps
-                        if self.decode_steps else None),
-                },
-                "paths": {
-                    "paged_decode_steps": self.decode_steps_paged,
-                    "gather_decode_steps": self.decode_steps_gather,
-                    "prefill_chunks": self.prefill_chunks,
-                    "prefill_queue_depth": self._prefill_depth_last,
-                },
-                "cache": {"block_utilization": self._cache_util_last},
-            }
+        milliseconds over finished/started requests. Shape unchanged by
+        the registry migration (tests pin it); histogram-backed fields
+        now also expose p50/p95/p99."""
+        self._refresh_gauges(engine, scheduler)
+        elapsed = time.perf_counter() - self._t0
+        completed, failed = self.completed, self.failed
+        expired, tokens = self.expired, self.tokens_generated
+        steps = self.decode_steps
+        fin = max(1, completed + failed)
+        started = max(1, completed + failed - expired)
+        snap = {
+            "requests": {
+                "submitted": self.submitted,
+                "completed": completed,
+                "failed": failed,
+                "rejected": self.rejected,
+                "expired": expired,
+                "engine_failures": self.engine_failures,
+            },
+            "latency_ms": {
+                "queue_mean": 1e3 * self._h_queue.sum / started,
+                "prefill_mean": 1e3 * self._h_prefill.sum / started,
+                "time_to_first_token_mean":
+                    1e3 * self._h_ttft.sum / started,
+                "time_to_first_token_p95":
+                    (1e3 * self._h_ttft.quantile(0.95)
+                     if self._h_ttft.count else None),
+                "total_mean": 1e3 * self._h_total.sum / fin,
+                "decode_per_token_mean": (
+                    1e3 * self._h_step.sum / tokens if tokens else None),
+                "decode_step_p50": (1e3 * self._h_step.quantile(0.5)
+                                    if self._h_step.count else None),
+                "decode_step_p99": (1e3 * self._h_step.quantile(0.99)
+                                    if self._h_step.count else None),
+            },
+            "throughput": {
+                "tokens_generated": tokens,
+                "tokens_per_sec": (tokens / elapsed
+                                   if elapsed > 0 else None),
+                "decode_steps": steps,
+            },
+            "batch": {
+                "mean_active": (self._h_batch.sum / steps
+                                if steps else None),
+                "mean_occupancy": (self._h_occupancy.sum / steps
+                                   if steps else None),
+            },
+            "paths": {
+                "paged_decode_steps": self.decode_steps_paged,
+                "gather_decode_steps": self.decode_steps_gather,
+                "prefill_chunks": self.prefill_chunks,
+                "prefill_queue_depth": self._prefill_depth_last,
+            },
+            "cache": {"block_utilization": self._cache_util_last},
+        }
         if engine is not None:
             snap["engine"] = {
                 "prefill_compilations": engine.prefill_compilations,

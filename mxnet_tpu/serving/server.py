@@ -22,9 +22,20 @@ import threading
 import time
 
 from ..base import MXNetError
+from .. import telemetry
 from .engine import Engine, TransformerLM, BlockLM, ExportedLM
 from .scheduler import Scheduler, Request, QueueFull
 from .metrics import ServingMetrics
+
+
+def _queue_span(req):
+    """Record the request's submit -> admission wait as a span on its
+    trace row (req.t_submit/t_admit are perf_counter seconds; span
+    timestamps are the same clock in microseconds)."""
+    telemetry.record_span("serving.queue", int(req.t_submit * 1e6),
+                          int((req.t_admit - req.t_submit) * 1e6),
+                          trace=req.id, category="serving",
+                          to_profiler=False)
 
 
 def _resolve_model(model, vocab=None, max_len=None, time_major=False):
@@ -71,6 +82,7 @@ class LMServer:
         # iteration; decode progress stamps separately
         self._last_beat = time.perf_counter()
         self._last_step_t = None
+        self._wedge_dumped = False
         # HTTP submit-on-QueueFull retry budget (utils.retry): a briefly
         # full queue absorbs a burst instead of bouncing clients to 429
         self.submit_retries = 3
@@ -103,6 +115,13 @@ class LMServer:
                 self.metrics.request_rejected()
             raise
         self.metrics.request_submitted()
+        # the trace row's start marker: every later span (queue, prefill
+        # chunks, decode steps) shares this request id as its trace id
+        telemetry.record_span("serving.submit", int(req.t_submit * 1e6),
+                              0, trace=req.id, category="serving",
+                              to_profiler=False,
+                              prompt_len=len(req.prompt),
+                              max_new_tokens=req.max_new_tokens)
         self._work.set()
         return req
 
@@ -116,16 +135,33 @@ class LMServer:
     def snapshot(self):
         return self.metrics.snapshot(self.engine, self.scheduler)
 
+    def prometheus_text(self):
+        """Prometheus exposition of the server's metrics registry (the
+        `/metrics` body under `Accept: text/plain`)."""
+        return self.metrics.prometheus_text(self.engine, self.scheduler)
+
     def health(self, max_beat_age=5.0):
         """Loop-liveness summary for /healthz: `ok` requires the serving
         thread alive AND beating recently (a wedged loop is as dead as a
         crashed one). `last_step_age_s` is decode-progress age — None
-        until the first decode step, and allowed to grow while idle."""
+        until the first decode step, and allowed to grow while idle.
+
+        Wedge detection doubles as a flight-recorder trigger: the FIRST
+        health check that observes a wedged-but-not-closed loop dumps
+        the black box (the post-mortem of what the loop was doing when
+        it stopped beating)."""
         now = time.perf_counter()
         alive = self._thread.is_alive() and not self._closed
         beat_age = now - self._last_beat
+        ok = bool(alive and beat_age < max_beat_age)
+        if not ok and not self._closed and not self._wedge_dumped:
+            self._wedge_dumped = True
+            telemetry.flight().record(
+                "fault", "serving.healthz_wedge",
+                loop_alive=bool(alive), beat_age_s=round(beat_age, 3))
+            telemetry.flight().dump("healthz_wedge")
         return {
-            "ok": bool(alive and beat_age < max_beat_age),
+            "ok": ok,
             "loop_alive": bool(alive),
             "last_beat_age_s": round(beat_age, 3),
             "last_step_age_s": (round(now - self._last_step_t, 3)
@@ -160,6 +196,10 @@ class LMServer:
             self._loop_inner()
         except BaseException as e:  # noqa: BLE001 — a dead loop must not
             # strand clients in result(): fail everything in flight
+            telemetry.flight().record("fault", "serving.loop_died",
+                                      error="%s: %s"
+                                      % (type(e).__name__, e))
+            telemetry.flight().dump("serving_loop_died")
             err = MXNetError("serving loop died: %s: %s"
                              % (type(e).__name__, e))
             for seq in (self.scheduler.running
@@ -237,8 +277,15 @@ class LMServer:
         for i, req in enumerate(admitted):
             t0 = time.perf_counter()
             try:
-                seq = eng.start(req.prompt, req.max_new_tokens,
-                                eos_id=req.eos_id)
+                # the engine's prefill span inherits the request's trace
+                # id via the thread-local (the Sequence only learns its
+                # request after start() returns)
+                prev = telemetry.set_trace(req.id)
+                try:
+                    seq = eng.start(req.prompt, req.max_new_tokens,
+                                    eos_id=req.eos_id)
+                finally:
+                    telemetry.set_trace(prev)
             except Exception as e:  # engine fault: fail THIS request,
                 met.engine_failure()  # the loop (and the rest of the
                 req._finish(error=MXNetError(  # batch) live on
@@ -254,6 +301,7 @@ class LMServer:
                 break
             seq.request = req
             req.state = "running"
+            _queue_span(req)
             sched.running.append(seq)
             met.request_prefilled(req, time.perf_counter() - t0)
 
@@ -279,6 +327,7 @@ class LMServer:
                 break
             seq.request = req
             req.state = "running"
+            _queue_span(req)
             sched.prefilling.append(seq)
 
     def _prefill_chunks(self):
@@ -352,7 +401,20 @@ class LMServer:
                     h = outer.health()
                     self._reply(200 if h["ok"] else 503, h)
                 elif self.path in ("/v1/metrics", "/metrics"):
-                    self._reply(200, outer.snapshot())
+                    accept = self.headers.get("Accept", "")
+                    if "text/plain" in accept:
+                        # Prometheus scrape: text exposition 0.0.4
+                        body = outer.prometheus_text().encode()
+                        self.send_response(200)
+                        self.send_header(
+                            "Content-Type",
+                            "text/plain; version=0.0.4; charset=utf-8")
+                        self.send_header("Content-Length",
+                                         str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    else:
+                        self._reply(200, outer.snapshot())
                 else:
                     self._reply(404, {"error": "unknown path %s"
                                       % self.path})

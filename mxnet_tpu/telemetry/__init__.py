@@ -1,0 +1,55 @@
+"""Unified telemetry: metrics registry, span tracing, crash flight recorder.
+
+The common measurement substrate for training and serving (ISSUE 7; see
+docs/OBSERVABILITY.md):
+
+  * `metrics` — counters / gauges / fixed-bucket histograms labeled by
+    host/replica, with Prometheus text exposition and a JSON snapshot
+    (`default_registry()` is the process-wide instance; the serving
+    stack builds one per server).
+  * `tracing` — `span(name, trace=..., **attrs)` context manager; spans
+    sharing a trace id (serving: the request id) render as one connected
+    row in the Perfetto JSON export and feed the legacy chrome-trace
+    recorder (`profiler.dump()`).
+  * `flight` — a bounded ring of recent spans/flagged-metric/fault
+    events that dumps to `MXNET_FLIGHT_RECORDER_DIR` on SIGTERM,
+    serving-loop death, or /healthz wedge detection; rendered by
+    `tools/postmortem.py`.
+
+Master switch: `MXNET_TELEMETRY` (default on; `0` turns every recording
+site into a no-op).
+"""
+from . import metrics
+from . import tracing
+from . import flight as _flight_mod
+
+from .metrics import (enabled, MetricsRegistry, default_registry,
+                      DEFAULT_BUCKETS)
+from .tracing import (span, record_span, current_trace, set_trace,
+                      spans, export_perfetto)
+from .flight import FlightRecorder, flight
+
+
+def counter(name, help="", flight=False):
+    """Counter on the default registry."""
+    return default_registry().counter(name, help=help, flight=flight)
+
+
+def gauge(name, help=""):
+    """Gauge on the default registry."""
+    return default_registry().gauge(name, help=help)
+
+
+def histogram(name, help="", buckets=DEFAULT_BUCKETS):
+    """Histogram on the default registry."""
+    return default_registry().histogram(name, help=help, buckets=buckets)
+
+
+def snapshot():
+    """JSON snapshot of the default registry."""
+    return default_registry().snapshot()
+
+
+def prometheus_text():
+    """Prometheus exposition of the default registry."""
+    return default_registry().prometheus_text()

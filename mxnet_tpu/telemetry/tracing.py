@@ -1,0 +1,177 @@
+"""Span tracing: one request's (or train step's) life as a connected trace.
+
+`span(name, trace=..., **attrs)` is a context manager timing a region.
+Every closed span is recorded three ways:
+
+  * the legacy chrome-trace recorder (`profiler.record_event`, when the
+    profiler is running) — so existing `profiler.dump()` traces gain the
+    serving/training spans alongside the op-level events;
+  * the in-process span ring (bounded; `export_perfetto()` turns it into
+    a Perfetto-loadable JSON trace where every trace id is its own row);
+  * the flight recorder ring (`telemetry.flight`) — the post-mortem
+    record of "what was this process doing right before it died".
+
+Trace ids connect spans: the serving stack uses the request id, so one
+request's submit → queue → prefill chunks → decode steps all share an id
+and render as a single row. Ids propagate implicitly to nested spans via
+a thread-local (set once at the root span, inherited below), or
+explicitly with `span(..., trace=id)` / `record_span(..., trace=id)` for
+regions timed outside a `with` block (e.g. one decode step fanned out to
+every sequence it advanced).
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from .. import profiler
+from .metrics import enabled
+
+_ids = itertools.count(1)
+_tls = threading.local()
+
+#: closed spans, newest last. Bounded: tracing must be always-on-able
+#: without growing without bound; export before the ring wraps (or raise
+#: MXNET_TELEMETRY_SPAN_RING).
+_ring_size = int(os.environ.get("MXNET_TELEMETRY_SPAN_RING", "8192"))
+_spans = deque(maxlen=_ring_size)
+_lock = threading.Lock()
+
+
+def current_trace():
+    """The thread's active trace id, or None."""
+    return getattr(_tls, "trace", None)
+
+
+def set_trace(trace):
+    """Set the thread's trace id; returns the previous value (restore it
+    when the propagation scope ends)."""
+    prev = getattr(_tls, "trace", None)
+    _tls.trace = trace
+    return prev
+
+
+def _now_us():
+    return time.perf_counter_ns() // 1000
+
+
+def record_span(name, start_us, dur_us, trace=None, category="trace",
+                to_profiler=True, to_flight=True, **attrs):
+    """Record one already-timed span. The seam for fan-out: a batched
+    decode step is timed once but attributed to every request it
+    advanced, so each request's row stays connected. The per-request
+    copies only matter to the span ring (their Perfetto rows):
+    `to_profiler=False` keeps them out of the chrome trace and
+    `to_flight=False` out of the flight-recorder ring, where B duplicate
+    copies per decode step would evict the history the black box exists
+    to keep (the batch-level span covers the interval in both)."""
+    if not enabled():
+        return
+    if trace is None:
+        trace = current_trace()
+    rec = {"id": next(_ids), "name": name, "cat": category,
+           "trace": trace, "ts": start_us, "dur": dur_us,
+           "pid": os.getpid(), "tid": threading.get_ident()}
+    if attrs:
+        rec["attrs"] = attrs
+    with _lock:
+        _spans.append(rec)
+    if to_profiler:
+        profiler.record_event(name, category, start_us, dur_us,
+                              dict(attrs, trace=trace) if attrs
+                              else {"trace": trace})
+    if to_flight:
+        from .flight import flight
+        flight().record("span", name, trace=trace, dur_us=dur_us,
+                        **attrs)
+    return rec
+
+
+class span:
+    """Time a region and record it as a span. Usage:
+
+        with telemetry.span("serving.prefill", trace=req.id, chunk=3):
+            ...
+
+    `trace=None` inherits the thread's current trace id; passing an
+    explicit id also makes it the thread's current id for the duration
+    (nested spans connect automatically)."""
+
+    def __init__(self, name, trace=None, category="trace", **attrs):
+        self.name = name
+        self.category = category
+        self.attrs = attrs
+        self._trace = trace
+        self._prev = None
+
+    def __enter__(self):
+        if self._trace is not None:
+            self._prev = set_trace(self._trace)
+        self._t0 = _now_us()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t1 = _now_us()
+        if exc_type is not None:
+            self.attrs.setdefault("error", exc_type.__name__)
+        record_span(self.name, self._t0, t1 - self._t0,
+                    trace=self._trace, category=self.category,
+                    **self.attrs)
+        if self._trace is not None:
+            set_trace(self._prev)
+        return False
+
+
+def spans(trace=None):
+    """Recorded spans, oldest first; `trace=` filters to one id."""
+    with _lock:
+        out = list(_spans)
+    if trace is not None:
+        out = [s for s in out if s["trace"] == trace]
+    return out
+
+
+def clear():
+    """Drop the ring (tests)."""
+    with _lock:
+        _spans.clear()
+
+
+def export_perfetto(path=None):
+    """Write the span ring as Perfetto-compatible chrome-trace JSON.
+
+    Each distinct trace id becomes its own thread row (`tid` = trace id,
+    named by a thread_name metadata event), so loading the file in
+    Perfetto/chrome://tracing shows one request's whole life — queue,
+    prefill chunks, decode steps — as a single connected row; untraced
+    spans keep their real thread id. Returns the trace dict (and writes
+    it to `path` when given)."""
+    with _lock:
+        recs = list(_spans)
+    events = []
+    rows = {}
+    for r in recs:
+        tid = r["tid"]
+        if r["trace"] is not None:
+            # stable small row ids: first-seen order per trace id
+            tid = rows.setdefault(r["trace"], 1_000_000 + len(rows))
+        ev = {"name": r["name"], "cat": r["cat"], "ph": "X",
+              "ts": r["ts"], "dur": r["dur"], "pid": r["pid"],
+              "tid": tid,
+              "args": dict(r.get("attrs") or {}, trace=r["trace"],
+                           span_id=r["id"])}
+        events.append(ev)
+    for trace, tid in rows.items():
+        events.append({"name": "thread_name", "ph": "M",
+                       "pid": os.getpid(), "tid": tid,
+                       "args": {"name": "trace %s" % (trace,)}})
+    events.sort(key=lambda e: e.get("ts", 0))
+    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump(doc, f)
+    return doc

@@ -97,6 +97,10 @@ def _should(name, step):
     if name in _fired or _conf.get(name) != int(step):
         return False
     _fired.add(name)
+    # the injected fault is itself a flight-recorder event: a post-mortem
+    # timeline that cannot show the fault that caused it is useless
+    from .. import telemetry
+    telemetry.flight().record("fault", "chaos." + name, step=int(step))
     return True
 
 

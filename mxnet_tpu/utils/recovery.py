@@ -76,11 +76,42 @@ import json
 import os
 import re
 import threading
+import time as _time
 
 import numpy as np
 import jax
 
 from . import chaos as _chaos
+from .. import telemetry
+
+
+def _ckpt_metrics():
+    """The checkpoint IO instruments, on the process-global registry
+    (idempotent creation — every manager shares them)."""
+    reg = telemetry.default_registry()
+    return {
+        "save_s": reg.histogram(
+            "checkpoint_save_seconds",
+            help="checkpoint write + atomic publish, per save"),
+        "restore_s": reg.histogram(
+            "checkpoint_restore_seconds",
+            help="checkpoint verify + deserialize, per restore"),
+        "bytes": reg.gauge(
+            "checkpoint_bytes_per_host",
+            help="bytes THIS host wrote for the last checkpoint "
+                 "(sharded: only the shards it owns)"),
+        "saves": reg.counter("checkpoint_saves_total",
+                             help="checkpoints published by this host"),
+        "restores": reg.counter("checkpoint_restores_total",
+                                help="checkpoints restored"),
+        "retries": reg.counter(
+            "checkpoint_io_retries_total", flight=True,
+            help="transient publish-IO failures retried with backoff"),
+        "manifest_failures": reg.counter(
+            "checkpoint_manifest_failures_total", flight=True,
+            help="checkpoints skipped because manifest/shard "
+                 "verification failed (corrupt or incomplete)"),
+    }
 
 _CKPT_RE = re.compile(r"^ckpt-(\d+)\.npz$")
 _SHARD_RE = re.compile(r"^ckpt-(\d+)\.shard(\d+)of(\d+)\.npz$")
@@ -146,6 +177,7 @@ class CheckpointManager:
         self._worker = None
         self._lock = threading.Lock()
         self._error = None
+        self._metrics = _ckpt_metrics()
         if self.is_writer or sharded:
             os.makedirs(directory, exist_ok=True)
 
@@ -338,7 +370,9 @@ class CheckpointManager:
     def _io_retry(self, fn):
         from mxnet_tpu.utils import retry
         return retry(fn, attempts=self.io_retries, backoff=0.05,
-                     jitter=0.5, retry_on=OSError)
+                     jitter=0.5, retry_on=OSError,
+                     on_retry=lambda e, i: self._metrics["retries"].inc(
+                         error=str(e), attempt=i))
 
     def _write_npz(self, path, host):
         import io
@@ -379,49 +413,61 @@ class CheckpointManager:
 
     def _write(self, step, host):
         try:
-            final = os.path.join(self.directory, "ckpt-%d.npz" % step)
-            tmp = final + ".tmp-%d" % os.getpid()
-            self._write_npz(tmp, host)
-            sha, size = self._sha_size(tmp)
-            manifest = {"step": int(step),
-                        "file": os.path.basename(final),
-                        "size": size,
-                        "sha256": sha,
-                        "arrays": sorted(host.keys())}
-            _chaos.maybe_kill_during_save(step)
-            self._io_retry(lambda: os.replace(tmp, final))  # atomic publish
-            self._publish_json(manifest, self._manifest_path(step))
-            # rename durability: the publication is only real once the
-            # directory entry itself is on disk
-            _fsync_dir(self.directory)
-            _chaos.maybe_corrupt_checkpoint(step, final)
-            self._prune()
+            with telemetry.span("ckpt.write", category="ckpt", step=step):
+                final = os.path.join(self.directory, "ckpt-%d.npz" % step)
+                tmp = final + ".tmp-%d" % os.getpid()
+                t0 = _time.perf_counter()
+                self._write_npz(tmp, host)
+                sha, size = self._sha_size(tmp)
+                manifest = {"step": int(step),
+                            "file": os.path.basename(final),
+                            "size": size,
+                            "sha256": sha,
+                            "arrays": sorted(host.keys())}
+                _chaos.maybe_kill_during_save(step)
+                self._io_retry(lambda: os.replace(tmp, final))  # atomic
+                self._publish_json(manifest, self._manifest_path(step))
+                # rename durability: the publication is only real once
+                # the directory entry itself is on disk
+                _fsync_dir(self.directory)
+                self._metrics["save_s"].observe(_time.perf_counter() - t0)
+                self._metrics["bytes"].set(size)
+                self._metrics["saves"].inc()
+                _chaos.maybe_corrupt_checkpoint(step, final)
+                self._prune()
         except Exception as e:  # surfaced on the next save()/wait()
             with self._lock:
                 self._error = e
 
     def _write_sharded(self, step, host, entries, gmeta):
         try:
-            base = self._shard_basename(step)
-            final = os.path.join(self.directory, base + ".npz")
-            tmp = final + ".tmp-%d" % os.getpid()
-            self._write_npz(tmp, host)
-            sha, size = self._sha_size(tmp)
-            _chaos.maybe_kill_during_save(step)
-            self._io_retry(lambda: os.replace(tmp, final))
-            manifest = {"step": int(step), "file": base + ".npz",
-                        "size": size, "sha256": sha,
-                        "process_index": self.process_index,
-                        "process_count": self.process_count,
-                        "entries": entries}
-            self._publish_json(manifest,
-                               os.path.join(self.directory,
-                                            base + ".manifest.json"))
-            if self.is_writer:
-                self._publish_json(gmeta, self._manifest_path(step))
-            _fsync_dir(self.directory)
-            _chaos.maybe_corrupt_checkpoint(step, final)
-            self._prune()
+            with telemetry.span("ckpt.write_sharded", category="ckpt",
+                                step=step,
+                                process_index=self.process_index):
+                base = self._shard_basename(step)
+                final = os.path.join(self.directory, base + ".npz")
+                tmp = final + ".tmp-%d" % os.getpid()
+                t0 = _time.perf_counter()
+                self._write_npz(tmp, host)
+                sha, size = self._sha_size(tmp)
+                _chaos.maybe_kill_during_save(step)
+                self._io_retry(lambda: os.replace(tmp, final))
+                manifest = {"step": int(step), "file": base + ".npz",
+                            "size": size, "sha256": sha,
+                            "process_index": self.process_index,
+                            "process_count": self.process_count,
+                            "entries": entries}
+                self._publish_json(manifest,
+                                   os.path.join(self.directory,
+                                                base + ".manifest.json"))
+                if self.is_writer:
+                    self._publish_json(gmeta, self._manifest_path(step))
+                _fsync_dir(self.directory)
+                self._metrics["save_s"].observe(_time.perf_counter() - t0)
+                self._metrics["bytes"].set(size)
+                self._metrics["saves"].inc()
+                _chaos.maybe_corrupt_checkpoint(step, final)
+                self._prune()
         except Exception as e:  # surfaced on the next save()/wait()
             with self._lock:
                 self._error = e
@@ -587,6 +633,8 @@ class CheckpointManager:
                 out.append(step)
             except (OSError, ValueError, zipfile.BadZipFile, EOFError,
                     KeyError) as e:
+                self._metrics["manifest_failures"].inc(step=step,
+                                                       error=str(e))
                 warnings.warn("skipping corrupt checkpoint step %d: %s"
                               % (step, e))
         return out
@@ -701,9 +749,18 @@ class CheckpointManager:
             candidates = self.all_steps()
         for step in reversed(candidates):
             try:
-                return step, self.restore(step)
+                t0 = _time.perf_counter()
+                with telemetry.span("ckpt.restore", category="ckpt",
+                                    step=step):
+                    tree = self.restore(step)
+                self._metrics["restore_s"].observe(
+                    _time.perf_counter() - t0)
+                self._metrics["restores"].inc()
+                return step, tree
             except (OSError, ValueError, zipfile.BadZipFile, EOFError,
                     KeyError) as e:
+                self._metrics["manifest_failures"].inc(step=step,
+                                                       error=str(e))
                 warnings.warn("skipping corrupt checkpoint step %d: %s"
                               % (step, e))
                 continue

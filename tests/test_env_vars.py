@@ -122,3 +122,34 @@ def test_elastic_dp_policy_env_default(monkeypatch, tmp_path):
     monkeypatch.setenv("MXNET_ELASTIC_DP_POLICY", "explode")
     with pytest.raises(ValueError):
         loop()
+
+
+def test_telemetry_env_knobs(monkeypatch, tmp_path):
+    """MXNET_TELEMETRY gates recording; MXNET_FLIGHT_RECORDER_RING sizes
+    the black box; MXNET_FLIGHT_RECORDER_DIR routes its dumps (unset =
+    record in-process, write nothing)."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.telemetry import FlightRecorder
+
+    monkeypatch.setenv("MXNET_FLIGHT_RECORDER_RING", "5")
+    fr = FlightRecorder()
+    assert fr.capacity == 5
+    for i in range(9):
+        fr.record("event", "e%d" % i)
+    assert len(fr.events()) == 5
+    monkeypatch.delenv("MXNET_FLIGHT_RECORDER_DIR", raising=False)
+    assert fr.dump("nowhere") is None       # no dir -> no file, no error
+    monkeypatch.setenv("MXNET_FLIGHT_RECORDER_DIR", str(tmp_path))
+    path = fr.dump("somewhere")
+    assert path and os.path.exists(path)
+
+    monkeypatch.setenv("MXNET_TELEMETRY", "0")
+    fr2 = FlightRecorder(capacity=4)
+    fr2.record("event", "dropped")
+    assert fr2.events() == []
+    reg = telemetry.MetricsRegistry()
+    reg.counter("off_total").inc(7)
+    assert reg.counter("off_total").value == 0
+    monkeypatch.delenv("MXNET_TELEMETRY")
+    reg.counter("off_total").inc(7)
+    assert reg.counter("off_total").value == 7

@@ -246,6 +246,49 @@ def _final_of(host):
     return lines[-1] if lines else None
 
 
+def _check_flight_dumps(flight_dir, survivors):
+    """Post-mortem gate of the pod drill: every SIGTERM'd survivor must
+    have dumped its flight recorder, each dump must parse and hold the
+    spans from right before the injected fault, and tools/postmortem.py
+    must render the set into a usable timeline."""
+    import importlib.util
+    import json as _json
+    files = sorted(os.path.join(flight_dir, n)
+                   for n in os.listdir(flight_dir)
+                   if n.startswith("flight-") and
+                   n.endswith(".sigterm.json"))
+    hosts_seen = set()
+    for f in files:
+        with open(f) as fh:
+            doc = _json.load(fh)           # parseable
+        hosts_seen.add(doc["host"])
+        span_names = [e["name"] for e in doc["events"]
+                      if e.get("kind") == "span"]
+        assert "train.device_step" in span_names, (
+            "flight dump %s holds no train spans from before the fault"
+            % f)
+        faults = [e["name"] for e in doc["events"]
+                  if e.get("kind") == "fault"]
+        assert "chaos.sigterm_at" in faults, (
+            "flight dump %s is missing the injected fault event" % f)
+    assert len(hosts_seen) == survivors, (
+        "expected flight dumps from %d survivor hosts, got %s"
+        % (survivors, sorted(hosts_seen)))
+    spec = importlib.util.spec_from_file_location(
+        "postmortem", os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "postmortem.py"))
+    pm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pm)
+    text = pm.render(pm.load_dumps([flight_dir]))
+    assert "FAULT" in text and "train.device_step" in text
+    head = text.splitlines()
+    print("-- flight recorder: %d dump(s) from %d survivor host(s); "
+          "post-mortem timeline renders (%d lines)"
+          % (len(files), len(hosts_seen), len(head)))
+    for line in head[:6]:
+        print("   " + line)
+
+
 def multihost(args):
     """The pod-scale drill (see the module docstring, Multi-host mode)."""
     import shutil
@@ -280,12 +323,18 @@ def multihost(args):
     # the victim died) instead of racing an orchestrator-sent signal
     # against the survivors' progress. The survivors' drain checkpoints
     # land at a step the dead host never sharded -> incomplete, and the
-    # relaunch must refuse it.
+    # relaunch must refuse it. Every pod host gets a flight-recorder
+    # directory: the SIGKILL'd victim can't dump (that's the point of a
+    # black box on the OTHERS), the SIGTERM'd survivors must.
+    flight_dir = os.path.join(base, "flight")
     k_drain = k_kill + 2
     crew = [_Host(args, fault_dir, i, hosts,
-                  chaos={"MXNET_CHAOS_SIGKILL_AT": str(k_kill)}
-                  if i == hosts - 1 else
-                  {"MXNET_CHAOS_SIGTERM_AT": str(k_drain)})
+                  chaos=dict(
+                      {"MXNET_CHAOS_SIGKILL_AT": str(k_kill)}
+                      if i == hosts - 1 else
+                      {"MXNET_CHAOS_SIGTERM_AT": str(k_drain)},
+                      MXNET_FLIGHT_RECORDER_DIR=flight_dir,
+                      MXNET_HOST_ID=str(i)))
             for i in range(hosts)]
     victim = crew[-1]
     rc = victim.wait()
@@ -298,6 +347,7 @@ def multihost(args):
         assert rc == EXIT_PREEMPTED, \
             "survivor did not drain cleanly (%r):\n%s" % (rc,
                                                           h.stdout[-2000:])
+    _check_flight_dumps(flight_dir, survivors=hosts - 1)
 
     shutil.copytree(fault_dir, elastic_dir)   # snapshot for leg 4
 

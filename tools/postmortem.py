@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Render flight-recorder dumps into a human-readable post-mortem timeline.
+
+A pod host that dies (or drains on preemption) leaves one or more
+`flight-host<h>-pid<p>-<n>.<reason>.json` files in
+`MXNET_FLIGHT_RECORDER_DIR` (see mxnet_tpu/telemetry/flight.py). This
+tool merges any number of them — the whole pod's black boxes — into one
+wall-clock-ordered timeline tagged by host/pid, calls out injected and
+observed FAULTs, and summarizes each dump's final metric values, so "what
+was the pod doing in its last seconds" is one command:
+
+    python tools/postmortem.py /path/to/flight-dir
+    python tools/postmortem.py dumpA.json dumpB.json
+
+The multi-host chaos drill (tools/chaos_train.py --multihost) asserts
+that the killed host's survivors leave dumps this tool can render.
+"""
+import argparse
+import json
+import os
+import sys
+
+
+def load_dumps(paths):
+    """Load flight dumps from files and/or directories. Returns a list
+    of dump dicts, each annotated with its source path. Raises on a
+    dump that does not parse (a torn dump should be loud, not skipped:
+    the whole point is certainty about the last seconds)."""
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            files += sorted(os.path.join(p, n) for n in os.listdir(p)
+                            if n.startswith("flight-")
+                            and n.endswith(".json"))
+        else:
+            files.append(p)
+    if not files:
+        raise FileNotFoundError("no flight-recorder dumps under %r"
+                                % (paths,))
+    dumps = []
+    for f in files:
+        with open(f) as fh:
+            doc = json.load(fh)
+        for key in ("reason", "host", "pid", "events"):
+            if key not in doc:
+                raise ValueError("%s is not a flight-recorder dump "
+                                 "(missing %r)" % (f, key))
+        doc["_path"] = f
+        dumps.append(doc)
+    return dumps
+
+
+def _fmt_extras(ev):
+    skip = {"t", "kind", "name"}
+    parts = []
+    for k in sorted(ev):
+        if k in skip or ev[k] is None:
+            continue
+        v = ev[k]
+        if k == "dur_us":
+            parts.append("%.3fms" % (v / 1000.0))
+        else:
+            parts.append("%s=%s" % (k, v))
+    return " ".join(parts)
+
+
+def render(dumps):
+    """One merged timeline, oldest event first, host/pid-tagged; then a
+    per-dump summary (reason + headline metric values)."""
+    rows = []
+    t0 = None
+    for d in dumps:
+        tag = "host%s/pid%s" % (d["host"], d["pid"])
+        for ev in d["events"]:
+            t = float(ev.get("t", 0.0))
+            t0 = t if t0 is None else min(t0, t)
+            rows.append((t, tag, ev))
+    rows.sort(key=lambda r: r[0])
+    lines = ["== flight-recorder post-mortem: %d dump(s), %d event(s)"
+             % (len(dumps), len(rows))]
+    for d in dumps:
+        lines.append("   %s: reason=%s  (%s)"
+                     % ("host%s/pid%s" % (d["host"], d["pid"]),
+                        d["reason"], os.path.basename(d["_path"])))
+    lines.append("-- timeline (t is seconds since the oldest event)")
+    for t, tag, ev in rows:
+        kind = ev.get("kind", "?")
+        marker = {"fault": "FAULT ", "metric": "metric",
+                  "span": "span  ", "event": "event "}.get(kind, kind)
+        lines.append("  +%8.3fs %-14s %s %-28s %s"
+                     % (t - (t0 or 0.0), tag, marker, ev.get("name", "?"),
+                        _fmt_extras(ev)))
+    for d in dumps:
+        metrics = (d.get("metrics") or {}).get("metrics") or {}
+        if not metrics:
+            continue
+        lines.append("-- final metrics: host%s/pid%s"
+                     % (d["host"], d["pid"]))
+        for name, m in sorted(metrics.items()):
+            if m.get("kind") == "histogram":
+                if not m.get("count"):
+                    continue
+                lines.append(
+                    "   %-36s count=%d mean=%.6g p50=%.6g p99=%.6g"
+                    % (name, m["count"], m["mean"] or 0.0,
+                       m["p50"] or 0.0, m["p99"] or 0.0))
+            elif m.get("value"):
+                lines.append("   %-36s %g" % (name, m["value"]))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+",
+                    help="flight dump files and/or directories")
+    args = ap.parse_args(argv)
+    print(render(load_dumps(args.paths)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

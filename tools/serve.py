@@ -9,7 +9,8 @@ randomly-initialized tiny transformer for smoke-testing the stack.
     python tools/serve.py --model lm.mxtpu --port 8080
     curl -X POST localhost:8080/v1/generate \
          -d '{"tokens": [3, 1, 4, 1, 5], "max_new_tokens": 16}'
-    curl localhost:8080/v1/metrics
+    curl localhost:8080/v1/metrics                      # JSON snapshot
+    curl -H 'Accept: text/plain' localhost:8080/metrics # Prometheus
 """
 import argparse
 import os
