@@ -916,7 +916,8 @@ def bench_e2e_train_io(smoke, dtype, device_kind):
             pass
 
 
-def bench_serving(smoke, dtype, device_kind, batch=None):
+def bench_serving(smoke, dtype, device_kind, batch=None, tp=None,
+                  replicas=None):
     """Offline continuous-batching decode throughput (tokens/s) through
     mxnet_tpu.serving's paged-KV engine — the serving trajectory line.
     BENCH_SERVING_BATCH overrides the batch; the full run sweeps
@@ -926,13 +927,22 @@ def bench_serving(smoke, dtype, device_kind, batch=None):
     how a steady-state server spends its time. `paged_attention: on|off`
     (MXNET_PAGED_ATTENTION, the ragged Pallas kernel + chunked prefill
     of ops/pallas_paged.py) labels every line so A/B runs pair up —
-    tpu_session.sh step 2d emits both legs."""
+    tpu_session.sh step 2d emits both legs.
+
+    With `tp=`/`replicas=` (the ISSUE 8 grid, tpu_session.sh step 2g)
+    the leg measures the multi-chip front door instead: aggregate tok/s
+    through `serve(replicas=..., tp=...)` under a mixed-length request
+    wave, per-replica TTFT p50/p95, and the router's pick overhead in
+    microseconds."""
     import jax
     import jax.numpy as jnp
     from mxnet_tpu import serving
     from mxnet_tpu.models.transformer import (TransformerConfig,
                                               init_transformer_params)
 
+    if tp is not None or replicas is not None:
+        return _bench_serving_frontdoor(smoke, dtype, tp or 1,
+                                        replicas or 1, batch)
     if batch is None:
         batch = int(os.environ.get("BENCH_SERVING_BATCH", "2" if smoke
                                    else "8"))
@@ -1011,6 +1021,110 @@ def bench_serving(smoke, dtype, device_kind, batch=None):
                              "line tracks the trajectory from PR 1 on "
                              "(config widened r6 for kernel tile "
                              "eligibility)"}
+
+
+def _bench_serving_frontdoor(smoke, dtype, tp, replicas, batch=None):
+    """One tp x replicas leg of the multi-chip serving grid (ISSUE 8):
+    a mixed-length wave of `replicas * batch` requests through the real
+    front door (`serve(replicas=, tp=)` — router, per-replica engines,
+    continuous batching). Reports AGGREGATE tok/s over the timed wave
+    (one untimed warmup wave absorbs every prefill/decode compile),
+    per-replica TTFT p50/p95 from the replica registries, and router
+    pick overhead in microseconds. tp falls back per the placement
+    rules; the emitted `tp` is the EFFECTIVE degree, with the requested
+    one and the reason disclosed on fallback."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import serving
+    from mxnet_tpu.models.transformer import (TransformerConfig,
+                                              init_transformer_params)
+
+    if batch is None:
+        batch = int(os.environ.get("BENCH_SERVING_BATCH", "2" if smoke
+                                   else "8"))
+    cfg = TransformerConfig(vocab=128, d_model=32, n_heads=4, n_layers=2,
+                            d_ff=64, max_len=64) if smoke else \
+        TransformerConfig(vocab=8192, d_model=512, n_heads=4, n_layers=4,
+                          d_ff=2048, max_len=1024)
+    gen = 8 if smoke else 64
+    base_len = 8 if smoke else 32
+    params = init_transformer_params(jax.random.PRNGKey(0), cfg)
+    if dtype == "bfloat16":
+        params = {k: v.astype(jnp.bfloat16) for k, v in params.items()}
+    srv = serving.serve((params, cfg), replicas=replicas, tp=tp,
+                        max_batch=batch, block_size=16, paged=True,
+                        max_queue=4 * batch * replicas)
+    try:
+        reps = srv.replicas if replicas > 1 else [srv]
+        eng0 = reps[0].engine
+        rng = np.random.RandomState(0)
+        # mixed lengths: the router's least-loaded score has real work
+        # to balance, same spread every leg
+        lens = [max(1, int(l)) for l in
+                rng.randint(base_len // 2, 2 * base_len,
+                            batch * replicas)]
+
+        def wave(lengths):
+            reqs = [srv.submit(list(rng.randint(1, cfg.vocab, L)),
+                               max_new_tokens=gen) for L in lengths]
+            for r in reqs:
+                r.result(timeout=600)
+            return reqs
+
+        # warmup replays the SAME length multiset the timed wave uses,
+        # so every pow2 prefill/decode bucket the timed wave can hit is
+        # already compiled — no compile lands inside the timing
+        wave(lens)
+        t0 = time.perf_counter()
+        timed = wave(lens)
+        dt = time.perf_counter() - t0
+        tokens = sum(len(r.tokens) - len(r.prompt) for r in timed)
+
+        # steady-state TTFT per replica from the TIMED wave only (the
+        # registries' lifetime histograms include warmup compiles)
+        by_rep = [[] for _ in reps]
+        for r in timed:
+            by_rep[getattr(r, "replica", None) or 0].append(
+                1e3 * (r.t_first_token - r.t_submit))
+
+        def ttft_ms(i, q):
+            return (round(float(np.percentile(by_rep[i], q)), 3)
+                    if by_rep[i] else None)
+
+        line = {"metric": ("smoke_serving_frontdoor_tok_per_sec" if smoke
+                           else "serving_frontdoor_tok_per_sec"),
+                "value": round(tokens / dt, 1), "unit": "tok/s",
+                "tp": eng0.tp, "tp_requested": eng0.tp_requested,
+                "replicas": replicas, "batch": batch,
+                "requests_timed": len(timed), "gen_tokens": gen,
+                "requests_per_replica": [len(b) for b in by_rep],
+                "paged_attention": "on" if eng0.paged else "off",
+                "ttft_ms_p50_per_replica": [ttft_ms(i, 50)
+                                            for i in range(len(reps))],
+                "ttft_ms_p95_per_replica": [ttft_ms(i, 95)
+                                            for i in range(len(reps))],
+                "prefill_compilations": [r.engine.prefill_compilations
+                                         for r in reps],
+                "decode_compilations": [r.engine.decode_compilations
+                                        for r in reps],
+                "vs_baseline": None,
+                "baseline_note": "ISSUE 8 tp x replicas grid; pairs "
+                                 "against its own tp=1/replicas=1 leg, "
+                                 "not the reference (no serving path "
+                                 "exists there)"}
+        if eng0.tp_fallback:
+            line["tp_fallback"] = eng0.tp_fallback
+        if replicas > 1:
+            pick = srv.registry.histogram("serving_router_pick_seconds")
+            line["router_pick_us_mean"] = (
+                round(1e6 * pick.mean, 2) if pick.count else None)
+            p95 = pick.quantile(0.95)
+            line["router_pick_us_p95"] = (
+                round(1e6 * p95, 2) if p95 is not None else None)
+            line["replicas_drained"] = sum(srv._drained)
+        return line
+    finally:
+        srv.close()
 
 
 def bench_resilience(smoke, dtype, device_kind):
@@ -1234,6 +1348,12 @@ def _run_configs(smoke):
                 os.environ.get("BENCH_SERVING_BATCH") is None:
             # the serving trajectory is tracked at three batch points
             runs = [{"batch": b} for b in (1, 8, 32)]
+            if os.environ.get("BENCH_SERVING_GRID") == "1":
+                # ISSUE 8 multi-chip grid: tp x replicas front-door
+                # legs (tpu_session.sh step 2g; the tp=1/replicas=1
+                # leg is the grid's own baseline)
+                runs += [{"tp": t, "replicas": r}
+                         for r in (1, 2) for t in (1, 2)]
         if name == "lstm_sweep":
             # always a paired A/B; the full batch sweep (the round-7
             # latency-vs-bandwidth adjudicator) is opt-in — 8 TrainStep

@@ -29,11 +29,24 @@ bytes (disclosed on every CPU line, same caveat as bytes_report.py);
 the decision signals on CPU are the flat-vs-linear byte/flop curves in
 T, not the absolute paged bytes.
 
+A second claim rode in with ISSUE 8: under tensor-parallel serving
+(`MXNET_SERVING_TP=k`, serving/tp.py) the bytes ONE CHIP moves per
+decode step scale ~1/k — the pool shards over heads, each chip's paged
+kernel walks H/k heads of the same table. The instrument compiles the
+tp-sharded decode over an emulated k-device mesh and reads XLA's cost
+model for the PER-PARTITION module (SPMD: the compiled module IS one
+chip's program), alongside the kernel's own declared per-chip bytes
+(ops/pallas_paged.paged_call_cost at the local head count). Replicated
+weights/activations keep the ratio above the pure-KV 1/k floor at this
+tiny d_model; the KV term dominates as models grow.
+
 Knobs: SERVING_BYTES_T (comma list, default 128,512,2048),
 SERVING_BYTES_BATCH (4), SERVING_BYTES_EXEC=1 (also time 20 real decode
-steps per leg). Output: one JSON line per (path, T) + a summary table
-on stderr. tpu_session.sh step 2d runs it on TPU; the committed CPU run
-is BENCH_BYTES_SERVING_CPU.txt.
+steps per leg), SERVING_BYTES_TP (comma list, default 1,2,4 — legs that
+don't fit the device/head count are skipped with a note). Output: one
+JSON line per (path, T) and per tp leg + a summary table on stderr.
+tpu_session.sh steps 2d/2g run it on TPU; the committed CPU run is
+BENCH_BYTES_SERVING_CPU.txt.
 """
 import json
 import os
@@ -43,7 +56,7 @@ import time
 import numpy as np
 
 
-def build_engine(paged, max_len, batch, cfg_kw, block_size=16):
+def build_engine(paged, max_len, batch, cfg_kw, block_size=16, tp=None):
     import jax
     from mxnet_tpu import serving
     from mxnet_tpu.models.transformer import (TransformerConfig,
@@ -52,7 +65,7 @@ def build_engine(paged, max_len, batch, cfg_kw, block_size=16):
     params = init_transformer_params(jax.random.PRNGKey(0), cfg)
     model = serving.TransformerLM(params, cfg)
     eng = serving.Engine(model, max_batch=batch, block_size=block_size,
-                         paged=paged)
+                         paged=paged, tp=tp)
     return eng, model
 
 
@@ -86,8 +99,13 @@ def paged_width(eng, true_lens):
 def analyze(eng, model, padded_T, width, true_lens):
     import jax.numpy as jnp
     toks, pos, tabs = decode_args(eng, true_lens, width)
-    fn = model._decode_paged_jit if eng.paged else model._decode_jit
-    args = (model.params, eng.cache.k, eng.cache.v, jnp.asarray(toks),
+    if eng.tp > 1:
+        fn, params = model._decode_tp_jit, model._tp_params
+    elif eng.paged:
+        fn, params = model._decode_paged_jit, model.params
+    else:
+        fn, params = model._decode_jit, model.params
+    args = (params, eng.cache.k, eng.cache.v, jnp.asarray(toks),
             jnp.asarray(pos), jnp.asarray(tabs))
     t0 = time.perf_counter()
     compiled = fn.lower(*args).compile()
@@ -96,6 +114,7 @@ def analyze(eng, model, padded_T, width, true_lens):
         cost = cost[0] if cost else {}
     info = {
         "path": "paged" if eng.paged else "gather",
+        "tp": eng.tp,
         "padded_T": padded_T,
         "table_width": width,
         "true_lens": list(true_lens),
@@ -109,7 +128,7 @@ def analyze(eng, model, padded_T, width, true_lens):
         t0 = time.perf_counter()
         n = 20
         for _ in range(n):
-            k, v, logits, nxt = fn(model.params, k, v, args[3], args[4],
+            k, v, logits, nxt = fn(params, k, v, args[3], args[4],
                                    args[5])
         np.asarray(nxt)
         info["decode_ms_per_step"] = round(
@@ -118,6 +137,16 @@ def analyze(eng, model, padded_T, width, true_lens):
 
 
 def main():
+    # the tp legs need a multi-device host platform; the flag must land
+    # before the first jax import and is a no-op for real TPU backends
+    tp_legs = [int(x) for x in os.environ.get("SERVING_BYTES_TP",
+                                              "1,2,4").split(",") if x]
+    flags = os.environ.get("XLA_FLAGS", "")
+    if max(tp_legs, default=1) > 1 and \
+            "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=%d"
+            % max(tp_legs)).strip()
     import jax
     dev = jax.devices()[0]
     batch = int(os.environ.get("SERVING_BYTES_BATCH", "4"))
@@ -178,6 +207,58 @@ def main():
               "(flat == independent of padded history)"
               % (max(gather) / min(gather), max(paged) / min(paged)),
               file=sys.stderr)
+
+    # --- tensor-parallel legs: PER-CHIP decode bytes vs tp=1 ------------
+    from mxnet_tpu.ops.pallas_paged import paged_call_cost
+    cfg_heads, cfg_dh = cfg_kw["n_heads"], \
+        cfg_kw["d_model"] // cfg_kw["n_heads"]
+    n_dev = len(jax.devices())
+    tp_rows = []
+    for k in tp_legs:
+        if k > 1 and (cfg_heads % k or n_dev < k):
+            print(json.dumps({"path": "paged", "tp": k,
+                              "skipped": "needs %d devices and heads%%%d"
+                                         "==0 (have %d devices, %d heads)"
+                                         % (k, k, n_dev, cfg_heads)}),
+                  flush=True)
+            continue
+        eng_t, model_t = build_engine(True, t_max, batch, cfg_kw,
+                                      block_size, tp=k)
+        if eng_t.tp != k:
+            print(json.dumps({"path": "paged", "tp": k,
+                              "skipped": eng_t.tp_fallback}), flush=True)
+            continue
+        info = analyze(eng_t, model_t, t_max, w_paged, true_lens)
+        info["batch"] = batch
+        info["device"] = getattr(dev, "device_kind", dev.platform)
+        # the kernel's own declared per-chip traffic at H/k local heads
+        # (exact 1/k modulo the replicated int32 tables)
+        fl, by = paged_call_cost(batch, 1, cfg_heads // k, cfg_dh,
+                                 w_paged, block_size)
+        info["declared_kernel_bytes_per_chip_per_layer"] = by
+        if interp:
+            info["note"] = ("per-partition cost of the SPMD module "
+                            "(one chip's program); Pallas interpreter "
+                            "staging inflates absolute bytes on CPU — "
+                            "the tp RATIO is the decision signal, and "
+                            "replicated weights keep it above the "
+                            "pure-KV 1/k floor at this tiny d_model")
+        tp_rows.append(info)
+        print(json.dumps(info), flush=True)
+    if tp_rows and all(r["bytes_accessed"] for r in tp_rows):
+        # baseline is the tp=1 leg when it ran; otherwise the smallest
+        # tp that did (SERVING_BYTES_TP may exclude 1) — the header
+        # names whichever it is, never a silently-wrong "tp1"
+        base = min(tp_rows, key=lambda r: r["tp"])
+        b1 = base["bytes_accessed"]
+        print("\ntp   per-chip MB/step  ratio-vs-tp%d   declared-kernel-"
+              "bytes/chip/layer" % base["tp"], file=sys.stderr)
+        for r in tp_rows:
+            print("%-4d %15.2f  %12.2f   %d"
+                  % (r["tp"], r["bytes_accessed"] / 1e6,
+                     r["bytes_accessed"] / b1,
+                     r["declared_kernel_bytes_per_chip_per_layer"]),
+                  file=sys.stderr)
 
 
 if __name__ == "__main__":

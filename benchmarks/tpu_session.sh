@@ -99,6 +99,27 @@ timeout -k 30 1200 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   python tools/chaos_train.py --multihost --net mlp --steps 16 \
   --save-every 4 2>&1 | tee BENCH_MULTIHOST_DRILL.txt
 
+echo "=== 2g. multi-chip serving: tp-sharded paged engine + replica front door (ISSUE 8) ==="
+# (a) per-chip decode bytes A/B: the serving bytes report's tp legs
+# compile the tp-sharded decode over the real mesh and read the SPMD
+# per-partition cost model next to the kernel's declared per-chip bytes
+# at H/k local heads (paged_call_cost) — expect ~1/k scaling
+# (BENCH_NOTES.md round 9, predictions registered BEFORE this runs; CPU
+# rehearsal committed in BENCH_BYTES_SERVING_CPU.txt's tp section).
+# (b) the tp x replicas front-door grid: aggregate tok/s through
+# serve(replicas=, tp=) under a mixed-length wave, per-replica TTFT
+# p50/p95, router pick overhead in µs — the decision input for the
+# round-9 rule (tp=2 >= +20% decode tok/s at batch 8 => document tp=2
+# as the multi-chip serving recommendation). timeout-bounded: a Mosaic
+# compile hang or a wedged replica must not stall the session.
+: > BENCH_BYTES_SERVING_TP_TPU.txt   # truncate: reruns must not interleave
+timeout -k 30 1800 env SERVING_BYTES_TP=1,2,4 PYTHONPATH=. \
+  python benchmarks/serving_bytes_report.py \
+  2> >(tee -a BENCH_BYTES_SERVING_TP_TPU.txt >&2) \
+  | tee -a BENCH_BYTES_SERVING_TP_TPU.txt
+timeout -k 30 3000 env BENCH_CONFIGS=serving BENCH_SERVING_GRID=1 \
+  MXNET_PAGED_ATTENTION=1 python bench.py | tee BENCH_SERVING_GRID.jsonl
+
 echo "=== 3. flash attention seq sweep (1024/2048/4096) ==="
 BENCH_CONFIGS=transformer_flash BENCH_FLASH_SEQ=1024,2048,4096,8192 \
   python bench.py | tee BENCH_FLASH_SWEEP.jsonl

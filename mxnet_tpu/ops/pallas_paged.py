@@ -29,6 +29,14 @@ sequence), chunked prefill is Tq=chunk (a fixed-shape query block whose
 K/V were appended to the pool just before the call; the ragged mask
 `key_pos <= q_start + i` doubles as the causal mask within the chunk).
 
+Tensor-parallel serving (serving/tp.py) runs this SAME kernel inside
+shard_map over a head-sharded pool: each chip sees H/k heads of every
+block and walks the same replicated table. Nothing here is tp-aware —
+the head grid dimension and the declared CostEstimate are computed from
+the (local) shapes the kernel receives, so per-chip bytes scale ~1/k by
+construction (`paged_call_cost`). Online softmax is per-head, so the
+sharded call needs no cross-chip traffic.
+
 Every pallas_call declares a CostEstimate: on TPU the kernel is an opaque
 custom call, and without declared flops/bytes the XLA cost model — the
 A/B instrument of benchmarks/serving_bytes_report.py — would count it as
@@ -55,6 +63,24 @@ def paged_enabled():
     """MXNET_PAGED_ATTENTION=1 — read when an Engine is constructed
     (docs/ENV_VARS.md)."""
     return os.environ.get("MXNET_PAGED_ATTENTION", "0") == "1"
+
+
+def paged_call_cost(B, Tq, H, Dh, w, block_size, kv_itemsize=4,
+                    q_itemsize=4):
+    """Declared (flops, bytes) of ONE paged_attention call — the
+    CostEstimate `_make_paged` hands XLA, factored out so instruments
+    (benchmarks/serving_bytes_report.py) can cite the same numbers.
+    `H` is the head count THE KERNEL SEES: under tensor-parallel serving
+    (serving/tp.py) each chip runs the kernel over its H/k local heads
+    of the pool shard, so the declared per-chip bytes scale ~1/k by this
+    very formula — tables/q_start (replicated int32) are the only terms
+    that don't."""
+    nk = B * H * w * block_size           # pool tokens touched
+    flops = 4 * nk * Tq * Dh              # 2 MACs/pair for QK and PV
+    bytes_ = (2 * nk * Dh * kv_itemsize           # K + V blocks walked
+              + 2 * B * Tq * H * Dh * q_itemsize  # q in, out back
+              + B * w * 4 + B * 4)                # tables + q_start
+    return flops, bytes_
 
 
 def paged_eligible(head_dim, block_size, n_queries, interpret):
@@ -164,18 +190,18 @@ def _make_paged(scale, block_size, interpret):
         )
         kern = functools.partial(_kernel, scale=scale,
                                  block_size=block_size, nw=w, tq=Tq)
-        nk = B * H * w * block_size           # pool tokens touched
+        # 2 MACs/flop-pair per element for each of the QK and PV
+        # matmuls; bytes = K+V blocks walked + q/out + the tables
+        # (paged_call_cost — shared with the bytes-report instrument)
+        flops, bytes_ = paged_call_cost(
+            B, Tq, H, Dh, w, block_size, kv_itemsize=itemsize,
+            q_itemsize=jnp.dtype(q.dtype).itemsize)
         return pl.pallas_call(
             kern,
             grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
             interpret=interpret,
-            # 2 MACs/flop-pair per element for each of the QK and PV
-            # matmuls; bytes = K+V blocks walked + q/out + the tables
-            **_cost(4 * nk * Tq * Dh,
-                    2 * nk * Dh * itemsize
-                    + 2 * B * Tq * H * Dh * jnp.dtype(q.dtype).itemsize
-                    + tables.size * 4 + q_start.size * 4),
+            **_cost(flops, bytes_),
         )(tables, q_start, q, k_pool, v_pool)
 
     return call

@@ -35,6 +35,25 @@ def data_parallel_mesh(devices=None):
     return build_mesh({"dp": -1}, devices)
 
 
+def replica_devices(replica, tp, devices=None):
+    """Device window for serving replica `replica` at tensor-parallel
+    degree `tp`: the contiguous slice [replica*tp, (replica+1)*tp) —
+    contiguity keeps each replica's tp collectives on neighboring chips
+    (ICI, not DCN). The window wraps modulo the device count, so with
+    fewer than replicas*tp devices, replicas SHARE windows
+    (oversubscription — fine for emulated/CPU hosts; real deployments
+    should size replicas*tp <= devices). A mesh can never hold the same
+    device twice, so when the host has fewer than tp devices the full
+    (short) device list is returned and the Engine's placement fallback
+    reports the honest reason instead of building a broken mesh."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if n < tp:
+        return list(devices)
+    start = (replica * tp) % n
+    return [devices[(start + i) % n] for i in range(tp)]
+
+
 def mesh_sharding(mesh, *spec):
     """NamedSharding shorthand: mesh_sharding(mesh, 'dp', None)."""
     return NamedSharding(mesh, P(*spec))

@@ -25,6 +25,9 @@ from .engine import (Engine, Sequence, TransformerLM, BlockLM, ExportedLM,
 from .scheduler import Scheduler, Request, QueueFull, RequestTimeout
 from .metrics import ServingMetrics
 from .server import LMServer, serve
+from .router import (ReplicatedLMServer, serving_replicas,
+                     NoHealthyReplicas)
+from .tp import serving_tp
 
 __all__ = [
     "BlockPool", "PagedKVCache", "CacheOverflow",
@@ -32,4 +35,6 @@ __all__ = [
     "pow2_bucket",
     "Scheduler", "Request", "QueueFull", "RequestTimeout",
     "ServingMetrics", "LMServer", "serve",
+    "ReplicatedLMServer", "serving_replicas", "serving_tp",
+    "NoHealthyReplicas",
 ]

@@ -311,6 +311,18 @@ class TransformerLM:
             lambda p, k, v, t, qs, ln, li, tb: _tf_prefill_chunk(
                 p, k, v, t, qs, ln, li, tb, cfg, block_size))
 
+    def bind_tp(self, block_size, mesh):
+        """Build the tensor-parallel step functions over `mesh` (axis
+        'tp'): head-major-resharded params plus shard_map-wrapped
+        decode/prefill-chunk (serving/tp.py). `self.params` stays the
+        untouched replicated oracle for the single-device paths."""
+        from .tp import (place_tp_params, build_tp_decode,
+                         build_tp_prefill_chunk)
+        self._tp_params = place_tp_params(self.params, self.cfg, mesh)
+        self._decode_tp_jit = build_tp_decode(self.cfg, block_size, mesh)
+        self._prefill_chunk_tp_jit = build_tp_prefill_chunk(
+            self.cfg, block_size, mesh)
+
     def prefill(self, k, v, tokens, length, table_row):
         return self._prefill_jit(self.params, k, v, tokens, length,
                                  table_row)
@@ -327,6 +339,16 @@ class TransformerLM:
                       table_row):
         return self._prefill_chunk_jit(self.params, k, v, tokens, q_start,
                                        length, last_idx, table_row)
+
+    def decode_tp(self, k, v, tokens, positions, tables):
+        return self._decode_tp_jit(self._tp_params, k, v, tokens,
+                                   positions, tables)
+
+    def prefill_chunk_tp(self, k, v, tokens, q_start, length, last_idx,
+                         table_row):
+        return self._prefill_chunk_tp_jit(self._tp_params, k, v, tokens,
+                                          q_start, length, last_idx,
+                                          table_row)
 
 
 # ---------------------------------------------------------------------------
@@ -426,13 +448,29 @@ class Engine:
     """Owns the compiled step functions, the cache pool, and the shape
     buckets. Thread-compatible, not thread-safe: all compute entry points
     (`start`, `decode_step`) must be called from one serving thread (the
-    server loop); that keeps the functional cache update race-free."""
+    server loop); that keeps the functional cache update race-free.
+
+    Placement flags (`paged`, `tp`, `prefill_chunk`) are read at
+    CONSTRUCTION only and frozen afterwards: the compiled step functions,
+    the cache layout, and the mesh placement are all derived from them at
+    bind time, so a post-start mutation could leave a replica straddling
+    two configs (half the pool sharded one way, jits traced another).
+    Assigning any of them after `__init__` raises; build a new Engine (or
+    replica) instead."""
+
+    #: flags the engine derives compiled state from — construction-only
+    _FROZEN_FLAGS = frozenset(
+        ("paged", "paged_requested", "prefill_chunk", "tp",
+         "tp_requested", "mesh"))
 
     def __init__(self, model, max_batch=8, max_len=None, block_size=16,
                  num_blocks=None, keep_logits=False, paged=None,
-                 prefill_chunk=None):
+                 prefill_chunk=None, tp=None, devices=None):
         from ..ops.pallas_paged import paged_enabled, paged_eligible
         from ..ops.pallas_attention import default_interpret
+        from .tp import (serving_tp, tp_fallback_reason, build_tp_mesh,
+                         kv_pool_spec)
+        from jax.sharding import NamedSharding
         self.model = model
         self.max_batch = max_batch
         self.max_len = int(max_len or model.max_len)
@@ -441,11 +479,27 @@ class Engine:
         self.decode_compilations = 0
         self._sigs = set()
         self.cache = None
+        # tensor parallel: env default (MXNET_SERVING_TP), explicit
+        # `tp=` overrides. tp>1 implies the paged path (the gather
+        # oracle is deliberately single-device); configs the tp step
+        # can't shard fall back to tp=1 with the reason recorded on
+        # `tp_fallback` — the flag switches placement, never logits.
+        tp_req = serving_tp() if tp is None else int(tp)
+        if tp_req < 1:
+            raise MXNetError("tp must be >= 1, got %d" % tp_req)
+        self.tp_requested = tp_req
+        self.tp_fallback = None
+        self.tp = 1
+        self.mesh = None
+        if tp_req > 1 and paged is False:
+            self.tp_fallback = ("paged=False pins the single-device "
+                                "gather oracle")
+            tp_req = 1
         # paged path: env default (MXNET_PAGED_ATTENTION), explicit
         # `paged=` overrides; shapes the Mosaic kernel can't tile fall
         # back to the gather path (interpret mode takes anything)
-        self.paged_requested = paged_enabled() if paged is None \
-            else bool(paged)
+        self.paged_requested = (tp_req > 1) or (
+            paged_enabled() if paged is None else bool(paged))
         self.paged = False
         self.prefill_chunk = 0
         if model.uses_cache:
@@ -463,6 +517,30 @@ class Engine:
                 self.paged = paged_eligible(dh, block_size,
                                             self.prefill_chunk,
                                             default_interpret())
+            if tp_req > 1:
+                reason = tp_fallback_reason(model.cfg, self.paged,
+                                            tp_req, devices)
+                if reason is not None:
+                    self.tp_fallback = reason
+                else:
+                    self.mesh = build_tp_mesh(tp_req, devices)
+                    self.tp = tp_req
+                    self.cache.place(
+                        NamedSharding(self.mesh, kv_pool_spec()))
+                    model.bind_tp(block_size, self.mesh)
+        elif tp_req > 1:
+            self.tp_fallback = ("model family has no cache hooks "
+                                "(BlockLM/ExportedLM run single-device)")
+        self._constructed = True
+
+    def __setattr__(self, name, value):
+        if name in self._FROZEN_FLAGS and \
+                getattr(self, "_constructed", False):
+            raise MXNetError(
+                "Engine.%s is fixed at construction (the compiled steps, "
+                "cache layout, and mesh placement derive from it); build "
+                "a new Engine instead of mutating a live one" % name)
+        object.__setattr__(self, name, value)
 
     # -- admission accounting ------------------------------------------------
 
@@ -538,12 +616,13 @@ class Engine:
                 w = pow2_bucket(self.cache.blocks_for(qs + C),
                                 lo=1, hi=self._nblk)
                 self._count("prefill", (C, w))
-                self.cache.k, self.cache.v, logits = \
-                    self.model.prefill_chunk(
-                        self.cache.k, self.cache.v, jnp.asarray(toks),
-                        jnp.int32(qs), jnp.int32(L),
-                        jnp.int32(min(L - 1 - qs, C - 1)),
-                        jnp.asarray(seq.table_row[:w]))
+                chunk_fn = self.model.prefill_chunk_tp if self.tp > 1 \
+                    else self.model.prefill_chunk
+                self.cache.k, self.cache.v, logits = chunk_fn(
+                    self.cache.k, self.cache.v, jnp.asarray(toks),
+                    jnp.int32(qs), jnp.int32(L),
+                    jnp.int32(min(L - 1 - qs, C - 1)),
+                    jnp.asarray(seq.table_row[:w]))
                 seq.prefilled = min(L, qs + C)
                 if seq.prefilled < L:
                     return False
@@ -621,7 +700,10 @@ class Engine:
                     tabs[i] = s.table_row[:w]
                 step_fn = self.model.decode
                 if self.paged:
-                    step_fn = self.model.decode_paged
+                    # same (batch, width) signature lattice whether the
+                    # step runs on one chip or sharded over the tp mesh
+                    step_fn = self.model.decode_tp if self.tp > 1 \
+                        else self.model.decode_paged
                     self._count("decode", (bb, w))
                 else:
                     self._count("decode", bb)

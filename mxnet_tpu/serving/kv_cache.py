@@ -113,6 +113,15 @@ class PagedKVCache:
         self.k = jnp.zeros(shape, dtype)
         self.v = jnp.zeros(shape, dtype)
 
+    def place(self, sharding):
+        """Lay the device pools out under `sharding` (a NamedSharding).
+        The tensor-parallel engine shards the HEAD axis — each chip owns
+        n_heads/k heads of every block — so block ids, tables, and the
+        host free-list are placement-agnostic and unchanged."""
+        import jax
+        self.k = jax.device_put(self.k, sharding)
+        self.v = jax.device_put(self.v, sharding)
+
     def blocks_for(self, n_tokens):
         """Blocks needed to hold n_tokens KV entries — by construction
         the kernel-side table width for a sequence of that length:

@@ -35,10 +35,18 @@ _OCCUPANCY_BUCKETS = (0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
 
 
 class ServingMetrics:
-    def __init__(self, registry=None):
+    def __init__(self, registry=None, replica=None):
+        """`replica=` stamps every sample of this server's registry with
+        a `replica` label — the multi-replica front door gives each
+        engine replica its own ServingMetrics and aggregates the
+        registries into one exposition (docs/OBSERVABILITY.md)."""
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
-        self.registry = registry or telemetry.MetricsRegistry()
+        if registry is None:
+            labels = {"replica": str(replica)} if replica is not None \
+                else None
+            registry = telemetry.MetricsRegistry(labels=labels)
+        self.registry = registry
         reg = self.registry
         c, g, h = reg.counter, reg.gauge, reg.histogram
         self._submitted = c("serving_requests_submitted_total",

@@ -1,0 +1,338 @@
+"""Multi-replica serving front door: N engine replicas, one door.
+
+One `LMServer` is capped by one serving thread driving one engine —
+tensor parallelism (serving/tp.py) buys per-request latency, but
+aggregate throughput needs replicas. `ReplicatedLMServer` runs N full
+replicas — each with its OWN scheduler, KV block pool, serving thread,
+and private metrics registry (labeled `replica="<i>"`) — behind one
+submit/HTTP front:
+
+* **Least-loaded routing**: a request goes to the healthy replica with
+  the lowest committed-token score (queued prompt+generation budgets
+  plus every in-flight sequence's remaining tokens,
+  `LMServer.load_tokens`), round-robin on ties so equal replicas share
+  bursts instead of piling onto index 0.
+* **Aggregate admission**: the router checks saturation across ALL
+  healthy replicas before accepting — a burst can't be waved through
+  the front door only to be bounced by every replica's private queue.
+  When everyone is full the router raises QueueFull, which the HTTP
+  frontend maps to 503 + Retry-After (one saturated replica is a 429
+  retry story; a saturated FLEET is a capacity signal).
+* **Wedge drain**: a replica whose serving loop stops beating is marked
+  drained — new traffic routes around it and its queued (not yet
+  admitted) requests are re-routed to healthy replicas. `/healthz`
+  reports degraded-not-dead: 200 with `degraded: true` while at least
+  one replica serves. A drained replica that starts beating again (a
+  transient stall — e.g. a multi-second XLA compile of a new shape
+  bucket — not a dead loop) is RESTORED to the routable set, so a
+  hiccup never permanently shrinks the fleet; only a loop that stays
+  wedged stays drained.
+* **Aggregated observability**: `/metrics` merges the per-replica
+  registries into one Prometheus exposition distinguished by the
+  `replica` label (telemetry.merged_prometheus_text); the JSON snapshot
+  carries per-replica snapshots plus summed aggregates.
+
+With tensor parallelism, replica i runs on the contiguous device window
+[i*tp, (i+1)*tp) (parallel/mesh.replica_devices) — tp collectives stay
+on neighboring chips, replicas never share one (when the host has
+enough devices). All placement is fixed at construction, same contract
+as the Engine flags.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ..base import MXNetError
+from .. import telemetry
+from .scheduler import QueueFull
+from .server import LMServer, _HTTPFrontend
+
+
+def serving_replicas():
+    """MXNET_SERVING_REPLICAS — read when `serve()` builds the front
+    door (docs/ENV_VARS.md). 1/unset = single LMServer."""
+    env = os.environ.get("MXNET_SERVING_REPLICAS")
+    return int(env) if env else 1
+
+
+class NoHealthyReplicas(MXNetError):
+    """Every replica behind the front door is drained/dead — a fleet
+    outage, not a client error (the HTTP frontend maps this to 503,
+    never 400; /healthz is already reporting not-ok)."""
+
+
+class ReplicatedLMServer(_HTTPFrontend):
+    """N `LMServer` replicas behind one front door. Construct via
+    `serve(model, replicas=N, ...)`; per-replica kwargs (max_batch,
+    block_size, paged, tp, ...) pass through unchanged."""
+
+    saturated_status = 503          # a saturated FLEET, not one queue
+
+    def __init__(self, model, replicas=2, tp=None, devices=None,
+                 retry_after_s=1.0, **kwargs):
+        from .tp import serving_tp
+        from ..parallel.mesh import replica_devices
+        if replicas < 1:
+            raise MXNetError("replicas must be >= 1, got %r" % replicas)
+        if devices is not None:
+            raise MXNetError("pass devices per replica via tp placement; "
+                             "ReplicatedLMServer slices jax.devices() "
+                             "itself")
+        tp_req = serving_tp() if tp is None else int(tp)
+        if tp_req > 1 and replicas > 1 and \
+                not isinstance(model, (tuple, str)):
+            raise MXNetError(
+                "replicas>1 with tp>1 needs a re-instantiable model — "
+                "pass (params, cfg) or a .mxtpu path, not a shared "
+                "adapter (each replica lays params out on its own "
+                "device window)")
+        self.retry_after_s = retry_after_s
+        self._closed = False
+        self._lock = threading.Lock()
+        self._rr = 0                # round-robin tie-break cursor
+        # router-level observability rides the same merged exposition
+        self.registry = telemetry.MetricsRegistry(
+            labels={"replica": "router"})
+        self._c_requests = self.registry.counter(
+            "serving_router_requests_total",
+            help="requests through the front door (placed + finally "
+                 "rejected; HTTP submit retries count once)")
+        self._c_rejected = self.registry.counter(
+            "serving_router_rejected_total",
+            help="requests bounced because every replica was saturated")
+        self._c_rerouted = self.registry.counter(
+            "serving_router_rerouted_total",
+            help="queued requests re-routed off a drained replica")
+        self._c_drained = self.registry.counter(
+            "serving_router_replicas_drained_total", flight=True,
+            help="replicas drained after a wedge observation")
+        self._c_restored = self.registry.counter(
+            "serving_router_replicas_restored_total",
+            help="drained replicas restored after their loop resumed "
+                 "beating (transient stall, not a dead loop)")
+        self._g_healthy = self.registry.gauge(
+            "serving_router_replicas_healthy",
+            help="replicas currently routable")
+        self._h_pick = self.registry.histogram(
+            "serving_router_pick_seconds",
+            help="least-loaded replica selection (routing overhead)")
+        self.replicas = []
+        self._drained = []
+        try:
+            for i in range(replicas):
+                devs = (replica_devices(i, tp_req) if tp_req > 1
+                        else None)
+                self.replicas.append(LMServer(
+                    model, tp=tp_req, devices=devs, replica_id=i,
+                    **kwargs))
+                self._drained.append(False)
+        except BaseException:
+            for rep in self.replicas:
+                rep.close(drain=False, timeout=5.0)
+            raise
+        self._g_healthy.set(len(self.replicas))
+
+    # -- routing -------------------------------------------------------------
+
+    def _sweep(self, max_beat_age=5.0):
+        """One health pass over every replica: a replica whose loop
+        stopped beating is drained and its queued requests re-homed; a
+        drained replica whose loop beats again (transient stall — a
+        long compile is not a dead loop) is restored. Its queue was
+        already re-homed, so it rejoins empty; sequences that were in
+        flight on it complete normally. Returns this pass's per-replica
+        health dicts so callers never probe a second, later instant —
+        `drained` and `ok` in one /healthz body always agree."""
+        healths = []
+        for i, rep in enumerate(self.replicas):
+            h = rep.health(max_beat_age=max_beat_age)
+            healths.append(h)
+            if self._closed:
+                continue
+            if not self._drained[i] and not h["ok"]:
+                with self._lock:
+                    if self._drained[i]:
+                        continue
+                    self._drained[i] = True
+                self._c_drained.inc(replica=i)
+                self._rehome(rep)
+            elif self._drained[i] and h["ok"]:
+                with self._lock:
+                    if not self._drained[i]:
+                        continue
+                    self._drained[i] = False
+                self._c_restored.inc(replica=i)
+        self._g_healthy.set(len(self.replicas) - sum(self._drained))
+        return healths
+
+    def _routable(self, max_beat_age=5.0):
+        """Indices of replicas traffic may go to, after a wedge/restore
+        sweep."""
+        if self._closed:
+            return []
+        self._sweep(max_beat_age)
+        return [i for i in range(len(self.replicas))
+                if not self._drained[i]]
+
+    def _rehome(self, rep):
+        """Move a drained replica's queued (never admitted) requests to
+        healthy replicas; fail the ones nobody can absorb. Requests
+        already running/prefilling on the wedged engine cannot be moved
+        (their KV blocks live there) — they fail by their own
+        timeouts."""
+        targets = [r for i, r in enumerate(self.replicas)
+                   if not self._drained[i]]
+        for req in rep.drain_queue():
+            placed = False
+            for tgt in sorted(targets, key=lambda r: r.load_tokens()):
+                try:
+                    tgt.adopt(req)
+                    placed = True
+                    break
+                except QueueFull:
+                    continue
+            if placed:
+                self._c_rerouted.inc()
+            else:
+                req._finish(error=MXNetError(
+                    "replica drained and no healthy replica could "
+                    "absorb request %d" % req.id))
+                # the wedged replica counted it submitted; close its
+                # ledger there so aggregate submitted == completed +
+                # failed and no phantom in-flight request lingers
+                rep.metrics.request_finished(req)
+
+    def _pick_order(self):
+        """Routable replicas, least-loaded first; ties broken
+        round-robin from a rotating cursor so equal replicas alternate.
+        The scan is a few dict/list reads per replica — the router
+        overhead the serving bench reports in microseconds."""
+        t0 = time.perf_counter()
+        alive = self._routable()
+        n = len(self.replicas)
+        with self._lock:
+            rr = self._rr
+            self._rr += 1
+        order = sorted(alive, key=lambda i: (
+            self.replicas[i].load_tokens(), (i - rr) % n))
+        self._h_pick.observe(time.perf_counter() - t0)
+        return order
+
+    # -- client API ----------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens=32, eos_id=None,
+               count_reject=True):
+        """Route one request to the least-loaded healthy replica;
+        returns the Request future. Raises QueueFull only when EVERY
+        healthy replica is saturated (the HTTP front maps that to 503 +
+        Retry-After), NoHealthyReplicas when the whole fleet is
+        drained/dead (HTTP 503 — an outage is never a 400), MXNetError
+        when the request can never be served (oversized prompt)."""
+        if self._closed:
+            raise MXNetError("server is closed")
+        order = self._pick_order()
+        if not order:
+            raise NoHealthyReplicas(
+                "no healthy replicas (all %d drained)"
+                % len(self.replicas))
+        for i in order:
+            try:
+                req = self.replicas[i].submit(
+                    prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
+                    count_reject=False)
+                req.replica = i          # where the router placed it
+                # counted on placement (or final rejection) — never per
+                # HTTP retry attempt, which would inflate the request
+                # rate exactly when the fleet is overloaded
+                self._c_requests.inc()
+                return req
+            except QueueFull:
+                continue
+        if count_reject:
+            self._final_reject()
+        raise QueueFull(
+            "all %d replicas saturated; retry after %.0fs"
+            % (len(order), self.retry_after_s or 1.0))
+
+    def generate(self, prompt, max_new_tokens=32, eos_id=None,
+                 timeout=None):
+        return self.submit(prompt, max_new_tokens=max_new_tokens,
+                           eos_id=eos_id).result(timeout)
+
+    def _final_reject(self):
+        self._c_requests.inc()
+        self._c_rejected.inc()
+
+    # -- observability -------------------------------------------------------
+
+    def health(self, max_beat_age=5.0):
+        """Fleet liveness for /healthz: `ok` while ANY replica serves
+        (degraded-not-dead — one wedged replica is drained and routed
+        around, it must not take the door down). Per-replica statuses
+        are the same health dicts the drain/restore sweep judged, so
+        `ok` and `drained` in one response never disagree."""
+        reps = self._sweep(max_beat_age=max_beat_age)
+        for i, h in enumerate(reps):
+            h["replica"] = i
+            h["drained"] = self._drained[i]
+        ok_n = sum(1 for h in reps if h["ok"])
+        return {
+            "ok": bool(ok_n > 0 and not self._closed),
+            "degraded": bool(ok_n < len(reps)),
+            "replicas_total": len(reps),
+            "replicas_healthy": ok_n,
+            "replicas": reps,
+        }
+
+    def snapshot(self):
+        """Per-replica snapshots plus summed aggregates (the JSON
+        /metrics body)."""
+        snaps = [rep.snapshot() for rep in self.replicas]
+        agg_req = {}
+        for s in snaps:
+            for k, v in s["requests"].items():
+                agg_req[k] = agg_req.get(k, 0) + v
+        tokens = sum(s["throughput"]["tokens_generated"] for s in snaps)
+        steps = sum(s["throughput"]["decode_steps"] for s in snaps)
+        queued = sum(s.get("scheduler", {}).get("queued", 0)
+                     for s in snaps)
+        return {
+            "replicas": snaps,
+            "aggregate": {
+                "requests": agg_req,
+                "tokens_generated": tokens,
+                "decode_steps": steps,
+                "queued": queued,
+                "replicas_total": len(snaps),
+                "replicas_drained": sum(self._drained),
+            },
+            "router": self.registry.snapshot(),
+        }
+
+    def prometheus_text(self):
+        """ONE Prometheus exposition over every replica registry plus
+        the router's own — each sample labeled `replica="<i>"` (or
+        `"router"`), HELP/TYPE once per metric name."""
+        for rep in self.replicas:
+            rep.metrics._refresh_gauges(rep.engine, rep.scheduler)
+        return telemetry.merged_prometheus_text(
+            [rep.metrics.registry for rep in self.replicas]
+            + [self.registry])
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self, drain=True, timeout=30.0):
+        self._closed = True
+        for rep in self.replicas:
+            rep.close(drain=drain, timeout=timeout)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -56,25 +56,172 @@ def _resolve_model(model, vocab=None, max_len=None, time_major=False):
                      % type(model))
 
 
-class LMServer:
+class _HTTPFrontend:
+    """The stdlib HTTP front door, shared by the single-engine
+    `LMServer` and the multi-replica `ReplicatedLMServer` (router.py).
+    A front provides submit/snapshot/prometheus_text/health/close plus
+    two backpressure knobs: `saturated_status` (the HTTP code a full
+    queue maps to — 429 on one server, 503 behind the router) and
+    `retry_after_s` (emitted as a Retry-After header when set)."""
+
+    saturated_status = 429
+    retry_after_s = None
+    submit_retries = 3
+    submit_backoff = 0.05
+    _httpd = None
+
+    def _final_reject(self):
+        """Count one request bounced by backpressure after retries."""
+
+    def serve_http(self, host="127.0.0.1", port=8080, block=True):
+        """Start the stdlib HTTP frontend. Endpoints:
+        POST /v1/generate  {"tokens": [...], "max_new_tokens": N,
+                            "eos_id": id?}  -> {"tokens": [...], ...}
+        GET  /v1/metrics   -> the metrics snapshot
+        GET  /healthz      -> {"ok": true}
+        Returns the bound (host, port); with block=False the HTTP server
+        runs on a daemon thread (tests bind port 0)."""
+        from http.server import ThreadingHTTPServer
+        self._httpd = ThreadingHTTPServer((host, port),
+                                          _make_handler(self))
+        addr = self._httpd.server_address
+        if block:
+            try:
+                self._httpd.serve_forever()
+            except KeyboardInterrupt:
+                pass
+            finally:
+                self.close()
+        else:
+            threading.Thread(target=self._httpd.serve_forever,
+                             daemon=True).start()
+        return addr
+
+
+def _make_handler(outer):
+    """BaseHTTPRequestHandler class bound to one `_HTTPFrontend`. All
+    handler threads funnel into the front's submit path; the serving
+    thread(s) stay the single writers of their engines."""
+    from http.server import BaseHTTPRequestHandler
+    from .router import NoHealthyReplicas
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):   # keep stdout clean
+            pass
+
+        def _reply(self, code, payload, headers=None):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                h = outer.health()
+                self._reply(200 if h["ok"] else 503, h)
+            elif self.path in ("/v1/metrics", "/metrics"):
+                accept = self.headers.get("Accept", "")
+                if "text/plain" in accept:
+                    # Prometheus scrape: text exposition 0.0.4
+                    body = outer.prometheus_text().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._reply(200, outer.snapshot())
+            else:
+                self._reply(404, {"error": "unknown path %s" % self.path})
+
+        def do_POST(self):
+            if self.path not in ("/v1/generate", "/generate"):
+                self._reply(404, {"error": "unknown path %s" % self.path})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                from ..utils import retry
+                # a briefly-full queue drains in a few decode steps:
+                # absorb the burst with bounded backoff before bouncing.
+                # count_reject=False: only the FINAL failure below
+                # counts as a rejection in the metrics
+                req = retry(
+                    lambda: outer.submit(
+                        body["tokens"],
+                        max_new_tokens=int(
+                            body.get("max_new_tokens", 32)),
+                        eos_id=body.get("eos_id"),
+                        count_reject=False),
+                    attempts=outer.submit_retries,
+                    backoff=outer.submit_backoff,
+                    retry_on=QueueFull)
+            except QueueFull as e:
+                outer._final_reject()
+                headers = None
+                if outer.retry_after_s is not None:
+                    headers = {"Retry-After":
+                               "%d" % max(1, int(outer.retry_after_s))}
+                self._reply(outer.saturated_status, {"error": str(e)},
+                            headers=headers)
+                return
+            except NoHealthyReplicas as e:
+                # fleet outage, NOT a client error: 503 so load
+                # balancers fail over / clients retry (a 400 would
+                # read as permanent and mask the outage)
+                self._reply(503, {"error": str(e)})
+                return
+            except (KeyError, ValueError, TypeError, MXNetError) as e:
+                # submit-side failures are the CLIENT's fault
+                # (malformed body, empty/oversized prompt)
+                self._reply(400, {"error": "bad request: %s" % e})
+                return
+            try:
+                generated = req.result(
+                    timeout=float(body.get("timeout", 300)))
+            except MXNetError as e:
+                self._reply(500, {"error": str(e)})
+                return
+            self._reply(200, {
+                "tokens": generated,
+                "prompt_len": len(req.prompt),
+                "latency_ms": 1e3 * (req.t_done - req.t_submit),
+            })
+
+    return Handler
+
+
+class LMServer(_HTTPFrontend):
     """Continuous-batching server over one Engine. Start with
-    `serve(...)`; stop with `close()` (or use as a context manager)."""
+    `serve(...)`; stop with `close()` (or use as a context manager).
+    `replica_id=` labels this server's metrics registry (the router
+    gives each replica its index); `tp=`/`devices=` pass through to the
+    Engine's tensor-parallel placement (serving/tp.py)."""
 
     def __init__(self, model, max_batch=8, max_len=None, block_size=16,
                  num_blocks=None, max_queue=64, queue_timeout=None,
                  keep_logits=False, vocab=None, time_major=False,
                  idle_wait=0.005, paged=None, prefill_chunk=None,
-                 token_budget=None):
+                 token_budget=None, tp=None, devices=None,
+                 replica_id=None):
         adapter = _resolve_model(model, vocab=vocab, max_len=max_len,
                                  time_major=time_major)
         self.engine = Engine(adapter, max_batch=max_batch, max_len=max_len,
                              block_size=block_size, num_blocks=num_blocks,
                              keep_logits=keep_logits, paged=paged,
-                             prefill_chunk=prefill_chunk)
+                             prefill_chunk=prefill_chunk, tp=tp,
+                             devices=devices)
         self.scheduler = Scheduler(max_batch=max_batch, max_queue=max_queue,
                                    queue_timeout=queue_timeout,
                                    token_budget=token_budget)
-        self.metrics = ServingMetrics()
+        self.metrics = ServingMetrics(replica=replica_id)
+        self.replica_id = replica_id
         self._idle_wait = idle_wait
         self._work = threading.Event()
         self._closed = False
@@ -370,115 +517,58 @@ class LMServer:
                     met.request_prefilled(seq.request, seq.prefill_s)
             met.prefill_chunk(len(sched.prefilling))
 
-    # -- HTTP frontend -------------------------------------------------------
+    # -- router hooks --------------------------------------------------------
 
-    def serve_http(self, host="127.0.0.1", port=8080, block=True):
-        """Start the stdlib HTTP frontend. Endpoints:
-        POST /v1/generate  {"tokens": [...], "max_new_tokens": N,
-                            "eos_id": id?}  -> {"tokens": [...], ...}
-        GET  /v1/metrics   -> the metrics snapshot
-        GET  /healthz      -> {"ok": true}
-        Returns the bound (host, port); with block=False the HTTP server
-        runs on a daemon thread (tests bind port 0)."""
-        from http.server import (BaseHTTPRequestHandler,
-                                 ThreadingHTTPServer)
-        outer = self
+    def _final_reject(self):
+        self.metrics.request_rejected()
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *args):   # keep stdout clean
-                pass
+    def load_tokens(self):
+        """Routing score for the front door: tokens this replica is
+        still committed to — queued requests' prompt+generation budgets
+        plus every in-flight sequence's remaining tokens. Advisory (the
+        serving thread mutates the running set concurrently); list
+        copies keep the reads safe."""
+        sched = self.scheduler
+        with sched._lock:
+            queued = sum(len(r.prompt) + r.max_new_tokens
+                         for r in sched._queue)
+        running = sum(max(1, s.max_total - len(s.tokens))
+                      for s in list(sched.running))
+        prefilling = sum(
+            max(1, (s.prompt_len - s.prefilled)
+                + (s.max_total - s.prompt_len))
+            for s in list(sched.prefilling))
+        return queued + running + prefilling
 
-            def _reply(self, code, payload):
-                body = json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+    def drain_queue(self):
+        """Pull every queued (not yet admitted) request off this
+        replica's scheduler — the router calls this when the replica
+        wedges, then re-routes the orphans to healthy replicas."""
+        with self.scheduler._lock:
+            orphans = list(self.scheduler._queue)
+            self.scheduler._queue.clear()
+        return orphans
 
-            def do_GET(self):
-                if self.path == "/healthz":
-                    h = outer.health()
-                    self._reply(200 if h["ok"] else 503, h)
-                elif self.path in ("/v1/metrics", "/metrics"):
-                    accept = self.headers.get("Accept", "")
-                    if "text/plain" in accept:
-                        # Prometheus scrape: text exposition 0.0.4
-                        body = outer.prometheus_text().encode()
-                        self.send_response(200)
-                        self.send_header(
-                            "Content-Type",
-                            "text/plain; version=0.0.4; charset=utf-8")
-                        self.send_header("Content-Length",
-                                         str(len(body)))
-                        self.end_headers()
-                        self.wfile.write(body)
-                    else:
-                        self._reply(200, outer.snapshot())
-                else:
-                    self._reply(404, {"error": "unknown path %s"
-                                      % self.path})
-
-            def do_POST(self):
-                if self.path not in ("/v1/generate", "/generate"):
-                    self._reply(404, {"error": "unknown path %s"
-                                      % self.path})
-                    return
-                try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    body = json.loads(self.rfile.read(n) or b"{}")
-                    from ..utils import retry
-                    # a briefly-full queue drains in a few decode steps:
-                    # absorb the burst with bounded backoff before 429.
-                    # count_reject=False: only the FINAL failure below
-                    # counts as a rejection in the metrics
-                    req = retry(
-                        lambda: outer.submit(
-                            body["tokens"],
-                            max_new_tokens=int(
-                                body.get("max_new_tokens", 32)),
-                            eos_id=body.get("eos_id"),
-                            count_reject=False),
-                        attempts=outer.submit_retries,
-                        backoff=outer.submit_backoff,
-                        retry_on=QueueFull)
-                except QueueFull as e:
-                    outer.metrics.request_rejected()
-                    self._reply(429, {"error": str(e)})
-                    return
-                except (KeyError, ValueError, TypeError, MXNetError) as e:
-                    # submit-side failures are the CLIENT's fault
-                    # (malformed body, empty/oversized prompt)
-                    self._reply(400, {"error": "bad request: %s" % e})
-                    return
-                try:
-                    generated = req.result(
-                        timeout=float(body.get("timeout", 300)))
-                except MXNetError as e:
-                    self._reply(500, {"error": str(e)})
-                    return
-                self._reply(200, {
-                    "tokens": generated,
-                    "prompt_len": len(req.prompt),
-                    "latency_ms": 1e3 * (req.t_done - req.t_submit),
-                })
-
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
-        addr = self._httpd.server_address
-        if block:
-            try:
-                self._httpd.serve_forever()
-            except KeyboardInterrupt:
-                pass
-            finally:
-                self.close()
-        else:
-            threading.Thread(target=self._httpd.serve_forever,
-                             daemon=True).start()
-        return addr
+    def adopt(self, req):
+        """Enqueue a Request object created elsewhere (a drained
+        replica's orphan). Raises QueueFull under backpressure."""
+        if self._closed:
+            raise QueueFull("replica is closed")
+        self.scheduler.submit(req)
+        self._work.set()
+        return req
 
 
-def serve(model, **kwargs):
-    """Build and start an LMServer over `model` (see module docstring for
-    accepted forms). Keyword args pass through to LMServer."""
+def serve(model, replicas=None, **kwargs):
+    """Build and start a serving front door over `model` (see module
+    docstring for accepted forms). With `replicas=N > 1` (or
+    `MXNET_SERVING_REPLICAS=N`) this is a `ReplicatedLMServer`: N engine
+    replicas — each with its own scheduler, cache pool, serving thread,
+    and metrics registry — behind one submit/HTTP front with
+    least-loaded routing (router.py). Otherwise a single `LMServer`.
+    Keyword args pass through to each LMServer."""
+    from .router import ReplicatedLMServer, serving_replicas
+    n = serving_replicas() if replicas is None else int(replicas)
+    if n > 1:
+        return ReplicatedLMServer(model, replicas=n, **kwargs)
     return LMServer(model, **kwargs)

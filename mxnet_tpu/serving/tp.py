@@ -1,0 +1,255 @@
+"""Tensor-parallel serving: the paged engine sharded over a named mesh.
+
+`MXNET_SERVING_TP=k` (or `Engine(tp=k)`) shards one engine replica's
+transformer weights and KV block pool over a `{'tp': k}` mesh
+(parallel/mesh.py — the SAME GSPMD axis the training dp×tp mesh uses),
+so per-request decode latency stops being capped by one chip:
+
+* Weights shard Megatron-style head-wise/column-row with NamedSharding
+  (the SNIPPETS [1]–[3] pattern, `transformer_shardings`' tp specs):
+  wqkv column-parallel over heads, wo row-parallel, FFN w1 column /
+  w2 row. Embeddings, layer norms, and the LM head stay replicated —
+  after every row-parallel psum the residual stream is replicated, so
+  logits come out identical on every chip (no cross-chip argmax).
+* The KV block pool shards over the HEAD axis — each chip owns H/k
+  heads of EVERY block, so block tables stay replicated host-side
+  integers and the free-list/scheduling logic is untouched.
+* The ragged paged-attention kernel (ops/pallas_paged.py) runs inside
+  `shard_map`: each chip walks the same block table against its own
+  H/k-head pool shard. Online softmax is per-head, so no softmax
+  statistic ever crosses a chip — the only collectives are the two
+  psums per layer (attention output and FFN output projections), and
+  the decode bytes each chip moves drop ~1/k.
+
+Fallback semantics (docs/ENV_VARS.md): the flag switches PLACEMENT,
+never logits. Configs the tp path can't shard (heads or d_ff not
+divisible by k, MoE FFN, fewer than k devices, paged kernel ineligible,
+model family without cache hooks) fall back to tp=1 with the reason
+recorded on `Engine.tp_fallback`; the math is bit-comparable either way
+(f32 parity pinned in tests/test_serving_tp.py against both the
+single-device paged and the gather oracles).
+
+Everything here is read at Engine CONSTRUCTION only — a replica can
+never straddle two placements (Engine raises on post-start mutation).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from ..parallel.mesh import build_mesh
+from ..parallel.collectives import shard_map, allreduce
+
+#: the serving mesh axis name — deliberately the same axis name the
+#: training dp×tp mesh uses for its tensor dimension.
+TP_AXIS = "tp"
+
+
+def serving_tp():
+    """MXNET_SERVING_TP — read when an Engine is constructed
+    (docs/ENV_VARS.md). 1/unset = single-chip."""
+    env = os.environ.get("MXNET_SERVING_TP")
+    return int(env) if env else 1
+
+
+def tp_fallback_reason(cfg, paged, tp, devices=None):
+    """Why a tp>1 request must fall back to tp=1 (None = shardable).
+    Placement-only fallback: the served logits are identical either
+    way."""
+    if not paged:
+        return ("paged path off/ineligible; the gather oracle is "
+                "single-device")
+    if cfg.n_experts:
+        return "MoE FFN is not tp-sharded; serve dense-FFN configs"
+    if cfg.n_heads % tp:
+        return "n_heads %d not divisible by tp=%d" % (cfg.n_heads, tp)
+    if cfg.d_ff % tp:
+        return "d_ff %d not divisible by tp=%d" % (cfg.d_ff, tp)
+    n = len(devices if devices is not None else jax.devices())
+    if n < tp:
+        return "tp=%d needs %d devices, have %d" % (tp, tp, n)
+    return None
+
+
+def build_tp_mesh(tp, devices=None):
+    return build_mesh({TP_AXIS: tp}, devices)
+
+
+def kv_pool_spec():
+    """The block pool (L, num_blocks, block_size, H, Dh) shards over the
+    head axis: every chip owns H/k heads of every block, tables stay
+    replicated."""
+    return P(None, None, None, TP_AXIS, None)
+
+
+def reorder_qkv_heads(wqkv, n_heads):
+    """Rewrite a fused (D, 3D) QKV projection from qkv-major columns
+    ([q all heads | k all heads | v all heads]) to HEAD-major
+    ([head0: q,k,v | head1: q,k,v | ...]) so a contiguous column shard
+    is exactly the q/k/v projections of H/k whole heads."""
+    D = wqkv.shape[0]
+    Dh = D // n_heads
+    return wqkv.reshape(D, 3, n_heads, Dh).transpose(0, 2, 1, 3) \
+        .reshape(D, 3 * D)
+
+
+def tp_param_specs(cfg):
+    """name -> PartitionSpec for the serving tp mesh (dense-FFN configs
+    only; `tp_fallback_reason` gates MoE out). Matches the head-major
+    wqkv layout of `reorder_qkv_heads`."""
+    s = {"embed": P(), "pos_embed": P(), "head": P(),
+         "lnf_g": P(), "lnf_b": P()}
+    for i in range(cfg.n_layers):
+        pre = "layer%d_" % i
+        s[pre + "ln1_g"] = P()
+        s[pre + "ln1_b"] = P()
+        s[pre + "wqkv"] = P(None, TP_AXIS)   # column parallel (heads)
+        s[pre + "wo"] = P(TP_AXIS, None)     # row parallel
+        s[pre + "ln2_g"] = P()
+        s[pre + "ln2_b"] = P()
+        s[pre + "w1"] = P(None, TP_AXIS)
+        s[pre + "w2"] = P(TP_AXIS, None)
+    return s
+
+
+def place_tp_params(params, cfg, mesh):
+    """Head-major-reorder the QKV projections and lay the whole params
+    dict out on the mesh per `tp_param_specs`. Returns a NEW dict — the
+    caller's original (replicated, qkv-major) params stay untouched as
+    the single-device parity oracle."""
+    out = dict(params)
+    for i in range(cfg.n_layers):
+        pre = "layer%d_" % i
+        out[pre + "wqkv"] = reorder_qkv_heads(params[pre + "wqkv"],
+                                              cfg.n_heads)
+    specs = tp_param_specs(cfg)
+    missing = set(out) - set(specs)
+    if missing:
+        raise MXNetError("tp serving: no PartitionSpec for params %r"
+                         % sorted(missing))
+    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in out.items()}
+
+
+# ---------------------------------------------------------------------------
+# the sharded step bodies (run inside shard_map: every array is the
+# per-chip LOCAL shard; heads dimension is H/k)
+# ---------------------------------------------------------------------------
+
+
+def _local_qkv(h, wqkv_local, Dh):
+    """h (S, D) @ head-major wqkv shard -> per-head q/kk/vv (S, Hl, Dh)."""
+    S = h.shape[0]
+    qkv = (h @ wqkv_local).reshape(S, -1, 3, Dh)
+    return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+
+def _decode_body(params, k_pool, v_pool, tokens, positions, tables, cfg,
+                 block_size):
+    """Per-chip half of `engine._tf_decode_paged`: same contract, but
+    q/k/v and the pool carry only this chip's heads and the output/FFN
+    projections psum over the tp axis. The residual stream `x` is
+    replicated-by-construction after every psum, so the logits (and the
+    argmax) are identical on every chip."""
+    from ..models.transformer import _layer_norm
+    from ..ops.pallas_paged import paged_attention
+    from .kv_cache import flat_slots, write_kv
+
+    B = tokens.shape[0]
+    D, H = cfg.d_model, cfg.n_heads
+    Dh = D // H
+    x = params["embed"][tokens] + params["pos_embed"][positions]
+    slots = flat_slots(tables, positions, block_size)
+    for i in range(cfg.n_layers):
+        pre = "layer%d_" % i
+        h = _layer_norm(x, params[pre + "ln1_g"], params[pre + "ln1_b"])
+        q, kk, vv = _local_qkv(h, params[pre + "wqkv"], Dh)
+        k_pool, v_pool = write_kv(k_pool, v_pool, i, slots, kk, vv)
+        att = paged_attention(q[:, None], k_pool[i], v_pool[i], tables,
+                              positions, block_size)[:, 0]   # (B,Hl,Dh)
+        x = x + allreduce(att.reshape(B, -1) @ params[pre + "wo"],
+                          TP_AXIS)
+        h = _layer_norm(x, params[pre + "ln2_g"], params[pre + "ln2_b"])
+        x = x + allreduce(
+            jax.nn.relu(h @ params[pre + "w1"]) @ params[pre + "w2"],
+            TP_AXIS)
+    h = _layer_norm(x, params["lnf_g"], params["lnf_b"])
+    logits = (h @ params["head"]).astype(jnp.float32)
+    return k_pool, v_pool, logits, jnp.argmax(logits, -1).astype(jnp.int32)
+
+
+def _prefill_chunk_body(params, k_pool, v_pool, toks, qs, length,
+                        last_idx, table_row, cfg, block_size):
+    """Per-chip half of `engine._tf_prefill_chunk` (one fixed-shape
+    chunk of ONE sequence): identical null-block padding semantics, this
+    chip's heads only, psum on the two output projections."""
+    from ..models.transformer import _layer_norm
+    from ..ops.pallas_paged import paged_attention
+    from .kv_cache import write_kv
+
+    C = toks.shape[0]
+    D, H = cfg.d_model, cfg.n_heads
+    Dh = D // H
+    pos = qs + jnp.arange(C)
+    x = params["embed"][toks] + params["pos_embed"][pos]
+    slots = jnp.take(table_row, pos // block_size) * block_size \
+        + pos % block_size
+    slots = jnp.where(pos < length, slots, pos % block_size)   # null blk
+    tables = table_row[None]
+    qs_row = jnp.reshape(qs, (1,)).astype(jnp.int32)
+    for i in range(cfg.n_layers):
+        pre = "layer%d_" % i
+        h = _layer_norm(x, params[pre + "ln1_g"], params[pre + "ln1_b"])
+        q, kk, vv = _local_qkv(h, params[pre + "wqkv"], Dh)
+        k_pool, v_pool = write_kv(k_pool, v_pool, i, slots, kk, vv)
+        att = paged_attention(q[None], k_pool[i], v_pool[i], tables,
+                              qs_row, block_size)[0]          # (C,Hl,Dh)
+        x = x + allreduce(att.reshape(C, -1) @ params[pre + "wo"],
+                          TP_AXIS)
+        h = _layer_norm(x, params[pre + "ln2_g"], params[pre + "ln2_b"])
+        x = x + allreduce(
+            jax.nn.relu(h @ params[pre + "w1"]) @ params[pre + "w2"],
+            TP_AXIS)
+    h_last = _layer_norm(x[last_idx], params["lnf_g"], params["lnf_b"])
+    logits = (h_last @ params["head"]).astype(jnp.float32)
+    return k_pool, v_pool, logits
+
+
+def build_tp_decode(cfg, block_size, mesh):
+    """jit(shard_map(decode)) over the tp mesh. Signature matches the
+    single-device `_decode_paged_jit`: (params, k, v, tokens, positions,
+    tables) -> (k, v, logits, next)."""
+    specs = tp_param_specs(cfg)
+    pool = kv_pool_spec()
+
+    def body(params, k, v, toks, pos, tabs):
+        return _decode_body(params, k, v, toks, pos, tabs, cfg,
+                            block_size)
+
+    return jax.jit(shard_map(
+        body, mesh,
+        in_specs=(specs, pool, pool, P(None), P(None), P(None, None)),
+        out_specs=(pool, pool, P(None, None), P(None)),
+        check_vma=False))
+
+
+def build_tp_prefill_chunk(cfg, block_size, mesh):
+    """jit(shard_map(prefill_chunk)) over the tp mesh. Signature matches
+    the single-device `_prefill_chunk_jit`: (params, k, v, toks, qs,
+    length, last_idx, table_row) -> (k, v, logits)."""
+    specs = tp_param_specs(cfg)
+    pool = kv_pool_spec()
+
+    def body(params, k, v, toks, qs, length, last_idx, table_row):
+        return _prefill_chunk_body(params, k, v, toks, qs, length,
+                                   last_idx, table_row, cfg, block_size)
+
+    return jax.jit(shard_map(
+        body, mesh,
+        in_specs=(specs, pool, pool, P(None), P(), P(), P(), P(None)),
+        out_specs=(pool, pool, P(None)),
+        check_vma=False))

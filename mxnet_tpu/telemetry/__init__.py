@@ -24,7 +24,7 @@ from . import tracing
 from . import flight as _flight_mod
 
 from .metrics import (enabled, MetricsRegistry, default_registry,
-                      DEFAULT_BUCKETS)
+                      DEFAULT_BUCKETS, merged_prometheus_text)
 from .tracing import (span, record_span, current_trace, set_trace,
                       spans, export_perfetto)
 from .flight import FlightRecorder, flight

@@ -281,32 +281,61 @@ class MetricsRegistry:
         by tests: HELP/TYPE comment pairs, label set on every sample,
         cumulative `_bucket{le=...}` + `_sum`/`_count` for histograms,
         trailing newline."""
-        lines = []
+        return merged_prometheus_text([self])
+
+    def _sample_lines(self, lines, name, m):
+        """Append one metric's sample lines (no HELP/TYPE) under this
+        registry's label set."""
         labels = self._label_str()
-        with self._lock:
-            metrics = dict(self._metrics)
-        for name, m in sorted(metrics.items()):
-            if m.help:
-                lines.append("# HELP %s %s" % (name, m.help))
-            lines.append("# TYPE %s %s" % (name, m.kind))
-            if m.kind == "histogram":
-                cum = 0
-                for bound, c in zip(list(m.buckets) + [float("inf")],
-                                    m._counts):
-                    cum += c
-                    lab = '%s,le="%s"' % (labels, _fmt(bound)) if labels \
-                        else 'le="%s"' % _fmt(bound)
-                    lines.append("%s_bucket{%s} %d" % (name, lab, cum))
-                lines.append("%s_sum{%s} %s" % (name, labels, _fmt(m.sum)))
-                lines.append("%s_count{%s} %d" % (name, labels, m.count))
-            else:
-                lines.append("%s{%s} %s" % (name, labels, _fmt(m.value)))
-        return "\n".join(lines) + "\n"
+        if m.kind == "histogram":
+            cum = 0
+            for bound, c in zip(list(m.buckets) + [float("inf")],
+                                m._counts):
+                cum += c
+                lab = '%s,le="%s"' % (labels, _fmt(bound)) if labels \
+                    else 'le="%s"' % _fmt(bound)
+                lines.append("%s_bucket{%s} %d" % (name, lab, cum))
+            lines.append("%s_sum{%s} %s" % (name, labels, _fmt(m.sum)))
+            lines.append("%s_count{%s} %d" % (name, labels, m.count))
+        else:
+            lines.append("%s{%s} %s" % (name, labels, _fmt(m.value)))
 
     def reset(self):
         """Drop every metric (tests and bench.py's per-config isolation)."""
         with self._lock:
             self._metrics.clear()
+
+
+def merged_prometheus_text(registries):
+    """One Prometheus exposition over several registries — the
+    multi-replica serving front door's `/metrics`: each engine replica
+    records into a private registry labeled `replica="<i>"`, and the
+    router merges them so every metric name appears ONCE with HELP/TYPE
+    and one sample (or histogram series) per replica. Same-name metrics
+    must agree on kind (first registry wins the HELP text)."""
+    per = []
+    for reg in registries:
+        with reg._lock:
+            per.append((reg, dict(reg._metrics)))
+    names = sorted({n for _, ms in per for n in ms})
+    lines = []
+    for name in names:
+        kinds = {ms[name].kind for _, ms in per if name in ms}
+        if len(kinds) > 1:
+            raise ValueError("metric %r registered with mixed kinds %r "
+                             "across registries" % (name, sorted(kinds)))
+        meta_done = False
+        for reg, ms in per:
+            m = ms.get(name)
+            if m is None:
+                continue
+            if not meta_done:
+                if m.help:
+                    lines.append("# HELP %s %s" % (name, m.help))
+                lines.append("# TYPE %s %s" % (name, m.kind))
+                meta_done = True
+            reg._sample_lines(lines, name, m)
+    return "\n".join(lines) + "\n"
 
 
 _default = MetricsRegistry()

@@ -153,3 +153,19 @@ def test_telemetry_env_knobs(monkeypatch, tmp_path):
     monkeypatch.delenv("MXNET_TELEMETRY")
     reg.counter("off_total").inc(7)
     assert reg.counter("off_total").value == 7
+
+
+def test_serving_tp_and_replicas_env_defaults(monkeypatch):
+    """MXNET_SERVING_TP / MXNET_SERVING_REPLICAS are the construction
+    defaults for Engine(tp=) and serve(replicas=); explicit arguments
+    win (behavior pinned end-to-end in test_serving_tp.py and
+    test_serving_router.py)."""
+    from mxnet_tpu.serving import serving_tp, serving_replicas
+    monkeypatch.setenv("MXNET_SERVING_TP", "2")
+    monkeypatch.setenv("MXNET_SERVING_REPLICAS", "3")
+    assert serving_tp() == 2
+    assert serving_replicas() == 3
+    monkeypatch.delenv("MXNET_SERVING_TP")
+    monkeypatch.delenv("MXNET_SERVING_REPLICAS")
+    assert serving_tp() == 1
+    assert serving_replicas() == 1

@@ -43,6 +43,18 @@ def main():
                     help="per-iteration token budget: decode tokens + "
                          "prefill chunks (default: "
                          "MXNET_SERVING_TOKEN_BUDGET or unbounded)")
+    ap.add_argument("--tp", type=int, default=None,
+                    help="tensor-parallel degree per replica: shard the "
+                         "transformer weights and the KV block pool "
+                         "head-wise over a {'tp': k} mesh (default: "
+                         "MXNET_SERVING_TP or 1; implies --paged; "
+                         "unshardable configs fall back to 1 — "
+                         "placement changes, logits never do)")
+    ap.add_argument("--replicas", type=int, default=None,
+                    help="engine replicas behind one front door with "
+                         "least-loaded routing (default: "
+                         "MXNET_SERVING_REPLICAS or 1); with --tp k, "
+                         "replica i runs on devices [i*k, (i+1)*k)")
     args = ap.parse_args()
 
     from mxnet_tpu import serving
@@ -62,13 +74,27 @@ def main():
     else:
         ap.error("pass --model artifact.mxtpu or --demo")
 
+    # placement flags (--paged/--tp/--replicas) are read HERE, at
+    # construction, and frozen: the Engine raises on post-start
+    # mutation, so a replica can never straddle two configs — restart
+    # the process to change placement
     srv = serving.serve(model, max_batch=args.max_batch,
                         max_queue=args.max_queue,
                         block_size=args.block_size,
                         queue_timeout=args.queue_timeout,
                         paged=args.paged,
                         prefill_chunk=args.prefill_chunk,
-                        token_budget=args.token_budget)
+                        token_budget=args.token_budget,
+                        tp=args.tp,
+                        replicas=args.replicas)
+    if isinstance(srv, serving.ReplicatedLMServer):
+        eng = srv.replicas[0].engine
+        print("front door: %d replicas, tp=%d per replica%s"
+              % (len(srv.replicas), eng.tp,
+                 " (tp fallback: %s)" % eng.tp_fallback
+                 if eng.tp_fallback else ""))
+    elif srv.engine.tp_fallback:
+        print("tp fallback: %s" % srv.engine.tp_fallback)
     print("listening on http://%s:%d  (POST /v1/generate, GET /v1/metrics)"
           % (args.host, args.port))
     srv.serve_http(host=args.host, port=args.port, block=True)
