@@ -33,6 +33,15 @@ BENCH_TOTAL_TIMEOUT (1500), BENCH_REMAT (none|full|io) and BENCH_FUSED
 Every emitted line passes check_line(): numeric comparison fields
 (vs_baseline, mfu, overlap_efficiency, ...) must be computed from a
 measurement — sentinels are rejected at emit time, never recorded.
+
+Every config line also carries the compile watchdog's accounting
+(telemetry/introspect.py): `compile_s` — total wall time the config
+spent compiling (trace + XLA, summed over the watchdog events the
+config triggered) — and `exec_hbm_bytes` — the peak compiled-executable
+device footprint among them via memory_analysis (null where the
+backend doesn't expose it). `tools/bench_sentinel.py` judges a fresh
+run's lines against the committed BASELINE.json + BENCH_r*.json
+trajectory.
 """
 import json
 import os
@@ -209,6 +218,26 @@ def check_line(r):
             and r.get("mfu") is not None:
         raise ValueError("mfu derived from an undisclosed flop count: "
                          "%r" % (r,))
+    # compile-watchdog fields (ISSUE 9): compile_s is the summed wall time
+    # of the watchdog-observed compilations this config triggered,
+    # exec_hbm_bytes the peak compiled-executable footprint among them.
+    # Both are measurements, so the same sentinel rules apply.
+    cs = r.get("compile_s")
+    if cs is not None and (not isinstance(cs, (int, float))
+                           or isinstance(cs, bool) or cs < 0
+                           or cs != cs or cs == float("inf")):
+        raise ValueError("compile_s must be a finite non-negative "
+                         "number of seconds: %r" % (r,))
+    hbm = r.get("exec_hbm_bytes")
+    if hbm is not None:
+        if not isinstance(hbm, int) or isinstance(hbm, bool) or hbm <= 0:
+            raise ValueError("exec_hbm_bytes must be a positive byte "
+                             "count or null (backend without "
+                             "memory_analysis): %r" % (r,))
+        if not cs:
+            raise ValueError("exec_hbm_bytes without compile time — the "
+                             "footprint can only come from a compile "
+                             "event: %r" % (r,))
     return r
 
 
@@ -1364,8 +1393,18 @@ def _run_configs(smoke):
             runs = [{**({} if b is None else {"batch": b}), "fused": f}
                     for b in batches for f in (False, True)]
         for kw in runs:
+            # bracket the config with a watchdog mark: compile_s is the
+            # wall time this config spent compiling (trace + XLA),
+            # exec_hbm_bytes the peak compiled-executable footprint from
+            # memory_analysis (null where the backend doesn't expose it)
+            from mxnet_tpu.telemetry.introspect import watchdog
+            wd_mark = watchdog().mark()
             try:
-                r = check_line(table[name](smoke, dtype, device_kind, **kw))
+                r = table[name](smoke, dtype, device_kind, **kw)
+                compile_s, peak_hbm = watchdog().since(wd_mark)
+                r.setdefault("compile_s", round(compile_s, 6))
+                r.setdefault("exec_hbm_bytes", peak_hbm)
+                r = check_line(r)
             except Exception as e:  # one broken config must not eat the rest
                 r = {"metric": name + "_error", "value": None, "unit": "",
                      "error": "%s: %s" % (type(e).__name__, e), **kw}

@@ -442,7 +442,18 @@ class TrainStep:
                 out = out + (ok, gnorm)
             return out
 
-        self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2))
+        # the compile watchdog (telemetry/introspect.py) owns the
+        # executable cache: every (re)compilation of the fused step is an
+        # attributed `compile` event with memory/cost accounting, and
+        # MXNET_COMPILE_BUDGET / MXNET_HBM_BUDGET_GB apply. `.lower` and
+        # `.__wrapped__` still reach the underlying jit (bench cost
+        # probes, bytes reports, export_train_step).
+        from ..telemetry import introspect as _introspect
+        self._step_fn = _introspect.instrument(
+            jax.jit(step, donate_argnums=(0, 1, 2)), site="train.step",
+            phase="train",
+            argnames=("grad_vals", "nograd_vals", "opt_state", "x", "y",
+                      "key", "lr", "t", "poison"))
         self._names = names
         self._plist = plist
         self._grad_mask = grad_mask

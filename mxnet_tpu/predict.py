@@ -228,7 +228,10 @@ def export_model(model, input_shapes, path, params=None,
         shapes = [(n, shape_map[n]) for n in input_names]
     specs = [jax.ShapeDtypeStruct(tuple(s), jnp.dtype(
         dtypes.get(n, "float32"))) for n, s in shapes]
-    exported = jax.export.export(jax.jit(fn))(*specs)
+    from .telemetry import introspect
+    with introspect.compile_region("predict.export", phase="export",
+                                   path=str(path)):
+        exported = jax.export.export(jax.jit(fn))(*specs)
     blob = exported.serialize()
     meta = {"inputs": [{"name": n, "shape": list(s),
                         "dtype": str(jnp.dtype(dtypes.get(n, "float32")))}
@@ -319,7 +322,10 @@ def export_train_step(step, example_x, example_y, path):
               jax.ShapeDtypeStruct((), jnp.int32),    # seed
               jax.ShapeDtypeStruct((), jnp.float32),  # lr
               jax.ShapeDtypeStruct((), jnp.int32)]    # t
-    exported = jax.export.export(jax.jit(fn))(*specs)
+    from .telemetry import introspect
+    with introspect.compile_region("predict.export", phase="export",
+                                   path=str(path), train_step=True):
+        exported = jax.export.export(jax.jit(fn))(*specs)
     sig = ["in %s %s" % (_sig_dtype(a.dtype),
                          "x".join(str(d) for d in a.shape))
            for a in exported.in_avals]

@@ -36,11 +36,17 @@ Prompt lengths pad to power-of-two buckets (gather path) or one fixed
 chunk shape (paged path), the decode batch and the paged table width pad
 to power-of-two buckets, and the cache pool is fixed-shape (kv_cache.py)
 — so the number of distinct compilations is bounded by #buckets, not by
-traffic. The engine counts distinct signatures (`prefill_compilations` /
-`decode_compilations`); tests pin the bounds for both paths.
+traffic. Since ISSUE 9 every step function registers through the compile
+watchdog (telemetry/introspect.py): `prefill_compilations` /
+`decode_compilations` count, at the real jit seam, the compiles THIS
+engine's calls paid (per-thread dispatch attribution, so engines sharing
+one adapter never absorb a sibling's warm-up), and each compile is
+attributed to the argument whose shape/dtype/sharding changed; tests pin
+the bounds for both paths.
 """
 from __future__ import annotations
 
+import contextlib
 import math
 import time
 
@@ -296,32 +302,61 @@ class TransformerLM:
         return (self.cfg.n_layers, self.cfg.n_heads,
                 self.cfg.d_model // self.cfg.n_heads, dt)
 
+    #: compile-watchdog argument names, shared by every decode/prefill
+    #: signature diff ("tables: shape (1, 1) -> (1, 2) (axis 1)")
+    _DECODE_ARGS = ("params", "k_pool", "v_pool", "tokens", "positions",
+                    "tables")
+    _PREFILL_ARGS = ("params", "k_pool", "v_pool", "tokens", "length",
+                     "table_row")
+    _CHUNK_ARGS = ("params", "k_pool", "v_pool", "tokens", "q_start",
+                   "length", "last_idx", "table_row")
+
     def bind(self, block_size):
         cfg = self.cfg
-        self._prefill_jit = jax.jit(
+        instrument = telemetry.introspect.instrument
+        self._prefill_jit = instrument(jax.jit(
             lambda p, k, v, t, ln, tb: _tf_prefill(p, k, v, t, ln, tb,
-                                                   cfg, block_size))
-        self._decode_jit = jax.jit(
+                                                   cfg, block_size)),
+            site="serving.prefill", phase="prefill",
+            argnames=self._PREFILL_ARGS)
+        self._decode_jit = instrument(jax.jit(
             lambda p, k, v, t, pos, tb: _tf_decode(p, k, v, t, pos, tb,
-                                                   cfg, block_size))
-        self._decode_paged_jit = jax.jit(
+                                                   cfg, block_size)),
+            site="serving.decode", phase="decode",
+            argnames=self._DECODE_ARGS)
+        self._decode_paged_jit = instrument(jax.jit(
             lambda p, k, v, t, pos, tb: _tf_decode_paged(
-                p, k, v, t, pos, tb, cfg, block_size))
-        self._prefill_chunk_jit = jax.jit(
+                p, k, v, t, pos, tb, cfg, block_size)),
+            site="serving.decode", phase="decode",
+            argnames=self._DECODE_ARGS)
+        self._prefill_chunk_jit = instrument(jax.jit(
             lambda p, k, v, t, qs, ln, li, tb: _tf_prefill_chunk(
-                p, k, v, t, qs, ln, li, tb, cfg, block_size))
+                p, k, v, t, qs, ln, li, tb, cfg, block_size)),
+            site="serving.prefill", phase="prefill",
+            argnames=self._CHUNK_ARGS)
 
     def bind_tp(self, block_size, mesh):
         """Build the tensor-parallel step functions over `mesh` (axis
         'tp'): head-major-resharded params plus shard_map-wrapped
         decode/prefill-chunk (serving/tp.py). `self.params` stays the
-        untouched replicated oracle for the single-device paths."""
+        untouched replicated oracle for the single-device paths.
+
+        The tp jits register at the SAME watchdog sites as the
+        single-device paths: a tp restart over unchanged shapes is then
+        attributed to the params/pool sharding diff, not misread as new
+        traffic shapes."""
         from .tp import (place_tp_params, build_tp_decode,
                          build_tp_prefill_chunk)
+        instrument = telemetry.introspect.instrument
         self._tp_params = place_tp_params(self.params, self.cfg, mesh)
-        self._decode_tp_jit = build_tp_decode(self.cfg, block_size, mesh)
-        self._prefill_chunk_tp_jit = build_tp_prefill_chunk(
-            self.cfg, block_size, mesh)
+        self._decode_tp_jit = instrument(
+            build_tp_decode(self.cfg, block_size, mesh),
+            site="serving.decode", phase="decode",
+            argnames=self._DECODE_ARGS)
+        self._prefill_chunk_tp_jit = instrument(
+            build_tp_prefill_chunk(self.cfg, block_size, mesh),
+            site="serving.prefill", phase="prefill",
+            argnames=self._CHUNK_ARGS)
 
     def prefill(self, k, v, tokens, length, table_row):
         return self._prefill_jit(self.params, k, v, tokens, length,
@@ -385,10 +420,14 @@ class BlockLM:
             return rows.astype(jnp.float32)              # (B, V)
 
         self._values = values
-        self._step_jit = jax.jit(step)
+        self._step_jit = telemetry.introspect.instrument(
+            jax.jit(step), site="serving.step_full",
+            argnames=("values", "tokens", "lengths"))
 
-    def step_full(self, tokens, lengths):
-        return self._step_jit(self._values, tokens, lengths)
+    def step_full(self, tokens, lengths, phase=None):
+        # one jit serves both prefill and decode; the caller's `phase`
+        # attributes each compile to the side that triggered it
+        return self._step_jit(self._values, tokens, lengths, _phase=phase)
 
 
 class ExportedLM:
@@ -415,8 +454,15 @@ class ExportedLM:
         self.max_len = self.sig_len
         self._dtype = desc[0]["dtype"]
         self.vocab = None  # unknown until the first forward
+        # the artifact compiles inside jax.export's call machinery — the
+        # watchdog can observe (time first-signature calls) but not AOT
+        # it, so no memory analysis on this site
+        self._call = telemetry.introspect.instrument(
+            lambda buf: pred._exported.call(buf),
+            site="serving.exported_call", argnames=("tokens",),
+            owned=False)
 
-    def step_full(self, tokens, lengths):
+    def step_full(self, tokens, lengths, phase=None):
         """tokens (B, S<=sig_len) int -> f32 logits (B, V) at lengths-1,
         chunking over the exported batch size."""
         tokens = np.asarray(tokens)
@@ -431,7 +477,7 @@ class ExportedLM:
             chunk = tokens[lo:lo + self.sig_batch]
             buf[:] = 0
             buf[:len(chunk), :S] = chunk
-            logits = np.asarray(self._pred._exported.call(buf)[0],
+            logits = np.asarray(self._call(buf, _phase=phase)[0],
                                 np.float32)              # (Bs, Ss, V)
             self.vocab = logits.shape[-1]
             take = lengths[lo:lo + self.sig_batch] - 1
@@ -475,8 +521,6 @@ class Engine:
         self.max_batch = max_batch
         self.max_len = int(max_len or model.max_len)
         self.keep_logits = keep_logits
-        self.prefill_compilations = 0
-        self.decode_compilations = 0
         self._sigs = set()
         self.cache = None
         # tensor parallel: env default (MXNET_SERVING_TP), explicit
@@ -531,6 +575,14 @@ class Engine:
         elif tp_req > 1:
             self.tp_fallback = ("model family has no cache hooks "
                                 "(BlockLM/ExportedLM run single-device)")
+        # per-engine compile counters, fed by the watchdog's per-thread
+        # dispatch attribution (telemetry/introspect.py): each model call
+        # below is bracketed by `_count`, which adds exactly the compiles
+        # THIS engine's call paid — so engines sharing one model adapter
+        # (replicas over a BlockLM, a rebound TransformerLM) never absorb
+        # a sibling's warm-up compiles, matching the pre-migration
+        # engine-local ints while the watchdog stays the source of truth
+        self._compile_counts = {"prefill": 0, "decode": 0}
         self._constructed = True
 
     def __setattr__(self, name, value):
@@ -562,13 +614,36 @@ class Engine:
     def cache_utilization(self):
         return self.cache.utilization() if self.cache else None
 
+    @property
+    def prefill_compilations(self):
+        """Prefill-path compilations THIS engine's calls paid, counted
+        by the compile watchdog at the real jit seam
+        (telemetry/introspect.py) — no longer a hand-maintained proxy.
+        The signature-bound tests pin the same <=2 chunked / per-bucket
+        dense contract as before the migration."""
+        return self._compile_counts["prefill"]
+
+    @property
+    def decode_compilations(self):
+        """Watchdog-counted decode-path compilations (see
+        `prefill_compilations`)."""
+        return self._compile_counts["decode"]
+
+    @contextlib.contextmanager
     def _count(self, kind, sig):
-        if (kind, sig) not in self._sigs:
-            self._sigs.add((kind, sig))
-            if kind == "prefill":
-                self.prefill_compilations += 1
-            else:
-                self.decode_compilations += 1
+        """Bracket one model step call: record its shape-bucket signature
+        (test failure messages show it) and add the compiles the call
+        paid — per-thread attribution, so a sibling engine sharing this
+        adapter never inflates these counters — to this engine's tally."""
+        self._sigs.add((kind, sig))
+        mark = telemetry.introspect.dispatch_mark()
+        try:
+            yield
+        finally:
+            # a dispatch that compiled then FAILED to run still paid the
+            # compile; count it even as the exception propagates
+            self._compile_counts[kind] += \
+                telemetry.introspect.dispatch_compiles_since(mark)
 
     # -- prefill -------------------------------------------------------------
 
@@ -615,14 +690,14 @@ class Engine:
                 toks[:min(C, L - qs)] = prompt[qs:qs + C]
                 w = pow2_bucket(self.cache.blocks_for(qs + C),
                                 lo=1, hi=self._nblk)
-                self._count("prefill", (C, w))
                 chunk_fn = self.model.prefill_chunk_tp if self.tp > 1 \
                     else self.model.prefill_chunk
-                self.cache.k, self.cache.v, logits = chunk_fn(
-                    self.cache.k, self.cache.v, jnp.asarray(toks),
-                    jnp.int32(qs), jnp.int32(L),
-                    jnp.int32(min(L - 1 - qs, C - 1)),
-                    jnp.asarray(seq.table_row[:w]))
+                with self._count("prefill", (C, w)):
+                    self.cache.k, self.cache.v, logits = chunk_fn(
+                        self.cache.k, self.cache.v, jnp.asarray(toks),
+                        jnp.int32(qs), jnp.int32(L),
+                        jnp.int32(min(L - 1 - qs, C - 1)),
+                        jnp.asarray(seq.table_row[:w]))
                 seq.prefilled = min(L, qs + C)
                 if seq.prefilled < L:
                     return False
@@ -632,19 +707,21 @@ class Engine:
                                     hi=self.max_len)
                 toks = np.zeros((s_pad,), np.int32)
                 toks[:L] = prompt
-                self._count("prefill", s_pad)
-                self.cache.k, self.cache.v, logits = self.model.prefill(
-                    self.cache.k, self.cache.v, jnp.asarray(toks),
-                    jnp.int32(L), jnp.asarray(seq.table_row))
+                with self._count("prefill", s_pad):
+                    self.cache.k, self.cache.v, logits = \
+                        self.model.prefill(
+                            self.cache.k, self.cache.v, jnp.asarray(toks),
+                            jnp.int32(L), jnp.asarray(seq.table_row))
                 seq.prefilled = L
                 logits = np.asarray(logits)
             else:
                 s_pad = pow2_bucket(L, lo=1, hi=self.max_len)
                 toks = np.zeros((1, s_pad), np.int32)
                 toks[0, :L] = prompt
-                self._count("prefill", s_pad)
-                logits = np.asarray(self.model.step_full(
-                    jnp.asarray(toks), jnp.asarray([L], np.int32)))[0]
+                with self._count("prefill", s_pad):
+                    logits = np.asarray(self.model.step_full(
+                        jnp.asarray(toks), jnp.asarray([L], np.int32),
+                        phase="prefill"))[0]
                 seq.prefilled = L
         if self.keep_logits:
             seq.last_logits = logits
@@ -704,12 +781,13 @@ class Engine:
                     # step runs on one chip or sharded over the tp mesh
                     step_fn = self.model.decode_tp if self.tp > 1 \
                         else self.model.decode_paged
-                    self._count("decode", (bb, w))
+                    sig = (bb, w)
                 else:
-                    self._count("decode", bb)
-                self.cache.k, self.cache.v, logits, nxt = step_fn(
-                    self.cache.k, self.cache.v, jnp.asarray(toks),
-                    jnp.asarray(pos), jnp.asarray(tabs))
+                    sig = bb
+                with self._count("decode", sig):
+                    self.cache.k, self.cache.v, logits, nxt = step_fn(
+                        self.cache.k, self.cache.v, jnp.asarray(toks),
+                        jnp.asarray(pos), jnp.asarray(tabs))
                 nxt = np.asarray(nxt)
                 logits = np.asarray(logits) if self.keep_logits else None
             else:
@@ -720,8 +798,9 @@ class Engine:
                 for i, s in enumerate(seqs):
                     toks[i, :len(s.tokens)] = s.tokens
                     lens[i] = len(s.tokens)
-                self._count("decode", (bb, s_pad))
-                logits = np.asarray(self.model.step_full(toks, lens))
+                with self._count("decode", (bb, s_pad)):
+                    logits = np.asarray(self.model.step_full(
+                        toks, lens, phase="decode"))
                 nxt = np.argmax(logits, axis=-1)
         # fan the batch-level decode interval out to every request it
         # advanced, so each request's trace row stays connected through

@@ -22,12 +22,16 @@ site into a no-op).
 from . import metrics
 from . import tracing
 from . import flight as _flight_mod
+from . import introspect
 
 from .metrics import (enabled, MetricsRegistry, default_registry,
                       DEFAULT_BUCKETS, merged_prometheus_text)
 from .tracing import (span, record_span, current_trace, set_trace,
                       spans, export_perfetto)
 from .flight import FlightRecorder, flight
+from .introspect import (watchdog, instrument, compile_events,
+                         compile_region, CompileBudgetExceeded,
+                         HbmBudgetExceeded)
 
 
 def counter(name, help="", flight=False):

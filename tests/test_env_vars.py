@@ -169,3 +169,34 @@ def test_serving_tp_and_replicas_env_defaults(monkeypatch):
     monkeypatch.delenv("MXNET_SERVING_REPLICAS")
     assert serving_tp() == 1
     assert serving_replicas() == 1
+
+
+def test_compile_and_hbm_budget_env_knobs(monkeypatch):
+    """MXNET_COMPILE_BUDGET / MXNET_HBM_BUDGET_GB parse `<value>[:policy]`
+    with per-knob policy defaults (warn for the compile budget, raise for
+    the HBM pre-flight); a bad policy fails loudly. Enforcement is pinned
+    end-to-end in test_introspect.py."""
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.telemetry import introspect
+
+    monkeypatch.delenv("MXNET_COMPILE_BUDGET", raising=False)
+    assert introspect.compile_budget() == (None, None)
+    monkeypatch.setenv("MXNET_COMPILE_BUDGET", "4")
+    assert introspect.compile_budget() == (4, "warn")
+    monkeypatch.setenv("MXNET_COMPILE_BUDGET", "4:raise")
+    assert introspect.compile_budget() == (4, "raise")
+    monkeypatch.setenv("MXNET_COMPILE_BUDGET", "4:explode")
+    with pytest.raises(MXNetError):
+        introspect.compile_budget()
+    # a malformed number names the env var too, instead of surfacing as
+    # a bare ValueError from inside the next compile
+    monkeypatch.setenv("MXNET_COMPILE_BUDGET", "4GB")
+    with pytest.raises(MXNetError, match="MXNET_COMPILE_BUDGET"):
+        introspect.compile_budget()
+
+    monkeypatch.delenv("MXNET_HBM_BUDGET_GB", raising=False)
+    assert introspect.hbm_budget_bytes() == (None, None)
+    monkeypatch.setenv("MXNET_HBM_BUDGET_GB", "1.5")
+    assert introspect.hbm_budget_bytes() == (1.5 * 1024.0 ** 3, "raise")
+    monkeypatch.setenv("MXNET_HBM_BUDGET_GB", "2:warn")
+    assert introspect.hbm_budget_bytes() == (2.0 * 1024.0 ** 3, "warn")

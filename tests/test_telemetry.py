@@ -458,3 +458,81 @@ def test_every_pallas_call_declares_cost_estimate():
     assert not missing, ("pallas_call without a declared cost_estimate "
                          "(bytes reports would count it as zero): %s"
                          % ", ".join(missing))
+
+
+# ---------------------------------------------------------------------------
+# static invariant: docs/OBSERVABILITY.md can't drift from the registry
+# ---------------------------------------------------------------------------
+
+
+def _doc_instrument_names():
+    """Backticked instrument-looking tokens in docs/OBSERVABILITY.md,
+    outside fenced code blocks: lowercase snake_case, with `<site>`
+    placeholders mapped onto the %s metric-name templates
+    (telemetry/introspect.py), one optional `{a,b,...}` alternation
+    expanded, `*` kept as a wildcard."""
+    repo = pathlib.Path(mx.__file__).resolve().parent.parent
+    doc = (repo / "docs" / "OBSERVABILITY.md").read_text()
+    doc = re.sub(r"```.*?```", "", doc, flags=re.S)
+    names = set()
+    for span in re.findall(r"`([^`]+)`", doc):
+        t = span.replace("<site>", "%s")
+        if "_" not in t or not re.match(
+                r"^[a-z][a-z0-9_%*]*(?:\{[a-z0-9_,]*\}[a-z0-9_]*)?$", t):
+            continue
+        m = re.match(r"^([a-z0-9_%*]*)\{([a-z0-9_,]*)\}([a-z0-9_]*)$", t)
+        if m:
+            names.update(m.group(1) + alt + m.group(3)
+                         for alt in m.group(2).split(","))
+        else:
+            names.add(t)
+    return names
+
+
+def _code_name_population():
+    """Everything a doc-referenced instrument may resolve to: string
+    literals and attribute names under mxnet_tpu/ + tools/ + bench.py,
+    plus each literal's dot->underscore form (what `CompileSite.sane`
+    renders a site name to, so `serving_decode` finds "serving.decode")."""
+    repo = pathlib.Path(mx.__file__).resolve().parent.parent
+    files = (list((repo / "mxnet_tpu").rglob("*.py"))
+             + list((repo / "tools").glob("*.py"))
+             + [repo / "bench.py"])
+    population = set()
+    for py in files:
+        try:
+            tree = ast.parse(py.read_text(), filename=str(py))
+        except (OSError, SyntaxError):                 # pragma: no cover
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value,
+                                                             str):
+                population.add(node.value)
+                if "." in node.value:
+                    population.add(node.value.replace(".", "_"))
+            elif isinstance(node, ast.Attribute):
+                population.add(node.attr)
+    return population
+
+
+def test_observability_doc_names_only_existing_instruments():
+    """Every instrument name docs/OBSERVABILITY.md references must exist
+    in code (as a metric-name literal, a %s template, or — for sites and
+    accessors — an attribute), so the page cannot drift from the
+    registry. The count floor pins the extraction itself: if a doc
+    rewrite silently stops matching, this fails before the doc rots."""
+    doc_names = _doc_instrument_names()
+    assert len(doc_names) >= 45, ("doc scan broke (found %d names)"
+                                  % len(doc_names))
+    population = _code_name_population()
+    missing = []
+    for name in sorted(doc_names):
+        if "*" in name:
+            pat = re.compile("^" + re.escape(name)
+                             .replace(r"\*", "[a-z0-9_]*") + "$")
+            if not any(pat.match(p) for p in population):
+                missing.append(name + " (wildcard: nothing matches)")
+        elif name not in population:
+            missing.append(name)
+    assert not missing, ("docs/OBSERVABILITY.md names instruments that "
+                         "don't exist in code: %s" % ", ".join(missing))
