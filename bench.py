@@ -1156,6 +1156,110 @@ def _bench_serving_frontdoor(smoke, dtype, tp, replicas, batch=None):
         srv.close()
 
 
+def bench_serving_prefix(smoke, dtype, device_kind, prefix_cache=False):
+    """Shared-system-prompt serving A/B (ISSUE 10): R requests share a
+    long common prefix (the multi-tenant system-prompt / few-shot
+    pattern) with unique per-request suffixes, streamed sequentially
+    through the paged engine with the prefix cache off vs on. The
+    cache-on leg should serve later requests' shared blocks from
+    residency — whole prefill chunks skipped — so the line reports
+    per-request TTFT p50/p95 (the headline value), prefill tok/s, the
+    hit rate, and tokens whose prefill was skipped. Both legs run the
+    SAME compiled kernels; the only difference is which blocks the
+    tables point at (logit parity pinned in
+    tests/test_serving_prefix.py). On CPU the paged kernels run in
+    Pallas interpret mode — absolute times are inflated; judge the
+    on/off DELTA, not the magnitudes (disclosed on the line)."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import serving
+    from mxnet_tpu.models.transformer import (TransformerConfig,
+                                              init_transformer_params)
+
+    cfg = TransformerConfig(vocab=128, d_model=32, n_heads=4, n_layers=2,
+                            d_ff=64, max_len=128) if smoke else \
+        TransformerConfig(vocab=8192, d_model=512, n_heads=4, n_layers=4,
+                          d_ff=2048, max_len=1024)
+    block_size = 8 if smoke else 16
+    shared_len = 48 if smoke else 256
+    suffix_len = 8 if smoke else 32
+    gen = 4 if smoke else 16
+    requests = 6 if smoke else 8
+    params = init_transformer_params(jax.random.PRNGKey(0), cfg)
+    if dtype == "bfloat16":
+        params = {k: v.astype(jnp.bfloat16) for k, v in params.items()}
+    eng = serving.Engine(serving.TransformerLM(params, cfg),
+                         max_batch=requests, block_size=block_size,
+                         paged=True, prefix_cache=prefix_cache)
+    if not eng.paged:
+        raise RuntimeError("prefix A/B needs the paged path; fallback: "
+                           "%r" % (eng.prefix_cache_fallback,))
+    rng = np.random.RandomState(0)
+    shared = list(rng.randint(1, cfg.vocab, shared_len))
+    prompts = [shared + list(rng.randint(1, cfg.vocab, suffix_len))
+               for _ in range(requests)]
+    # warmup: two same-shape requests with a shared prefix, so the
+    # chunk/decode kernels AND the cache-on leg's COW copy are all
+    # compiled before timing; drop the warmup's cache state afterwards
+    wshared = list(rng.randint(1, cfg.vocab, shared_len))
+    for wsuf in ([1, 2], [1, 3]):
+        w = eng.start(wshared + wsuf + [0] * (suffix_len - 2),
+                      max_new=2)
+        eng.decode_step([w])
+        eng.release(w)
+    pc = eng.prefix_cache
+    if pc is not None:
+        pc.flush()
+        pc.lookups = pc.hits = pc.misses = 0
+        pc.hit_tokens_total = pc.cow_copies = pc.evictions = 0
+    ttft_s, seqs = [], []
+    t0 = time.perf_counter()
+    for p in prompts:
+        t1 = time.perf_counter()
+        seqs.append(eng.start(list(p), max_new=gen + 1))
+        ttft_s.append(time.perf_counter() - t1)
+    t_prefill = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    steps = 0
+    for _ in range(gen - 1):
+        eng.decode_step(seqs)
+        steps += 1
+    dt = time.perf_counter() - t0
+    for s in seqs:
+        eng.release(s)
+    line = {"metric": ("smoke_serving_prefix_ttft_ms_p50" if smoke
+                       else "serving_prefix_ttft_ms_p50"),
+            "value": round(1e3 * float(np.percentile(ttft_s, 50)), 3),
+            "unit": "ms",
+            "prefix_cache": "on" if prefix_cache else "off",
+            "requests": requests, "shared_prefix_len": shared_len,
+            "suffix_len": suffix_len, "prompt_len": shared_len
+            + suffix_len, "block_size": block_size,
+            "ttft_ms_p95": round(1e3 * float(np.percentile(ttft_s, 95)),
+                                 3),
+            "prefill_s_total": round(t_prefill, 4),
+            "prefill_tok_per_sec": round(
+                requests * (shared_len + suffix_len) / t_prefill, 1),
+            "decode_tok_per_sec": round(requests * steps / dt, 1),
+            "paged_attention": "on",
+            "vs_baseline": None,
+            "baseline_note": "ISSUE 10 cache on/off A/B at a shared-"
+                             "system-prompt workload; pairs against its "
+                             "own prefix_cache=off leg (no serving path "
+                             "exists in the reference tree)"}
+    if pc is not None:
+        line.update(prefix_hit_rate=round(pc.hit_rate, 4),
+                    prefix_hit_tokens=pc.hit_tokens_total,
+                    prefix_cow_copies=pc.cow_copies,
+                    prefix_evictions=pc.evictions)
+    if device_kind in ("cpu", "CPU") or "cpu" in str(device_kind).lower():
+        line["interpreter_note"] = (
+            "CPU leg: Pallas paged kernels run in interpret mode; "
+            "absolute times are inflated ~100x — judge the cache "
+            "on/off delta only")
+    return line
+
+
 def bench_resilience(smoke, dtype, device_kind):
     """BENCH_RESILIENCE: fault-tolerance runtime overhead — checkpoint
     state-capture (device->host copy, the only part that blocks the
@@ -1319,6 +1423,7 @@ _CONFIGS = [
     ("ssd_forward", bench_ssd_forward),
     ("sparse_linear", bench_sparse_linear),
     ("serving", bench_serving),
+    ("serving_prefix", bench_serving_prefix),
     ("resilience", bench_resilience),
     ("io_pipeline", bench_io_pipeline),
     ("e2e_train_io", bench_e2e_train_io),
@@ -1383,6 +1488,10 @@ def _run_configs(smoke):
                 # leg is the grid's own baseline)
                 runs += [{"tp": t, "replicas": r}
                          for r in (1, 2) for t in (1, 2)]
+        if name == "serving_prefix":
+            # ISSUE 10 A/B: both legs in one invocation, same process,
+            # so the pair always lands together in the artifact
+            runs = [{"prefix_cache": False}, {"prefix_cache": True}]
         if name == "lstm_sweep":
             # always a paired A/B; the full batch sweep (the round-7
             # latency-vs-bandwidth adjudicator) is opt-in — 8 TrainStep

@@ -120,6 +120,17 @@ timeout -k 30 1800 env SERVING_BYTES_TP=1,2,4 PYTHONPATH=. \
 timeout -k 30 3000 env BENCH_CONFIGS=serving BENCH_SERVING_GRID=1 \
   MXNET_PAGED_ATTENTION=1 python bench.py | tee BENCH_SERVING_GRID.jsonl
 
+echo "=== 2h. multi-tenant prefix cache A/B (hit-rate + TTFT, ISSUE 10) ==="
+# Shared-system-prompt workload through the paged engine with
+# MXNET_PREFIX_CACHE off vs on — one invocation emits BOTH legs, so the
+# pair always lands together. Predicted deltas are registered in
+# BENCH_NOTES.md round 10 BEFORE this runs (hit-rate > 0 and TTFT p50
+# improvement on the cache-on leg are the acceptance gates; the CPU
+# cost-model rehearsal is BENCH_PREFIX_AB_CPU.jsonl). timeout-bounded:
+# a Mosaic compile hang must not stall the session.
+timeout -k 30 1800 env BENCH_CONFIGS=serving_prefix \
+  MXNET_PAGED_ATTENTION=1 python bench.py | tee BENCH_PREFIX_AB.jsonl
+
 echo "=== 3. flash attention seq sweep (1024/2048/4096) ==="
 BENCH_CONFIGS=transformer_flash BENCH_FLASH_SEQ=1024,2048,4096,8192 \
   python bench.py | tee BENCH_FLASH_SWEEP.jsonl

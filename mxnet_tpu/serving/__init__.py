@@ -7,9 +7,12 @@ production-shape replacement for autoregressive models: a paged KV-cache
 with bucketed shapes — and, under `MXNET_PAGED_ATTENTION=1`, a ragged
 paged-attention Pallas kernel that reads the cache in place plus
 chunked prefill (ops/pallas_paged.py) — a continuous-batching scheduler
-with backpressure and a per-iteration token budget, serving metrics,
-and an in-process `serve()` API with a stdlib HTTP frontend
-(tools/serve.py).
+with backpressure, a per-iteration token budget, priority classes and
+per-tenant token budgets, a content-addressed prefix cache
+(`MXNET_PREFIX_CACHE=1`, prefix_cache.py: shared prompt prefixes hit
+resident refcounted blocks, copy-on-write on divergence, LRU eviction),
+serving metrics, and an in-process `serve()` API with a stdlib HTTP
+frontend (tools/serve.py).
 
 Quickstart::
 
@@ -20,6 +23,7 @@ Quickstart::
     srv.close()
 """
 from .kv_cache import BlockPool, PagedKVCache, CacheOverflow
+from .prefix_cache import PrefixCache, prefix_cache_enabled
 from .engine import (Engine, Sequence, TransformerLM, BlockLM, ExportedLM,
                      pow2_bucket)
 from .scheduler import Scheduler, Request, QueueFull, RequestTimeout
@@ -31,6 +35,7 @@ from .tp import serving_tp
 
 __all__ = [
     "BlockPool", "PagedKVCache", "CacheOverflow",
+    "PrefixCache", "prefix_cache_enabled",
     "Engine", "Sequence", "TransformerLM", "BlockLM", "ExportedLM",
     "pow2_bucket",
     "Scheduler", "Request", "QueueFull", "RequestTimeout",

@@ -57,7 +57,8 @@ import jax.numpy as jnp
 from ..base import MXNetError
 from .. import telemetry
 from .kv_cache import (PagedKVCache, flat_slots, prompt_slots, write_kv,
-                       gather_kv)
+                       gather_kv, copy_block)
+from .prefix_cache import PrefixCache, prefix_cache_enabled
 
 
 def pow2_bucket(n, lo=1, hi=None):
@@ -77,7 +78,8 @@ class Sequence:
 
     __slots__ = ("tokens", "prompt_len", "block_ids", "table_row",
                  "max_total", "eos_id", "done", "last_logits", "request",
-                 "prefilled", "prefill_s")
+                 "prefilled", "prefill_s", "cache_hit_tokens",
+                 "shared_blocks")
 
     def __init__(self, prompt, max_total, eos_id=None):
         self.tokens = list(prompt)
@@ -91,6 +93,9 @@ class Sequence:
         self.request = None
         self.prefilled = 0
         self.prefill_s = 0.0
+        self.cache_hit_tokens = 0     # prompt tokens served by prefix hits
+        self.shared_blocks = 0        # table entries pointing at shared
+                                      # (refcounted) cache blocks
 
     @property
     def generated(self):
@@ -507,11 +512,12 @@ class Engine:
     #: flags the engine derives compiled state from — construction-only
     _FROZEN_FLAGS = frozenset(
         ("paged", "paged_requested", "prefill_chunk", "tp",
-         "tp_requested", "mesh"))
+         "tp_requested", "mesh", "prefix_cache"))
 
     def __init__(self, model, max_batch=8, max_len=None, block_size=16,
                  num_blocks=None, keep_logits=False, paged=None,
-                 prefill_chunk=None, tp=None, devices=None):
+                 prefill_chunk=None, tp=None, devices=None,
+                 prefix_cache=None):
         from ..ops.pallas_paged import paged_enabled, paged_eligible
         from ..ops.pallas_attention import default_interpret
         from .tp import (serving_tp, tp_fallback_reason, build_tp_mesh,
@@ -575,6 +581,29 @@ class Engine:
         elif tp_req > 1:
             self.tp_fallback = ("model family has no cache hooks "
                                 "(BlockLM/ExportedLM run single-device)")
+        # prefix cache: env default (MXNET_PREFIX_CACHE), explicit
+        # `prefix_cache=` overrides. Needs the chunked-prefill paged
+        # path (a prefill that can START mid-prompt); ineligible configs
+        # fall back with the reason recorded — the flag switches which
+        # blocks a table points at, never logits.
+        self.prefix_cache = None
+        self.prefix_cache_fallback = None
+        self._cow_jit = None
+        want_prefix = (prefix_cache_enabled() if prefix_cache is None
+                       else bool(prefix_cache))
+        if want_prefix:
+            if not model.uses_cache:
+                self.prefix_cache_fallback = (
+                    "model family has no cache hooks (prefix reuse "
+                    "needs the paged KV pool)")
+            elif not self.paged:
+                self.prefix_cache_fallback = (
+                    "prefix reuse needs the chunked-prefill paged path "
+                    "(MXNET_PAGED_ATTENTION=1 / Engine(paged=True)); "
+                    "the gather oracle prefills whole prompts")
+            else:
+                self.prefix_cache = PrefixCache(self.cache.pool,
+                                                block_size)
         # per-engine compile counters, fed by the watchdog's per-thread
         # dispatch attribution (telemetry/introspect.py): each model call
         # below is bracketed by `_count`, which adds exactly the compiles
@@ -603,13 +632,25 @@ class Engine:
         return self.cache.blocks_for(total)
 
     def can_admit(self, prompt_len, max_new):
+        """Would this request's block reservation fit right now? With
+        the prefix cache on, refcount-zero cached blocks count as
+        available — `try_alloc` reclaims them LRU on demand, so a cache
+        that has absorbed the free list is capacity, not exhaustion
+        (without this the scheduler would gate admission forever and
+        the reclaimer, which only runs inside allocation, would never
+        fire). The count is a cheap upper bound (an interior entry
+        pinned through a child may not be reclaimable THIS instant);
+        over-admission is safe — `begin` returns None on the transient
+        shortfall and the serving loop requeues in order."""
         if prompt_len > self.max_len:
             raise MXNetError("prompt length %d exceeds max_len %d"
                              % (prompt_len, self.max_len))
         if self.cache is None:
             return True
-        return self.blocks_needed(prompt_len, max_new) \
-            <= self.cache.pool.available
+        avail = self.cache.pool.available
+        if self.prefix_cache is not None:
+            avail += self.prefix_cache.reclaimable_blocks()
+        return self.blocks_needed(prompt_len, max_new) <= avail
 
     def cache_utilization(self):
         return self.cache.utilization() if self.cache else None
@@ -657,12 +698,57 @@ class Engine:
             raise MXNetError("empty prompt")
         seq = Sequence(prompt, min(self.max_len, L + max_new), eos_id)
         if self.cache is not None:
-            ids = self.cache.pool.try_alloc(self.blocks_needed(L, max_new))
+            n = self.blocks_needed(L, max_new)
+            if self.prefix_cache is None:
+                ids = self.cache.pool.try_alloc(n)
+            else:
+                ids = self._begin_cached(seq, prompt, n)
             if ids is None:
                 return None
             seq.block_ids = ids
             seq.table_row = self.cache.table_row(ids, self._nblk)
         return seq
+
+    def _begin_cached(self, seq, prompt, n):
+        """Prefix-cache admission: point the leading table entries at
+        resident shared blocks (refs taken by the lookup), allocate the
+        rest fresh, and COW-copy a partially-matched tail block — this
+        request WILL write into it (the rest of its prompt, then
+        decode), and a reader must never mutate a shared block. Skipped
+        prefix tokens start `seq.prefilled` past zero, so whole prefill
+        chunks never run. Returns the table's id list, or None on
+        transient exhaustion (all refs dropped)."""
+        pool = self.cache.pool
+        with telemetry.span("prefix.lookup", category="serving",
+                            prompt_len=len(prompt)):
+            full, tail = self.prefix_cache.lookup(prompt)
+        fresh = pool.try_alloc(n - len(full))
+        if fresh is None:
+            held = full + ([tail[0]] if tail else [])
+            if held:
+                pool.free(held)
+            return None
+        hit = len(full) * self.cache.block_size
+        if tail is not None:
+            src, m = tail
+            if self._cow_jit is None:
+                # donate the pools so XLA updates the one block in
+                # place instead of materializing a full-pool copy per
+                # COW (backends without donation just warn and copy)
+                self._cow_jit = jax.jit(copy_block,
+                                        donate_argnums=(0, 1))
+            self.cache.k, self.cache.v = self._cow_jit(
+                self.cache.k, self.cache.v, jnp.int32(src),
+                jnp.int32(fresh[0]))
+            pool.free([src])          # drop the transient tail ref: the
+                                      # private copy replaces it in the
+                                      # table
+            self.prefix_cache.cow_copies += 1
+            hit += m
+        seq.prefilled = hit
+        seq.cache_hit_tokens = hit
+        seq.shared_blocks = len(full)
+        return full + fresh
 
     def prefill_tokens_per_step(self, prompt_len):
         """Tokens one `prefill_step` call will process — the scheduler's
@@ -702,6 +788,13 @@ class Engine:
                 if seq.prefilled < L:
                     return False
                 logits = np.asarray(logits)
+                if self.prefix_cache is not None:
+                    # the full prompt blocks are immutable from here on
+                    # (decode writes start past the prompt): register
+                    # them now so a same-prefix burst hits while this
+                    # request is still decoding. The partial tail stays
+                    # private until release — decode keeps writing it.
+                    self.prefix_cache.insert(prompt, seq.block_ids, L)
             elif self.model.uses_cache:
                 s_pad = pow2_bucket(L, lo=min(8, self.max_len),
                                     hi=self.max_len)
@@ -825,8 +918,23 @@ class Engine:
                 or len(seq.tokens) >= seq.max_total:
             seq.done = True
 
-    def release(self, seq):
-        """Recycle a finished sequence's cache blocks."""
+    def release(self, seq, reusable=True):
+        """Recycle a finished sequence's cache blocks. With the prefix
+        cache on, everything whose KV is now immutable — full blocks
+        over prompt AND generated tokens, plus the final partial tail —
+        is registered for reuse first (the cache pins what it keeps via
+        refcounts; this sequence's own refs are dropped either way).
+        `reusable=False` skips registration — fault paths release
+        sequences whose KV cannot be trusted (a poisoned batch must not
+        seed the cache), and a mid-prefill release registers nothing
+        either way (its blocks may hold partial garbage)."""
         if seq.block_ids:
+            if reusable and self.prefix_cache is not None and \
+                    seq.prefilled >= seq.prompt_len:
+                # the final token was appended but its KV never written:
+                # only tokens[:-1] are content-addressable
+                self.prefix_cache.insert(seq.tokens, seq.block_ids,
+                                         len(seq.tokens) - 1,
+                                         partial_ok=True)
             self.cache.pool.free(seq.block_ids)
             seq.block_ids = []

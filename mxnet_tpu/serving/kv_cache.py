@@ -50,6 +50,19 @@ class BlockPool:
     (LIFO — the hottest block stays cache-warm on the host bookkeeping
     side; device placement is unaffected). `high_water` tracks the peak
     in-use count for the serving metrics snapshot.
+
+    Blocks are REFCOUNTED (the prefix cache shares one block between
+    many sequences): `try_alloc` hands a block out at refcount 1,
+    `add_ref` pins it for an additional reader, and `free` drops one
+    ref per id — a block only returns to the free list at refcount
+    zero, so freeing a shared block can never yank it out from under
+    its other readers. A `free` call is validated ATOMICALLY before any
+    mutation: duplicate ids within one call and ids that are not live
+    both raise with the pool untouched (a partial free on error was a
+    silent corruption vector once blocks became shared). When the free
+    list runs short, `try_alloc` first asks the `reclaimer` hook (the
+    prefix cache) to evict refcount-zero cached blocks, so resident
+    prefixes are reusable capacity, never a leak.
     """
 
     def __init__(self, num_blocks):
@@ -59,7 +72,9 @@ class BlockPool:
         self.num_blocks = num_blocks
         self._free = list(range(num_blocks - 1, 0, -1))  # pop() -> 1 first
         self._live = set()
+        self._refs = {}               # live block id -> refcount >= 1
         self.high_water = 0
+        self.reclaimer = None         # callable(shortfall) -> blocks freed
 
     @property
     def available(self):
@@ -69,26 +84,63 @@ class BlockPool:
     def in_use(self):
         return len(self._live)
 
+    def refcount(self, b):
+        """Current refcount of a block (0 when not live)."""
+        return self._refs.get(b, 0)
+
     def try_alloc(self, n):
-        """Reserve n blocks; None when the pool can't satisfy it right now
-        (backpressure), CacheOverflow when it never could."""
+        """Reserve n blocks (each at refcount 1); None when the pool
+        can't satisfy it right now (backpressure), CacheOverflow when it
+        never could. A shortfall first asks the reclaimer (the prefix
+        cache's LRU eviction) to release refcount-zero cached blocks."""
         if n > self.num_blocks - 1:
             raise CacheOverflow(
                 "requested %d blocks but the pool only has %d total"
                 % (n, self.num_blocks - 1))
+        if n > len(self._free) and self.reclaimer is not None:
+            self.reclaimer(n - len(self._free))
         if n > len(self._free):
             return None
         ids = [self._free.pop() for _ in range(n)]
         self._live.update(ids)
+        for b in ids:
+            self._refs[b] = 1
         self.high_water = max(self.high_water, len(self._live))
         return ids
 
-    def free(self, ids):
+    def add_ref(self, ids):
+        """Pin each live block for one more reader; raises on a block
+        that is not currently live (nothing to pin)."""
         for b in ids:
             if b not in self._live:
-                raise MXNetError("double-free or foreign block id %r" % b)
-            self._live.remove(b)
-            self._free.append(b)
+                raise MXNetError(
+                    "add_ref on block %r which is not live" % b)
+        for b in ids:
+            self._refs[b] += 1
+
+    def free(self, ids):
+        """Drop one ref per id; blocks reaching refcount zero return to
+        the free list. Validated atomically BEFORE any mutation: a
+        duplicate id in one call or a non-live id raises MXNetError and
+        leaves the pool unchanged."""
+        ids = list(ids)
+        seen = set()
+        for b in ids:
+            if b in seen:
+                raise MXNetError(
+                    "duplicate block id %r in one free() call (would "
+                    "drop two refs for one reader); pool left unchanged"
+                    % b)
+            seen.add(b)
+            if b not in self._live:
+                raise MXNetError("double-free or foreign block id %r; "
+                                 "pool left unchanged" % b)
+        for b in ids:
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                del self._refs[b]
+                self._live.remove(b)
+                self._free.append(b)
 
 
 class PagedKVCache:
@@ -170,6 +222,19 @@ def write_kv(k_pool, v_pool, layer, slots, k_new, v_new):
     blk, off = slots // bs, slots % bs
     k_pool = k_pool.at[layer, blk, off].set(k_new.astype(k_pool.dtype))
     v_pool = v_pool.at[layer, blk, off].set(v_new.astype(v_pool.dtype))
+    return k_pool, v_pool
+
+
+def copy_block(k_pool, v_pool, src, dst):
+    """Copy one block's K/V across every layer — the prefix cache's
+    copy-on-write op: a request that will write into a shared block
+    (its tokens diverge mid-block, or its prompt/decode continues
+    inside a cached tail) gets a private copy first, so a shared block
+    is never mutated by a reader. One dynamic-index update per pool;
+    under tensor-parallel placement the block axis is replicated and
+    the head axis sharded, so the copy stays chip-local."""
+    k_pool = k_pool.at[:, dst].set(k_pool[:, src])
+    v_pool = v_pool.at[:, dst].set(v_pool[:, src])
     return k_pool, v_pool
 
 

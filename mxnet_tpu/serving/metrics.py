@@ -76,6 +76,37 @@ class ServingMetrics:
                                     "gather path")
         self._chunks = c("serving_prefill_chunks_total",
                          help="chunked-prefill kernel calls")
+        # prefix-cache observables (ISSUE 10): counters synced from the
+        # engine's PrefixCache monotonic stats on every read path
+        self._prefix_lookups = c("serving_prefix_lookups_total",
+                                 help="prefix-cache lookups at admission")
+        self._prefix_hits = c("serving_prefix_hits_total",
+                              help="admissions served >=1 shared block")
+        self._prefix_misses = c("serving_prefix_misses_total",
+                                help="admissions with no reusable prefix")
+        self._prefix_hit_tokens = c(
+            "serving_prefix_hit_tokens_total",
+            help="prompt tokens whose prefill was skipped via shared "
+                 "blocks")
+        self._prefix_evictions = c(
+            "serving_prefix_evictions_total",
+            help="cached blocks evicted LRU under pool pressure")
+        self._prefix_cow = c(
+            "serving_prefix_cow_total",
+            help="copy-on-write block copies (divergence mid-block / "
+                 "write into a shared tail)")
+        self._prefix_inserts = c("serving_prefix_inserts_total",
+                                 help="blocks registered as reusable "
+                                      "content")
+        self._g_prefix_resident = g(
+            "serving_prefix_resident_tokens",
+            help="tokens of KV currently resident in the prefix cache")
+        self._g_prefix_blocks = g(
+            "serving_prefix_resident_blocks",
+            help="pool blocks the prefix cache currently holds")
+        self._g_prefix_hit_rate = g(
+            "serving_prefix_hit_rate",
+            help="lifetime prefix-cache hit rate (hits / lookups)")
         # paged-serving observables (PR 4) as gauges, so they appear in
         # the Prometheus exposition, not just the JSON snapshot
         self._g_queue = g("serving_queue_depth",
@@ -230,6 +261,24 @@ class ServingMetrics:
             util = engine.cache_utilization()
             if util is not None:
                 self._g_util.set(util)
+        pc = getattr(engine, "prefix_cache", None)
+        if pc is not None:
+            # counters stay monotonic: sync the delta since last read
+            # from the cache's own lifetime totals
+            for ctr, total in ((self._prefix_lookups, pc.lookups),
+                               (self._prefix_hits, pc.hits),
+                               (self._prefix_misses, pc.misses),
+                               (self._prefix_hit_tokens,
+                                pc.hit_tokens_total),
+                               (self._prefix_evictions, pc.evictions),
+                               (self._prefix_cow, pc.cow_copies),
+                               (self._prefix_inserts, pc.inserts)):
+                delta = total - ctr.value
+                if delta > 0:
+                    ctr.inc(delta)
+            self._g_prefix_resident.set(pc.resident_tokens)
+            self._g_prefix_blocks.set(len(pc))
+            self._g_prefix_hit_rate.set(pc.hit_rate)
 
     def prometheus_text(self, engine=None, scheduler=None):
         """Prometheus text exposition (format 0.0.4) of the server's
@@ -303,7 +352,26 @@ class ServingMetrics:
                 "max_len": engine.max_len,
                 "paged_attention": bool(engine.paged),
                 "prefill_chunk": engine.prefill_chunk,
+                "prefix_cache": getattr(engine, "prefix_cache",
+                                        None) is not None,
             }
+            if getattr(engine, "prefix_cache_fallback", None):
+                snap["engine"]["prefix_cache_fallback"] = \
+                    engine.prefix_cache_fallback
+            pc = getattr(engine, "prefix_cache", None)
+            if pc is not None:
+                snap["cache"]["prefix"] = {
+                    "lookups": pc.lookups,
+                    "hits": pc.hits,
+                    "misses": pc.misses,
+                    "hit_rate": pc.hit_rate,
+                    "hit_tokens": pc.hit_tokens_total,
+                    "evictions": pc.evictions,
+                    "cow_copies": pc.cow_copies,
+                    "inserts": pc.inserts,
+                    "resident_tokens": pc.resident_tokens,
+                    "resident_blocks": len(pc),
+                }
             util = engine.cache_utilization()
             if util is not None:
                 pool = engine.cache.pool
@@ -315,6 +383,8 @@ class ServingMetrics:
         if scheduler is not None:
             snap["scheduler"] = {
                 "token_budget": scheduler.token_budget,
+                "tenant_budget": getattr(scheduler, "tenant_budget",
+                                         None),
                 "queued": scheduler.pending(),
                 "prefilling": len(scheduler.prefilling),
             }

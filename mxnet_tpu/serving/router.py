@@ -223,13 +223,17 @@ class ReplicatedLMServer(_HTTPFrontend):
     # -- client API ----------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens=32, eos_id=None,
-               count_reject=True):
+               count_reject=True, tenant=None, priority=None):
         """Route one request to the least-loaded healthy replica;
         returns the Request future. Raises QueueFull only when EVERY
         healthy replica is saturated (the HTTP front maps that to 503 +
         Retry-After), NoHealthyReplicas when the whole fleet is
         drained/dead (HTTP 503 — an outage is never a 400), MXNetError
-        when the request can never be served (oversized prompt)."""
+        when the request can never be served (oversized prompt).
+        `tenant`/`priority` pass through to the placed replica's
+        scheduler (each replica also keeps its own prefix cache — hot
+        prefixes become resident wherever their tenants' traffic
+        lands)."""
         if self._closed:
             raise MXNetError("server is closed")
         order = self._pick_order()
@@ -241,7 +245,7 @@ class ReplicatedLMServer(_HTTPFrontend):
             try:
                 req = self.replicas[i].submit(
                     prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
-                    count_reject=False)
+                    count_reject=False, tenant=tenant, priority=priority)
                 req.replica = i          # where the router placed it
                 # counted on placement (or final rejection) — never per
                 # HTTP retry attempt, which would inflate the request
@@ -298,6 +302,13 @@ class ReplicatedLMServer(_HTTPFrontend):
         steps = sum(s["throughput"]["decode_steps"] for s in snaps)
         queued = sum(s.get("scheduler", {}).get("queued", 0)
                      for s in snaps)
+        # fleet-wide prefix-cache effectiveness: summed per-replica
+        # lookups/hits (each replica owns a private cache) and the
+        # derived hit rate the capacity dashboards key on
+        plook = sum(s.get("cache", {}).get("prefix", {})
+                    .get("lookups", 0) for s in snaps)
+        phits = sum(s.get("cache", {}).get("prefix", {})
+                    .get("hits", 0) for s in snaps)
         return {
             "replicas": snaps,
             "aggregate": {
@@ -305,6 +316,9 @@ class ReplicatedLMServer(_HTTPFrontend):
                 "tokens_generated": tokens,
                 "decode_steps": steps,
                 "queued": queued,
+                "prefix_lookups": plook,
+                "prefix_hits": phits,
+                "prefix_hit_rate": (phits / plook) if plook else None,
                 "replicas_total": len(snaps),
                 "replicas_drained": sum(self._drained),
             },

@@ -22,6 +22,20 @@ chunk fits the budget, bounding per-iteration latency — the knob that
 trades time-to-first-token for decode tail latency. Admission always
 makes progress (the budget never blocks the only candidate when nothing
 is running or prefilling).
+
+Multi-tenant admission (ISSUE 10): every request carries a `tenant`
+(isolation domain, default "default") and an integer `priority`
+(higher admits first). Admission considers candidates in (priority
+desc, arrival) order — head-of-line blocking still applies within that
+order (a big request is never starved by later small ones), but a
+tenant over its per-iteration `tenant_budget`
+(MXNET_SERVING_TENANT_BUDGET, or the per-tenant `tenant_budgets` map)
+is SKIPPED rather than blocking the queue: one tenant's burst spreads
+itself across iterations while other tenants keep admitting — it
+cannot starve their working set or monopolize the block pool. A tenant
+with nothing in flight always makes progress (its head request admits
+even when the request alone exceeds the budget), mirroring the global
+budget's progress rule.
 """
 from __future__ import annotations
 
@@ -51,13 +65,16 @@ class Request:
     """One generation request plus its completion handle. `wait`/`result`
     make it a minimal future the in-process API and HTTP frontend share."""
 
-    def __init__(self, prompt, max_new_tokens=32, eos_id=None):
+    def __init__(self, prompt, max_new_tokens=32, eos_id=None,
+                 tenant=None, priority=None):
         if not len(prompt):
             raise MXNetError("empty prompt")
         self.id = next(_ids)
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
+        self.tenant = str(tenant) if tenant is not None else "default"
+        self.priority = int(priority) if priority is not None else 0
         self.state = QUEUED
         self.error = None
         self.tokens = None            # prompt + generated, set on DONE
@@ -96,7 +113,8 @@ class Scheduler:
     `submit` vs. the single serving thread driving `admit`/`evict`."""
 
     def __init__(self, max_batch=8, max_queue=64, queue_timeout=None,
-                 token_budget=None):
+                 token_budget=None, tenant_budget=None,
+                 tenant_budgets=None):
         self.max_batch = max_batch
         self.max_queue = max_queue
         self.queue_timeout = queue_timeout
@@ -104,6 +122,11 @@ class Scheduler:
             env = os.environ.get("MXNET_SERVING_TOKEN_BUDGET")
             token_budget = int(env) if env else None
         self.token_budget = token_budget
+        if tenant_budget is None:
+            env = os.environ.get("MXNET_SERVING_TENANT_BUDGET")
+            tenant_budget = int(env) if env else None
+        self.tenant_budget = tenant_budget        # default per-tenant cap
+        self.tenant_budgets = dict(tenant_budgets or {})  # per-name override
         self._queue = deque()
         self._lock = threading.Lock()
         self.running = []             # serving-thread-only
@@ -135,23 +158,56 @@ class Scheduler:
             engine.prefill_tokens_per_step(s.prompt_len)
             for s in self.prefilling)
 
+    def tenant_budget_for(self, tenant):
+        """Per-iteration token cap for one tenant: the per-name override
+        wins, else the shared default, else unbounded."""
+        return self.tenant_budgets.get(tenant, self.tenant_budget)
+
+    @staticmethod
+    def _tenant_of(seq):
+        req = getattr(seq, "request", None)
+        return getattr(req, "tenant", None) or "default"
+
+    def spent_by_tenant(self, engine):
+        """Per-tenant committed tokens of the NEXT loop iteration (the
+        tenant-budget analogue of `spent_tokens`)."""
+        spent = {}
+        for s in self.running:
+            t = self._tenant_of(s)
+            spent[t] = spent.get(t, 0) + 1
+        for s in self.prefilling:
+            t = self._tenant_of(s)
+            spent[t] = spent.get(t, 0) \
+                + engine.prefill_tokens_per_step(s.prompt_len)
+        return spent
+
     def admit(self, engine, now=None):
         """Move queued requests into the running set while batch slots,
-        cache blocks, and the token budget allow; expire the ones that
-        waited too long. Returns (admitted, expired) — the caller
-        prefills the admitted ones."""
+        cache blocks, and the token budgets allow; expire the ones that
+        waited too long. Candidates are considered in (priority desc,
+        arrival) order — FIFO when nobody sets priorities, so the PR 1
+        fairness property is unchanged for single-tenant traffic. A
+        candidate that doesn't fit the block pool stops admission
+        (head-of-line: nothing lower-ranked jumps a big request); a
+        candidate whose TENANT is over its per-iteration token budget is
+        skipped instead — other tenants keep admitting, so one tenant's
+        burst can't starve the rest. Returns (admitted, expired) — the
+        caller prefills the admitted ones."""
         admitted, expired = [], []
         now = time.perf_counter() if now is None else now
         spent = self.spent_tokens(engine)
-        while len(self.running) + len(self.prefilling) + len(admitted) \
-                < self.max_batch:
-            with self._lock:
-                req = self._queue[0] if self._queue else None
-                if req is None:
+        by_tenant = self.spent_by_tenant(engine)
+        with self._lock:
+            order = sorted(self._queue,
+                           key=lambda r: (-r.priority, r.t_submit, r.id))
+            drop = set()
+            for req in order:
+                if len(self.running) + len(self.prefilling) \
+                        + len(admitted) >= self.max_batch:
                     break
                 if self.queue_timeout is not None and \
                         now - req.t_submit > self.queue_timeout:
-                    self._queue.popleft()
+                    drop.add(req.id)
                     expired.append(req)
                     continue
                 try:
@@ -160,22 +216,33 @@ class Scheduler:
                 except MXNetError as e:
                     # can NEVER be served (e.g. prompt > max_len): fail
                     # this request, don't let it wedge the whole queue
-                    self._queue.popleft()
+                    drop.add(req.id)
                     expired.append(req)
                     req.error = e
                     continue
                 if not fits:
-                    break             # head-of-line: preserve FIFO order
+                    break     # head-of-line within the priority order
                 cost = engine.prefill_tokens_per_step(len(req.prompt))
                 if self.token_budget is not None \
                         and spent + cost > self.token_budget \
                         and (spent > 0 or admitted):
                     break             # budget full this iteration; the
-                                      # head keeps its place (FIFO)
-                self._queue.popleft()
-            spent += cost
-            req.t_admit = now
-            admitted.append(req)
+                                      # head keeps its place
+                t_spent = by_tenant.get(req.tenant, 0)
+                budget = self.tenant_budget_for(req.tenant)
+                if budget is not None and t_spent + cost > budget \
+                        and t_spent > 0:
+                    continue  # THIS tenant over budget: skip, don't
+                              # block other tenants behind it (progress:
+                              # an idle tenant's head always admits)
+                spent += cost
+                by_tenant[req.tenant] = t_spent + cost
+                drop.add(req.id)
+                req.t_admit = now
+                admitted.append(req)
+            if drop:
+                self._queue = deque(r for r in self._queue
+                                    if r.id not in drop)
         for req in expired:
             req._finish(error=req.error or RequestTimeout(
                 "request %d expired after %.1fs in queue"

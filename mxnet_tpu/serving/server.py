@@ -152,13 +152,17 @@ def _make_handler(outer):
                 # absorb the burst with bounded backoff before bouncing.
                 # count_reject=False: only the FINAL failure below
                 # counts as a rejection in the metrics
+                priority = body.get("priority")
                 req = retry(
                     lambda: outer.submit(
                         body["tokens"],
                         max_new_tokens=int(
                             body.get("max_new_tokens", 32)),
                         eos_id=body.get("eos_id"),
-                        count_reject=False),
+                        count_reject=False,
+                        tenant=body.get("tenant"),
+                        priority=(int(priority) if priority is not None
+                                  else None)),
                     attempts=outer.submit_retries,
                     backoff=outer.submit_backoff,
                     retry_on=QueueFull)
@@ -209,17 +213,21 @@ class LMServer(_HTTPFrontend):
                  keep_logits=False, vocab=None, time_major=False,
                  idle_wait=0.005, paged=None, prefill_chunk=None,
                  token_budget=None, tp=None, devices=None,
-                 replica_id=None):
+                 replica_id=None, prefix_cache=None, tenant_budget=None,
+                 tenant_budgets=None, default_priority=0):
         adapter = _resolve_model(model, vocab=vocab, max_len=max_len,
                                  time_major=time_major)
         self.engine = Engine(adapter, max_batch=max_batch, max_len=max_len,
                              block_size=block_size, num_blocks=num_blocks,
                              keep_logits=keep_logits, paged=paged,
                              prefill_chunk=prefill_chunk, tp=tp,
-                             devices=devices)
+                             devices=devices, prefix_cache=prefix_cache)
         self.scheduler = Scheduler(max_batch=max_batch, max_queue=max_queue,
                                    queue_timeout=queue_timeout,
-                                   token_budget=token_budget)
+                                   token_budget=token_budget,
+                                   tenant_budget=tenant_budget,
+                                   tenant_budgets=tenant_budgets)
+        self.default_priority = int(default_priority)
         self.metrics = ServingMetrics(replica=replica_id)
         self.replica_id = replica_id
         self._idle_wait = idle_wait
@@ -242,19 +250,25 @@ class LMServer(_HTTPFrontend):
     # -- client API ----------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens=32, eos_id=None,
-               count_reject=True):
+               count_reject=True, tenant=None, priority=None):
         """Enqueue one request; returns it (a future: .result(timeout)).
         Raises QueueFull immediately when backpressure kicks in.
         `count_reject=False` suppresses the rejected-metric increment —
         for retry wrappers that only count the FINAL failure (a request
-        that eventually lands is not a rejection)."""
+        that eventually lands is not a rejection). `tenant`/`priority`
+        feed the scheduler's multi-tenant admission (default tenant,
+        server default priority when omitted — fully backward
+        compatible)."""
         if self._closed:
             raise MXNetError("server is closed")
         if len(prompt) > self.engine.max_len:
             raise MXNetError(
                 "prompt length %d exceeds the server's max_len %d"
                 % (len(prompt), self.engine.max_len))
-        req = Request(prompt, max_new_tokens=max_new_tokens, eos_id=eos_id)
+        req = Request(prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
+                      tenant=tenant,
+                      priority=(priority if priority is not None
+                                else self.default_priority))
         try:
             self.scheduler.submit(req)
         except QueueFull:
@@ -389,7 +403,7 @@ class LMServer(_HTTPFrontend):
                                      % (type(e).__name__, e))
                     for seq in sched.running:
                         try:
-                            eng.release(seq)
+                            eng.release(seq, reusable=False)
                         except Exception:
                             pass
                         if seq.request is not None:
@@ -499,7 +513,7 @@ class LMServer(_HTTPFrontend):
                 met.engine_failure()  # free its blocks, keep serving
                 sched.prefilling.remove(seq)
                 try:
-                    eng.release(seq)
+                    eng.release(seq, reusable=False)
                 except Exception:
                     pass
                 if seq.request is not None:

@@ -1,0 +1,575 @@
+"""Multi-tenant prefix cache tests (ISSUE 10): content-addressed KV
+block reuse with copy-on-write over the paged serving engine.
+
+The load-bearing claims: (1) chained block hashes are a stable content
+identity — equal across instances, prefix-consistent, and disjoint
+across block sizes; (2) the refcounted block pool never double-frees,
+never goes negative, and validates each free() call atomically
+(duplicate ids / foreign ids raise with the pool untouched); (3) a
+shared block is NEVER mutated by a reader — divergence copies first
+(COW); (4) eviction is LRU over refcount-zero entries and only fires
+under pool pressure; (5) the flag switches which blocks a table points
+at, never logits: cache-on serving is logit-identical to the cache-off
+paged path AND the PR 1/PR 4 gather oracle, including COW-divergence
+and post-eviction re-miss scenarios, single-chip and tp=2; (6) the
+scheduler's priority classes and per-tenant token budgets isolate
+tenants without starving anyone.
+"""
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import serving
+from mxnet_tpu.serving import kv_cache
+from mxnet_tpu.serving.prefix_cache import PrefixCache
+from mxnet_tpu.models.transformer import (TransformerConfig,
+                                          init_transformer_params,
+                                          transformer_apply)
+import jax.numpy as jnp
+
+
+def tiny_cfg(**kw):
+    base = dict(vocab=48, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                max_len=64)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    cfg = tiny_cfg()
+    params = init_transformer_params(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+def arith_prompt(start, stride, n, vocab=48):
+    return [(start + stride * t) % vocab for t in range(n)]
+
+
+def make_engine(params, cfg, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("keep_logits", True)
+    kw.setdefault("paged", True)
+    return serving.Engine(serving.TransformerLM(params, cfg), **kw)
+
+
+def rollout(eng, prompt, steps=4):
+    """Run one request to `steps` generated tokens; returns (per-step
+    logits list, final tokens)."""
+    seq = eng.start(list(prompt), max_new=steps + 1)
+    logs = [np.asarray(seq.last_logits)]
+    for _ in range(steps):
+        eng.decode_step([seq])
+        logs.append(np.asarray(seq.last_logits))
+    toks = list(seq.tokens)
+    eng.release(seq)
+    return logs, toks
+
+
+def assert_rollouts_equal(got, want):
+    assert got[1] == want[1], (got[1], want[1])
+    for a, b in zip(got[0], want[0]):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# content identity: the chained hash
+# ---------------------------------------------------------------------------
+
+
+def test_chained_hash_stability_across_block_sizes():
+    """The chain key is a pure function of (block_size, token content):
+    equal across cache instances, prefix-consistent (two sequences
+    agreeing on the first k blocks share the first k keys and differ
+    after the first divergent block), and DISJOINT across block sizes —
+    caches at different block sizes can never alias."""
+    pool_a, pool_b = kv_cache.BlockPool(8), kv_cache.BlockPool(8)
+    c8a = PrefixCache(pool_a, 8)
+    c8b = PrefixCache(pool_b, 8)
+    c4 = PrefixCache(kv_cache.BlockPool(8), 4)
+    toks = arith_prompt(3, 1, 32)
+    assert c8a.chain_hashes(toks) == c8b.chain_hashes(toks)
+    assert len(c8a.chain_hashes(toks)) == 4
+    # prefix consistency: same first 2 blocks, divergence in block 2
+    other = toks[:20] + [(t + 7) % 48 for t in toks[20:]]
+    ha, hb = c8a.chain_hashes(toks), c8a.chain_hashes(other)
+    assert ha[:2] == hb[:2]
+    assert ha[2:] != hb[2:]
+    assert ha[2] != hb[2]
+    # block-size disjointness: not one shared key between bs=8 and bs=4
+    assert not set(c8a.chain_hashes(toks)) & set(c4.chain_hashes(toks))
+
+
+# ---------------------------------------------------------------------------
+# refcounted block pool (satellite: atomic free validation)
+# ---------------------------------------------------------------------------
+
+
+def test_block_pool_free_rejects_duplicates_atomically():
+    """A free() call with duplicate ids or a non-live id raises a clear
+    MXNetError and leaves the pool COMPLETELY unchanged — the partial
+    free on error was a silent corruption vector once blocks became
+    refcount-shared."""
+    pool = kv_cache.BlockPool(8)
+    a = pool.try_alloc(4)
+    before = (pool.available, pool.in_use,
+              {b: pool.refcount(b) for b in a})
+    with pytest.raises(mx.MXNetError, match="duplicate block id"):
+        pool.free([a[0], a[1], a[0]])
+    assert (pool.available, pool.in_use,
+            {b: pool.refcount(b) for b in a}) == before
+    # a foreign id anywhere in the call leaves the valid ids untouched
+    pool.free([a[3]])
+    with pytest.raises(mx.MXNetError, match="double-free or foreign"):
+        pool.free([a[0], a[3]])
+    assert (pool.available, pool.in_use) == (4, 3)
+    assert pool.refcount(a[0]) == 1     # not decremented by the failure
+    pool.free(a[:3])
+    assert pool.in_use == 0 and pool.available == 7
+
+
+def test_block_pool_refcounts_never_negative():
+    """add_ref pins a block across frees; each free() drops exactly one
+    ref; the block returns to the free list only at zero; and a ref can
+    never go negative (the would-be extra free raises instead)."""
+    pool = kv_cache.BlockPool(8)
+    (b,) = pool.try_alloc(1)
+    pool.add_ref([b])
+    pool.add_ref([b])
+    assert pool.refcount(b) == 3
+    pool.free([b])
+    pool.free([b])
+    assert pool.refcount(b) == 1 and pool.in_use == 1
+    pool.free([b])
+    assert pool.refcount(b) == 0 and pool.in_use == 0
+    with pytest.raises(mx.MXNetError):
+        pool.free([b])                  # a 4th free would go negative
+    with pytest.raises(mx.MXNetError):
+        pool.add_ref([b])               # can't pin a dead block
+    # the freed block is reusable and starts fresh at refcount 1
+    (b2,) = pool.try_alloc(1)
+    assert pool.refcount(b2) == 1
+
+
+# ---------------------------------------------------------------------------
+# reuse + COW + eviction mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_hit_skips_prefill_and_counts(tiny_lm):
+    """A same-prefix request reuses resident blocks: `prefilled` starts
+    past zero (whole chunks skipped), stats count the hit, and the
+    shared blocks are pinned while the hitter runs."""
+    params, cfg = tiny_lm
+    eng = make_engine(params, cfg, prefix_cache=True, prefill_chunk=8)
+    pc = eng.prefix_cache
+    prompt = arith_prompt(3, 1, 24)
+    rollout(eng, prompt)
+    assert pc.lookups == 1 and pc.misses == 1 and pc.inserts >= 3
+    seq = eng.begin(list(prompt), max_new=4)
+    assert seq.cache_hit_tokens > 0
+    assert seq.prefilled == seq.cache_hit_tokens
+    assert seq.shared_blocks >= 2
+    assert pc.hits == 1 and pc.hit_tokens_total >= 16
+    # shared blocks are pinned: refcount 2 (cache + this sequence)
+    shared = seq.block_ids[:seq.shared_blocks]
+    assert all(eng.cache.pool.refcount(b) == 2 for b in shared)
+    while not eng.prefill_step(seq):
+        pass
+    eng.release(seq)
+    assert all(eng.cache.pool.refcount(b) == 1 for b in shared)
+
+
+def test_cow_isolation_writer_cannot_mutate_shared_block(tiny_lm):
+    """A request whose tokens diverge inside a cached block gets a
+    PRIVATE copy (COW) before its first write: the donor block's device
+    bytes are bit-identical after the diverging request runs, and a
+    third request replaying the original prompt still hits the
+    untouched content."""
+    params, cfg = tiny_lm
+    eng = make_engine(params, cfg, prefix_cache=True)
+    pc = eng.prefix_cache
+    base = arith_prompt(3, 1, 20)
+    rollout(eng, base + [7, 9])
+    # release must register the partial tail as shareable content
+    assert any(len(e.tokens) < pc.block_size
+               for e in pc._by_hash.values())
+    # snapshot EVERY resident cached block's device bytes
+    donors = sorted(e.block_id for e in pc._by_hash.values())
+    before_k = np.asarray(eng.cache.k[:, donors])
+    before_v = np.asarray(eng.cache.v[:, donors])
+    # diverging request: same 2 full blocks, diverges inside block 2
+    got = rollout(eng, base + [7, 11])
+    assert pc.cow_copies == 1
+    np.testing.assert_array_equal(np.asarray(eng.cache.k[:, donors]),
+                                  before_k)
+    np.testing.assert_array_equal(np.asarray(eng.cache.v[:, donors]),
+                                  before_v)
+    # the divergent rollout matches a cache-off engine exactly
+    ref = rollout(make_engine(params, cfg), base + [7, 11])
+    assert_rollouts_equal(got, ref)
+
+
+def test_lru_eviction_order_under_pool_pressure():
+    """Only refcount-zero entries are evictable, leaves go before their
+    parents, and among evictable entries the LEAST recently used chain
+    goes first: after touching P1, pressure evicts P2's blocks while P1
+    stays resident."""
+    pool = kv_cache.BlockPool(10)            # 9 allocatable
+    cache = PrefixCache(pool, 4)
+    p1 = arith_prompt(1, 1, 8)
+    p2 = arith_prompt(9, 2, 8)
+    ids1 = pool.try_alloc(2)
+    cache.insert(p1, ids1, 8)
+    pool.free(ids1)                          # owner gone; cache holds 2
+    ids2 = pool.try_alloc(2)
+    cache.insert(p2, ids2, 8)
+    pool.free(ids2)
+    assert pool.in_use == 4 and len(cache) == 4
+    # touch P1 so P2 becomes the LRU chain
+    full, tail = cache.lookup(p1 + [0])
+    pool.free(full)                          # drop the probe's refs
+    # pressure: 7 fresh blocks need 2 reclaimed — P2's chain must go
+    got = pool.try_alloc(7)
+    assert got is not None
+    assert cache.evictions == 2
+    resident = {h.hex() for h in cache._by_hash}
+    assert cache.chain_hashes(p1)[-1] in resident        # P1 kept
+    assert not set(cache.chain_hashes(p2)) & resident    # P2 gone
+    probe1, _ = cache.lookup(p1 + [0])
+    assert len(probe1) == 2                  # P1 still hits
+    pool.free(probe1)
+    probe2, _ = cache.lookup(p2 + [0])
+    assert probe2 == []                      # P2 evicted
+    # pinned entries survive pressure: re-pin P1 and ask for the rest
+    pinned, _ = cache.lookup(p1 + [0])
+    rest = pool.try_alloc(pool.available)
+    assert pool.try_alloc(1) is None         # P1 pinned, nothing to evict
+    assert len(cache) == 2 and cache.evictions == 2
+    pool.free(pinned + rest + got)
+
+
+def test_refcounts_drain_to_zero_after_flush(tiny_lm):
+    """After every sequence releases and the cache flushes, the pool is
+    empty — no leaked refs anywhere in the share/COW/insert cycle."""
+    params, cfg = tiny_lm
+    eng = make_engine(params, cfg, prefix_cache=True)
+    base = arith_prompt(3, 1, 20)
+    for tail in ([7, 9], [7, 11], [7, 9], [2]):
+        rollout(eng, base + tail)
+    pool = eng.cache.pool
+    assert pool.in_use == len(eng.prefix_cache)   # only cache-held blocks
+    eng.prefix_cache.flush()
+    assert len(eng.prefix_cache) == 0
+    assert pool.in_use == 0
+    assert pool.available == eng.cache.num_blocks - 1
+
+
+# ---------------------------------------------------------------------------
+# the acceptance pin: cache on/off must be logit-identical, vs BOTH the
+# cache-off paged path and the gather oracle — hit, COW-divergence, and
+# post-eviction re-miss scenarios
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_parity_vs_paged_and_gather_oracle(tiny_lm):
+    params, cfg = tiny_lm
+    shared = arith_prompt(3, 1, 20)
+    scenarios = [
+        shared + [7, 9],         # miss (first sight) then insert
+        shared + [7, 9],         # full replay: full-block + tail hits
+        shared + [7, 11],        # COW divergence inside the tail block
+        arith_prompt(5, 3, 17),  # unrelated traffic
+        shared[:16],             # block-aligned prompt, full-block hits
+    ]
+    eng_cache = make_engine(params, cfg, prefix_cache=True)
+    eng_paged = make_engine(params, cfg)               # cache-off paged
+    eng_gather = make_engine(params, cfg, paged=False)  # PR 1/4 oracle
+    assert eng_cache.prefix_cache is not None
+    assert eng_gather.paged is False
+
+    def dense_last(tokens):
+        toks = jnp.asarray([tokens], jnp.int32)
+        return np.asarray(transformer_apply(params, toks, cfg),
+                          np.float32)[0, -1]
+
+    for prompt in scenarios:
+        got = rollout(eng_cache, prompt)
+        assert_rollouts_equal(got, rollout(eng_paged, prompt))
+        assert_rollouts_equal(got, rollout(eng_gather, prompt))
+        # and against the dense full-forward at the final step
+        np.testing.assert_allclose(got[0][-1], dense_last(got[1][:-1]),
+                                   rtol=1e-4, atol=1e-5)
+    assert eng_cache.prefix_cache.hits >= 3
+    assert eng_cache.prefix_cache.cow_copies >= 1
+
+
+def test_prefix_parity_post_eviction_re_miss(tiny_lm):
+    """Evicting a resident prefix under pool pressure and replaying its
+    prompt takes the miss path again — and is still logit-identical to
+    the never-cached rollout."""
+    params, cfg = tiny_lm
+    # 6 allocatable blocks, 4 per request: each new prompt forces LRU
+    # evictions, and two unrelated prompts push A's chain out entirely
+    eng = make_engine(params, cfg, max_batch=2, prefix_cache=True,
+                      num_blocks=7)
+    ref = make_engine(params, cfg, max_batch=2)
+    pA = arith_prompt(1, 1, 26)      # 4 blocks at bs=8
+    wantA = rollout(ref, pA)
+    got = rollout(eng, pA)
+    assert_rollouts_equal(got, wantA)
+    rollout(eng, arith_prompt(5, 2, 26))   # pressure round 1
+    rollout(eng, arith_prompt(9, 3, 26))   # pressure round 2: A fully out
+    pc = eng.prefix_cache
+    assert pc.evictions >= 4
+    resident = {h.hex() for h in pc._by_hash}
+    assert not set(pc.chain_hashes(pA)) & resident
+    misses_before = pc.misses
+    got2 = rollout(eng, pA)          # re-miss, re-prefill, re-insert
+    assert pc.misses == misses_before + 1
+    assert_rollouts_equal(got2, wantA)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2,
+                    reason="tp test needs >= 2 (emulated) devices")
+def test_tp2_parity_cache_on_off(tiny_lm):
+    """tp=2 on the emulated mesh with the prefix cache on — shared
+    blocks shard over heads via PagedKVCache.place, the cache stays
+    placement-agnostic, and logits match the tp cache-off engine AND
+    the single-device gather oracle, COW included."""
+    params, cfg = tiny_lm
+    eng_on = make_engine(params, cfg, tp=2, prefix_cache=True)
+    eng_off = make_engine(params, cfg, tp=2)
+    oracle = make_engine(params, cfg, paged=False)
+    assert eng_on.tp == 2 and eng_on.tp_fallback is None
+    assert eng_on.prefix_cache is not None
+    shared = arith_prompt(3, 1, 20)
+    for prompt in (shared + [7, 9], shared + [7, 9], shared + [7, 11]):
+        got = rollout(eng_on, prompt)
+        assert_rollouts_equal(got, rollout(eng_off, prompt))
+        assert_rollouts_equal(got, rollout(oracle, prompt))
+    assert eng_on.prefix_cache.hits >= 2
+    assert eng_on.prefix_cache.cow_copies >= 1
+
+
+# ---------------------------------------------------------------------------
+# gating: env default, placement contract, fallback semantics
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_cache_env_gating_and_fallback(tiny_lm, monkeypatch):
+    params, cfg = tiny_lm
+    monkeypatch.setenv("MXNET_PREFIX_CACHE", "1")
+    eng = make_engine(params, cfg)
+    assert eng.prefix_cache is not None
+    # explicit argument wins over the env default
+    assert make_engine(params, cfg, prefix_cache=False).prefix_cache \
+        is None
+    monkeypatch.delenv("MXNET_PREFIX_CACHE")
+    assert make_engine(params, cfg).prefix_cache is None
+    # the gather path can't start a prefill mid-prompt: recorded fallback
+    off = make_engine(params, cfg, paged=False, prefix_cache=True)
+    assert off.prefix_cache is None
+    assert "paged" in off.prefix_cache_fallback
+    # placement contract: frozen after construction
+    with pytest.raises(mx.MXNetError, match="fixed at construction"):
+        eng.prefix_cache = None
+
+
+def test_tenant_budget_env_default(monkeypatch):
+    monkeypatch.setenv("MXNET_SERVING_TENANT_BUDGET", "24")
+    assert serving.Scheduler(max_batch=4).tenant_budget == 24
+    monkeypatch.delenv("MXNET_SERVING_TENANT_BUDGET")
+    assert serving.Scheduler(max_batch=4).tenant_budget is None
+
+
+# ---------------------------------------------------------------------------
+# scheduler: priority classes + per-tenant token budgets
+# ---------------------------------------------------------------------------
+
+
+class FakeEngine:
+    def can_admit(self, plen, max_new):
+        return True
+
+    def prefill_tokens_per_step(self, plen):
+        return 8
+
+
+def test_scheduler_priority_order():
+    """Higher priority admits first regardless of arrival; FIFO within
+    one priority class (the PR 1 fairness property, unchanged for
+    unprioritized traffic)."""
+    sched = serving.Scheduler(max_batch=2)
+    lo1 = serving.Request([1, 2, 3])
+    lo2 = serving.Request([1, 2, 3])
+    hi = serving.Request([1, 2, 3], priority=5)
+    for r in (lo1, lo2, hi):
+        sched.submit(r)
+    admitted, _ = sched.admit(FakeEngine())
+    assert [r.id for r in admitted] == [hi.id, lo1.id]
+    assert sched.pending() == 1
+
+
+def test_tenant_budget_isolates_without_starving():
+    """Tenant A's burst saturates ITS budget and gets skipped; tenant
+    B's requests behind it still admit (no cross-tenant head-of-line
+    starvation); an idle tenant always makes progress even when one
+    request alone exceeds the budget."""
+    sched = serving.Scheduler(max_batch=8, tenant_budget=8)
+    a = [serving.Request([1, 2, 3], tenant="a") for _ in range(3)]
+    b = serving.Request([1, 2, 3], tenant="b")
+    for r in a + [b]:
+        sched.submit(r)
+    admitted, _ = sched.admit(FakeEngine())
+    # one 8-token chunk exhausts a's 8-token budget; b admits behind it
+    assert [r.id for r in admitted] == [a[0].id, b.id]
+    assert sched.pending() == 2
+    # progress: a request alone above the budget still admits when its
+    # tenant has nothing in flight
+    sched2 = serving.Scheduler(max_batch=8, tenant_budget=4)
+    solo = serving.Request([1, 2, 3], tenant="a")
+    sched2.submit(solo)
+    admitted, _ = sched2.admit(FakeEngine())
+    assert [r.id for r in admitted] == [solo.id]
+    # per-tenant override beats the shared default
+    sched3 = serving.Scheduler(max_batch=8, tenant_budget=8,
+                               tenant_budgets={"vip": 32})
+    assert sched3.tenant_budget_for("vip") == 32
+    assert sched3.tenant_budget_for("a") == 8
+
+
+def test_tenant_budget_counts_inflight_work():
+    """The per-tenant spend includes running + mid-prefill sequences,
+    attributed through seq.request.tenant."""
+
+    class Seq:
+        def __init__(self, tenant, prompt_len=4):
+            self.request = serving.Request([1] * prompt_len,
+                                           tenant=tenant)
+            self.prompt_len = prompt_len
+
+    sched = serving.Scheduler(max_batch=8, tenant_budget=9)
+    sched.running = [Seq("a"), Seq("b")]
+    sched.prefilling = [Seq("a")]
+    spent = sched.spent_by_tenant(FakeEngine())
+    assert spent == {"a": 9, "b": 1}
+    # tenant a is exactly at budget: its new request is skipped, b's and
+    # the untracked default tenant's requests admit
+    ra = serving.Request([1, 2, 3], tenant="a")
+    rb = serving.Request([1, 2, 3], tenant="b")
+    rc = serving.Request([1, 2, 3])
+    for r in (ra, rb, rc):
+        sched.submit(r)
+    admitted, _ = sched.admit(FakeEngine())
+    assert [r.id for r in admitted] == [rb.id, rc.id]
+    assert sched.pending() == 1
+
+
+def test_admission_reclaims_cache_held_blocks(tiny_lm):
+    """Regression: once the cache absorbs the whole free list, admission
+    must still proceed — `can_admit` counts refcount-zero cached blocks
+    as available (try_alloc reclaims them LRU on demand). Without this
+    the scheduler gates forever and every queued request hangs."""
+    params, cfg = tiny_lm
+    # 6 allocatable blocks; each 26-token request reserves 4, so after
+    # two requests the cache holds every block and the free list is
+    # empty — the third request only admits through reclamation
+    srv = serving.serve((params, cfg), max_batch=2, block_size=8,
+                        paged=True, prefix_cache=True, num_blocks=7)
+    try:
+        for i in range(3):
+            out = srv.generate(arith_prompt(1 + 4 * i, 1 + i, 26),
+                               max_new_tokens=3, timeout=120)
+            assert len(out) == 3
+        pool = srv.engine.cache.pool
+        pc = srv.engine.prefix_cache
+        assert pc.evictions > 0                  # pressure really hit
+        assert pool.available + pc.reclaimable_blocks() >= 4
+        snap = srv.snapshot()
+        assert snap["requests"]["completed"] == 3
+        assert snap["requests"]["failed"] == 0
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# end to end: server + HTTP frontend with tenant/priority + metrics
+# ---------------------------------------------------------------------------
+
+
+def test_server_prefix_metrics_and_http_fields(tiny_lm):
+    """serve(prefix_cache=True): the JSON snapshot grows the cache
+    section, the Prometheus exposition carries the new instruments, and
+    the HTTP frontend accepts per-request tenant/priority fields
+    (defaulted — an old client body still works)."""
+    params, cfg = tiny_lm
+    srv = serving.serve((params, cfg), max_batch=2, block_size=8,
+                        paged=True, prefix_cache=True, tenant_budget=64)
+    try:
+        host, port = srv.serve_http(port=0, block=False)
+        url = "http://%s:%d" % (host, port)
+        prompt = arith_prompt(4, 1, 20)
+
+        def post(body):
+            req = urllib.request.Request(
+                url + "/v1/generate", data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            return json.loads(urllib.request.urlopen(
+                req, timeout=60).read())
+
+        out = post({"tokens": prompt, "max_new_tokens": 4})  # old body
+        assert len(out["tokens"]) == 4
+        out2 = post({"tokens": prompt, "max_new_tokens": 4,
+                     "tenant": "acme", "priority": 3})
+        assert out2["tokens"] == out["tokens"]
+        met = json.loads(urllib.request.urlopen(
+            url + "/v1/metrics", timeout=10).read())
+        assert met["engine"]["prefix_cache"] is True
+        pref = met["cache"]["prefix"]
+        assert pref["lookups"] == 2
+        assert pref["hits"] == 1 and pref["hit_tokens"] > 0
+        assert 0 < pref["hit_rate"] <= 1
+        assert pref["resident_blocks"] > 0
+        assert met["scheduler"]["tenant_budget"] == 64
+        text = urllib.request.urlopen(urllib.request.Request(
+            url + "/metrics", headers={"Accept": "text/plain"}),
+            timeout=10).read().decode()
+        for name in ("serving_prefix_hits_total",
+                     "serving_prefix_misses_total",
+                     "serving_prefix_evictions_total",
+                     "serving_prefix_cow_total",
+                     "serving_prefix_resident_tokens",
+                     "serving_prefix_hit_rate"):
+            assert name in text, name
+    finally:
+        srv.close()
+
+
+def test_router_aggregates_prefix_hit_rate(tiny_lm):
+    """Per-replica caches stay private; the front door's snapshot sums
+    their lookups/hits into one fleet hit-rate and the merged Prometheus
+    exposition carries the per-replica instruments."""
+    params, cfg = tiny_lm
+    srv = serving.serve((params, cfg), replicas=2, max_batch=2,
+                        block_size=8, paged=True, prefix_cache=True)
+    try:
+        assert all(r.engine.prefix_cache is not None
+                   for r in srv.replicas)
+        prompt = arith_prompt(4, 1, 20)
+        for _ in range(4):
+            srv.generate(list(prompt), max_new_tokens=2, timeout=120)
+        snap = srv.snapshot()
+        agg = snap["aggregate"]
+        assert agg["prefix_lookups"] == 4
+        assert agg["prefix_hits"] >= 1
+        assert 0 < agg["prefix_hit_rate"] <= 1
+        assert "serving_prefix_hit_rate" in srv.prometheus_text()
+    finally:
+        srv.close()

@@ -60,7 +60,8 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 #: import-free so it also works while jax is wedged)
 _DISCRIMINATORS = ("batch", "seq_len", "layout", "remat",
                    "fused_bn_epilogue", "fused_rnn", "hidden",
-                   "num_features", "tp", "replicas", "quantized_dtype")
+                   "num_features", "tp", "replicas", "quantized_dtype",
+                   "prefix_cache")
 
 #: units where smaller is better; anything rate-like (…/s) is
 #: larger-is-better, unknown units default to larger-is-better
@@ -241,10 +242,16 @@ def _direction(line):
 
 
 def _judge_secondary(verdict, fresh, ref):
-    """Warn-only compile/footprint comparison (compile wall time is
-    noisy on shared hosts; footprint is not, but neither decides the
-    exit code — the measured value does)."""
-    for field, band in (("compile_s", 0.50), ("exec_hbm_bytes", 0.15)):
+    """Warn-only secondary-field comparison (compile wall time is noisy
+    on shared hosts; footprint is not; the prefix-cache hit rate is a
+    health signal, not the measurement) — none of these decide the exit
+    code, the measured value does. `bad` is the direction that warrants
+    a warning: +1 = growth is bad (time, bytes), -1 = a drop is bad
+    (hit rate)."""
+    for field, band, bad in (("compile_s", 0.50, 1),
+                             ("exec_hbm_bytes", 0.15, 1),
+                             ("prefix_hit_rate", 0.15, -1),
+                             ("prefix_hit_tokens", 0.25, -1)):
         fv, rv = fresh.get(field), ref.get(field)
         if not isinstance(fv, (int, float)) or not isinstance(
                 rv, (int, float)) or rv <= 0:
@@ -253,10 +260,12 @@ def _judge_secondary(verdict, fresh, ref):
         verdict[field] = fv
         verdict[field + "_ref"] = rv
         verdict[field + "_delta_pct"] = round(delta * 100, 1)
-        if delta > band:
+        if bad * delta > band:
             verdict.setdefault("warnings", []).append(
-                "%s grew %.0f%% over the last committed round (band "
-                "%.0f%%)" % (field, delta * 100, band * 100))
+                "%s %s %.0f%% vs the last committed round (warn band "
+                "%.0f%%)" % (field,
+                             "grew" if delta > 0 else "dropped",
+                             abs(delta) * 100, band * 100))
 
 
 def judge(fresh_lines, trajectory, baselines, min_band):

@@ -55,6 +55,24 @@ def main():
                          "least-loaded routing (default: "
                          "MXNET_SERVING_REPLICAS or 1); with --tp k, "
                          "replica i runs on devices [i*k, (i+1)*k)")
+    ap.add_argument("--prefix-cache", action="store_true", default=None,
+                    help="content-addressed KV prefix reuse: shared "
+                         "prompt prefixes hit resident pool blocks "
+                         "instead of re-prefilling, copy-on-write on "
+                         "divergence, LRU eviction under pool pressure "
+                         "(default: the MXNET_PREFIX_CACHE env var; "
+                         "needs the paged path)")
+    ap.add_argument("--tenant-budget", type=int, default=None,
+                    help="per-iteration token budget PER TENANT: one "
+                         "tenant's burst spreads across iterations "
+                         "while other tenants keep admitting (default: "
+                         "MXNET_SERVING_TENANT_BUDGET or unbounded; "
+                         "requests carry a 'tenant' field, default "
+                         "'default')")
+    ap.add_argument("--priority", type=int, default=0,
+                    help="default priority for requests that don't "
+                         "carry a 'priority' field (higher admits "
+                         "first; default 0)")
     args = ap.parse_args()
 
     from mxnet_tpu import serving
@@ -86,15 +104,37 @@ def main():
                         prefill_chunk=args.prefill_chunk,
                         token_budget=args.token_budget,
                         tp=args.tp,
-                        replicas=args.replicas)
+                        replicas=args.replicas,
+                        prefix_cache=args.prefix_cache,
+                        tenant_budget=args.tenant_budget,
+                        default_priority=args.priority)
     if isinstance(srv, serving.ReplicatedLMServer):
         eng = srv.replicas[0].engine
         print("front door: %d replicas, tp=%d per replica%s"
               % (len(srv.replicas), eng.tp,
                  " (tp fallback: %s)" % eng.tp_fallback
                  if eng.tp_fallback else ""))
-    elif srv.engine.tp_fallback:
-        print("tp fallback: %s" % srv.engine.tp_fallback)
+        first = srv.replicas[0]
+    else:
+        first = srv
+        if srv.engine.tp_fallback:
+            print("tp fallback: %s" % srv.engine.tp_fallback)
+    eng = first.engine
+    print("config: paged=%s prefill_chunk=%s block_size=%d "
+          "max_batch=%d max_queue=%d"
+          % ("on" if eng.paged else "off", eng.prefill_chunk or "-",
+             args.block_size, args.max_batch, args.max_queue))
+    if eng.prefix_cache is not None:
+        print("prefix cache: on (content-addressed KV block reuse, "
+              "copy-on-write, LRU eviction)")
+    elif eng.prefix_cache_fallback:
+        print("prefix cache: OFF — %s" % eng.prefix_cache_fallback)
+    else:
+        print("prefix cache: off")
+    print("tenants: budget=%s tokens/iteration, default priority=%d "
+          "(per-request 'tenant'/'priority' JSON fields accepted)"
+          % (first.scheduler.tenant_budget or "unbounded",
+             args.priority))
     print("listening on http://%s:%d  (POST /v1/generate, GET /v1/metrics)"
           % (args.host, args.port))
     srv.serve_http(host=args.host, port=args.port, block=True)
