@@ -131,6 +131,20 @@ echo "=== 2h. multi-tenant prefix cache A/B (hit-rate + TTFT, ISSUE 10) ==="
 timeout -k 30 1800 env BENCH_CONFIGS=serving_prefix \
   MXNET_PAGED_ATTENTION=1 python bench.py | tee BENCH_PREFIX_AB.jsonl
 
+echo "=== 2i. serving survival layer: fault-storm bench + chaos drill (ISSUE 11) ==="
+# (a) serving_chaos bench leg: availability % through a replica-thread
+# kill, failover added-latency p95, respawn-to-first-token (dominated
+# by the fresh engine's compiles — the ROADMAP item-1 AOT-cache gap,
+# now measured on the serving side too). Predictions registered in
+# BENCH_NOTES.md round 11 BEFORE this runs; sentinel judges
+# serving_chaos_* warn-only. (b) the full 3-replica chaos drill —
+# wedge/kill/poison/exhaust/crash-loop — must pass on-chip exactly as
+# on CPU. timeout-bounded: a wedged respawn must not stall the session.
+timeout -k 30 1800 env BENCH_CONFIGS=serving_chaos python bench.py \
+  | tee BENCH_SERVING_CHAOS.jsonl
+timeout -k 30 1800 python tools/chaos_serve.py \
+  | tee CHAOS_SERVE_TPU.txt
+
 echo "=== 3. flash attention seq sweep (1024/2048/4096) ==="
 BENCH_CONFIGS=transformer_flash BENCH_FLASH_SEQ=1024,2048,4096,8192 \
   python bench.py | tee BENCH_FLASH_SWEEP.jsonl

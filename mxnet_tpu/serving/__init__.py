@@ -26,11 +26,13 @@ from .kv_cache import BlockPool, PagedKVCache, CacheOverflow
 from .prefix_cache import PrefixCache, prefix_cache_enabled
 from .engine import (Engine, Sequence, TransformerLM, BlockLM, ExportedLM,
                      pow2_bucket)
-from .scheduler import Scheduler, Request, QueueFull, RequestTimeout
+from .scheduler import (Scheduler, Request, QueueFull, RequestTimeout,
+                        DeadlineExceeded, DeadlineUnmeetable,
+                        BrownoutShed, make_resume)
 from .metrics import ServingMetrics
-from .server import LMServer, serve
+from .server import LMServer, serve, spawn_resume
 from .router import (ReplicatedLMServer, serving_replicas,
-                     NoHealthyReplicas)
+                     serving_respawn_max, NoHealthyReplicas)
 from .tp import serving_tp
 
 __all__ = [
@@ -39,7 +41,9 @@ __all__ = [
     "Engine", "Sequence", "TransformerLM", "BlockLM", "ExportedLM",
     "pow2_bucket",
     "Scheduler", "Request", "QueueFull", "RequestTimeout",
+    "DeadlineExceeded", "DeadlineUnmeetable", "BrownoutShed",
+    "make_resume", "spawn_resume",
     "ServingMetrics", "LMServer", "serve",
-    "ReplicatedLMServer", "serving_replicas", "serving_tp",
-    "NoHealthyReplicas",
+    "ReplicatedLMServer", "serving_replicas", "serving_respawn_max",
+    "serving_tp", "NoHealthyReplicas",
 ]

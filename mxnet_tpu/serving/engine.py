@@ -49,6 +49,7 @@ from __future__ import annotations
 import contextlib
 import math
 import time
+import weakref
 
 import numpy as np
 import jax
@@ -494,6 +495,11 @@ class ExportedLM:
 # the engine
 # ---------------------------------------------------------------------------
 
+#: every live Engine, weakly held — the serving tests' shared quiescence
+#: fixture audits the pools of engines a test created (leak check: after
+#: a clean close, in-use blocks == prefix-cache residents, nothing else)
+_LIVE = weakref.WeakSet()
+
 
 class Engine:
     """Owns the compiled step functions, the cache pool, and the shape
@@ -613,6 +619,7 @@ class Engine:
         # engine-local ints while the watchdog stays the source of truth
         self._compile_counts = {"prefill": 0, "decode": 0}
         self._constructed = True
+        _LIVE.add(self)
 
     def __setattr__(self, name, value):
         if name in self._FROZEN_FLAGS and \
@@ -917,6 +924,34 @@ class Engine:
         if (seq.eos_id is not None and token == seq.eos_id) \
                 or len(seq.tokens) >= seq.max_total:
             seq.done = True
+
+    def audit_quiescent(self):
+        """Leak audit (ISSUE 11): with no sequence in flight, every
+        allocated pool block must be a prefix-cache resident pinned by
+        exactly the cache's own ref — anything else is a block some
+        sequence leaked. Raises MXNetError listing the leaked ids."""
+        if self.cache is None:
+            return
+        resident = []
+        if self.prefix_cache is not None:
+            resident = [e.block_id
+                        for e in self.prefix_cache._by_hash.values()]
+        self.cache.pool.assert_quiescent(resident)
+
+    def close(self, audit=True):
+        """End-of-life seam: with `audit=True` (the default) run the
+        block-pool leak audit — an engine being retired with blocks that
+        belong to no cache entry has leaked them, and at fleet scale a
+        silent leak is a slow-motion outage. Callers tearing down a
+        CRASHED engine pass audit=False (its pool dies with it; the
+        in-flight blocks were already released by the death path). The
+        engine leaves the live set either way — a failed audit already
+        surfaced the leak once; close() stays idempotent."""
+        try:
+            if audit:
+                self.audit_quiescent()
+        finally:
+            _LIVE.discard(self)
 
     def release(self, seq, reusable=True):
         """Recycle a finished sequence's cache blocks. With the prefix

@@ -108,6 +108,31 @@ class BlockPool:
         self.high_water = max(self.high_water, len(self._live))
         return ids
 
+    def assert_quiescent(self, cache_resident=()):
+        """Leak audit (ISSUE 11): with no sequence in flight, every live
+        block must be a prefix-cache resident pinned by exactly the
+        cache's own ref. Anything else — a block some released sequence
+        never freed, or a cache entry with a phantom extra ref — is a
+        leak, and at serving scale a slow leak is an outage with a delay
+        timer. Raises MXNetError LISTING the leaked block ids (the hard
+        part of chasing a leak is knowing which allocation it was);
+        called from `Engine.close()` and the serving tests' shared
+        quiescence fixture."""
+        resident = set(cache_resident)
+        leaked = sorted(b for b in self._live
+                        if b not in resident or self._refs[b] != 1)
+        phantom = sorted(b for b in resident if b not in self._live)
+        if leaked or phantom:
+            raise MXNetError(
+                "BlockPool not quiescent: %d leaked block id(s) %r "
+                "(in_use=%d, cache-resident=%d%s) — a sequence was "
+                "released without freeing them, or a shared block "
+                "holds a ref no reader owns"
+                % (len(leaked), leaked[:32], len(self._live),
+                   len(resident),
+                   (", cache entries pointing at dead blocks %r"
+                    % phantom[:8]) if phantom else ""))
+
     def add_ref(self, ids):
         """Pin each live block for one more reader; raises on a block
         that is not currently live (nothing to pin)."""

@@ -64,6 +64,27 @@ class ServingMetrics:
             "serving_engine_failures_total", flight=True,
             help="engine exceptions absorbed by the serving loop "
                  "(requests failed, loop kept alive)")
+        # survival-layer observables (ISSUE 11)
+        self._deadline_shed = c(
+            "serving_deadline_shed_total",
+            help="requests shed on deadline: admission-time unmeetable "
+                 "sheds plus queue expiries dropped before prefill")
+        self._brownout_shed = c(
+            "serving_brownout_shed_total",
+            help="requests shed by brownout mode (lowest priority "
+                 "class under sustained saturation)")
+        self._failovers = c(
+            "serving_failover_total", flight=True,
+            help="in-flight requests re-homed as a prefill replay "
+                 "(cross-replica on drain/death, or a local resume "
+                 "after a decode fault)")
+        self._failover_tokens = c(
+            "serving_failover_resumed_tokens_total",
+            help="already-generated tokens salvaged by failover "
+                 "replays (not re-decoded, only re-prefilled)")
+        self._g_brownout = g(
+            "serving_brownout_active",
+            help="1 while brownout shedding/clamping is engaged")
         self._tokens = c("serving_tokens_generated_total",
                          help="decode tokens emitted")
         self._steps = c("serving_decode_steps_total",
@@ -144,6 +165,10 @@ class ServingMetrics:
                                    "(active/max_batch)")
         self._cache_util_last = None
         self._prefill_depth_last = 0
+        # prompt tokens whose prefill compute has been observed — the
+        # denominator feed for observed_prefill_rate() (plain attr, not
+        # an exposition metric: it exists only to rate the h_prefill sum)
+        self._prefill_tokens_obs = 0
         self._counter = _DOMAIN.new_counter("tokens_generated")
 
     # -- legacy attribute surface (health(), tests) --------------------------
@@ -171,6 +196,22 @@ class ServingMetrics:
     @property
     def engine_failures(self):
         return int(self._engine_failures.value)
+
+    @property
+    def deadline_shed(self):
+        return int(self._deadline_shed.value)
+
+    @property
+    def brownout_shed(self):
+        return int(self._brownout_shed.value)
+
+    @property
+    def failovers(self):
+        return int(self._failovers.value)
+
+    @property
+    def failover_resumed_tokens(self):
+        return int(self._failover_tokens.value)
 
     @property
     def tokens_generated(self):
@@ -203,6 +244,17 @@ class ServingMetrics:
     def engine_failure(self):
         self._engine_failures.inc()
 
+    def request_deadline_shed(self):
+        self._deadline_shed.inc()
+
+    def request_brownout_shed(self):
+        self._brownout_shed.inc()
+
+    def request_failover(self, resumed_tokens):
+        self._failovers.inc()
+        if resumed_tokens:
+            self._failover_tokens.inc(resumed_tokens)
+
     def request_expired(self, req):
         """Counts the expiry only; request_finished() (always called
         after) does the failed/total accounting exactly once."""
@@ -211,6 +263,8 @@ class ServingMetrics:
     def request_prefilled(self, req, prefill_s):
         self._h_queue.observe(req.t_admit - req.t_submit)
         self._h_prefill.observe(prefill_s)
+        with self._lock:
+            self._prefill_tokens_obs += len(req.prompt)
         req.t_first_token = time.perf_counter()
         self._h_ttft.observe(req.t_first_token - req.t_submit)
 
@@ -244,6 +298,26 @@ class ServingMetrics:
         if req.t_done is not None:
             self._h_total.observe(req.t_done - req.t_submit)
 
+    def observed_token_rate(self, min_steps=8):
+        """Decode tokens per COMPUTE second, from the step-time and
+        batch histograms (sum of live sequences per step over summed
+        step wall time) — the service rate the deadline admission check
+        divides the committed-token backlog by. None until `min_steps`
+        decode steps have been observed: a cold server never sheds on a
+        rate it hasn't measured."""
+        if self.decode_steps < min_steps or self._h_step.sum <= 0:
+            return None
+        return self._h_batch.sum / self._h_step.sum
+
+    def observed_prefill_rate(self):
+        """Prompt tokens per prefill-compute second — prefill drains far
+        faster than decode, so the deadline gate must not price prompt
+        backlog at the decode rate (that would falsely shed servable
+        long-prompt requests). None until a prefill has been observed."""
+        if self._prefill_tokens_obs <= 0 or self._h_prefill.sum <= 0:
+            return None
+        return self._prefill_tokens_obs / self._h_prefill.sum
+
     # -- reading -------------------------------------------------------------
 
     def _refresh_gauges(self, engine=None, scheduler=None):
@@ -253,6 +327,8 @@ class ServingMetrics:
             self._g_queue.set(scheduler.pending())
             self._g_prefill_backlog.set(len(scheduler.prefilling))
             self._g_token_budget.set(scheduler.token_budget or 0)
+            self._g_brownout.set(
+                1 if getattr(scheduler, "brownout_active", False) else 0)
         if engine is not None and engine.cache is not None:
             pool = engine.cache.pool
             self._g_in_use.set(pool.in_use)
@@ -307,6 +383,9 @@ class ServingMetrics:
                 "rejected": self.rejected,
                 "expired": expired,
                 "engine_failures": self.engine_failures,
+                "deadline_shed": self.deadline_shed,
+                "brownout_shed": self.brownout_shed,
+                "failovers": self.failovers,
             },
             "latency_ms": {
                 "queue_mean": 1e3 * self._h_queue.sum / started,

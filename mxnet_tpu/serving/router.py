@@ -19,14 +19,38 @@ submit/HTTP front:
   frontend maps to 503 + Retry-After (one saturated replica is a 429
   retry story; a saturated FLEET is a capacity signal).
 * **Wedge drain**: a replica whose serving loop stops beating is marked
-  drained — new traffic routes around it and its queued (not yet
-  admitted) requests are re-routed to healthy replicas. `/healthz`
-  reports degraded-not-dead: 200 with `degraded: true` while at least
-  one replica serves. A drained replica that starts beating again (a
+  drained — new traffic routes around it, its queued (not yet
+  admitted) requests are re-routed to healthy replicas, and its
+  IN-FLIGHT sequences are failed over (below). `/healthz` reports
+  degraded-not-dead: 200 with `degraded: true` while at least one
+  replica serves. A drained replica that starts beating again (a
   transient stall — e.g. a multi-second XLA compile of a new shape
   bucket — not a dead loop) is RESTORED to the routable set, so a
   hiccup never permanently shrinks the fleet; only a loop that stays
   wedged stays drained.
+* **Supervision & respawn (ISSUE 11)**: *dead* (loop thread raised and
+  exited — `LMServer._died`) is distinguished from *wedged* (alive but
+  not beating). A dead replica is REBUILT — fresh engine + block pool
+  on the same device window (`mesh.replica_devices`) — and restored to
+  rotation, with per-replica crash-loop accounting: respawns back off
+  exponentially, and after `MXNET_REPLICA_RESPAWN_MAX` failed lives the
+  replica's circuit OPENS — it stays drained, is reported distinctly in
+  `/healthz` (`circuit_open`) and the merged exposition
+  (`serving_crash_loop_open`), and the fleet keeps serving on the
+  survivors. A respawned replica that stays healthy long enough earns
+  its attempt counter back (a crash months apart is not a crash loop).
+* **In-flight failover**: on drain or death, sequences that already
+  generated tokens are re-homed too — the original prompt plus the
+  generated-so-far tokens replay as a prefill on the target replica
+  (hitting its prefix cache when the prefix is resident) and decoding
+  continues. Greedy decoding is a pure function of the token history,
+  so the failed-over continuation is token-identical to an undisturbed
+  run and the client's future resolves with one seamless response. The
+  dead replica's blocks are released back to its pool (leak-audited);
+  an in-flight request NO healthy replica can absorb is failed
+  promptly with a distinct error and counted
+  (`serving_router_orphaned_total`) — never silently abandoned to its
+  timeout.
 * **Aggregated observability**: `/metrics` merges the per-replica
   registries into one Prometheus exposition distinguished by the
   `replica` label (telemetry.merged_prometheus_text); the JSON snapshot
@@ -57,6 +81,14 @@ def serving_replicas():
     return int(env) if env else 1
 
 
+def serving_respawn_max():
+    """MXNET_REPLICA_RESPAWN_MAX — how many times the router rebuilds
+    one dead replica before opening its crash-loop circuit
+    (docs/ENV_VARS.md); `ReplicatedLMServer(respawn_max=)` overrides."""
+    env = os.environ.get("MXNET_REPLICA_RESPAWN_MAX")
+    return int(env) if env else 3
+
+
 class NoHealthyReplicas(MXNetError):
     """Every replica behind the front door is drained/dead — a fleet
     outage, not a client error (the HTTP frontend maps this to 503,
@@ -71,9 +103,9 @@ class ReplicatedLMServer(_HTTPFrontend):
     saturated_status = 503          # a saturated FLEET, not one queue
 
     def __init__(self, model, replicas=2, tp=None, devices=None,
-                 retry_after_s=1.0, **kwargs):
+                 retry_after_s=1.0, max_beat_age=5.0, respawn_max=None,
+                 respawn_backoff=0.5, respawn_reset_s=30.0, **kwargs):
         from .tp import serving_tp
-        from ..parallel.mesh import replica_devices
         if replicas < 1:
             raise MXNetError("replicas must be >= 1, got %r" % replicas)
         if devices is not None:
@@ -89,6 +121,17 @@ class ReplicatedLMServer(_HTTPFrontend):
                 "adapter (each replica lays params out on its own "
                 "device window)")
         self.retry_after_s = retry_after_s
+        self.max_beat_age = float(max_beat_age)
+        # supervision knobs: how many lives one replica gets, how the
+        # respawns back off, and how long a respawned replica must stay
+        # healthy before its crash-loop counter resets
+        self.respawn_max = (serving_respawn_max() if respawn_max is None
+                            else int(respawn_max))
+        self.respawn_backoff = float(respawn_backoff)
+        self.respawn_reset_s = float(respawn_reset_s)
+        self._model = model
+        self._kwargs = dict(kwargs)
+        self._tp = tp_req
         self._closed = False
         self._lock = threading.Lock()
         self._rr = 0                # round-robin tie-break cursor
@@ -118,15 +161,34 @@ class ReplicatedLMServer(_HTTPFrontend):
         self._h_pick = self.registry.histogram(
             "serving_router_pick_seconds",
             help="least-loaded replica selection (routing overhead)")
+        self._c_orphaned = self.registry.counter(
+            "serving_router_orphaned_total", flight=True,
+            help="in-flight requests a drained/dead replica abandoned "
+                 "that NO healthy replica could absorb — failed "
+                 "promptly with a distinct error, never left to time "
+                 "out silently")
+        self._c_respawn = self.registry.counter(
+            "serving_respawn_total", flight=True,
+            help="dead replicas rebuilt (fresh engine + pool on the "
+                 "same device window) and restored to rotation")
+        self._g_circuit = self.registry.gauge(
+            "serving_crash_loop_open",
+            help="replicas whose respawn circuit is open (crash loop: "
+                 "died MXNET_REPLICA_RESPAWN_MAX times) — drained for "
+                 "good until an operator intervenes")
         self.replicas = []
         self._drained = []
+        # per-replica supervision state, index-aligned with `replicas`
+        self._respawn_attempts = [0] * replicas
+        self._respawn_next = [0.0] * replicas
+        self._respawning = [False] * replicas
+        self._circuit_open = [False] * replicas
+        self._ok_since = [None] * replicas
+        self._retired_engines = []      # crashed engines, kept for audit
+        self._retired_requests = {}     # dead replicas' request ledgers
         try:
             for i in range(replicas):
-                devs = (replica_devices(i, tp_req) if tp_req > 1
-                        else None)
-                self.replicas.append(LMServer(
-                    model, tp=tp_req, devices=devs, replica_id=i,
-                    **kwargs))
+                self.replicas.append(self._build_replica(i))
                 self._drained.append(False)
         except BaseException:
             for rep in self.replicas:
@@ -134,23 +196,67 @@ class ReplicatedLMServer(_HTTPFrontend):
             raise
         self._g_healthy.set(len(self.replicas))
 
+    def _build_replica(self, i):
+        """One fresh replica on its device window — the constructor's
+        path and the respawn path share it, so a rebuilt replica is
+        placed exactly like the original."""
+        from ..parallel.mesh import replica_devices
+        devs = replica_devices(i, self._tp) if self._tp > 1 else None
+        rep = LMServer(self._model, tp=self._tp, devices=devs,
+                       replica_id=i, **self._kwargs)
+        # the death hook runs ON the dying serving thread: queued and
+        # in-flight work is re-homed immediately, not at the next sweep
+        rep.on_death = self._on_replica_death
+        return rep
+
     # -- routing -------------------------------------------------------------
 
-    def _sweep(self, max_beat_age=5.0):
-        """One health pass over every replica: a replica whose loop
-        stopped beating is drained and its queued requests re-homed; a
-        drained replica whose loop beats again (transient stall — a
-        long compile is not a dead loop) is restored. Its queue was
-        already re-homed, so it rejoins empty; sequences that were in
-        flight on it complete normally. Returns this pass's per-replica
-        health dicts so callers never probe a second, later instant —
-        `drained` and `ok` in one /healthz body always agree."""
+    def _sweep(self, max_beat_age=None):
+        """One health pass over every replica, judging three states:
+
+        * **wedged** (loop alive, beat stale): drain — queued requests
+          re-homed, in-flight sequences failed over — and RESTORE when
+          the loop beats again (a long compile is not a dead loop).
+        * **dead** (loop thread raised and exited, `LMServer._died`, or
+          a thread that vanished without closing): drain + failover as
+          above, then RESPAWN — a fresh replica on the same device
+          window — under crash-loop accounting: exponential backoff
+          between lives, circuit OPEN after `respawn_max` attempts
+          (the replica then stays drained and the fleet serves on the
+          survivors), attempts forgiven after `respawn_reset_s` of
+          continuous health.
+        * **healthy**: restored to rotation if it was drained.
+
+        Returns this pass's per-replica health dicts so callers never
+        probe a second, later instant — `drained`/`circuit_open` and
+        `ok` in one /healthz body always agree."""
+        if max_beat_age is None:
+            max_beat_age = self.max_beat_age
         healths = []
-        for i, rep in enumerate(self.replicas):
+        now = time.perf_counter()
+        for i in range(len(self.replicas)):
+            rep = self.replicas[i]
             h = rep.health(max_beat_age=max_beat_age)
+            # dead = the loop CRASHED (raised out of _loop) or the
+            # thread vanished without an administrative close — a
+            # closed replica is down on purpose, not respawn fodder
+            h["dead"] = bool(rep._died or (not rep._thread.is_alive()
+                                           and not rep._closed))
+            h["circuit_open"] = self._circuit_open[i]
+            h["respawns"] = self._respawn_attempts[i]
             healths.append(h)
             if self._closed:
                 continue
+            if h["ok"]:
+                if self._ok_since[i] is None:
+                    self._ok_since[i] = now
+                elif self._respawn_attempts[i] and not \
+                        self._circuit_open[i] and \
+                        now - self._ok_since[i] >= self.respawn_reset_s:
+                    # survived a full probation: not a crash loop
+                    self._respawn_attempts[i] = 0
+            else:
+                self._ok_since[i] = None
             if not self._drained[i] and not h["ok"]:
                 with self._lock:
                     if self._drained[i]:
@@ -164,10 +270,98 @@ class ReplicatedLMServer(_HTTPFrontend):
                         continue
                     self._drained[i] = False
                 self._c_restored.inc(replica=i)
+            if h["dead"]:
+                self._maybe_respawn(i, now)
         self._g_healthy.set(len(self.replicas) - sum(self._drained))
+        self._g_circuit.set(sum(self._circuit_open))
         return healths
 
-    def _routable(self, max_beat_age=5.0):
+    def _maybe_respawn(self, i, now):
+        """Schedule a rebuild of dead replica i unless its circuit is
+        open, its backoff window hasn't elapsed, or a rebuild is already
+        in flight. The slot is reserved under the lock; the CONSTRUCTION
+        runs on a short-lived daemon thread — a sweep rides on client
+        submits and /healthz probes, and blocking a health probe for a
+        multi-second engine rebuild during a fault is exactly when an
+        external orchestrator would misread the whole door as down."""
+        with self._lock:
+            if self._closed or self._respawning[i] or \
+                    self._circuit_open[i]:
+                return
+            if self._respawn_attempts[i] >= self.respawn_max:
+                self._circuit_open[i] = True
+                self._g_circuit.set(sum(self._circuit_open))
+                telemetry.flight().record(
+                    "fault", "serving.crash_loop_open", replica=i,
+                    attempts=self._respawn_attempts[i])
+                return
+            if now < self._respawn_next[i]:
+                return
+            self._respawning[i] = True
+            self._respawn_attempts[i] += 1
+            self._respawn_next[i] = now + self.respawn_backoff \
+                * (2 ** (self._respawn_attempts[i] - 1))
+        threading.Thread(target=self._respawn_build,
+                         args=(i, self.replicas[i]),
+                         name="mxtpu-respawn-%d" % i,
+                         daemon=True).start()
+
+    def _respawn_build(self, i, old):
+        """The reserved rebuild of replica i: construct off the hot
+        paths, swap atomically, retire the corpse (its engine is kept
+        for the leak audit)."""
+        try:
+            rep = self._build_replica(i)
+        except Exception as e:
+            with self._lock:
+                self._respawning[i] = False
+            telemetry.flight().record(
+                "fault", "serving.respawn_failed", replica=i,
+                error="%s: %s" % (type(e).__name__, e))
+            return
+        with self._lock:
+            if self._closed:        # raced an administrative shutdown
+                self._respawning[i] = False
+                closed_race = True
+            else:
+                self.replicas[i] = rep
+                self._drained[i] = False
+                self._respawning[i] = False
+                closed_race = False
+        if closed_race:
+            rep.close(drain=False, timeout=5.0)
+            return
+        self._ok_since[i] = None
+        # fold the corpse's request ledger into the router's retired
+        # totals BEFORE discarding its registry: rescued requests'
+        # `submitted` counts live only there, and the aggregate
+        # submitted == completed + failed balance must survive the swap
+        try:
+            for k, v in old.snapshot()["requests"].items():
+                self._retired_requests[k] = \
+                    self._retired_requests.get(k, 0) + v
+        except Exception:
+            pass
+        # keep only a few corpses for post-hoc leak audits (the chaos
+        # drill reads them): an intermittently-crashing replica whose
+        # probation keeps forgiving its counter would otherwise pin
+        # every dead engine's pool buffers forever
+        self._retired_engines.append(old.engine)
+        del self._retired_engines[:-4]
+        try:
+            old.close(drain=False, timeout=1.0)
+        except Exception:
+            pass
+        if old.engine.cache is not None:
+            # the corpse is kept for the leak AUDIT, which only needs
+            # the pool's host-side bookkeeping — drop the device K/V
+            # buffers (the dominant allocation) so retired engines
+            # never pin HBM the replacement pools need
+            old.engine.cache.k = old.engine.cache.v = None
+        self._c_respawn.inc(replica=i)
+        self._g_healthy.set(len(self.replicas) - sum(self._drained))
+
+    def _routable(self, max_beat_age=None):
         """Indices of replicas traffic may go to, after a wedge/restore
         sweep."""
         if self._closed:
@@ -177,14 +371,57 @@ class ReplicatedLMServer(_HTTPFrontend):
                 if not self._drained[i]]
 
     def _rehome(self, rep):
-        """Move a drained replica's queued (never admitted) requests to
-        healthy replicas; fail the ones nobody can absorb. Requests
-        already running/prefilling on the wedged engine cannot be moved
-        (their KV blocks live there) — they fail by their own
-        timeouts."""
+        """Sweep-side drain of a wedged (or dead-without-hook) replica:
+        queued (never admitted) requests move wholesale; in-flight
+        sequences are detached from the stuck loop — request unhooked,
+        marked done so a loop that later RESUMES evicts them (releasing
+        their blocks) without double-serving — and failed over as
+        prefill replays. The detach-then-replay order is the
+        exactly-once pin: by the time a replay exists anywhere, the
+        source loop can only ever release, never finish."""
+        states = []
+        with rep._failover_lock:
+            for seq in (list(rep.scheduler.running)
+                        + list(rep.scheduler.prefilling)):
+                req = seq.request
+                if req is None or req._event.is_set():
+                    continue
+                states.append((req, list(seq.tokens), seq.prompt_len))
+                seq.request = None
+                seq.done = True
+        self._place_orphans(rep, rep.drain_queue(), states)
+
+    def _on_replica_death(self, rep, queued, states):
+        """LMServer's death hook — runs ON the dying serving thread,
+        after it released its blocks: mark the replica drained and
+        re-home everything immediately (clients must not wait for the
+        next health sweep to learn their requests moved)."""
+        try:
+            i = self.replicas.index(rep)
+        except ValueError:
+            i = None                    # already replaced by a respawn
+        if i is not None and not self._closed:
+            with self._lock:
+                fresh = not self._drained[i]
+                self._drained[i] = True
+            if fresh:
+                self._c_drained.inc(replica=i)
+            self._g_healthy.set(len(self.replicas) - sum(self._drained))
+        self._place_orphans(rep, queued, states)
+
+    def _place_orphans(self, rep, queued, states):
+        """Re-home a drained/dead replica's abandoned work. Queued
+        requests adopt wholesale (least-loaded first). In-flight states
+        — (request, tokens generated so far, prompt_len) — replay as
+        prefills via `spawn_resume`; the stitch completes the client's
+        original future token-identically. Work nobody can absorb is
+        failed PROMPTLY with a distinct error and counted
+        (`serving_router_orphaned_total`) — the pre-ISSUE-11 behavior
+        of letting it ride to its timeout was a silent outage."""
+        from .server import spawn_resume
         targets = [r for i, r in enumerate(self.replicas)
-                   if not self._drained[i]]
-        for req in rep.drain_queue():
+                   if not self._drained[i] and r is not rep]
+        for req in queued:
             placed = False
             for tgt in sorted(targets, key=lambda r: r.load_tokens()):
                 try:
@@ -203,6 +440,41 @@ class ReplicatedLMServer(_HTTPFrontend):
                 # ledger there so aggregate submitted == completed +
                 # failed and no phantom in-flight request lingers
                 rep.metrics.request_finished(req)
+        for req, tokens, prompt_len in states:
+            if req.failovers >= LMServer.max_failovers:
+                self._orphan(rep, req, "failover budget exhausted "
+                                       "(%d hops)" % req.failovers)
+                continue
+            placed = False
+            for tgt in sorted(targets, key=lambda r: r.load_tokens()):
+                try:
+                    resume, carried = spawn_resume(req, tokens, tgt)
+                except QueueFull:
+                    continue
+                placed = True
+                if resume is None:
+                    # generation was already complete: finished directly
+                    rep.metrics.request_finished(req)
+                else:
+                    tgt.metrics.request_failover(carried)
+                    telemetry.flight().record(
+                        "fault", "serving.failover", request=req.id,
+                        resumed_tokens=carried,
+                        target=tgt.replica_id)
+                break
+            if not placed:
+                self._orphan(rep, req, "no healthy replica could "
+                                       "absorb the failover replay")
+
+    def _orphan(self, rep, req, why):
+        """Fail one abandoned in-flight request promptly, with an error
+        string that names the abandonment (a generic queue timeout hides
+        the outage), and count it."""
+        self._c_orphaned.inc()
+        req._finish(error=MXNetError(
+            "in-flight request %d orphaned by replica drain/death: %s"
+            % (req.id, why)))
+        rep.metrics.request_finished(req)
 
     def _pick_order(self):
         """Routable replicas, least-loaded first; ties broken
@@ -223,13 +495,17 @@ class ReplicatedLMServer(_HTTPFrontend):
     # -- client API ----------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens=32, eos_id=None,
-               count_reject=True, tenant=None, priority=None):
+               count_reject=True, tenant=None, priority=None,
+               deadline_ms=None):
         """Route one request to the least-loaded healthy replica;
         returns the Request future. Raises QueueFull only when EVERY
         healthy replica is saturated (the HTTP front maps that to 503 +
         Retry-After), NoHealthyReplicas when the whole fleet is
         drained/dead (HTTP 503 — an outage is never a 400), MXNetError
-        when the request can never be served (oversized prompt).
+        when the request can never be served (oversized prompt), and
+        DeadlineUnmeetable when even the least-loaded replica's observed
+        service rate cannot meet `deadline_ms` (a more-loaded replica
+        certainly can't — HTTP 503 with the computed Retry-After).
         `tenant`/`priority` pass through to the placed replica's
         scheduler (each replica also keeps its own prefix cache — hot
         prefixes become resident wherever their tenants' traffic
@@ -245,7 +521,8 @@ class ReplicatedLMServer(_HTTPFrontend):
             try:
                 req = self.replicas[i].submit(
                     prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
-                    count_reject=False, tenant=tenant, priority=priority)
+                    count_reject=False, tenant=tenant, priority=priority,
+                    deadline_ms=deadline_ms)
                 req.replica = i          # where the router placed it
                 # counted on placement (or final rejection) — never per
                 # HTTP retry attempt, which would inflate the request
@@ -271,22 +548,29 @@ class ReplicatedLMServer(_HTTPFrontend):
 
     # -- observability -------------------------------------------------------
 
-    def health(self, max_beat_age=5.0):
+    def health(self, max_beat_age=None):
         """Fleet liveness for /healthz: `ok` while ANY replica serves
         (degraded-not-dead — one wedged replica is drained and routed
         around, it must not take the door down). Per-replica statuses
-        are the same health dicts the drain/restore sweep judged, so
-        `ok` and `drained` in one response never disagree."""
+        are the same health dicts the drain/restore/respawn sweep
+        judged, so `ok`, `drained`, and `circuit_open` in one response
+        never disagree; a circuit-open replica (crash loop — out of
+        respawn budget) is reported distinctly from a merely drained
+        one."""
         reps = self._sweep(max_beat_age=max_beat_age)
         for i, h in enumerate(reps):
             h["replica"] = i
             h["drained"] = self._drained[i]
+            # the sweep may have opened a circuit AFTER stamping this
+            # dict: re-stamp so the body reflects the sweep's verdict
+            h["circuit_open"] = self._circuit_open[i]
         ok_n = sum(1 for h in reps if h["ok"])
         return {
             "ok": bool(ok_n > 0 and not self._closed),
             "degraded": bool(ok_n < len(reps)),
             "replicas_total": len(reps),
             "replicas_healthy": ok_n,
+            "replicas_circuit_open": sum(self._circuit_open),
             "replicas": reps,
         }
 
@@ -294,7 +578,11 @@ class ReplicatedLMServer(_HTTPFrontend):
         """Per-replica snapshots plus summed aggregates (the JSON
         /metrics body)."""
         snaps = [rep.snapshot() for rep in self.replicas]
-        agg_req = {}
+        # seed with retired (respawned-away) replicas' ledgers so the
+        # aggregate submitted == completed + failed balance survives
+        # every death: a rescued request's `submitted` lives on the
+        # corpse, its completion on the rescue target
+        agg_req = dict(self._retired_requests)
         for s in snaps:
             for k, v in s["requests"].items():
                 agg_req[k] = agg_req.get(k, 0) + v
@@ -321,6 +609,11 @@ class ReplicatedLMServer(_HTTPFrontend):
                 "prefix_hit_rate": (phits / plook) if plook else None,
                 "replicas_total": len(snaps),
                 "replicas_drained": sum(self._drained),
+                "replicas_circuit_open": sum(self._circuit_open),
+                "failovers": sum(s["requests"].get("failovers", 0)
+                                 for s in snaps),
+                "respawns": int(self._c_respawn.value),
+                "orphaned": int(self._c_orphaned.value),
             },
             "router": self.registry.snapshot(),
         }
@@ -338,12 +631,24 @@ class ReplicatedLMServer(_HTTPFrontend):
     # -- lifecycle -----------------------------------------------------------
 
     def close(self, drain=True, timeout=30.0):
+        """Close every replica. Exception-safe against the leak audit:
+        one leaky replica's `Engine.close()` raise must not leave the
+        rest of the fleet's threads running and the HTTP port bound —
+        every replica is closed, the first audit error re-raises at the
+        end."""
         self._closed = True
+        first_err = None
         for rep in self.replicas:
-            rep.close(drain=drain, timeout=timeout)
+            try:
+                rep.close(drain=drain, timeout=timeout)
+            except Exception as e:
+                if first_err is None:
+                    first_err = e
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd = None
+        if first_err is not None:
+            raise first_err
 
     def __enter__(self):
         return self

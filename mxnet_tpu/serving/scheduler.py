@@ -36,6 +36,16 @@ cannot starve their working set or monopolize the block pool. A tenant
 with nothing in flight always makes progress (its head request admits
 even when the request alone exceeds the budget), mirroring the global
 budget's progress rule.
+
+Deadlines & brownout (ISSUE 11): a request may carry `deadline_ms` —
+its total latency budget from submit. Admission drops a request whose
+deadline already passed BEFORE spending prefill tokens on it
+(`DeadlineExceeded` → HTTP 504); the server-side admission gate sheds
+requests the observed service rate can't meet at all
+(`DeadlineUnmeetable` → 503 + computed Retry-After). Under sustained
+saturation, brownout mode (`MXNET_SERVING_BROWNOUT`) sheds the lowest
+priority class first and clamps `max_new_tokens` of newly admitted
+work — admitted work's length and logits are never touched.
 """
 from __future__ import annotations
 
@@ -56,6 +66,30 @@ class RequestTimeout(MXNetError):
     """The request waited in the queue longer than queue_timeout."""
 
 
+class DeadlineExceeded(MXNetError):
+    """The request's deadline passed while it waited for admission — it
+    is dropped BEFORE any prefill tokens are spent on it (serving a
+    response the client already gave up on is pure waste). The HTTP
+    frontend maps this to 504."""
+
+
+class DeadlineUnmeetable(MXNetError):
+    """Admission-time shed: at the observed service rate the queue ahead
+    of this request already exceeds its deadline, so accepting it would
+    only burn tokens on a guaranteed 504. The HTTP frontend maps this to
+    503 with the COMPUTED Retry-After carried on `retry_after_s`."""
+
+    def __init__(self, msg, retry_after_s=1.0):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class BrownoutShed(MXNetError):
+    """The request was shed by brownout mode (sustained saturation —
+    MXNET_SERVING_BROWNOUT): lowest priority class first, so paying
+    tenants degrade last. Maps to 503 + Retry-After."""
+
+
 _ids = itertools.count(1)
 
 QUEUED, RUNNING, DONE, FAILED = "queued", "running", "done", "failed"
@@ -66,7 +100,7 @@ class Request:
     make it a minimal future the in-process API and HTTP frontend share."""
 
     def __init__(self, prompt, max_new_tokens=32, eos_id=None,
-                 tenant=None, priority=None):
+                 tenant=None, priority=None, deadline_ms=None):
         if not len(prompt):
             raise MXNetError("empty prompt")
         self.id = next(_ids)
@@ -75,14 +109,22 @@ class Request:
         self.eos_id = eos_id
         self.tenant = str(tenant) if tenant is not None else "default"
         self.priority = int(priority) if priority is not None else 0
+        self.deadline_ms = (float(deadline_ms)
+                            if deadline_ms is not None else None)
         self.state = QUEUED
         self.error = None
         self.tokens = None            # prompt + generated, set on DONE
         self.t_submit = time.perf_counter()
+        # absolute deadline on the same clock the scheduler reads
+        self.t_deadline = (self.t_submit + self.deadline_ms / 1e3
+                           if self.deadline_ms is not None else None)
         self.t_admit = None
         self.t_first_token = None
         self.t_done = None
+        self.failovers = 0            # resume hops already spent on it
+        self._on_finish = None        # failover stitch callback
         self._event = threading.Event()
+        self._finish_lock = threading.Lock()
 
     def wait(self, timeout=None):
         return self._event.wait(timeout)
@@ -98,14 +140,61 @@ class Request:
         return self.tokens[len(self.prompt):]
 
     def _finish(self, tokens=None, error=None):
-        self.t_done = time.perf_counter()
-        if error is not None:
-            self.state = FAILED
-            self.error = error
-        else:
-            self.state = DONE
-            self.tokens = tokens
-        self._event.set()
+        # first finish wins, ATOMICALLY: a request that was failed over
+        # must never be completed a second time by its original replica
+        # resuming (the exactly-once contract the drain/restore race
+        # test pins), and two racing finishers must not interleave
+        # state/tokens/error writes
+        with self._finish_lock:
+            if self._event.is_set():
+                return
+            self.t_done = time.perf_counter()
+            if error is not None:
+                self.state = FAILED
+                self.error = error
+            else:
+                self.state = DONE
+                self.tokens = tokens
+            cb, self._on_finish = self._on_finish, None
+            self._event.set()
+        if cb is not None:           # outside the lock: the stitch
+            cb(self)                 # finishes ANOTHER request
+
+
+def make_resume(orig, tokens, max_len):
+    """Build the failover replay for `orig`: a fresh Request whose
+    prompt is the original prompt PLUS every token already generated —
+    replayed as a prefill on the target replica (hitting the prefix
+    cache when the prefix is resident), after which decode continues.
+    Greedy decoding is a pure function of the token history, so the
+    continuation is token-identical to an undisturbed run (the
+    parity-oracle discipline). Returns (resume, carried) where
+    `carried` counts the generated-so-far tokens the replay salvages,
+    or (None, carried) when nothing remains to generate (the caller
+    finishes `orig` directly with `tokens`).
+
+    The caller owns the stitch: set ``resume._on_finish`` to complete
+    `orig` from the resume's result — `orig.result()` slices by the
+    ORIGINAL prompt length, so handing it the resume's full token list
+    yields pre-fault and post-fault generation as one seamless
+    response."""
+    carried = max(0, len(tokens) - len(orig.prompt))
+    total = min(max_len, len(orig.prompt) + orig.max_new_tokens)
+    remaining = total - len(tokens)
+    hit_eos = (orig.eos_id is not None and carried
+               and tokens[-1] == orig.eos_id)
+    if remaining <= 0 or hit_eos:
+        return None, carried
+    resume = Request(tokens, max_new_tokens=remaining,
+                     eos_id=orig.eos_id, tenant=orig.tenant,
+                     priority=orig.priority,
+                     deadline_ms=orig.deadline_ms)
+    resume.failovers = orig.failovers + 1
+    # the deadline is ABSOLUTE from the client's submit — a failover hop
+    # must not extend it (t_submit stays fresh: queue_timeout measures
+    # queue wait, and the resume really does enter a queue anew)
+    resume.t_deadline = orig.t_deadline
+    return resume, carried
 
 
 class Scheduler:
@@ -114,7 +203,8 @@ class Scheduler:
 
     def __init__(self, max_batch=8, max_queue=64, queue_timeout=None,
                  token_budget=None, tenant_budget=None,
-                 tenant_budgets=None):
+                 tenant_budgets=None, brownout=None,
+                 brownout_after_s=1.0, brownout_max_new=16):
         self.max_batch = max_batch
         self.max_queue = max_queue
         self.queue_timeout = queue_timeout
@@ -127,6 +217,20 @@ class Scheduler:
             tenant_budget = int(env) if env else None
         self.tenant_budget = tenant_budget        # default per-tenant cap
         self.tenant_budgets = dict(tenant_budgets or {})  # per-name override
+        # brownout: graceful degradation under SUSTAINED saturation
+        # (MXNET_SERVING_BROWNOUT / Scheduler(brownout=True)). While
+        # active, admission sheds the lowest priority class first and
+        # clamps max_new_tokens of NEWLY admitted work — it never
+        # touches the logits (or length) of work already admitted.
+        if brownout is None:
+            brownout = os.environ.get("MXNET_SERVING_BROWNOUT", "0") == "1"
+        self.brownout = bool(brownout)
+        self.brownout_after_s = float(brownout_after_s)
+        self.brownout_max_new = int(brownout_max_new)
+        self._sat_since = None        # when the queue first ran hot
+        self.brownout_active = False
+        self.brownout_sheds = 0       # monotonic (metrics sync)
+        self.deadline_drops = 0       # admission-time deadline expiries
         self._queue = deque()
         self._lock = threading.Lock()
         self.running = []             # serving-thread-only
@@ -181,6 +285,26 @@ class Scheduler:
                 + engine.prefill_tokens_per_step(s.prompt_len)
         return spent
 
+    def _update_brownout(self, now):
+        """Saturation hysteresis (caller holds the lock): the queue
+        running at >= 3/4 of max_queue for `brownout_after_s` turns
+        brownout ON; draining back below 1/4 turns it OFF. The two
+        thresholds keep one oscillating burst from toggling the mode
+        every iteration."""
+        if not self.brownout:
+            return
+        qlen = len(self._queue)
+        hi = max(1, (3 * self.max_queue) // 4)
+        lo = max(0, self.max_queue // 4)
+        if qlen >= hi:
+            if self._sat_since is None:
+                self._sat_since = now
+            elif now - self._sat_since >= self.brownout_after_s:
+                self.brownout_active = True
+        elif qlen <= lo:
+            self._sat_since = None
+            self.brownout_active = False
+
     def admit(self, engine, now=None):
         """Move queued requests into the running set while batch slots,
         cache blocks, and the token budgets allow; expire the ones that
@@ -198,9 +322,51 @@ class Scheduler:
         spent = self.spent_tokens(engine)
         by_tenant = self.spent_by_tenant(engine)
         with self._lock:
+            self._update_brownout(now)
             order = sorted(self._queue,
                            key=lambda r: (-r.priority, r.t_submit, r.id))
             drop = set()
+            if self.brownout_active:
+                # shed the lowest priority class first (and only when
+                # classes are distinguishable — with one class the
+                # max_new clamp below is the degradation lever; shedding
+                # everyone would be an outage, not a brownout)
+                # failover resumes (failovers > 0) are exempt: they ARE
+                # admitted work mid-generation, re-queued only because
+                # their replica died — shedding or clamping one would
+                # fail/truncate a response the client was already
+                # receiving and break failover token parity
+                prios = {r.priority for r in order if r.failovers == 0}
+                if len(prios) > 1:
+                    floor = min(prios)
+                    for req in order:
+                        if req.priority == floor and req.failovers == 0:
+                            drop.add(req.id)
+                            expired.append(req)
+                            req.error = BrownoutShed(
+                                "request %d shed by brownout (sustained "
+                                "saturation, priority %d is the lowest "
+                                "queued class); retry later"
+                                % (req.id, req.priority))
+                            self.brownout_sheds += 1
+                    order = [r for r in order if r.id not in drop]
+            # expired deadlines drop over the WHOLE queue, before the
+            # batch-capacity break below can shadow them: a corpse must
+            # not hold a queue slot (inflating backpressure and the
+            # brownout hysteresis) for as long as the batch stays full,
+            # and its 504 must reach the client promptly
+            for req in order:
+                if req.t_deadline is not None and now > req.t_deadline:
+                    drop.add(req.id)
+                    expired.append(req)
+                    req.error = DeadlineExceeded(
+                        "request %d missed its %.0f ms deadline after "
+                        "%.1f ms in queue"
+                        % (req.id, req.deadline_ms or 0.0,
+                           1e3 * (now - req.t_submit)))
+                    self.deadline_drops += 1
+            if drop:
+                order = [r for r in order if r.id not in drop]
             for req in order:
                 if len(self.running) + len(self.prefilling) \
                         + len(admitted) >= self.max_batch:
@@ -238,6 +404,14 @@ class Scheduler:
                 spent += cost
                 by_tenant[req.tenant] = t_spent + cost
                 drop.add(req.id)
+                if self.brownout_active and req.failovers == 0:
+                    # degrade, don't deny: newly admitted work generates
+                    # fewer tokens under brownout. Admitted work is
+                    # never re-clamped and logits are never touched —
+                    # which is exactly why failover resumes are exempt
+                    # (they are admitted work continuing elsewhere).
+                    req.max_new_tokens = min(req.max_new_tokens,
+                                             self.brownout_max_new)
                 req.t_admit = now
                 admitted.append(req)
             if drop:

@@ -18,13 +18,16 @@ connect.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 
 from ..base import MXNetError
 from .. import telemetry
+from ..utils import chaos
 from .engine import Engine, TransformerLM, BlockLM, ExportedLM
-from .scheduler import Scheduler, Request, QueueFull
+from .scheduler import (Scheduler, Request, QueueFull, BrownoutShed,
+                        DeadlineExceeded, DeadlineUnmeetable, make_resume)
 from .metrics import ServingMetrics
 
 
@@ -153,6 +156,7 @@ def _make_handler(outer):
                 # count_reject=False: only the FINAL failure below
                 # counts as a rejection in the metrics
                 priority = body.get("priority")
+                deadline_ms = body.get("deadline_ms")
                 req = retry(
                     lambda: outer.submit(
                         body["tokens"],
@@ -162,10 +166,22 @@ def _make_handler(outer):
                         count_reject=False,
                         tenant=body.get("tenant"),
                         priority=(int(priority) if priority is not None
-                                  else None)),
+                                  else None),
+                        deadline_ms=(float(deadline_ms)
+                                     if deadline_ms is not None
+                                     else None)),
                     attempts=outer.submit_retries,
                     backoff=outer.submit_backoff,
                     retry_on=QueueFull)
+            except DeadlineUnmeetable as e:
+                # admission-time shed: the observed service rate cannot
+                # meet this request's deadline — 503 with the COMPUTED
+                # Retry-After (when the backlog will have drained enough
+                # for the same deadline to be feasible)
+                self._reply(503, {"error": str(e)},
+                            headers={"Retry-After":
+                                     "%d" % max(1, int(e.retry_after_s))})
+                return
             except QueueFull as e:
                 outer._final_reject()
                 headers = None
@@ -189,6 +205,15 @@ def _make_handler(outer):
             try:
                 generated = req.result(
                     timeout=float(body.get("timeout", 300)))
+            except DeadlineExceeded as e:
+                # the deadline passed in queue: dropped before prefill —
+                # a Gateway Timeout, not a server error
+                self._reply(504, {"error": str(e)})
+                return
+            except BrownoutShed as e:
+                self._reply(503, {"error": str(e)},
+                            headers={"Retry-After": "1"})
+                return
             except MXNetError as e:
                 self._reply(500, {"error": str(e)})
                 return
@@ -208,13 +233,18 @@ class LMServer(_HTTPFrontend):
     gives each replica its index); `tp=`/`devices=` pass through to the
     Engine's tensor-parallel placement (serving/tp.py)."""
 
+    #: resume hops one request may spend before its fault is surfaced
+    #: (a crash-looping fleet must not bounce a request forever)
+    max_failovers = 2
+
     def __init__(self, model, max_batch=8, max_len=None, block_size=16,
                  num_blocks=None, max_queue=64, queue_timeout=None,
                  keep_logits=False, vocab=None, time_major=False,
                  idle_wait=0.005, paged=None, prefill_chunk=None,
                  token_budget=None, tp=None, devices=None,
                  replica_id=None, prefix_cache=None, tenant_budget=None,
-                 tenant_budgets=None, default_priority=0):
+                 tenant_budgets=None, default_priority=0,
+                 default_deadline_ms=None, brownout=None):
         adapter = _resolve_model(model, vocab=vocab, max_len=max_len,
                                  time_major=time_major)
         self.engine = Engine(adapter, max_batch=max_batch, max_len=max_len,
@@ -226,13 +256,31 @@ class LMServer(_HTTPFrontend):
                                    queue_timeout=queue_timeout,
                                    token_budget=token_budget,
                                    tenant_budget=tenant_budget,
-                                   tenant_budgets=tenant_budgets)
+                                   tenant_budgets=tenant_budgets,
+                                   brownout=brownout)
         self.default_priority = int(default_priority)
+        if default_deadline_ms is None:
+            env = os.environ.get("MXNET_SERVING_DEADLINE_MS")
+            default_deadline_ms = float(env) if env else None
+        self.default_deadline_ms = default_deadline_ms
         self.metrics = ServingMetrics(replica=replica_id)
         self.replica_id = replica_id
         self._idle_wait = idle_wait
         self._work = threading.Event()
         self._closed = False
+        # survival-layer state (ISSUE 11): `on_death` is the router's
+        # rescue hook — called on the DYING serving thread with the
+        # queued requests and in-flight resume states so they can be
+        # re-homed instead of failed; `_died` distinguishes a crashed
+        # loop (respawnable) from an administrative close
+        self.on_death = None
+        self._died = False
+        self._chaos_stolen = None     # (block ids, release-at iteration)
+        # serializes in-flight capture between the death path (dying
+        # serving thread) and the router's wedge rescue (sweep thread):
+        # whoever detaches a sequence first owns its failover — the
+        # other side sees request=None and captures nothing
+        self._failover_lock = threading.Lock()
         # liveness observables for /healthz: the loop thread beats every
         # iteration; decode progress stamps separately
         self._last_beat = time.perf_counter()
@@ -250,7 +298,8 @@ class LMServer(_HTTPFrontend):
     # -- client API ----------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens=32, eos_id=None,
-               count_reject=True, tenant=None, priority=None):
+               count_reject=True, tenant=None, priority=None,
+               deadline_ms=None):
         """Enqueue one request; returns it (a future: .result(timeout)).
         Raises QueueFull immediately when backpressure kicks in.
         `count_reject=False` suppresses the rejected-metric increment —
@@ -258,23 +307,60 @@ class LMServer(_HTTPFrontend):
         that eventually lands is not a rejection). `tenant`/`priority`
         feed the scheduler's multi-tenant admission (default tenant,
         server default priority when omitted — fully backward
-        compatible)."""
+        compatible). `deadline_ms` (default `default_deadline_ms` /
+        MXNET_SERVING_DEADLINE_MS) is the client's total latency budget:
+        a request the OBSERVED service rate already can't meet is shed
+        right here (DeadlineUnmeetable, with the computed Retry-After)
+        instead of burning queue slots and prefill tokens on a
+        guaranteed 504."""
         if self._closed:
+            # a replica behind the router reports closure as
+            # backpressure so the door tries the next replica (a crash
+            # racing a routed submit must not surface as a hard error
+            # while healthy replicas exist); a standalone server keeps
+            # the hard contract
+            if self.replica_id is not None:
+                raise QueueFull("replica %s is closed"
+                                % self.replica_id)
             raise MXNetError("server is closed")
         if len(prompt) > self.engine.max_len:
             raise MXNetError(
                 "prompt length %d exceeds the server's max_len %d"
                 % (len(prompt), self.engine.max_len))
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        if deadline_ms is not None:
+            self._check_deadline_meetable(len(prompt), max_new_tokens,
+                                          float(deadline_ms))
         req = Request(prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
                       tenant=tenant,
                       priority=(priority if priority is not None
-                                else self.default_priority))
+                                else self.default_priority),
+                      deadline_ms=deadline_ms)
         try:
             self.scheduler.submit(req)
         except QueueFull:
             if count_reject:
                 self.metrics.request_rejected()
             raise
+        if self._closed:
+            # the loop died between the check above and the enqueue: if
+            # the death-path drain already took the request it will be
+            # re-homed (proceed and count it submitted here, matching
+            # the drained-replica ledger convention); if it is still on
+            # the dead queue, pull it back and report backpressure so
+            # the caller retries elsewhere — never strand it
+            with self.scheduler._lock:
+                try:
+                    self.scheduler._queue.remove(req)
+                    pulled = True
+                except ValueError:
+                    pulled = False
+            if pulled:
+                if self.replica_id is not None:
+                    raise QueueFull("replica %s closed mid-submit"
+                                    % self.replica_id)
+                raise MXNetError("server is closed")
         self.metrics.request_submitted()
         # the trace row's start marker: every later span (queue, prefill
         # chunks, decode steps) shares this request id as its trace id
@@ -285,6 +371,55 @@ class LMServer(_HTTPFrontend):
                               max_new_tokens=req.max_new_tokens)
         self._work.set()
         return req
+
+    def _load_split(self):
+        """Committed backlog split into (prefill tokens, decode tokens)
+        — the two drain at very different rates, and the deadline gate
+        must price each at its own observed rate. Advisory reads, same
+        caveats as `load_tokens`."""
+        sched = self.scheduler
+        with sched._lock:
+            queued = list(sched._queue)
+        pre = sum(len(r.prompt) for r in queued)
+        dec = sum(r.max_new_tokens for r in queued)
+        for s in list(sched.running):
+            dec += max(1, s.max_total - len(s.tokens))
+        for s in list(sched.prefilling):
+            pre += max(0, s.prompt_len - s.prefilled)
+            dec += max(1, s.max_total - s.prompt_len)
+        return pre, dec
+
+    def _check_deadline_meetable(self, prompt_len, max_new, deadline_ms):
+        """Admission-time deadline gate: estimated completion time is
+        the committed DECODE backlog over the observed decode token
+        rate PLUS the prefill backlog over the observed prefill rate
+        (prefill drains orders of magnitude faster — pricing prompt
+        tokens at the decode rate would falsely shed servable
+        long-prompt requests). When the estimate already exceeds the
+        deadline, shed NOW with a Retry-After computed from how long
+        the backlog needs to drain below feasibility — honest
+        backpressure beats a queue full of corpses. Still an estimate:
+        it only has to be right about hopeless cases, and a false
+        accept is dropped at scheduling time."""
+        rate = self.metrics.observed_token_rate()
+        if rate is None or rate <= 0:
+            return                      # nothing measured yet: admit
+        pre_b, dec_b = self._load_split()
+        pre_b += prompt_len
+        dec_b += max_new
+        prate = self.metrics.observed_prefill_rate()
+        est_s = dec_b / rate + (pre_b / prate if prate else 0.0)
+        if est_s <= deadline_ms / 1e3:
+            return
+        self.metrics.request_deadline_shed()
+        retry_after = max(1.0, est_s - deadline_ms / 1e3)
+        raise DeadlineUnmeetable(
+            "deadline %.0f ms unmeetable: %d decode + %d prefill "
+            "backlog tokens at the observed %.0f tok/s decode rate "
+            "need ~%.0f ms; retry in %.0fs"
+            % (deadline_ms, dec_b, pre_b, rate, est_s * 1e3,
+               retry_after),
+            retry_after_s=retry_after)
 
     def generate(self, prompt, max_new_tokens=32, eos_id=None,
                  timeout=None):
@@ -331,18 +466,26 @@ class LMServer(_HTTPFrontend):
         }
 
     def close(self, drain=True, timeout=30.0):
-        """Stop the loop; with drain=True finish in-flight work first."""
+        """Stop the loop; with drain=True finish in-flight work first.
+        A clean close (drained, loop exited on its own terms) runs the
+        engine's block-pool leak audit — `Engine.close()` raises listing
+        leaked block ids, so a serving-side leak fails loudly at the
+        point of retirement instead of starving a future pool."""
         if drain:
             deadline = time.perf_counter() + timeout
             while self.scheduler.has_work() and \
                     time.perf_counter() < deadline:
                 time.sleep(0.01)
+        clean = (drain and not self._died
+                 and not self.scheduler.has_work())
         self._closed = True
         self._work.set()
         self._thread.join(timeout=timeout)
+        self._release_chaos_blocks()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd = None
+        self.engine.close(audit=clean and self._thread.is_alive() is False)
 
     def __enter__(self):
         return self
@@ -356,31 +499,86 @@ class LMServer(_HTTPFrontend):
         try:
             self._loop_inner()
         except BaseException as e:  # noqa: BLE001 — a dead loop must not
-            # strand clients in result(): fail everything in flight
+            # strand clients in result(): rescue (via the router's
+            # on_death hook) or fail everything in flight
             telemetry.flight().record("fault", "serving.loop_died",
                                       error="%s: %s"
                                       % (type(e).__name__, e))
             telemetry.flight().dump("serving_loop_died")
+            self._died = True
+            self._closed = True
             err = MXNetError("serving loop died: %s: %s"
                              % (type(e).__name__, e))
+            # capture AND DETACH the in-flight survivors (under the
+            # failover lock — a concurrent wedge-rescue sweep racing
+            # this handler must not capture the same request twice)
+            # before releasing their blocks: prompt + generated-so-far
+            # is all a failover replay needs, the KV is reconstructible
+            # from tokens
+            states = []
+            with self._failover_lock:
+                for s in (self.scheduler.running
+                          + self.scheduler.prefilling):
+                    req = s.request
+                    if req is None or req._event.is_set():
+                        continue
+                    states.append((req, list(s.tokens), s.prompt_len))
+                    s.request = None
+                    s.done = True
+            # the dead replica's blocks go back to the pool NOW (leak
+            # audit: in-use returns to zero once the batch closes out);
+            # reusable=False — a dying loop cannot certify its KV
             for seq in (self.scheduler.running
                         + self.scheduler.prefilling):
-                if seq.request is not None and seq.request.error is None:
-                    seq.request._finish(error=err)
+                try:
+                    self.engine.release(seq, reusable=False)
+                except Exception:
+                    pass
+            self.scheduler.running = []
+            self.scheduler.prefilling = []
+            self._release_chaos_blocks()
             with self.scheduler._lock:
                 queued = list(self.scheduler._queue)
                 self.scheduler._queue.clear()
-            for req in queued:
-                req._finish(error=err)
-            self._closed = True
+            rescued = False
+            if self.on_death is not None:
+                try:
+                    self.on_death(self, queued, states)
+                    rescued = True
+                except Exception:   # rescue failed: fall back to failing
+                    pass
+            if not rescued:
+                for req, tokens, _plen in states:
+                    req._finish(error=err)
+                    self.metrics.request_finished(req)
+                for req in queued:
+                    req._finish(error=err)
+                    # close the ledger: submitted == completed + failed
+                    # must survive a crash, or the snapshot reports
+                    # phantom in-flight load forever
+                    self.metrics.request_finished(req)
             raise
 
     def _loop_inner(self):
         eng, sched, met = self.engine, self.scheduler, self.metrics
+        rid = self.replica_id if self.replica_id is not None else 0
+        it = 0
         while not self._closed:
+            it += 1
             self._last_beat = time.perf_counter()
+            # chaos seams (no-ops unless armed; utils/chaos.py): a kill
+            # raises HERE — outside the engine-fault isolation — so the
+            # loop dies like a real bug; a wedge sleeps so the beat goes
+            # stale; exhaustion steals the free list for a few rounds
+            chaos.maybe_kill_serving_loop(rid, it)
+            chaos.maybe_wedge_serving_loop(rid, it)
+            self._chaos_pool_pressure(rid, it)
             admitted, expired = sched.admit(eng)
             for req in expired:
+                if isinstance(req.error, DeadlineExceeded):
+                    met.request_deadline_shed()
+                elif isinstance(req.error, BrownoutShed):
+                    met.request_brownout_shed()
                 met.request_expired(req)
                 met.request_finished(req)
             if eng.paged:
@@ -393,22 +591,22 @@ class LMServer(_HTTPFrontend):
             if sched.running:
                 t0 = time.perf_counter()
                 try:
+                    if chaos.decode_poison(rid, it):
+                        raise MXNetError("chaos: decode step poisoned")
                     advanced = eng.decode_step(sched.running)
                 except Exception as e:
-                    # a decode fault poisons the whole active batch (we
-                    # cannot tell whose tokens are trustworthy): fail the
-                    # affected requests, recycle their blocks, keep serving
+                    # a decode fault poisons the STEP, not the history:
+                    # every token already appended came from a step that
+                    # completed. Re-home the batch onto this server's own
+                    # queue as failover replays (prompt + generated so
+                    # far re-prefills, decode continues token-identically)
+                    # instead of failing user-visible work; a request
+                    # that keeps hitting faults exhausts max_failovers
+                    # and surfaces the error
                     met.engine_failure()
                     err = MXNetError("engine decode failed: %s: %s"
                                      % (type(e).__name__, e))
-                    for seq in sched.running:
-                        try:
-                            eng.release(seq, reusable=False)
-                        except Exception:
-                            pass
-                        if seq.request is not None:
-                            seq.request._finish(error=err)
-                            met.request_finished(seq.request)
+                    self._resume_locally(sched.running, err)
                     sched.running = []
                     continue
                 self._last_step_t = time.perf_counter()
@@ -502,6 +700,16 @@ class LMServer(_HTTPFrontend):
         budget = sched.token_budget
         spent = len(sched.running)
         for seq in list(sched.prefilling):
+            if seq.done:
+                # detached by a router failover while this loop was
+                # wedged: the request lives elsewhere now — release the
+                # blocks (mid-prefill KV may be partial) and move on
+                sched.prefilling.remove(seq)
+                try:
+                    eng.release(seq, reusable=False)
+                except Exception:
+                    pass
+                continue
             cost = eng.prefill_tokens_per_step(seq.prompt_len)
             if budget is not None and spent + cost > budget \
                     and spent > 0:
@@ -531,6 +739,69 @@ class LMServer(_HTTPFrontend):
                     met.request_prefilled(seq.request, seq.prefill_s)
             met.prefill_chunk(len(sched.prefilling))
 
+    # -- failover ------------------------------------------------------------
+
+    def _resume_locally(self, seqs, err):
+        """Decode-fault recovery: release every poisoned sequence's
+        blocks and re-queue each request on THIS server as a failover
+        replay (prompt + tokens generated so far; the generated history
+        predates the faulted step, so it is trustworthy and the greedy
+        continuation is token-identical). A request that has exhausted
+        its failover budget surfaces the engine error instead."""
+        for seq in list(seqs):
+            req = seq.request
+            tokens = list(seq.tokens)
+            try:
+                self.engine.release(seq, reusable=False)
+            except Exception:
+                pass
+            if req is None or req._event.is_set():
+                continue
+            if req.failovers >= self.max_failovers:
+                req._finish(error=err)
+                self.metrics.request_finished(req)
+                continue
+            try:
+                resume, carried = spawn_resume(req, tokens, self)
+            except QueueFull:
+                req._finish(error=err)
+                self.metrics.request_finished(req)
+                continue
+            if resume is None:      # generation was already complete
+                self.metrics.request_finished(req)
+            else:
+                self.metrics.request_failover(carried)
+
+    # -- chaos seams ---------------------------------------------------------
+
+    def _chaos_pool_pressure(self, rid, it):
+        """Armed serve_exhaust: steal the whole free list for a few loop
+        iterations (admission sees transient exhaustion and queues), then
+        hand the blocks back."""
+        if self._chaos_stolen is not None:
+            ids, release_at = self._chaos_stolen
+            if it >= release_at:
+                if ids:
+                    self.engine.cache.pool.free(ids)
+                self._chaos_stolen = None
+            return
+        hold = chaos.pool_exhaustion(rid, it)
+        if hold and self.engine.cache is not None:
+            pool = self.engine.cache.pool
+            ids = pool.try_alloc(pool.available) or []
+            self._chaos_stolen = (ids, it + hold)
+
+    def _release_chaos_blocks(self):
+        if self._chaos_stolen is None:
+            return
+        ids, _ = self._chaos_stolen
+        self._chaos_stolen = None
+        try:
+            if ids:
+                self.engine.cache.pool.free(ids)
+        except Exception:
+            pass
+
     # -- router hooks --------------------------------------------------------
 
     def _final_reject(self):
@@ -539,20 +810,13 @@ class LMServer(_HTTPFrontend):
     def load_tokens(self):
         """Routing score for the front door: tokens this replica is
         still committed to — queued requests' prompt+generation budgets
-        plus every in-flight sequence's remaining tokens. Advisory (the
+        plus every in-flight sequence's remaining tokens. One backlog
+        walk (`_load_split`) feeds both this score and the deadline
+        gate, so the two can never silently diverge. Advisory (the
         serving thread mutates the running set concurrently); list
         copies keep the reads safe."""
-        sched = self.scheduler
-        with sched._lock:
-            queued = sum(len(r.prompt) + r.max_new_tokens
-                         for r in sched._queue)
-        running = sum(max(1, s.max_total - len(s.tokens))
-                      for s in list(sched.running))
-        prefilling = sum(
-            max(1, (s.prompt_len - s.prefilled)
-                + (s.max_total - s.prompt_len))
-            for s in list(sched.prefilling))
-        return queued + running + prefilling
+        pre, dec = self._load_split()
+        return pre + dec
 
     def drain_queue(self):
         """Pull every queued (not yet admitted) request off this
@@ -571,6 +835,35 @@ class LMServer(_HTTPFrontend):
         self.scheduler.submit(req)
         self._work.set()
         return req
+
+
+def spawn_resume(orig, tokens, target):
+    """Place one failover replay for `orig` onto `target` (an LMServer):
+    the resume request's prompt is `tokens` — the original prompt plus
+    everything generated before the fault — replayed as a prefill
+    (hitting the target's prefix cache when the prefix is resident),
+    after which decode continues. The stitch callback completes `orig`
+    from the resume's result, so the client's future resolves with ONE
+    seamless token stream, greedy-token-identical to an undisturbed run.
+
+    Returns `(resume, carried)`; `resume` is None when the generation
+    was already complete (orig finished directly, nothing placed).
+    Raises QueueFull when the target can't absorb it. Ledger/metric
+    accounting stays with the caller."""
+    resume, carried = make_resume(orig, tokens, target.engine.max_len)
+    if resume is None:
+        orig._finish(tokens=list(tokens))
+        return None, carried
+
+    def stitch(r):
+        if r.error is None:
+            orig._finish(tokens=list(r.tokens))
+        else:
+            orig._finish(error=r.error)
+
+    resume._on_finish = stitch
+    target.adopt(resume)
+    return resume, carried
 
 
 def serve(model, replicas=None, **kwargs):

@@ -28,24 +28,90 @@ changes:
                                    cleanup (the multi-host chaos drill
                                    kills one host of a pod this way).
 
+SERVING faults (ISSUE 11; tools/chaos_serve.py drives them through a
+multi-replica fleet) target one replica's serving loop and are keyed
+`<replica>:<iteration>` — the loop iteration counter of THAT LMServer
+instance, so a respawned replica re-counts from zero. A fault fires at
+the FIRST opportunity with iteration >= the armed one (some hook sites
+only run under load), then latches (serve_crash_loop excepted):
+
+  MXNET_CHAOS_SERVE_KILL=<r>:<i>        raise inside replica r's serving
+                                        loop at iteration i (outside the
+                                        engine-fault isolation): the loop
+                                        DIES — the thread-death fault the
+                                        router's respawn path exists for.
+  MXNET_CHAOS_SERVE_CRASH_LOOP=<r>:<i>  same, but NOT latched: every
+                                        (re)spawned instance of replica r
+                                        dies again at its iteration i —
+                                        the crash loop that must open the
+                                        respawn circuit breaker.
+  MXNET_CHAOS_SERVE_WEDGE=<r>:<i>[:<s>] sleep s seconds (default 2.0)
+                                        inside the loop: a stale beat
+                                        with the thread alive — the
+                                        drain-then-restore shape.
+  MXNET_CHAOS_SERVE_POISON=<r>:<i>      poison one decode step (raises
+                                        inside the engine-fault try):
+                                        the batch's requests must be
+                                        resumed, the loop must survive.
+  MXNET_CHAOS_SERVE_EXHAUST=<r>:<i>[:<n>] steal every free block of the
+                                        replica's pool for n loop
+                                        iterations (default 20):
+                                        transient exhaustion, requests
+                                        queue instead of failing.
+
 Steps are 1-based and compare against the trainer's post-increment step
 counter (`TrainStep._t`), i.e. the value `ResilientLoop` reports. Each
-fault fires at most once per process (`_fired` latch) so a relaunched
-worker with a stale environment does not re-kill itself — relaunch
-scripts should still scrub `MXNET_CHAOS_*` when they can.
+fault fires at most once per process (`_fired` latch, serve_crash_loop
+excepted) so a relaunched worker with a stale environment does not
+re-kill itself — relaunch scripts should still scrub `MXNET_CHAOS_*`
+when they can.
 """
 from __future__ import annotations
 
 import os
 import signal
+import time
 
 
 _FAULTS = ("kill_save", "corrupt_ckpt", "nan_step", "sigterm_at",
            "sigkill_at")
 
+#: serving faults: value is (replica, iteration[, extra]) — parsed from
+#: "r:i[:x]" env strings or passed as tuples to configure()
+_SERVE_FAULTS = ("serve_kill", "serve_crash_loop", "serve_wedge",
+                 "serve_poison", "serve_exhaust")
+
+
+class ChaosReplicaKilled(RuntimeError):
+    """The injected serving-loop death (serve_kill / serve_crash_loop):
+    raised from inside the loop, OUTSIDE the engine-fault isolation, so
+    the loop's catch-all sees a dying thread exactly like a real bug."""
+
 _conf = {}          # fault name -> step (int)
 _fired = set()      # fault names that already triggered in this process
 _env_loaded = False
+
+
+def _parse_serve(name, val):
+    """(replica, iteration[, extra]) out of an "r:i[:x]" string or a
+    tuple/list; extra stays a float (wedge seconds / exhaust hold)."""
+    if isinstance(val, (tuple, list)):
+        parts = list(val)
+    else:
+        parts = str(val).split(":")
+    if len(parts) not in (2, 3):
+        raise ValueError(
+            "%s must be <replica>:<iteration>[:<extra>], got %r"
+            % (name, val))
+    try:
+        out = [int(parts[0]), int(parts[1])]
+        if len(parts) == 3:
+            out.append(float(parts[2]))
+    except (TypeError, ValueError):
+        raise ValueError(
+            "%s must be <replica>:<iteration>[:<extra>], got %r"
+            % (name, val))
+    return tuple(out)
 
 
 def _load_env():
@@ -61,19 +127,27 @@ def _load_env():
             except ValueError:
                 raise ValueError("MXNET_CHAOS_%s must be an integer step, "
                                  "got %r" % (name.upper(), val))
+    for name in _SERVE_FAULTS:
+        val = os.environ.get("MXNET_CHAOS_" + name.upper())
+        if val:
+            _conf.setdefault(name, _parse_serve(
+                "MXNET_CHAOS_" + name.upper(), val))
 
 
 def configure(**faults):
-    """Arm faults programmatically: configure(nan_step=7, sigterm_at=12).
+    """Arm faults programmatically: configure(nan_step=7, sigterm_at=12)
+    or, for serving faults, configure(serve_kill=(replica, iteration)).
     A value of None disarms. Returns the active config."""
     _load_env()
     for name, step in faults.items():
-        if name not in _FAULTS:
+        if name not in _FAULTS and name not in _SERVE_FAULTS:
             raise ValueError("unknown chaos fault %r (know %s)"
-                             % (name, ", ".join(_FAULTS)))
+                             % (name, ", ".join(_FAULTS + _SERVE_FAULTS)))
         if step is None:
             _conf.pop(name, None)
             _fired.discard(name)
+        elif name in _SERVE_FAULTS:
+            _conf[name] = _parse_serve(name, step)
         else:
             _conf[name] = int(step)
     return dict(_conf)
@@ -137,6 +211,78 @@ def maybe_sigterm(step):
         os.kill(os.getpid(), signal.SIGTERM)
         return True
     return False
+
+
+def _should_serve(name, replica, iteration, latch=True):
+    """Match one serving fault against (replica, loop iteration); fires
+    at the FIRST opportunity with iteration >= the armed one (some hook
+    sites only run under load — e.g. decode poison — so an exact-match
+    iteration could slip past unconsumed). Latched like `_should` unless
+    `latch=False` — the crash-loop fault re-fires for every respawned
+    instance. Every firing lands in the flight recorder: the chaos
+    drill's postmortem gate asserts each injected fault is on the
+    merged timeline."""
+    _load_env()
+    cfg = _conf.get(name)
+    if cfg is None or (latch and name in _fired):
+        return None
+    if int(replica) != cfg[0] or int(iteration) < cfg[1]:
+        return None
+    if latch:
+        _fired.add(name)
+    from .. import telemetry
+    telemetry.flight().record("fault", "chaos." + name,
+                              replica=int(replica), step=int(iteration))
+    return cfg
+
+
+def fired():
+    """Fault names that have triggered in this process (drill/test
+    observability; crash-loop firings are unlatched and not listed)."""
+    return set(_fired)
+
+
+def maybe_kill_serving_loop(replica, iteration):
+    """LMServer's loop calls this every iteration, OUTSIDE the engine
+    fault isolation: an armed serve_kill (one-shot) or serve_crash_loop
+    (every instance of the replica, since a respawned LMServer restarts
+    its iteration counter) raises — the loop dies like a real bug."""
+    if _should_serve("serve_kill", replica, iteration):
+        raise ChaosReplicaKilled(
+            "chaos: serving loop of replica %r killed at iteration %d"
+            % (replica, iteration))
+    if _should_serve("serve_crash_loop", replica, iteration, latch=False):
+        raise ChaosReplicaKilled(
+            "chaos: replica %r crash-looping (dies every iteration %d)"
+            % (replica, iteration))
+
+
+def maybe_wedge_serving_loop(replica, iteration):
+    """Armed serve_wedge: sleep inside the loop so the beat goes stale
+    with the thread alive — the transient-stall shape the router must
+    drain around and then RESTORE."""
+    cfg = _should_serve("serve_wedge", replica, iteration)
+    if cfg:
+        time.sleep(cfg[2] if len(cfg) > 2 else 2.0)
+        return True
+    return False
+
+
+def decode_poison(replica, iteration):
+    """Armed serve_poison: the loop raises inside its decode try block,
+    exercising the batch-fault path (requests resumed, loop alive)."""
+    return _should_serve("serve_poison", replica, iteration) is not None
+
+
+def pool_exhaustion(replica, iteration):
+    """Armed serve_exhaust: returns how many loop iterations the loop
+    should hold the replica's entire free list hostage (0 = disarmed) —
+    transient pool exhaustion, which must queue requests, not fail
+    them."""
+    cfg = _should_serve("serve_exhaust", replica, iteration)
+    if cfg is None:
+        return 0
+    return int(cfg[2]) if len(cfg) > 2 else 20
 
 
 def maybe_sigkill(step):

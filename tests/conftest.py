@@ -103,3 +103,27 @@ def _seed_all():
     np.random.seed(0)
     mx.random.seed(0)
     yield
+
+
+@pytest.fixture(autouse=True)
+def _serving_pool_audit():
+    """Shared block-pool leak audit (ISSUE 11): every serving Engine a
+    test creates must end the test quiescent — allocated blocks are
+    exactly the prefix-cache residents, each pinned only by the cache.
+    `Engine.close()` runs the same audit on clean server shutdown and
+    removes the engine from the live set; engines torn down on a crash
+    path are excluded the same way. Anything still live here leaked."""
+    import sys
+    eng_mod = sys.modules.get("mxnet_tpu.serving.engine")
+    # STRONG refs: holding the pre-test engines alive for the test's
+    # duration means a new engine can never reuse a dead one's id and
+    # slip past the audit by identity-collision
+    before = list(eng_mod._LIVE) if eng_mod is not None else []
+    yield
+    eng_mod = sys.modules.get("mxnet_tpu.serving.engine")
+    if eng_mod is None:
+        return
+    for eng in list(eng_mod._LIVE):
+        if any(eng is b for b in before):
+            continue
+        eng.audit_quiescent()

@@ -68,11 +68,16 @@ def test_example_runs(script, args):
 
 
 # CLI tools that are themselves end-to-end drills (CPU backend). The
-# chaos drill trains LeNet through SIGTERM preemption, a mid-save kill,
-# and an injected-NaN rollback, asserting the final state is
-# bit-identical to an undisturbed run.
+# training chaos drill trains LeNet through SIGTERM preemption, a
+# mid-save kill, and an injected-NaN rollback, asserting the final
+# state is bit-identical to an undisturbed run. The serving chaos
+# drill (ISSUE 11) drives a 3-replica fleet through a fault storm —
+# wedge, thread kill, decode poison, pool exhaustion, crash loop —
+# asserting >=99% availability, greedy-token-identical failover, zero
+# leaked blocks, and every fault on the postmortem timeline.
 _TOOL_CASES = [
     ("chaos_train.py", []),
+    ("chaos_serve.py", []),
 ]
 
 

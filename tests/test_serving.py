@@ -410,8 +410,19 @@ def test_engine_prefill_exception_fails_request_not_loop(tiny_lm):
         srv.close()
 
 
-def test_engine_decode_exception_fails_batch_not_loop(tiny_lm):
+def test_engine_decode_exception_resumes_batch_not_loop(tiny_lm):
+    """ISSUE 11: a decode fault poisons the STEP, not the history — the
+    batch's requests are re-queued as failover replays (prompt +
+    generated-so-far re-prefills, decode continues) and complete
+    token-identically to an undisturbed run; the loop survives and the
+    faulted sequences' blocks are recycled."""
     params, cfg = tiny_lm
+    oracle = serving.serve((params, cfg), max_batch=2, block_size=8)
+    try:
+        want = oracle.generate(arith_prompt(4, 1, 5), max_new_tokens=4,
+                               timeout=120)
+    finally:
+        oracle.close()
     srv = serving.serve((params, cfg), max_batch=2, block_size=8)
     try:
         real_decode = srv.engine.decode_step
@@ -424,8 +435,11 @@ def test_engine_decode_exception_fails_batch_not_loop(tiny_lm):
 
         srv.engine.decode_step = flaky_decode
         req = srv.submit(arith_prompt(4, 1, 5), max_new_tokens=4)
-        with pytest.raises(mx.MXNetError, match="decode failed"):
-            req.result(timeout=60)
+        assert req.result(timeout=120) == want
+        snap = srv.snapshot()
+        assert snap["requests"]["engine_failures"] == 1
+        assert snap["requests"]["failovers"] == 1
+        assert snap["requests"]["failed"] == 0
         # blocks recycled, loop alive: a fresh request decodes fine and
         # /healthz stays green
         out = srv.generate(arith_prompt(5, 1, 5), max_new_tokens=4,
@@ -435,6 +449,29 @@ def test_engine_decode_exception_fails_batch_not_loop(tiny_lm):
         assert h["ok"] is True and h["engine_failures"] == 1
         pool = srv.engine.cache.pool
         assert pool.in_use == 0  # everything released despite the fault
+    finally:
+        srv.close()
+
+
+def test_engine_decode_fault_budget_exhausted_surfaces_error(tiny_lm):
+    """A PERSISTENT decode fault must not bounce a request between
+    resume hops forever: after max_failovers replays the engine error
+    surfaces to the client."""
+    params, cfg = tiny_lm
+    srv = serving.serve((params, cfg), max_batch=2, block_size=8)
+    try:
+        def dead_decode(seqs):
+            raise RuntimeError("persistent decode fault")
+
+        srv.engine.decode_step = dead_decode
+        req = srv.submit(arith_prompt(4, 1, 5), max_new_tokens=4)
+        with pytest.raises(mx.MXNetError, match="decode failed"):
+            req.result(timeout=120)
+        snap = srv.snapshot()
+        assert snap["requests"]["engine_failures"] >= 3
+        assert snap["requests"]["failed"] == 1
+        assert srv.engine.cache.pool.in_use == 0
+        assert srv.health()["ok"] is True
     finally:
         srv.close()
 

@@ -67,6 +67,13 @@ _DISCRIMINATORS = ("batch", "seq_len", "layout", "remat",
 #: larger-is-better, unknown units default to larger-is-better
 _SMALLER_IS_BETTER = ("ms", "s", "us", "seconds")
 
+#: metric prefixes judged WARN-ONLY (ISSUE 11): the serving-chaos drill
+#: numbers (availability %, failover added latency, respawn-to-first-
+#: token) are resilience health signals riding a fault-injection
+#: harness — their run-to-run wobble must be reported, but only real
+#: performance measurements decide the exit code
+_WARN_ONLY_PREFIXES = ("serving_chaos_", "smoke_serving_chaos_")
+
 
 def _device_class(line):
     """'TPU v5 lite', 'tpu', 'v5e' … -> 'tpu'; everything else keeps its
@@ -251,7 +258,9 @@ def _judge_secondary(verdict, fresh, ref):
     for field, band, bad in (("compile_s", 0.50, 1),
                              ("exec_hbm_bytes", 0.15, 1),
                              ("prefix_hit_rate", 0.15, -1),
-                             ("prefix_hit_tokens", 0.25, -1)):
+                             ("prefix_hit_tokens", 0.25, -1),
+                             ("failover_added_latency_p95_ms", 0.50, 1),
+                             ("respawn_to_first_token_ms", 0.50, 1)):
         fv, rv = fresh.get(field), ref.get(field)
         if not isinstance(fv, (int, float)) or not isinstance(
                 rv, (int, float)) or rv <= 0:
@@ -320,6 +329,12 @@ def judge(fresh_lines, trajectory, baselines, min_band):
             v["verdict"] = "regressed"
         else:
             v["verdict"] = "within-noise"
+        if metric.startswith(_WARN_ONLY_PREFIXES):
+            v["warn_only"] = True
+            if v["verdict"] == "regressed":
+                v.setdefault("warnings", []).append(
+                    "%s regressed but is a warn-only chaos-drill "
+                    "metric; not failing the session" % metric)
         _judge_secondary(v, line, ref)
         verdicts.append(v)
     return verdicts
@@ -329,15 +344,19 @@ def summarize(verdicts, fail_on_outage):
     counts = {}
     for v in verdicts:
         counts[v["verdict"]] = counts.get(v["verdict"], 0) + 1
+    # warn-only metrics (chaos-drill health signals) never decide the
+    # exit code — their regressions ride along as warnings
+    hard_regressed = [v for v in verdicts
+                      if v["verdict"] in ("regressed", "config-error")
+                      and not v.get("warn_only")]
     exit_code = 0
-    if counts.get("regressed") or counts.get("config-error"):
+    if hard_regressed:
         exit_code = 1
     elif fail_on_outage and counts.get("outage"):
         exit_code = 2
     return {"sentinel_summary": {
         "counts": counts, "judged": len(verdicts), "exit_code": exit_code,
-        "regressed": [v["metric"] for v in verdicts
-                      if v["verdict"] in ("regressed", "config-error")],
+        "regressed": [v["metric"] for v in hard_regressed],
     }}, exit_code
 
 

@@ -73,6 +73,24 @@ def main():
                     help="default priority for requests that don't "
                          "carry a 'priority' field (higher admits "
                          "first; default 0)")
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="default per-request deadline: shed at "
+                         "admission when the observed service rate "
+                         "can't meet it (503 + computed Retry-After), "
+                         "drop unstarted work past it (504) (default: "
+                         "MXNET_SERVING_DEADLINE_MS or none; requests "
+                         "may override via a 'deadline_ms' field)")
+    ap.add_argument("--brownout", action="store_true", default=None,
+                    help="graceful degradation under sustained "
+                         "saturation: shed the lowest priority class "
+                         "first, then clamp max_new_tokens of newly "
+                         "admitted work (default: the "
+                         "MXNET_SERVING_BROWNOUT env var)")
+    ap.add_argument("--respawn-max", type=int, default=None,
+                    help="with --replicas: how many times a dead "
+                         "replica is rebuilt before its crash-loop "
+                         "circuit opens (default: "
+                         "MXNET_REPLICA_RESPAWN_MAX or 3)")
     args = ap.parse_args()
 
     from mxnet_tpu import serving
@@ -96,18 +114,28 @@ def main():
     # construction, and frozen: the Engine raises on post-start
     # mutation, so a replica can never straddle two configs — restart
     # the process to change placement
-    srv = serving.serve(model, max_batch=args.max_batch,
-                        max_queue=args.max_queue,
-                        block_size=args.block_size,
-                        queue_timeout=args.queue_timeout,
-                        paged=args.paged,
-                        prefill_chunk=args.prefill_chunk,
-                        token_budget=args.token_budget,
-                        tp=args.tp,
-                        replicas=args.replicas,
-                        prefix_cache=args.prefix_cache,
-                        tenant_budget=args.tenant_budget,
-                        default_priority=args.priority)
+    kwargs = dict(max_batch=args.max_batch,
+                  max_queue=args.max_queue,
+                  block_size=args.block_size,
+                  queue_timeout=args.queue_timeout,
+                  paged=args.paged,
+                  prefill_chunk=args.prefill_chunk,
+                  token_budget=args.token_budget,
+                  tp=args.tp,
+                  replicas=args.replicas,
+                  prefix_cache=args.prefix_cache,
+                  tenant_budget=args.tenant_budget,
+                  default_priority=args.priority,
+                  default_deadline_ms=args.deadline_ms,
+                  brownout=args.brownout)
+    if args.respawn_max is not None:
+        n = (args.replicas if args.replicas is not None
+             else serving.serving_replicas())
+        if n <= 1:
+            ap.error("--respawn-max needs a multi-replica front door "
+                     "(--replicas > 1 or MXNET_SERVING_REPLICAS > 1)")
+        kwargs["respawn_max"] = args.respawn_max
+    srv = serving.serve(model, **kwargs)
     if isinstance(srv, serving.ReplicatedLMServer):
         eng = srv.replicas[0].engine
         print("front door: %d replicas, tp=%d per replica%s"
@@ -135,6 +163,12 @@ def main():
           "(per-request 'tenant'/'priority' JSON fields accepted)"
           % (first.scheduler.tenant_budget or "unbounded",
              args.priority))
+    print("survival: deadline=%s brownout=%s%s"
+          % ("%.0fms" % first.default_deadline_ms
+             if first.default_deadline_ms else "none",
+             "on" if first.scheduler.brownout else "off",
+             (" respawn_max=%d" % srv.respawn_max)
+             if isinstance(srv, serving.ReplicatedLMServer) else ""))
     print("listening on http://%s:%d  (POST /v1/generate, GET /v1/metrics)"
           % (args.host, args.port))
     srv.serve_http(host=args.host, port=args.port, block=True)
