@@ -218,6 +218,36 @@ def check_line(r):
             and r.get("mfu") is not None:
         raise ValueError("mfu derived from an undisclosed flop count: "
                          "%r" % (r,))
+    # SLO/goodput fields (ISSUE 13): attainment is a fraction of
+    # MEASURED requests against a DISCLOSED threshold, and goodput can
+    # never exceed the measured throughput it is a subset of.
+    att = r.get("slo_ttft_attainment")
+    if att is not None:
+        if r.get("value") is None:
+            raise ValueError("slo_ttft_attainment without a measured "
+                             "value: %r" % (r,))
+        if not isinstance(att, (int, float)) or isinstance(att, bool) \
+                or not 0.0 <= att <= 1.0:
+            raise ValueError("slo_ttft_attainment must be a fraction "
+                             "in [0, 1]: %r" % (r,))
+        if r.get("slo_ttft_ms") is None:
+            raise ValueError("slo_ttft_attainment without the "
+                             "slo_ttft_ms threshold it was judged "
+                             "against: %r" % (r,))
+    gp = r.get("goodput_tok_per_sec")
+    if gp is not None:
+        if r.get("value") is None or att is None:
+            raise ValueError("goodput_tok_per_sec needs a measured "
+                             "value and its attainment fraction: %r"
+                             % (r,))
+        if not isinstance(gp, (int, float)) or isinstance(gp, bool) \
+                or gp < 0:
+            raise ValueError("goodput_tok_per_sec must be a "
+                             "non-negative rate: %r" % (r,))
+        if gp > 1.001 * r["value"] + 1e-9:
+            raise ValueError("goodput %.3f exceeds the measured "
+                             "throughput %.3f it is a subset of: %r"
+                             % (gp, r["value"], r))
     # compile-watchdog fields (ISSUE 9): compile_s is the summed wall time
     # of the watchdog-observed compilations this config triggered,
     # exec_hbm_bytes the peak compiled-executable footprint among them.
@@ -1027,9 +1057,21 @@ def bench_serving(smoke, dtype, device_kind, batch=None, tp=None,
     dt = time.perf_counter() - t0
     for s in seqs:
         eng.release(s)
+    # SLO view of the same measurements (ISSUE 13): fraction of
+    # requests whose TTFT met the disclosed threshold, and the tokens
+    # those requests delivered per second (every sequence decodes the
+    # same `steps` tokens here, so goodput is exactly attainment-scaled
+    # throughput). BENCH_SLO_TTFT_MS overrides the threshold.
+    slo_ttft_ms = float(os.environ.get("BENCH_SLO_TTFT_MS", "250"))
+    n_meet = sum(1 for t in ttft_s if 1e3 * t <= slo_ttft_ms)
+    attainment = n_meet / float(len(ttft_s))
+    value = round(batch * steps / dt, 1)
     return {"metric": ("smoke_serving_decode_tok_per_sec" if smoke
                        else "serving_decode_tok_per_sec"),
-            "value": round(batch * steps / dt, 1), "unit": "tok/s",
+            "value": value, "unit": "tok/s",
+            "slo_ttft_ms": slo_ttft_ms,
+            "slo_ttft_attainment": round(attainment, 4),
+            "goodput_tok_per_sec": round(n_meet * steps / dt, 1),
             "batch": batch, "prompt_len": prompt_len,
             "seq_len": cfg.max_len,
             "decode_ms_per_step": round(1e3 * dt / steps, 3),
@@ -1116,6 +1158,15 @@ def _bench_serving_frontdoor(smoke, dtype, tp, replicas, batch=None):
             by_rep[getattr(r, "replica", None) or 0].append(
                 1e3 * (r.t_first_token - r.t_submit))
 
+        # SLO view (ISSUE 13): per-request TTFT against the disclosed
+        # threshold; goodput counts only the tokens of meeting requests
+        slo_ttft_ms = float(os.environ.get("BENCH_SLO_TTFT_MS", "250"))
+        meeting = [r for r in timed
+                   if 1e3 * (r.t_first_token - r.t_submit)
+                   <= slo_ttft_ms]
+        goodput_tokens = sum(len(r.tokens) - len(r.prompt)
+                             for r in meeting)
+
         def ttft_ms(i, q):
             return (round(float(np.percentile(by_rep[i], q)), 3)
                     if by_rep[i] else None)
@@ -1127,6 +1178,12 @@ def _bench_serving_frontdoor(smoke, dtype, tp, replicas, batch=None):
                 "replicas": replicas, "batch": batch,
                 "requests_timed": len(timed), "gen_tokens": gen,
                 "requests_per_replica": [len(b) for b in by_rep],
+                "slo_ttft_ms": slo_ttft_ms,
+                "slo_ttft_attainment": (round(
+                    len(meeting) / float(len(timed)), 4)
+                    if timed else None),
+                "goodput_tok_per_sec": (round(goodput_tokens / dt, 1)
+                                        if timed else None),
                 "paged_attention": "on" if eng0.paged else "off",
                 "ttft_ms_p50_per_replica": [ttft_ms(i, 50)
                                             for i in range(len(reps))],

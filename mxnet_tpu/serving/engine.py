@@ -772,7 +772,7 @@ class Engine:
         prefilled and the first token has been sampled."""
         L = seq.prompt_len
         prompt = seq.tokens[:L]
-        rid = seq.request.id if seq.request is not None else None
+        rid = seq.request.trace if seq.request is not None else None
         with telemetry.span("serving.prefill", trace=rid,
                             category="serving", prompt_len=L,
                             chunk_start=seq.prefilled):
@@ -913,7 +913,7 @@ class Engine:
             self._append(s, int(nxt[i]))
             if s.request is not None:
                 telemetry.record_span("serving.decode", t0_us, dur_us,
-                                      trace=s.request.id,
+                                      trace=s.request.trace,
                                       category="serving",
                                       to_profiler=False, to_flight=False,
                                       position=len(s.tokens) - 1)

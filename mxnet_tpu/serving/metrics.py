@@ -26,12 +26,25 @@ import time
 
 from .. import profiler
 from .. import telemetry
+from ..telemetry import slo as _slo
 
 _DOMAIN = profiler.Domain("serving")
 
 #: decode/prefill batch-size buckets (powers of two up to a big pod batch)
 _BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
 _OCCUPANCY_BUCKETS = (0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
+
+#: per-tenant instrument-name templates (ISSUE 13; docs/OBSERVABILITY.md
+#: names these with a `<tenant>` placeholder). Token counters share the
+#: terminal-classification ledger documented in telemetry/slo.py:
+#: submitted == goodput + slow + shed + expired + failed, always.
+_TENANT_TOKEN_KINDS = ("submitted", "goodput", "slow", "shed",
+                       "expired", "failed", "replayed")
+_T_TOKENS = "serving_tenant_%s_%s_tokens_total"
+_T_TTFT = "serving_tenant_%s_ttft_seconds"
+_T_ITL = "serving_tenant_%s_itl_seconds"
+_T_REQ_DONE = "serving_tenant_%s_requests_completed_total"
+_T_REQ_FAIL = "serving_tenant_%s_requests_failed_total"
 
 
 class ServingMetrics:
@@ -47,8 +60,46 @@ class ServingMetrics:
                 else None
             registry = telemetry.MetricsRegistry(labels=labels)
         self.registry = registry
+        self.replica = replica
         reg = self.registry
         c, g, h = reg.counter, reg.gauge, reg.histogram
+        # ISSUE 13: the fleet-wide goodput token ledger. Every request
+        # is classified EXACTLY ONCE at its terminal state (see
+        # telemetry/slo.py): submitted == goodput + slow + shed +
+        # expired + failed at every instant; replayed counts failover
+        # salvage separately (extra work, not a terminal class).
+        self._tok = {}
+        for kind, help_ in (
+                ("submitted", "tokens classified at terminal "
+                              "accounting (the sum of goodput + slow + "
+                              "shed + expired + failed)"),
+                ("goodput", "delivered tokens whose request met its "
+                            "SLO (TTFT objective + deadline)"),
+                ("slow", "delivered tokens whose request violated its "
+                         "SLO — served, but late"),
+                ("shed", "tokens of requests shed at admission "
+                         "(unmeetable deadline, brownout)"),
+                ("expired", "tokens of requests that expired in queue "
+                            "(deadline/timeout passed before prefill)"),
+                ("failed", "tokens of requests that failed (engine "
+                           "fault, orphaned by a dead replica)")):
+            self._tok[kind] = c("serving_%s_tokens_total" % kind,
+                                help=help_)
+        self._h_itl = h("serving_itl_seconds",
+                        help="per-request inter-token latency (gap "
+                             "between consecutive emitted tokens, "
+                             "failover stalls included)")
+        # per-tenant ledgers + latency histograms, created lazily on a
+        # tenant's first traffic (name templates above)
+        self._tenants = {}
+        # SLO objectives (MXNET_SLO_*; read at construction) + burn
+        # tracking over this registry's own histograms
+        self.slo = _slo.SLOTracker(reg, self._slo_counts)
+        # fail LOUDLY at construction on a malformed sample knob — the
+        # per-event path downgrades to a warning instead of letting a
+        # config typo kill the serving thread
+        if _slo.request_log().enabled:
+            _slo.request_log().sample_rate()
         self._submitted = c("serving_requests_submitted_total",
                             help="requests accepted by submit()")
         self._rejected = c("serving_requests_rejected_total",
@@ -233,10 +284,136 @@ class ServingMetrics:
     def prefill_chunks(self):
         return int(self._chunks.value)
 
+    # -- per-tenant ledger + SLO sources (ISSUE 13) --------------------------
+
+    #: distinct per-tenant instrument sets one server will create —
+    #: tenant names arrive from CLIENT JSON, and ~11 instruments per
+    #: name must not let a misbehaving client grow the registry (and
+    #: every scrape) without bound; traffic beyond the cap folds into
+    #: one "overflow" ledger, loudly named
+    _TENANT_CAP = 64
+
+    def _tenant(self, name):
+        """This tenant's instrument set, created lazily on first
+        traffic (token counters, TTFT/ITL histograms, request
+        outcomes). All registry-backed, so the Prometheus exposition
+        and /statusz read the same numbers. Keyed by the SANITIZED
+        name — the same identity the metric names carry — so two raw
+        names that sanitize identically share ONE ledger instead of
+        aliasing the same counters under two entries (which the fleet
+        aggregate would then double-count)."""
+        from ..telemetry.metrics import _sane
+        key = _sane(str(name) if name is not None else "default")
+        t = self._tenants.get(key)
+        if t is None:
+            if len(self._tenants) >= self._TENANT_CAP \
+                    and key != "overflow":
+                return self._tenant("overflow")
+            reg = self.registry
+            name = key
+            created = {
+                "tokens": {k: reg.counter(
+                    _T_TOKENS % (key, k),
+                    help="tenant %r %s tokens (see the fleet "
+                         "serving_%s_tokens_total ledger)"
+                    % (name, k, k)) for k in _TENANT_TOKEN_KINDS},
+                "ttft": reg.histogram(
+                    _T_TTFT % key,
+                    help="tenant %r submit -> first token" % name),
+                "itl": reg.histogram(
+                    _T_ITL % key,
+                    help="tenant %r inter-token latency" % name),
+                "completed": reg.counter(
+                    _T_REQ_DONE % key,
+                    help="tenant %r requests finished cleanly" % name),
+                "failed": reg.counter(
+                    _T_REQ_FAIL % key,
+                    help="tenant %r requests finished with an error "
+                         "(sheds and expiries included)" % name),
+            }
+            # insert under the lock: statusz()/_slo_counts iterate a
+            # locked copy of this dict from HTTP threads while request
+            # threads grow it (registry creation above is idempotent,
+            # so a racing double-build resolves to the same metrics)
+            with self._lock:
+                t = self._tenants.setdefault(key, created)
+        return t
+
+    def _tenants_view(self):
+        """A point-in-time copy safe to iterate while request threads
+        add tenants."""
+        with self._lock:
+            return dict(self._tenants)
+
+    def _account_tokens(self, req, kind, n):
+        """Terminal classification: `n` tokens land on `kind` AND on
+        `submitted`, fleet-wide and on the request's tenant — the
+        ledger identity holds by construction."""
+        n = int(n)
+        if n < 0:
+            n = 0
+        t = self._tenant(req.tenant)["tokens"]
+        self._tok[kind].inc(n)
+        self._tok["submitted"].inc(n)
+        t[kind].inc(n)
+        t["submitted"].inc(n)
+
+    def _slo_counts(self, obj):
+        """Lifetime (good, total) for one objective, from this
+        registry's own instruments (the SLOTracker's source)."""
+        t = None
+        if obj.tenant is not None:
+            from ..telemetry.metrics import _sane
+            t = self._tenants_view().get(_sane(obj.tenant))
+        if obj.kind == "availability":
+            if obj.tenant is None:
+                good, bad = self.completed, self.failed
+            else:
+                good = int(t["completed"].value) if t else 0
+                bad = int(t["failed"].value) if t else 0
+            return float(good), float(good + bad)
+        if obj.kind == "ttft":
+            hist = self._h_ttft if obj.tenant is None else \
+                (t["ttft"] if t else None)
+        else:
+            hist = self._h_itl if obj.tenant is None else \
+                (t["itl"] if t else None)
+        if hist is None:
+            return 0.0, 0.0
+        return (float(hist.count_below(obj.threshold_s)),
+                float(hist.count))
+
+    def _met_slo(self, req):
+        """Did this (terminal, clean) request meet its SLO? The goodput
+        classifier: the governing TTFT objective (tenant-scoped wins)
+        plus the request's own absolute deadline. ITL objectives burn
+        budget at the fleet level but don't reclassify single requests
+        (one slow gap in a 500-token stream is not a failed delivery)."""
+        thr = self.slo.ttft_threshold(req.tenant)
+        if thr is not None and req.t_client_first_token is not None \
+                and (req.t_client_first_token
+                     - req.t_client_submit) > thr:
+            return False
+        if req.t_deadline is not None and req.t_done is not None and \
+                req.t_done > req.t_deadline:
+            return False
+        return True
+
+    def log_event(self, event, req, **fields):
+        """Route one lifecycle event to the request log / flight mirror
+        with this server's replica label attached."""
+        _slo.request_event(event, req, replica=self.replica, **fields)
+
     # -- recording -----------------------------------------------------------
 
-    def request_submitted(self):
+    def request_submitted(self, req=None):
         self._submitted.inc()
+        if req is not None:
+            self.log_event("queued", req, prompt_len=len(req.prompt),
+                           max_new_tokens=req.max_new_tokens,
+                           priority=req.priority,
+                           deadline_ms=req.deadline_ms,
+                           failovers=req.failovers or None)
 
     def request_rejected(self):
         self._rejected.inc()
@@ -244,21 +421,49 @@ class ServingMetrics:
     def engine_failure(self):
         self._engine_failures.inc()
 
-    def request_deadline_shed(self):
+    def request_deadline_shed(self, req=None):
+        """Deadline shed. With `req` (the admission-time unmeetable
+        path — the request is refused BEFORE it is ever submitted, so
+        no request_finished() will run for it) this is also its
+        terminal accounting: shed tokens + the lifecycle event. The
+        queue-expiry path passes nothing — its terminal accounting
+        happens in request_finished()."""
         self._deadline_shed.inc()
+        if req is not None:
+            # tokens land on `shed`; the request OUTCOME counters stay
+            # untouched (fleet and tenant alike) — an admission refusal
+            # is backpressure, not an availability failure, and the two
+            # availability views must agree on what counts
+            self._account_tokens(req, "shed", req.max_new_tokens)
+            self.log_event("shed", req, reason="deadline_unmeetable",
+                           max_new_tokens=req.max_new_tokens)
 
     def request_brownout_shed(self):
         self._brownout_shed.inc()
 
-    def request_failover(self, resumed_tokens):
+    def request_failover(self, req, resumed_tokens):
+        """One failover replay placed for `req`'s trace: count it, and
+        credit the salvaged tokens as `replayed` on the tenant ledger
+        (extra work performed — NOT a terminal class; the replay's own
+        finish classifies the delivery)."""
         self._failovers.inc()
         if resumed_tokens:
             self._failover_tokens.inc(resumed_tokens)
+            self._tenant(req.tenant)["tokens"]["replayed"].inc(
+                resumed_tokens)
+        self.log_event("failover", req, resumed_tokens=resumed_tokens,
+                       hop=req.failovers + 1)
 
     def request_expired(self, req):
         """Counts the expiry only; request_finished() (always called
         after) does the failed/total accounting exactly once."""
         self._expired.inc()
+        from .scheduler import BrownoutShed, DeadlineUnmeetable
+        shedlike = isinstance(req.error, (BrownoutShed,
+                                          DeadlineUnmeetable))
+        self.log_event("shed" if shedlike else "expired", req,
+                       reason=type(req.error).__name__
+                       if req.error is not None else "timeout")
 
     def request_prefilled(self, req, prefill_s):
         self._h_queue.observe(req.t_admit - req.t_submit)
@@ -266,7 +471,55 @@ class ServingMetrics:
         with self._lock:
             self._prefill_tokens_obs += len(req.prompt)
         req.t_first_token = time.perf_counter()
-        self._h_ttft.observe(req.t_first_token - req.t_submit)
+        if req.t_last_token is not None:
+            # a failover resume carried the victim's last emit time:
+            # the replay's first fresh token closes the client's real
+            # cross-hop gap — exactly the stall an ITL SLO must see
+            itl = req.t_first_token - req.t_last_token
+            self._h_itl.observe(itl)
+            self._tenant(req.tenant)["itl"].observe(itl)
+        req.t_last_token = req.t_first_token
+        if req.t_client_first_token is None:
+            # the CLIENT's first token, measured from the CLIENT's
+            # submit — for a resume whose victim died mid-prefill this
+            # includes the whole failed first life; a resume whose
+            # client already HAS a first token observes nothing (a
+            # fresh-clock replay TTFT would make the histogram — and
+            # the goodput classifier — optimistic under failover)
+            req.t_client_first_token = req.t_first_token
+            ttft = req.t_client_first_token - req.t_client_submit
+            self._h_ttft.observe(ttft)
+            self._tenant(req.tenant)["ttft"].observe(ttft)
+            self.log_event("first_token", req,
+                           ttft_ms=round(1e3 * ttft, 3),
+                           prefill_ms=round(1e3 * prefill_s, 3))
+
+    def request_admitted(self, req):
+        """Lifecycle only (the counters move at prefill/finish)."""
+        self.log_event("admitted", req,
+                       queue_ms=round(1e3 * (req.t_admit - req.t_submit),
+                                      3) if req.t_admit else None)
+
+    def request_chunk(self, req, prefilled):
+        """One prefill chunk ran for `req` (lifecycle ledger only)."""
+        self.log_event("prefill_chunk", req, prefilled=prefilled)
+
+    def token_generated(self, req, now=None, position=None):
+        """One decode token emitted for `req`: observe the per-request
+        inter-token latency (fleet + tenant) — failover stalls land
+        here too, which is exactly what an ITL SLO must see."""
+        now = time.perf_counter() if now is None else now
+        prev = req.t_last_token
+        req.t_last_token = now
+        if prev is None:
+            return
+        itl = now - prev
+        self._h_itl.observe(itl)
+        self._tenant(req.tenant)["itl"].observe(itl)
+        if _slo.request_log().enabled:
+            self.log_event("decode", req,
+                           itl_ms=round(1e3 * itl, 3),
+                           position=position)
 
     def prefill_chunk(self, queue_depth):
         """One chunked-prefill kernel call ran; `queue_depth` is the
@@ -291,12 +544,47 @@ class ServingMetrics:
         self._counter.increment(active)
 
     def request_finished(self, req):
+        from .scheduler import (BrownoutShed, DeadlineExceeded,
+                                DeadlineUnmeetable, RequestTimeout)
+        tenant = self._tenant(req.tenant)
         if req.error is None:
             self._completed.inc()
+            tenant["completed"].inc()
+            # delivered tokens: this request's own generation plus
+            # whatever a failover replay carried in its prompt (the
+            # client received both as one stream)
+            gen = (len(req.tokens) - len(req.prompt)) if req.tokens \
+                else 0
+            gen += req.resumed_tokens
+            self._account_tokens(
+                req, "goodput" if self._met_slo(req) else "slow", gen)
         else:
             self._failed.inc()
+            tenant["failed"].inc()
+            if isinstance(req.error, (BrownoutShed, DeadlineUnmeetable)):
+                kind = "shed"
+            elif isinstance(req.error, (DeadlineExceeded,
+                                        RequestTimeout)):
+                kind = "expired"
+            else:
+                kind = "failed"
+            # the work the client asked for and never got (a failover
+            # resume's prompt already carries its salvage — count its
+            # remaining ask plus the carried tokens it now can't
+            # deliver either)
+            self._account_tokens(req, kind,
+                                 req.max_new_tokens + req.resumed_tokens)
         if req.t_done is not None:
             self._h_total.observe(req.t_done - req.t_submit)
+        self.log_event(
+            "finish", req,
+            outcome="completed" if req.error is None
+            else type(req.error).__name__,
+            generated=(len(req.tokens) - len(req.prompt))
+            if req.tokens else 0,
+            latency_ms=round(1e3 * (req.t_done - req.t_submit), 3)
+            if req.t_done is not None else None,
+            failovers=req.failovers or None)
 
     def observed_token_rate(self, min_steps=8):
         """Decode tokens per COMPUTE second, from the step-time and
@@ -360,7 +648,56 @@ class ServingMetrics:
         """Prometheus text exposition (format 0.0.4) of the server's
         registry — the `/metrics` body under `Accept: text/plain`."""
         self._refresh_gauges(engine, scheduler)
+        self.slo.update()
         return self.registry.prometheus_text()
+
+    def tokens_ledger(self):
+        """The fleet goodput/shed/expired/failed token ledger as plain
+        ints (reads the registry counters — /statusz can never disagree
+        with /metrics)."""
+        out = {k: int(c.value) for k, c in self._tok.items()}
+        out["replayed"] = self.failover_resumed_tokens
+        out["generated"] = self.tokens_generated
+        return out
+
+    def statusz(self, engine=None, scheduler=None):
+        """The /statusz JSON body (ISSUE 13): request/token ledgers,
+        per-tenant breakdown, and the SLO block (attainment, error
+        budget remaining, multi-window burn). Everything is read from
+        the same registry the Prometheus exposition serves."""
+        self._refresh_gauges(engine, scheduler)
+        elapsed = max(1e-9, time.perf_counter() - self._t0)
+        tenants = {}
+        for name, t in sorted(self._tenants_view().items()):
+            tenants[name] = {
+                "tokens": {k: int(c.value)
+                           for k, c in t["tokens"].items()},
+                "requests": {"completed": int(t["completed"].value),
+                             "failed": int(t["failed"].value)},
+                "ttft_ms_p95": (round(1e3 * t["ttft"].quantile(0.95), 3)
+                                if t["ttft"].count else None),
+                "itl_ms_p99": (round(1e3 * t["itl"].quantile(0.99), 3)
+                               if t["itl"].count else None),
+            }
+        return {
+            "replica": self.replica,
+            "uptime_s": round(elapsed, 3),
+            "requests": {
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "failed": self.failed,
+                "rejected": self.rejected,
+                "expired": self.expired,
+                "deadline_shed": self.deadline_shed,
+                "brownout_shed": self.brownout_shed,
+                "failovers": self.failovers,
+            },
+            "tokens": self.tokens_ledger(),
+            "goodput_tok_per_sec": round(
+                self._tok["goodput"].value / elapsed, 3),
+            "tenants": tenants,
+            "slo": self.slo.payload(),
+        }
 
     def snapshot(self, engine=None, scheduler=None):
         """One dict with everything: the HTTP /metrics body and the test
@@ -422,6 +759,9 @@ class ServingMetrics:
                 "prefill_queue_depth": self._prefill_depth_last,
             },
             "cache": {"block_utilization": self._cache_util_last},
+            # ISSUE 13: the goodput token ledger rides the snapshot too
+            # (fleet_top and the router aggregate read it from here)
+            "tokens": self.tokens_ledger(),
         }
         if engine is not None:
             snap["engine"] = {

@@ -186,6 +186,8 @@ class ReplicatedLMServer(_HTTPFrontend):
         self._ok_since = [None] * replicas
         self._retired_engines = []      # crashed engines, kept for audit
         self._retired_requests = {}     # dead replicas' request ledgers
+        self._retired_tokens = {}       # ... and their token ledgers
+        self._retired_tenants = {}      # {tenant: {kind: tokens}}
         try:
             for i in range(replicas):
                 self.replicas.append(self._build_replica(i))
@@ -263,6 +265,10 @@ class ReplicatedLMServer(_HTTPFrontend):
                         continue
                     self._drained[i] = True
                 self._c_drained.inc(replica=i)
+                telemetry.record_span(
+                    "serving.drain", time.perf_counter_ns() // 1000, 0,
+                    category="serving", to_profiler=False, replica=i,
+                    dead=h["dead"])
                 self._rehome(rep)
             elif self._drained[i] and h["ok"]:
                 with self._lock:
@@ -342,6 +348,20 @@ class ReplicatedLMServer(_HTTPFrontend):
                     self._retired_requests.get(k, 0) + v
         except Exception:
             pass
+        # same for the goodput token ledger (ISSUE 13): tokens the
+        # corpse classified must keep counting toward the fleet
+        # identity after its registry is discarded
+        try:
+            stz = old.metrics.statusz()
+            for k, v in stz["tokens"].items():
+                self._retired_tokens[k] = \
+                    self._retired_tokens.get(k, 0) + v
+            for name, t in stz["tenants"].items():
+                acc = self._retired_tenants.setdefault(name, {})
+                for k, v in t["tokens"].items():
+                    acc[k] = acc.get(k, 0) + v
+        except Exception:
+            pass
         # keep only a few corpses for post-hoc leak audits (the chaos
         # drill reads them): an intermittently-crashing replica whose
         # probation keeps forgiving its counter would otherwise pin
@@ -359,6 +379,10 @@ class ReplicatedLMServer(_HTTPFrontend):
             # never pin HBM the replacement pools need
             old.engine.cache.k = old.engine.cache.v = None
         self._c_respawn.inc(replica=i)
+        telemetry.record_span(
+            "serving.respawn", time.perf_counter_ns() // 1000, 0,
+            category="serving", to_profiler=False, replica=i,
+            attempt=self._respawn_attempts[i])
         self._g_healthy.set(len(self.replicas) - sum(self._drained))
 
     def _routable(self, max_beat_age=None):
@@ -456,7 +480,7 @@ class ReplicatedLMServer(_HTTPFrontend):
                     # generation was already complete: finished directly
                     rep.metrics.request_finished(req)
                 else:
-                    tgt.metrics.request_failover(carried)
+                    tgt.metrics.request_failover(req, carried)
                     telemetry.flight().record(
                         "fault", "serving.failover", request=req.id,
                         resumed_tokens=carried,
@@ -496,7 +520,7 @@ class ReplicatedLMServer(_HTTPFrontend):
 
     def submit(self, prompt, max_new_tokens=32, eos_id=None,
                count_reject=True, tenant=None, priority=None,
-               deadline_ms=None):
+               deadline_ms=None, trace=None):
         """Route one request to the least-loaded healthy replica;
         returns the Request future. Raises QueueFull only when EVERY
         healthy replica is saturated (the HTTP front maps that to 503 +
@@ -522,7 +546,7 @@ class ReplicatedLMServer(_HTTPFrontend):
                 req = self.replicas[i].submit(
                     prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
                     count_reject=False, tenant=tenant, priority=priority,
-                    deadline_ms=deadline_ms)
+                    deadline_ms=deadline_ms, trace=trace)
                 req.replica = i          # where the router placed it
                 # counted on placement (or final rejection) — never per
                 # HTTP retry attempt, which would inflate the request
@@ -618,12 +642,45 @@ class ReplicatedLMServer(_HTTPFrontend):
             "router": self.registry.snapshot(),
         }
 
+    def statusz(self):
+        """Fleet /statusz (ISSUE 13): per-replica SLO/goodput bodies
+        plus an exact aggregate — token ledgers (retired corpses'
+        ledgers folded in, so the submitted == goodput + slow + shed +
+        expired + failed identity survives every respawn), per-tenant
+        sums, and fleet burn rates recomputed from the SUMMED window
+        deltas (`telemetry.slo.merge_slo`), never averaged."""
+        from ..telemetry import slo as _slo
+        bodies = [rep.statusz() for rep in self.replicas]
+        tokens = dict(self._retired_tokens)
+        tenants = {}
+        for name, acc in self._retired_tenants.items():
+            tenants[name] = {"tokens": dict(acc)}
+        for b in bodies:
+            for k, v in b["tokens"].items():
+                tokens[k] = tokens.get(k, 0) + v
+            for name, t in b["tenants"].items():
+                agg = tenants.setdefault(name, {"tokens": {}})
+                for k, v in t["tokens"].items():
+                    agg["tokens"][k] = agg["tokens"].get(k, 0) + v
+        return {
+            "replicas": bodies,
+            "fleet": {
+                "replicas_total": len(self.replicas),
+                "replicas_drained": sum(self._drained),
+                "replicas_circuit_open": sum(self._circuit_open),
+                "tokens": tokens,
+                "tenants": tenants,
+                "slo": _slo.merge_slo([b["slo"] for b in bodies]),
+            },
+        }
+
     def prometheus_text(self):
         """ONE Prometheus exposition over every replica registry plus
         the router's own — each sample labeled `replica="<i>"` (or
         `"router"`), HELP/TYPE once per metric name."""
         for rep in self.replicas:
             rep.metrics._refresh_gauges(rep.engine, rep.scheduler)
+            rep.metrics.slo.update()
         return telemetry.merged_prometheus_text(
             [rep.metrics.registry for rep in self.replicas]
             + [self.registry])

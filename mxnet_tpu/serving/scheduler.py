@@ -100,10 +100,19 @@ class Request:
     make it a minimal future the in-process API and HTTP frontend share."""
 
     def __init__(self, prompt, max_new_tokens=32, eos_id=None,
-                 tenant=None, priority=None, deadline_ms=None):
+                 tenant=None, priority=None, deadline_ms=None,
+                 trace=None):
         if not len(prompt):
             raise MXNetError("empty prompt")
         self.id = next(_ids)
+        # the request's TRACE id (ISSUE 13): a W3C-compatible 32-hex id
+        # accepted from the client's `traceparent` header or minted
+        # fresh. Every span of this request's life — submit, queue,
+        # prefill chunks, decode steps — is keyed by it, and
+        # `make_resume` carries it across failover hops, so one request
+        # is ONE connected trace no matter how many replicas served it.
+        from ..telemetry import new_trace_id
+        self.trace = str(trace) if trace else new_trace_id()
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
@@ -121,7 +130,20 @@ class Request:
         self.t_admit = None
         self.t_first_token = None
         self.t_done = None
+        # CLIENT-truth latency anchors (ISSUE 13): a failover resume is
+        # a fresh Request with a fresh t_submit, but the client has
+        # been waiting since the ORIGINAL submit and may already have
+        # its first token — the SLO classifier and the TTFT histogram
+        # must judge by these, or failover makes the numbers optimistic
+        # exactly when they matter (make_resume carries them forward)
+        self.t_client_submit = self.t_submit
+        self.t_client_first_token = None
         self.failovers = 0            # resume hops already spent on it
+        self.resumed_tokens = 0       # generated tokens a failover
+                                      # replay carried in its prompt
+                                      # (the goodput ledger credits the
+                                      # CLIENT-visible delivery)
+        self.t_last_token = None      # previous token's emit time (ITL)
         self._on_finish = None        # failover stitch callback
         self._event = threading.Event()
         self._finish_lock = threading.Lock()
@@ -188,8 +210,19 @@ def make_resume(orig, tokens, max_len):
     resume = Request(tokens, max_new_tokens=remaining,
                      eos_id=orig.eos_id, tenant=orig.tenant,
                      priority=orig.priority,
-                     deadline_ms=orig.deadline_ms)
+                     deadline_ms=orig.deadline_ms,
+                     trace=orig.trace)
     resume.failovers = orig.failovers + 1
+    resume.resumed_tokens = carried
+    # the victim's last token-emit time rides along so the client's
+    # real inter-token gap across the hop lands in the ITL histogram
+    # (the replay's first fresh token closes that gap); the client
+    # anchors ride too so TTFT is judged from the ORIGINAL submit and
+    # never re-observed for a client that already has its first token
+    resume.t_last_token = orig.t_last_token
+    resume.t_client_submit = orig.t_client_submit
+    resume.t_client_first_token = orig.t_client_first_token \
+        if orig.t_client_first_token is not None else orig.t_first_token
     # the deadline is ABSOLUTE from the client's submit — a failover hop
     # must not extend it (t_submit stays fresh: queue_timeout measures
     # queue wait, and the resume really does enter a queue anew)

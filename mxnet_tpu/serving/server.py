@@ -37,8 +37,8 @@ def _queue_span(req):
     timestamps are the same clock in microseconds)."""
     telemetry.record_span("serving.queue", int(req.t_submit * 1e6),
                           int((req.t_admit - req.t_submit) * 1e6),
-                          trace=req.id, category="serving",
-                          to_profiler=False)
+                          trace=req.trace, category="serving",
+                          to_profiler=False, request=req.id)
 
 
 def _resolve_model(model, vocab=None, max_len=None, time_major=False):
@@ -126,6 +126,10 @@ def _make_handler(outer):
             if self.path == "/healthz":
                 h = outer.health()
                 self._reply(200 if h["ok"] else 503, h)
+            elif self.path == "/statusz":
+                # ISSUE 13: the SLO / goodput view — per-tenant token
+                # ledgers, attainment, error budget, multi-window burn
+                self._reply(200, outer.statusz())
             elif self.path in ("/v1/metrics", "/metrics"):
                 accept = self.headers.get("Accept", "")
                 if "text/plain" in accept:
@@ -151,6 +155,12 @@ def _make_handler(outer):
                 n = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(n) or b"{}")
                 from ..utils import retry
+                # W3C trace context (ISSUE 13): a well-formed inbound
+                # `traceparent` joins the caller's trace; ANYTHING
+                # malformed or foreign degrades to a fresh trace id —
+                # a client's garbage header must never 500 the door
+                trace = telemetry.parse_traceparent(
+                    self.headers.get("traceparent"))
                 # a briefly-full queue drains in a few decode steps:
                 # absorb the burst with bounded backoff before bouncing.
                 # count_reject=False: only the FINAL failure below
@@ -169,7 +179,8 @@ def _make_handler(outer):
                                   else None),
                         deadline_ms=(float(deadline_ms)
                                      if deadline_ms is not None
-                                     else None)),
+                                     else None),
+                        trace=trace),
                     attempts=outer.submit_retries,
                     backoff=outer.submit_backoff,
                     retry_on=QueueFull)
@@ -221,7 +232,9 @@ def _make_handler(outer):
                 "tokens": generated,
                 "prompt_len": len(req.prompt),
                 "latency_ms": 1e3 * (req.t_done - req.t_submit),
-            })
+                "trace": req.trace,
+            }, headers={"traceparent":
+                        telemetry.format_traceparent(req.trace)})
 
     return Handler
 
@@ -299,7 +312,7 @@ class LMServer(_HTTPFrontend):
 
     def submit(self, prompt, max_new_tokens=32, eos_id=None,
                count_reject=True, tenant=None, priority=None,
-               deadline_ms=None):
+               deadline_ms=None, trace=None):
         """Enqueue one request; returns it (a future: .result(timeout)).
         Raises QueueFull immediately when backpressure kicks in.
         `count_reject=False` suppresses the rejected-metric increment —
@@ -312,7 +325,10 @@ class LMServer(_HTTPFrontend):
         a request the OBSERVED service rate already can't meet is shed
         right here (DeadlineUnmeetable, with the computed Retry-After)
         instead of burning queue slots and prefill tokens on a
-        guaranteed 504."""
+        guaranteed 504. `trace` (ISSUE 13) is the caller's trace id —
+        the HTTP frontend passes a parsed W3C `traceparent` through it;
+        unset mints a fresh id. Every span of the request's life keys
+        on it, across replicas and failover hops."""
         if self._closed:
             # a replica behind the router reports closure as
             # backpressure so the door tries the next replica (a crash
@@ -329,14 +345,17 @@ class LMServer(_HTTPFrontend):
                 % (len(prompt), self.engine.max_len))
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
-        if deadline_ms is not None:
-            self._check_deadline_meetable(len(prompt), max_new_tokens,
-                                          float(deadline_ms))
         req = Request(prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
                       tenant=tenant,
                       priority=(priority if priority is not None
                                 else self.default_priority),
-                      deadline_ms=deadline_ms)
+                      deadline_ms=deadline_ms, trace=trace)
+        if deadline_ms is not None:
+            # the gate runs AFTER the Request exists so an admission
+            # shed has an id/trace/tenant to account and log against
+            # (the request is discarded on the raise — it was never
+            # submitted, so its terminal accounting happens here)
+            self._check_deadline_meetable(req)
         try:
             self.scheduler.submit(req)
         except QueueFull:
@@ -361,12 +380,12 @@ class LMServer(_HTTPFrontend):
                     raise QueueFull("replica %s closed mid-submit"
                                     % self.replica_id)
                 raise MXNetError("server is closed")
-        self.metrics.request_submitted()
+        self.metrics.request_submitted(req)
         # the trace row's start marker: every later span (queue, prefill
-        # chunks, decode steps) shares this request id as its trace id
+        # chunks, decode steps) shares this request's trace id
         telemetry.record_span("serving.submit", int(req.t_submit * 1e6),
-                              0, trace=req.id, category="serving",
-                              to_profiler=False,
+                              0, trace=req.trace, category="serving",
+                              to_profiler=False, request=req.id,
                               prompt_len=len(req.prompt),
                               max_new_tokens=req.max_new_tokens)
         self._work.set()
@@ -389,7 +408,7 @@ class LMServer(_HTTPFrontend):
             dec += max(1, s.max_total - s.prompt_len)
         return pre, dec
 
-    def _check_deadline_meetable(self, prompt_len, max_new, deadline_ms):
+    def _check_deadline_meetable(self, req):
         """Admission-time deadline gate: estimated completion time is
         the committed DECODE backlog over the observed decode token
         rate PLUS the prefill backlog over the observed prefill rate
@@ -401,17 +420,18 @@ class LMServer(_HTTPFrontend):
         backpressure beats a queue full of corpses. Still an estimate:
         it only has to be right about hopeless cases, and a false
         accept is dropped at scheduling time."""
+        deadline_ms = req.deadline_ms
         rate = self.metrics.observed_token_rate()
         if rate is None or rate <= 0:
             return                      # nothing measured yet: admit
         pre_b, dec_b = self._load_split()
-        pre_b += prompt_len
-        dec_b += max_new
+        pre_b += len(req.prompt)
+        dec_b += req.max_new_tokens
         prate = self.metrics.observed_prefill_rate()
         est_s = dec_b / rate + (pre_b / prate if prate else 0.0)
         if est_s <= deadline_ms / 1e3:
             return
-        self.metrics.request_deadline_shed()
+        self.metrics.request_deadline_shed(req)
         retry_after = max(1.0, est_s - deadline_ms / 1e3)
         raise DeadlineUnmeetable(
             "deadline %.0f ms unmeetable: %d decode + %d prefill "
@@ -435,6 +455,11 @@ class LMServer(_HTTPFrontend):
         """Prometheus exposition of the server's metrics registry (the
         `/metrics` body under `Accept: text/plain`)."""
         return self.metrics.prometheus_text(self.engine, self.scheduler)
+
+    def statusz(self):
+        """The /statusz JSON body (ISSUE 13): the goodput token ledger,
+        per-tenant breakdown, and SLO attainment/burn for this server."""
+        return self.metrics.statusz(self.engine, self.scheduler)
 
     def health(self, max_beat_age=5.0):
         """Loop-liveness summary for /healthz: `ok` requires the serving
@@ -615,6 +640,14 @@ class LMServer(_HTTPFrontend):
                                     time.perf_counter() - t0,
                                     cache_util=eng.cache_utilization(),
                                     paged=eng.paged)
+                    # per-request inter-token latency (ISSUE 13): the
+                    # ITL SLO and the lifecycle ledger see every gap,
+                    # including the one a failover replay opened
+                    for s in advanced:
+                        if s.request is not None:
+                            met.token_generated(
+                                s.request, now=self._last_step_t,
+                                position=len(s.tokens) - 1)
                 for req in (s.request for s in sched.evict(eng)
                             if s.request is not None):
                     met.request_finished(req)
@@ -639,7 +672,7 @@ class LMServer(_HTTPFrontend):
                 # the engine's prefill span inherits the request's trace
                 # id via the thread-local (the Sequence only learns its
                 # request after start() returns)
-                prev = telemetry.set_trace(req.id)
+                prev = telemetry.set_trace(req.trace)
                 try:
                     seq = eng.start(req.prompt, req.max_new_tokens,
                                     eos_id=req.eos_id)
@@ -661,6 +694,7 @@ class LMServer(_HTTPFrontend):
             seq.request = req
             req.state = "running"
             _queue_span(req)
+            met.request_admitted(req)
             sched.running.append(seq)
             met.request_prefilled(req, time.perf_counter() - t0)
 
@@ -687,6 +721,7 @@ class LMServer(_HTTPFrontend):
             seq.request = req
             req.state = "running"
             _queue_span(req)
+            met.request_admitted(req)
             sched.prefilling.append(seq)
 
     def _prefill_chunks(self):
@@ -732,6 +767,8 @@ class LMServer(_HTTPFrontend):
                 continue
             seq.prefill_s += time.perf_counter() - t0
             spent += cost
+            if seq.request is not None:
+                met.request_chunk(seq.request, seq.prefilled)
             if done:
                 sched.prefilling.remove(seq)
                 sched.running.append(seq)
@@ -770,7 +807,7 @@ class LMServer(_HTTPFrontend):
             if resume is None:      # generation was already complete
                 self.metrics.request_finished(req)
             else:
-                self.metrics.request_failover(carried)
+                self.metrics.request_failover(req, carried)
 
     # -- chaos seams ---------------------------------------------------------
 
@@ -863,6 +900,15 @@ def spawn_resume(orig, tokens, target):
 
     resume._on_finish = stitch
     target.adopt(resume)
+    # the hop annotation on the request's (single, stitched) trace row:
+    # Perfetto shows where the request moved and how much it salvaged
+    now_us = time.perf_counter_ns() // 1000
+    telemetry.record_span("serving.failover_hop", now_us, 0,
+                          trace=orig.trace, category="serving",
+                          to_profiler=False, request=orig.id,
+                          resume=resume.id, carried_tokens=carried,
+                          hop=resume.failovers,
+                          target=target.replica_id)
     return resume, carried
 
 

@@ -23,15 +23,19 @@ from . import metrics
 from . import tracing
 from . import flight as _flight_mod
 from . import introspect
+from . import slo
 
 from .metrics import (enabled, MetricsRegistry, default_registry,
                       DEFAULT_BUCKETS, merged_prometheus_text)
 from .tracing import (span, record_span, current_trace, set_trace,
-                      spans, export_perfetto)
+                      spans, export_perfetto, new_trace_id,
+                      parse_traceparent, format_traceparent)
 from .flight import FlightRecorder, flight
 from .introspect import (watchdog, instrument, compile_events,
                          compile_region, CompileBudgetExceeded,
                          HbmBudgetExceeded)
+from .slo import (Objective, SLOTracker, parse_slo_env, parse_windows,
+                  merge_slo, request_log, request_event)
 
 
 def counter(name, help="", flight=False):

@@ -201,6 +201,26 @@ class Histogram(_Metric):
             lo = bound
         return self.buckets[-1]
 
+    def count_below(self, value):
+        """Estimated number of observations <= `value`, interpolating
+        linearly inside the owning bucket (the same convention as
+        `quantile`, run in the other direction) — the SLO engine's
+        good-event count for a latency threshold. Observations in the
+        +Inf bucket are assumed to exceed any finite threshold."""
+        value = float(value)
+        cum = 0.0
+        lo = 0.0
+        for i, bound in enumerate(self.buckets):
+            c = self._counts[i]
+            if value >= bound:
+                cum += c
+                lo = bound
+                continue
+            if value > lo and c and bound > lo:
+                cum += c * (value - lo) / (bound - lo)
+            return cum
+        return cum
+
     @property
     def mean(self):
         return self.sum / self.count if self.count else None

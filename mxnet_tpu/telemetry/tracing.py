@@ -24,12 +24,14 @@ from __future__ import annotations
 import itertools
 import json
 import os
+import re
 import threading
 import time
+import uuid
 from collections import deque
 
 from .. import profiler
-from .metrics import enabled
+from .metrics import enabled, default_registry
 
 _ids = itertools.count(1)
 _tls = threading.local()
@@ -40,6 +42,85 @@ _tls = threading.local()
 _ring_size = int(os.environ.get("MXNET_TELEMETRY_SPAN_RING", "8192"))
 _spans = deque(maxlen=_ring_size)
 _lock = threading.Lock()
+#: highest span id the last export_perfetto() saw: an overwrite of a
+#: NEWER span is a drop the operator never got to see (ISSUE 13 — drops
+#: were silent before; now they land on `spans_dropped_total` and the
+#: ring fill rides the `span_ring_occupancy` gauge)
+_exported_upto = 0
+
+
+#: cached (counter, gauge) pair — record_span runs once per request per
+#: decode step, so it must not pay a locked registry lookup per span.
+#: Invalidated when the default registry is reset (bench.py's
+#: per-config isolation): the cached counter identity is checked
+#: against the registry's current entry with one plain dict read.
+_ring_cache = None
+_occupancy_last = -1
+
+
+def _ring_instruments():
+    global _ring_cache
+    reg = default_registry()
+    cached = _ring_cache
+    if cached is not None and cached[0] is reg and \
+            reg._metrics.get("spans_dropped_total") is cached[1]:
+        return cached[1], cached[2]
+    ctr = reg.counter("spans_dropped_total",
+                      help="spans evicted from the bounded span ring "
+                           "before any export_perfetto() saw them "
+                           "(raise MXNET_TELEMETRY_SPAN_RING or "
+                           "export more often)")
+    gauge = reg.gauge("span_ring_occupancy",
+                      help="span-ring fill fraction (len / capacity)")
+    _ring_cache = (reg, ctr, gauge)
+    return ctr, gauge
+
+
+# -- W3C trace context (traceparent) ----------------------------------------
+
+#: traceparent: version "-" trace-id "-" parent-id "-" flags
+#: (https://www.w3.org/TR/trace-context/); version ff is forbidden and
+#: all-zero trace/parent ids are invalid
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+
+
+def new_trace_id():
+    """A fresh 32-hex W3C-compatible trace id."""
+    return uuid.uuid4().hex
+
+
+def parse_traceparent(value):
+    """The trace id out of a W3C `traceparent` header, or None for
+    anything malformed (wrong field count, bad charset, all-zero ids,
+    the forbidden ff version, bytes, whitespace garbage …). Callers
+    MUST treat None as "start a fresh trace", never as an error — a
+    client sending garbage must not be able to 500 the frontend."""
+    try:
+        m = _TRACEPARENT_RE.match(str(value).strip().lower())
+    except Exception:
+        return None
+    if m is None:
+        return None
+    version, trace_id, parent_id, _flags = m.groups()
+    if version == "ff":
+        return None
+    if trace_id == "0" * 32 or parent_id == "0" * 16:
+        return None
+    return trace_id
+
+
+def format_traceparent(trace, parent_id=None, sampled=True):
+    """Render a trace id back into a `traceparent` header value. A
+    trace id that is not already 32-hex (an in-process id) is folded
+    into one deterministically, so the emitted header is always
+    well-formed."""
+    t = str(trace).lower()
+    if not re.match(r"^[0-9a-f]{32}$", t):
+        t = uuid.uuid5(uuid.NAMESPACE_OID, str(trace)).hex
+    if parent_id is None:
+        parent_id = uuid.uuid4().hex[:16]
+    return "00-%s-%s-%s" % (t, parent_id, "01" if sampled else "00")
 
 
 def current_trace():
@@ -78,8 +159,32 @@ def record_span(name, start_us, dur_us, trace=None, category="trace",
            "pid": os.getpid(), "tid": threading.get_ident()}
     if attrs:
         rec["attrs"] = attrs
+    global _occupancy_last
+    dropped, occupancy = 0, 0.0
     with _lock:
+        if len(_spans) == _spans.maxlen \
+                and _spans[0]["id"] > _exported_upto:
+            # the ring is about to overwrite a span no export has seen:
+            # a silent gap in the next Perfetto row (satellite, ISSUE 13)
+            dropped = 1
         _spans.append(rec)
+        occupancy = len(_spans) / float(_spans.maxlen or 1)
+    # quantize the occupancy gauge so a full (or slowly-filling) ring
+    # doesn't pay a locked gauge.set per span on the decode hot path;
+    # a registry reset (bench.py per-config isolation) drops the cached
+    # instruments, so the staleness check below re-creates AND re-sets
+    # them even at a steady quantized fill
+    cache = _ring_cache
+    reg = default_registry()
+    stale = (cache is None or cache[0] is not reg or
+             reg._metrics.get("spans_dropped_total") is not cache[1])
+    occ_q = int(occupancy * 128)
+    if dropped or stale or occ_q != _occupancy_last:
+        ctr, gauge = _ring_instruments()
+        if dropped:
+            ctr.inc()
+        gauge.set(occupancy)
+        _occupancy_last = occ_q
     if to_profiler:
         profiler.record_event(name, category, start_us, dur_us,
                               dict(attrs, trace=trace) if attrs
@@ -137,8 +242,11 @@ def spans(trace=None):
 
 def clear():
     """Drop the ring (tests)."""
+    global _exported_upto, _occupancy_last
     with _lock:
         _spans.clear()
+        _exported_upto = 0
+    _occupancy_last = -1
 
 
 def export_perfetto(path=None):
@@ -150,8 +258,12 @@ def export_perfetto(path=None):
     prefill chunks, decode steps — as a single connected row; untraced
     spans keep their real thread id. Returns the trace dict (and writes
     it to `path` when given)."""
+    global _exported_upto
     with _lock:
         recs = list(_spans)
+        if recs:    # spans up to here have been exported: only younger
+            # ones count as dropped if the ring overwrites them
+            _exported_upto = max(_exported_upto, recs[-1]["id"])
     events = []
     rows = {}
     for r in recs:

@@ -155,6 +155,38 @@ def test_telemetry_env_knobs(monkeypatch, tmp_path):
     assert reg.counter("off_total").value == 7
 
 
+def test_slo_and_request_log_env_knobs(monkeypatch, tmp_path):
+    """MXNET_SLO_* declare objectives (parsed at ServingMetrics
+    construction; burn/attainment math pinned in test_slo.py);
+    MXNET_REQUEST_LOG[_SAMPLE] route the lifecycle ledger. Malformed
+    values fail loudly naming the knob."""
+    from mxnet_tpu import telemetry
+
+    monkeypatch.delenv("MXNET_SLO_TTFT_MS", raising=False)
+    monkeypatch.delenv("MXNET_SLO_ITL_MS", raising=False)
+    monkeypatch.delenv("MXNET_SLO_AVAILABILITY", raising=False)
+    assert telemetry.parse_slo_env() == []
+    monkeypatch.setenv("MXNET_SLO_TTFT_MS", "250,acme=100:0.99")
+    monkeypatch.setenv("MXNET_SLO_AVAILABILITY", "0.999")
+    objs = telemetry.parse_slo_env()
+    assert {(o.kind, o.tenant) for o in objs} == {
+        ("ttft", None), ("ttft", "acme"), ("availability", None)}
+    monkeypatch.setenv("MXNET_SLO_AVAILABILITY", "99.9")  # not a fraction
+    with pytest.raises(ValueError):
+        telemetry.parse_slo_env()
+
+    log = telemetry.request_log()
+    monkeypatch.delenv("MXNET_REQUEST_LOG", raising=False)
+    assert not log.enabled
+    monkeypatch.setenv("MXNET_REQUEST_LOG", str(tmp_path / "r.jsonl"))
+    assert log.enabled
+    monkeypatch.setenv("MXNET_REQUEST_LOG_SAMPLE", "0.25")
+    assert log.sample_rate() == 0.25
+    monkeypatch.setenv("MXNET_REQUEST_LOG_SAMPLE", "lots")
+    with pytest.raises(ValueError, match="MXNET_REQUEST_LOG_SAMPLE"):
+        log.sample_rate()
+
+
 def test_serving_tp_and_replicas_env_defaults(monkeypatch):
     """MXNET_SERVING_TP / MXNET_SERVING_REPLICAS are the construction
     defaults for Engine(tp=) and serve(replicas=); explicit arguments

@@ -282,7 +282,9 @@ def test_one_request_single_connected_trace(tmp_path):
     try:
         req = srv.submit([1, 2, 3], max_new_tokens=4)
         req.result(timeout=60)
-        rid = req.id
+        # since ISSUE 13 the trace key is the request's W3C-compatible
+        # trace id (rides failover hops), not the process-local req.id
+        rid = req.trace
     finally:
         srv.close()
     names = [s["name"] for s in telemetry.spans(trace=rid)]
@@ -467,16 +469,18 @@ def test_every_pallas_call_declares_cost_estimate():
 
 def _doc_instrument_names():
     """Backticked instrument-looking tokens in docs/OBSERVABILITY.md,
-    outside fenced code blocks: lowercase snake_case, with `<site>`
-    placeholders mapped onto the %s metric-name templates
-    (telemetry/introspect.py), one optional `{a,b,...}` alternation
-    expanded, `*` kept as a wildcard."""
+    outside fenced code blocks: lowercase snake_case, with
+    `<placeholder>` tokens (`<site>`, `<tenant>`, `<objective>`,
+    `<window>`, `<kind>`, ...) mapped onto the %s metric-name templates
+    (telemetry/introspect.py, serving/metrics.py, telemetry/slo.py),
+    one optional `{a,b,...}` alternation expanded, `*` kept as a
+    wildcard."""
     repo = pathlib.Path(mx.__file__).resolve().parent.parent
     doc = (repo / "docs" / "OBSERVABILITY.md").read_text()
     doc = re.sub(r"```.*?```", "", doc, flags=re.S)
     names = set()
     for span in re.findall(r"`([^`]+)`", doc):
-        t = span.replace("<site>", "%s")
+        t = re.sub(r"<[a-z_]+>", "%s", span)
         if "_" not in t or not re.match(
                 r"^[a-z][a-z0-9_%*]*(?:\{[a-z0-9_,]*\}[a-z0-9_]*)?$", t):
             continue

@@ -250,17 +250,23 @@ def _direction(line):
 
 def _judge_secondary(verdict, fresh, ref):
     """Warn-only secondary-field comparison (compile wall time is noisy
-    on shared hosts; footprint is not; the prefix-cache hit rate is a
-    health signal, not the measurement) — none of these decide the exit
-    code, the measured value does. `bad` is the direction that warrants
-    a warning: +1 = growth is bad (time, bytes), -1 = a drop is bad
-    (hit rate)."""
+    on shared hosts; footprint is not; the prefix-cache hit rate and
+    the SLO goodput/attainment pair are health signals, not the
+    measurement) — none of these decide the exit code, the measured
+    value does. `bad` is the direction that warrants a warning: +1 =
+    growth is bad (time, bytes), -1 = a drop is bad (hit rate,
+    goodput, attainment)."""
     for field, band, bad in (("compile_s", 0.50, 1),
                              ("exec_hbm_bytes", 0.15, 1),
                              ("prefix_hit_rate", 0.15, -1),
                              ("prefix_hit_tokens", 0.25, -1),
                              ("failover_added_latency_p95_ms", 0.50, 1),
-                             ("respawn_to_first_token_ms", 0.50, 1)):
+                             ("respawn_to_first_token_ms", 0.50, 1),
+                             # ISSUE 13: SLO health signals — a goodput
+                             # or attainment drop warns, the measured
+                             # tok/s decides the exit code
+                             ("goodput_tok_per_sec", 0.25, -1),
+                             ("slo_ttft_attainment", 0.10, -1)):
         fv, rv = fresh.get(field), ref.get(field)
         if not isinstance(fv, (int, float)) or not isinstance(
                 rv, (int, float)) or rv <= 0:

@@ -32,7 +32,14 @@ Asserted at the end:
   * zero leaked blocks: `Engine.audit_quiescent()` passes on every
     surviving replica AND every retired (crashed) engine;
   * every injected fault appears in the merged flight-recorder
-    postmortem timeline (tools/postmortem.py).
+    postmortem timeline (tools/postmortem.py), AND (ISSUE 13) so do the
+    pinned failover victims' per-request lifecycle events
+    (request.failover / request.finish, trace-linked), so a postmortem
+    answers "what happened to THAT request" — not just "what broke";
+  * the request-lifecycle JSONL ledger (MXNET_REQUEST_LOG) carries the
+    victims' full lifecycles under ONE trace id across the hop;
+  * tools/fleet_top.py renders a live frame against the degraded fleet
+    (statusz + healthz + metrics over HTTP) without errors.
 
 Usage:
     python tools/chaos_serve.py                  # CI config
@@ -119,6 +126,12 @@ def main():
 
     flight_dir = args.flight_dir or tempfile.mkdtemp(prefix="chaos_serve_")
     os.environ["MXNET_FLIGHT_RECORDER_DIR"] = flight_dir
+    # the per-request lifecycle ledger (ISSUE 13) rides the drill:
+    # every request's queued -> ... -> finish streams as JSONL, and the
+    # pinned victims' lifecycles must survive the failover hop under
+    # ONE trace id
+    request_log = os.path.join(flight_dir, "requests.jsonl")
+    os.environ["MXNET_REQUEST_LOG"] = request_log
 
     from mxnet_tpu import serving, telemetry
     from mxnet_tpu.utils import chaos
@@ -166,6 +179,9 @@ def main():
     srv.max_beat_age = 2.5
     print("-- fleet warmed: %d replicas through their compile lattice "
           "(%.1fs)" % (len(srv.replicas), time.time() - t0))
+    # the live console's quarry: statusz/healthz/metrics over HTTP
+    http_host, http_port = srv.serve_http(port=0, block=False)
+    console_url = "http://%s:%d" % (http_host, http_port)
     stop_sweep = threading.Event()
 
     def sweeper():                     # drives drain/restore/respawn
@@ -299,6 +315,22 @@ def main():
         assert got == want[j], "survivor diverged post-circuit-open"
     print("   survivors keep serving, token-identical")
 
+    # -- live console: fleet_top renders the DEGRADED fleet -----------------
+    spec = importlib.util.spec_from_file_location(
+        "fleet_top", os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "fleet_top.py"))
+    ft = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ft)
+    frame = ft.render_once(console_url)
+    assert "fleet:" in frame and "CIRCUIT" in frame, frame
+    assert "tokens: submitted" in frame, frame
+    for i in range(3):
+        assert ("\n  %d " % i) in frame or (" %d " % i) in frame, (
+            "replica %d missing from the console frame:\n%s" % (i, frame))
+    print("-- fleet_top console frame (degraded fleet, circuit open):")
+    for ln in frame.splitlines()[:8]:
+        print("   | " + ln)
+
     # -- leak audit: every pool quiescent, incl. the crashed engines --------
     stop_sweep.set()
     engines = ([rep.engine for i, rep in enumerate(srv.replicas)
@@ -327,8 +359,39 @@ def main():
     assert not missing, (
         "postmortem timeline is missing injected faults: %r" % missing)
     assert "FAULT" in text
-    print("== postmortem: all %d injected fault kinds on the merged "
-          "timeline (%s)" % (len(SERVE_FAULTS), flight_dir))
+    # ISSUE 13: the pinned failover victims' LIFECYCLES are on the same
+    # timeline as the faults that moved them — the hop event names the
+    # original request, and the replay's finish closes it out under the
+    # SAME trace id (the timeline answers "what happened to THAT
+    # request", not just "what broke")
+    assert "request.failover" in text, text[-2000:]
+    assert "request.finish" in text, text[-2000:]
+    for victim in (req_kill, req_poison):
+        assert ("request=%d" % victim.id) in text, (
+            "pinned victim %d's failover event missing from the "
+            "postmortem timeline" % victim.id)
+        assert victim.trace in text, (
+            "pinned victim %d's trace id missing from the postmortem "
+            "timeline" % victim.id)
+    print("== postmortem: all %d injected fault kinds + the pinned "
+          "victims' request lifecycles on the merged timeline (%s)"
+          % (len(SERVE_FAULTS), flight_dir))
+    # the JSONL request ledger carries both victims' lifecycles under
+    # ONE trace id across the hop: queued on the victim replica,
+    # finish on the rescue path
+    import json as _json
+    with open(request_log) as fh:
+        recs = [_json.loads(ln) for ln in fh if ln.strip()]
+    for victim in (req_kill, req_poison):
+        events = [r["event"] for r in recs
+                  if r.get("trace") == victim.trace]
+        for needed in ("queued", "failover", "finish"):
+            assert needed in events, (
+                "request log lost victim %d's %r event (has %r)"
+                % (victim.id, needed, events))
+    print("== request log: %d lifecycle events, victims' lifecycles "
+          "trace-connected across the hop (%s)"
+          % (len(recs), request_log))
     print("== OK: availability %.1f%%, failover token-identical, pools "
           "quiescent, faults accounted for" % (100 * availability))
     return 0

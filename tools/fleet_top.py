@@ -1,0 +1,236 @@
+#!/usr/bin/env python
+"""Live fleet console (ISSUE 13): `top` for a serving fleet.
+
+Polls a serving front door's `/healthz`, `/statusz`, and JSON
+`/metrics` endpoints and renders one terminal frame per interval:
+replica states (healthy / drained / respawning / circuit-open), queue
+depths and block-pool pressure, per-replica throughput, the per-tenant
+goodput token ledger, and every declared SLO's attainment /
+error-budget / multi-window burn. Works against a single `LMServer`
+and a multi-replica `ReplicatedLMServer` alike, and is deliberately
+**stdlib-only** — it must run on a bastion host where importing jax is
+not an option.
+
+    python tools/fleet_top.py --url http://127.0.0.1:8080
+    python tools/fleet_top.py --url ... --interval 1
+    python tools/fleet_top.py --url ... --once         # one frame, no
+                                                       # screen control
+
+The chaos drill (tools/chaos_serve.py) renders a frame against its live
+3-replica fleet mid-storm — the console must never crash on a degraded
+fleet (that is exactly when an operator is staring at it).
+"""
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+
+def fetch(base_url, timeout=5.0):
+    """(health, statusz, metrics-snapshot) from one front door; a path
+    that can't be fetched/parsed becomes None — the renderer degrades
+    per section instead of dying with the fleet."""
+    out = []
+    for path in ("/healthz", "/statusz", "/metrics"):
+        try:
+            with urllib.request.urlopen(base_url.rstrip("/") + path,
+                                        timeout=timeout) as r:
+                out.append(json.loads(r.read()))
+        except urllib.error.HTTPError as e:
+            # /healthz answers 503 with a JSON body on a degraded fleet
+            # — that body is the information, not an error
+            try:
+                out.append(json.loads(e.read()))
+            except Exception:
+                out.append(None)
+        except Exception:
+            out.append(None)
+    return tuple(out)
+
+
+def _num(v, fmt="%.1f", dash="-"):
+    if v is None:
+        return dash
+    try:
+        return fmt % v
+    except (TypeError, ValueError):
+        return dash
+
+
+def _replica_rows(health, statusz, snap):
+    """Normalized per-replica rows from whichever shapes are present:
+    the router nests lists under `replicas`, a single server is its own
+    only replica."""
+    h_reps = (health or {}).get("replicas")
+    s_reps = (snap or {}).get("replicas")
+    z_reps = (statusz or {}).get("replicas")
+    if h_reps is None and s_reps is None and z_reps is None:
+        h_reps = [health] if health else []
+        s_reps = [snap] if snap else []
+        z_reps = [statusz] if statusz else []
+    n = max(len(h_reps or []), len(s_reps or []), len(z_reps or []))
+    rows = []
+    for i in range(n):
+        h = (h_reps or [])[i] if i < len(h_reps or []) else {}
+        s = (s_reps or [])[i] if i < len(s_reps or []) else {}
+        z = (z_reps or [])[i] if i < len(z_reps or []) else {}
+        h = h or {}
+        s = s or {}
+        z = z or {}
+        if h.get("circuit_open"):
+            state = "CIRCUIT"
+        elif h.get("dead"):
+            state = "DEAD"
+        elif h.get("drained"):
+            state = "drained"
+        elif h.get("ok") is False:
+            state = "wedged"
+        else:
+            state = "healthy"
+        sched = s.get("scheduler") or {}
+        cache = s.get("cache") or {}
+        reqs = s.get("requests") or {}
+        thru = s.get("throughput") or {}
+        rid = h.get("replica", z.get("replica", i))
+        rows.append({
+            "replica": rid if rid is not None else i,
+            "state": state,
+            "queued": sched.get("queued"),
+            "prefilling": sched.get("prefilling"),
+            "tok_s": thru.get("tokens_per_sec"),
+            "blocks": (cache.get("blocks_in_use"),
+                       cache.get("blocks_total")),
+            "failovers": reqs.get("failovers"),
+            "goodput_s": z.get("goodput_tok_per_sec"),
+            "beat_age": h.get("last_beat_age_s"),
+            "respawns": h.get("respawns"),
+        })
+    return rows
+
+
+def render(health, statusz, snap, url="", now=None):
+    """One console frame (plain text, no escape codes) out of the three
+    endpoint bodies; any of them may be None."""
+    now = time.time() if now is None else now
+    stamp = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(now))
+    lines = ["mxnet_tpu fleet console  %s  %s" % (url, stamp)]
+    if health is None and statusz is None and snap is None:
+        lines.append("  front door UNREACHABLE")
+        return "\n".join(lines)
+    h = health or {}
+    if "replicas_total" in h:
+        lines.append(
+            "fleet: %s%s  replicas %s/%s healthy, %s circuit-open"
+            % ("OK" if h.get("ok") else "DOWN",
+               " (degraded)" if h.get("degraded") else "",
+               h.get("replicas_healthy", "?"),
+               h.get("replicas_total", "?"),
+               h.get("replicas_circuit_open", 0)))
+    else:
+        lines.append("server: %s  beat age %ss"
+                     % ("OK" if h.get("ok") else "DOWN",
+                        _num(h.get("last_beat_age_s"), "%.2f")))
+    rows = _replica_rows(health, statusz, snap)
+    if rows:
+        lines.append(
+            "  %-7s %-8s %6s %8s %10s %10s %9s %9s %8s"
+            % ("replica", "state", "queue", "prefill", "tok/s",
+               "goodput/s", "blocks", "failovers", "respawns"))
+        for r in rows:
+            used, total = r["blocks"]
+            blocks = ("%s/%s" % (used, total)
+                      if used is not None and total is not None else "-")
+            lines.append(
+                "  %-7s %-8s %6s %8s %10s %10s %9s %9s %8s"
+                % (r["replica"], r["state"],
+                   _num(r["queued"], "%d"), _num(r["prefilling"], "%d"),
+                   _num(r["tok_s"]), _num(r["goodput_s"]), blocks,
+                   _num(r["failovers"], "%d"),
+                   _num(r["respawns"], "%d")))
+    # tenants + slo come from the fleet aggregate when routed, else the
+    # single server's own statusz body
+    z = statusz or {}
+    agg = z.get("fleet", z)
+    tenants = agg.get("tenants") or {}
+    if tenants:
+        lines.append("tenants:")
+        lines.append("  %-12s %10s %8s %8s %8s %8s %9s"
+                     % ("tenant", "goodput", "slow", "shed",
+                        "expired", "failed", "replayed"))
+        for name in sorted(tenants):
+            tok = tenants[name].get("tokens") or {}
+            lines.append(
+                "  %-12s %10s %8s %8s %8s %8s %9s"
+                % (name[:12], tok.get("goodput", 0),
+                   tok.get("slow", 0), tok.get("shed", 0),
+                   tok.get("expired", 0), tok.get("failed", 0),
+                   tok.get("replayed", 0)))
+    slo = agg.get("slo") or []
+    if slo:
+        lines.append("slo:")
+        for obj in slo:
+            burn = obj.get("burn") or {}
+            burn_s = "  ".join(
+                "%s %.2f" % (w, (burn[w] or {}).get("rate") or 0.0)
+                for w in sorted(burn, key=lambda k: int(k.rstrip("s"))))
+            scope = obj.get("tenant") or "fleet"
+            thr = obj.get("threshold_ms")
+            lines.append(
+                "  %-12s %-9s %s target %.3f  attain %s  budget %s  "
+                "burn: %s"
+                % (obj.get("objective"), scope,
+                   ("thr %gms" % thr) if thr is not None else "",
+                   obj.get("target") or 0.0,
+                   _num(obj.get("attainment"), "%.4f"),
+                   _num(obj.get("budget_remaining"), "%.3f"),
+                   burn_s or "-"))
+    tok = agg.get("tokens") or z.get("tokens") or {}
+    if tok:
+        lines.append(
+            "tokens: submitted %s = goodput %s + slow %s + shed %s + "
+            "expired %s + failed %s   (replayed %s)"
+            % (tok.get("submitted", 0), tok.get("goodput", 0),
+               tok.get("slow", 0), tok.get("shed", 0),
+               tok.get("expired", 0), tok.get("failed", 0),
+               tok.get("replayed", 0)))
+    return "\n".join(lines)
+
+
+def render_once(url, timeout=5.0):
+    """Fetch + render one frame (the chaos drill's seam)."""
+    health, statusz, snap = fetch(url, timeout=timeout)
+    return render(health, statusz, snap, url=url)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", default="http://127.0.0.1:8080",
+                    help="serving front door base URL")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between frames")
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit (no screen control)")
+    ap.add_argument("--plain", action="store_true",
+                    help="never emit ANSI clear codes (log-friendly)")
+    args = ap.parse_args(argv)
+    try:
+        if args.once:
+            print(render_once(args.url))
+            return 0
+        while True:
+            frame = render_once(args.url)
+            if not args.plain and sys.stdout.isatty():
+                sys.stdout.write("\x1b[2J\x1b[H")
+            print(frame, flush=True)
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    except BrokenPipeError:      # `fleet_top ... | head` is fine
+        return 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,13 @@ randomly-initialized tiny transformer for smoke-testing the stack.
          -d '{"tokens": [3, 1, 4, 1, 5], "max_new_tokens": 16}'
     curl localhost:8080/v1/metrics                      # JSON snapshot
     curl -H 'Accept: text/plain' localhost:8080/metrics # Prometheus
+    curl localhost:8080/statusz    # SLO/goodput view (per-tenant
+                                   # ledger, burn rates; ISSUE 13)
+
+POST /v1/generate accepts a W3C `traceparent` header (malformed values
+degrade to a fresh trace id) and returns one, so a request is one
+connected trace across replicas and failover hops; watch the fleet
+live with `python tools/fleet_top.py --url http://host:port`.
 """
 import argparse
 import os
@@ -169,8 +176,17 @@ def main():
              "on" if first.scheduler.brownout else "off",
              (" respawn_max=%d" % srv.respawn_max)
              if isinstance(srv, serving.ReplicatedLMServer) else ""))
-    print("listening on http://%s:%d  (POST /v1/generate, GET /v1/metrics)"
-          % (args.host, args.port))
+    from mxnet_tpu import telemetry
+    slo_objs = [o.describe() for o in telemetry.parse_slo_env()]
+    if slo_objs:
+        print("slo: %d objective(s) armed — %s (burn on /statusz and "
+              "/metrics)"
+              % (len(slo_objs),
+                 ", ".join("%s%s" % (o["objective"],
+                                     "@" + o["tenant"] if o["tenant"]
+                                     else "") for o in slo_objs)))
+    print("listening on http://%s:%d  (POST /v1/generate, "
+          "GET /v1/metrics, GET /statusz)" % (args.host, args.port))
     srv.serve_http(host=args.host, port=args.port, block=True)
 
 
