@@ -268,6 +268,32 @@ def check_line(r):
             raise ValueError("exec_hbm_bytes without compile time — the "
                              "footprint can only come from a compile "
                              "event: %r" % (r,))
+    # training-observability fields (ISSUE 14): fractions are fractions,
+    # and the collective ledger can never exceed the executable traffic
+    # it is a subset of.
+    for field in ("data_wait_fraction", "comms_fraction_of_step"):
+        frac = r.get(field)
+        if frac is not None and (
+                not isinstance(frac, (int, float))
+                or isinstance(frac, bool) or not 0.0 <= frac <= 1.0):
+            raise ValueError("%s must be a fraction in [0, 1]: %r"
+                             % (field, r))
+    p95 = r.get("step_p95_ms")
+    if p95 is not None and (not isinstance(p95, (int, float))
+                            or isinstance(p95, bool) or p95 < 0
+                            or p95 != p95 or p95 == float("inf")):
+        raise ValueError("step_p95_ms must be a finite non-negative "
+                         "number of ms: %r" % (r,))
+    cb = r.get("comms_bytes_per_step")
+    if cb is not None:
+        if not isinstance(cb, int) or isinstance(cb, bool) or cb < 0:
+            raise ValueError("comms_bytes_per_step must be a "
+                             "non-negative byte count: %r" % (r,))
+        ba = r.get("step_bytes_accessed")
+        if ba is not None and cb > 1.001 * ba:
+            raise ValueError("comms_bytes_per_step %d exceeds the "
+                             "executable's total bytes accessed %d it "
+                             "is a subset of: %r" % (cb, ba, r))
     return r
 
 
@@ -1354,16 +1380,32 @@ def bench_resilience(smoke, dtype, device_kind):
     d = tempfile.mkdtemp(prefix="bench_resil_")
     try:
         mgr = CheckpointManager(d, keep=3)
+        # the batches flow through a real DataLoader + loop.batches()
+        # so the train_data_wait_seconds histogram is fed and the
+        # emitted data_wait_fraction (ISSUE 14) is a measurement, not a
+        # placeholder
+        from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+        xs = np.stack([batch_for(i)[0] for i in range(kill_at)]) \
+            .reshape(-1, hidden)
+        ys = np.concatenate([batch_for(i)[1] for i in range(kill_at)])
+        loader = DataLoader(ArrayDataset(xs, ys), batch_size=batch)
         # cadence saves OFF in the loop (save_every=0): the bench times
         # its own blocking saves below — a concurrent async save of the
         # same state would make every timed publish first drain it
-        loop = ResilientLoop(step, mgr, save_every=0,
+        # warm the compile BEFORE the loop exists: TrainStep.__call__
+        # records no train_step_seconds sample, so the first step's XLA
+        # compile (seconds vs ~ms steady steps) never lands in the
+        # histograms the step_p95_ms / data_wait_fraction fields read
+        from mxnet_tpu import telemetry as _telemetry
+        step(*batch_for(kill_at + 1))
+        loop = ResilientLoop(step, mgr, loader=loader, save_every=0,
                              policy="skip", watch_preemption=False,
-                             verbose=False)
+                             verbose=False, metrics_port=False)
         capture_s = []
         publish_s = []
+        batches = loop.batches()
         while loop.t < kill_at:          # train to the simulated kill
-            loop.step(*batch_for(loop.t))
+            loop.step(*next(batches))
             if loop.t % save_every == 0:
                 t0 = time.perf_counter()
                 state = loop.state_dict()      # device->host capture
@@ -1382,6 +1424,18 @@ def bench_resilience(smoke, dtype, device_kind):
                           for v in jax.tree.leaves(tree))
         single_npz = os.path.getsize(
             os.path.join(d, "ckpt-%d.npz" % mgr.latest_step()))
+
+        # ISSUE 14 step-tail / data-wait fields: read from the loop's
+        # OWN statusz (the live console computes them identically — one
+        # definition, bench and console can't diverge), snapshotted
+        # HERE because the sharded ZeRO-1 leg below runs loaderless
+        # steps (+ its own compile) that would dilute the fraction and
+        # hand the p95 to compile time
+        z = loop.statusz()
+        data_wait_fraction = (round(z["data_wait_fraction"], 4)
+                              if z["data_wait_fraction"] is not None
+                              else None)
+        step_p95_ms = z["step_p95_ms"]
 
         # -- sharded A/B (ISSUE 6): per-host sharded checkpoints of the
         # SAME state volume, N emulated hosts over a dp mesh with the
@@ -1408,7 +1462,8 @@ def bench_resilience(smoke, dtype, device_kind):
                               mesh=mesh, sharded_update=True, guard=True)
             loop2 = ResilientLoop(step2, CheckpointManager(
                 os.path.join(d, "throwaway")), save_every=0,
-                policy="skip", watch_preemption=False, verbose=False)
+                policy="skip", watch_preemption=False, verbose=False,
+                metrics_port=False)
             for i in range(3):
                 loop2.step(*batch_for(i))
             d2 = os.path.join(d, "sharded")
@@ -1446,6 +1501,18 @@ def bench_resilience(smoke, dtype, device_kind):
                 "zero1_sharded_update": True,
             }
 
+        # ISSUE 14 collective ledger: read AFTER the sharded leg so the
+        # latest train.step executable is the ZeRO-1 one when devices
+        # allowed it (else the single-device leg's honest 0)
+        comms = _telemetry.site_comms("train.step")
+        comms_bytes = comms_fraction = bytes_accessed = None
+        if comms is not None:
+            comms_bytes = int(comms["total_bytes"])
+            if comms.get("bytes_accessed"):
+                bytes_accessed = int(comms["bytes_accessed"])
+            if comms.get("fraction") is not None:
+                comms_fraction = round(comms["fraction"], 4)
+
         name = ("smoke_resilience_ckpt_publish_ms" if smoke
                 else "resilience_ckpt_publish_ms")
         return {"metric": name,
@@ -1457,6 +1524,11 @@ def bench_resilience(smoke, dtype, device_kind):
                 "save_every": save_every,
                 "steps_lost_per_preemption": steps_lost,
                 "bad_step_guard": True,
+                "data_wait_fraction": data_wait_fraction,
+                "step_p95_ms": step_p95_ms,
+                "comms_bytes_per_step": comms_bytes,
+                "comms_fraction_of_step": comms_fraction,
+                "step_bytes_accessed": bytes_accessed,
                 "sharded_ckpt": sharded,
                 "vs_baseline": None,
                 "baseline_note": "the reference has no in-tree recovery "
@@ -1466,7 +1538,11 @@ def bench_resilience(smoke, dtype, device_kind):
                                  "from PR 3 on; sharded_ckpt is the "
                                  "ISSUE 6 per-host A/B vs the "
                                  "single-writer baseline at equal state "
-                                 "size"}
+                                 "size; comms_bytes_per_step is the "
+                                 "latest train.step executable's "
+                                 "collective ledger (the ZeRO-1 "
+                                 "sharded leg when devices allow, else "
+                                 "the single-device leg's 0)"}
     finally:
         shutil.rmtree(d, ignore_errors=True)
 

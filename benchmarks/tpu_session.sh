@@ -92,7 +92,10 @@ echo "=== 2f. pod-scale resilience: sharded-ckpt A/B + multi-host chaos drill (I
 # drill runs on VIRTUAL CPU devices even during the TPU session (it
 # drills process death + shared-filesystem checkpoint semantics, not
 # chip kernels) — timeout-bounded so a wedged subprocess cannot stall
-# the session.
+# the session. Since ISSUE 14 the bench leg also emits the training-
+# observability fields (data_wait_fraction / step_p95_ms /
+# comms_bytes_per_step) and the drill carries the straggler/anomaly/
+# train_top gates unconditionally — step 2j verifies the fields landed.
 timeout -k 30 900 env BENCH_CONFIGS=resilience python bench.py \
   | tee BENCH_RESILIENCE_SHARDED.jsonl
 timeout -k 30 1200 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -144,6 +147,35 @@ timeout -k 30 1800 env BENCH_CONFIGS=serving_chaos python bench.py \
   | tee BENCH_SERVING_CHAOS.jsonl
 timeout -k 30 1800 python tools/chaos_serve.py \
   | tee CHAOS_SERVE_TPU.txt
+
+echo "=== 2j. training-fleet observability fields gate (ISSUE 14) ==="
+# The ISSUE 14 measurements ride legs that ALREADY ran: step 2f's
+# resilience bench emits data_wait_fraction / step_p95_ms /
+# comms_bytes_per_step + comms_fraction_of_step (check_line-enforced:
+# fractions in [0,1], comms <= step_bytes_accessed), and 2f's
+# multi-host drill asserts the straggler/anomaly/train_top gates
+# unconditionally (slow-host fault -> exactly that host flagged in the
+# black boxes, postmortem skew table, and a rendered train_top frame).
+# This step only verifies the fields actually landed in the fresh
+# artifact — no duplicate training legs; the sentinel judges their
+# LEVELS warn-only at step 8. Predictions: BENCH_NOTES.md round 14.
+python - <<'PYEOF'
+import json
+line = None
+for l in open("BENCH_RESILIENCE_SHARDED.jsonl"):
+    try:
+        r = json.loads(l)
+    except ValueError:
+        continue
+    if str(r.get("metric", "")).endswith("resilience_ckpt_publish_ms"):
+        line = r
+fields = ("data_wait_fraction", "step_p95_ms", "comms_bytes_per_step",
+          "comms_fraction_of_step")
+missing = [f for f in fields if line is None or f not in line]
+assert not missing, ("ISSUE 14 fields missing from the resilience "
+                     "line: %s" % missing)
+print("2j OK:", {f: line[f] for f in fields})
+PYEOF
 
 echo "=== 3. flash attention seq sweep (1024/2048/4096) ==="
 BENCH_CONFIGS=transformer_flash BENCH_FLASH_SEQ=1024,2048,4096,8192 \

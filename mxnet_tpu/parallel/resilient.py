@@ -36,9 +36,30 @@ owns the whole lifecycle around `TrainStep` + `CheckpointManager`:
       - ``off``       no guard compiled, zero overhead.
 
   * **Chaos integration** — every step boundary consults
-    `utils.chaos` (SIGTERM delivery, NaN grad poison), so the whole
-    lifecycle is drillable in-process and in subprocess tests without
-    touching production code paths.
+    `utils.chaos` (SIGTERM delivery, NaN grad poison, slow-host sleep),
+    so the whole lifecycle is drillable in-process and in subprocess
+    tests without touching production code paths.
+
+  * **Straggler detection** (ISSUE 14) — `MXNET_STRAGGLER_WINDOW=k`
+    closes a skew window every k steps: each host's mean step time is
+    allgathered (the `process_allgather` seam under real multi-process
+    jax; a shared-directory exchange under the emulated pod,
+    `MXNET_STRAGGLER_DIR`), max/median skew lands on gauges, and a host
+    exceeding `MXNET_STRAGGLER_FACTOR`x the pod median for
+    `MXNET_STRAGGLER_PATIENCE` consecutive windows is flight-flagged by
+    name (`train.straggler`) — off the hot path: one gather per window,
+    never per step.
+
+  * **Anomaly detection** (ISSUE 14) — `MXNET_ANOMALY_DETECT=1` scores
+    each step's loss and grad norm with EWMA z-scores
+    (telemetry/anomaly.py): the finite-but-wrong complement to the
+    bad-step guard's NaN/Inf check, sharing its step seam.
+
+  * **Live train console** (ISSUE 14) — `MXNET_TRAIN_METRICS_PORT`
+    starts a stdlib HTTP endpoint (`/metrics` Prometheus+JSON,
+    `/statusz` step-time percentiles / tok/s / data-wait fraction /
+    checkpoint age / skew table / anomaly count, `/healthz` liveness)
+    on a daemon thread; `tools/train_top.py` renders it live.
 
 Usage (the resilient-training quickstart):
 
@@ -59,6 +80,7 @@ from __future__ import annotations
 import json
 import os
 import signal
+import statistics
 import threading
 import time
 import warnings
@@ -177,6 +199,244 @@ class PreemptionWatcher:
         self._on_signal(None, None)
 
 
+def straggler_window_env():
+    """MXNET_STRAGGLER_WINDOW — steps per skew window (0/unset = off)."""
+    raw = os.environ.get("MXNET_STRAGGLER_WINDOW", "0") or "0"
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError("MXNET_STRAGGLER_WINDOW must be an integer "
+                         "step count, got %r" % (raw,))
+
+
+def straggler_factor():
+    """MXNET_STRAGGLER_FACTOR — flag threshold as a multiple of the pod
+    median step time (default 2.0; must be > 1)."""
+    raw = os.environ.get("MXNET_STRAGGLER_FACTOR", "2.0") or "2.0"
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError("MXNET_STRAGGLER_FACTOR must be a number > 1, "
+                         "got %r" % (raw,))
+    if v <= 1.0:
+        raise ValueError("MXNET_STRAGGLER_FACTOR must be > 1 (a host "
+                         "at the median is not a straggler), got %r"
+                         % (raw,))
+    return v
+
+
+def straggler_patience():
+    """MXNET_STRAGGLER_PATIENCE — consecutive over-factor windows before
+    a host is flagged (default 2)."""
+    raw = os.environ.get("MXNET_STRAGGLER_PATIENCE", "2") or "2"
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        raise ValueError("MXNET_STRAGGLER_PATIENCE must be an integer "
+                         "window count, got %r" % (raw,))
+
+
+class _FileTimeExchange:
+    """Shared-directory step-time exchange for EMULATED pods
+    (MXNET_STRAGGLER_DIR): each host publishes its window mean with an
+    atomic rename and reads whatever its peers last published. Real
+    multi-process jax uses `process_allgather` instead; the emulated
+    drill's hosts are separate single-process runtimes that only share
+    a filesystem — the same medium their sharded checkpoints use."""
+
+    def __init__(self, dirpath, host, max_age_s=300.0):
+        self.dir = dirpath
+        self.host = str(host)
+        self.max_age_s = float(max_age_s)
+        safe = "".join(c if c.isalnum() or c in "-_" else "_"
+                       for c in self.host)
+        self._path = os.path.join(dirpath, "steptime-host%s.json" % safe)
+
+    def __call__(self, mean_s):
+        now = time.time()
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            tmp = self._path + ".tmp%d" % os.getpid()
+            with open(tmp, "w") as f:
+                json.dump({"host": self.host, "mean_s": float(mean_s),
+                           "t": now}, f)
+            os.replace(tmp, self._path)
+        except OSError:
+            pass                      # a missed publish skews one window
+        out = {self.host: float(mean_s)}
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return out
+        for name in names:
+            if not (name.startswith("steptime-host")
+                    and name.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(self.dir, name)) as f:
+                    doc = json.load(f)
+                # expire stale publishes: a dead host's frozen mean (or
+                # a previous run's leftovers in a reused directory)
+                # must not skew every future window's median
+                if now - float(doc.get("t", 0.0)) > self.max_age_s:
+                    continue
+                out[str(doc["host"])] = float(doc["mean_s"])
+            except (OSError, ValueError, KeyError, TypeError):
+                continue              # torn peer write: skip this window
+        return out
+
+
+def _default_time_gather():
+    """The per-window step-time gather: `process_allgather` under real
+    multi-process jax (the restore() rail's seam), the shared-directory
+    exchange when MXNET_STRAGGLER_DIR names one (the emulated pod), and
+    a local-only view otherwise (skew degenerates to 1.0)."""
+    sdir = os.environ.get("MXNET_STRAGGLER_DIR")
+    host = telemetry.metrics._host_label()
+    if sdir:
+        return _FileTimeExchange(sdir, host)
+    try:
+        import jax
+        nproc = jax.process_count()
+    except Exception:
+        nproc = 1
+    if nproc > 1:
+        def gather(mean_s):
+            from jax.experimental import multihost_utils
+            # carry each host's MXNET_HOST_ID label (fixed-width bytes)
+            # alongside its time, so the skew table / flight events key
+            # hosts the same way every other instrument does — not by
+            # bare process index
+            lab = np.zeros(32, np.uint8)
+            raw = str(host).encode()[:32]
+            lab[:len(raw)] = np.frombuffer(raw, np.uint8)
+            times, labels = multihost_utils.process_allgather(
+                (np.float64(mean_s), lab))
+            times = np.ravel(np.asarray(times))
+            labels = np.asarray(labels).reshape(len(times), -1)
+            out = {}
+            for i, t in enumerate(times):
+                name = bytes(labels[i]).rstrip(b"\x00") \
+                    .decode("utf-8", "replace")
+                out[name or str(i)] = float(t)
+            return out
+        return gather
+    return lambda mean_s: {host: float(mean_s)}
+
+
+class StragglerMonitor:
+    """Windowed per-host step-time skew detection (ISSUE 14).
+
+    `observe(step, seconds)` accumulates this host's step wall times;
+    every `window` steps the window MEAN is exchanged with the pod
+    (`gather`: host -> mean seconds), max/median skew lands on gauges
+    (`train_step_skew`, `train_step_window_median_s`,
+    `train_step_window_max_s`), and a host whose mean exceeds
+    `factor` x the pod median for `patience` CONSECUTIVE windows is
+    flagged once per episode: `train_stragglers_total` counter (flight-
+    mirrored) plus an explicit `train.straggler` flight event naming
+    the host — what the multi-host chaos drill asserts survives in the
+    black boxes. The gather runs once per window, never per step."""
+
+    def __init__(self, window, factor=None, patience=None, gather=None,
+                 registry=None):
+        self.window = int(window)
+        self.factor = straggler_factor() if factor is None \
+            else float(factor)
+        self.patience = straggler_patience() if patience is None \
+            else int(patience)
+        self._gather = gather or _default_time_gather()
+        self._registry = registry
+        self._times = []
+        self.windows = 0              # closed windows
+        self._consec = {}             # host -> consecutive slow windows
+        self._episode = set()         # hosts flagged in the open episode
+        self.flagged = {}             # host -> times flagged (lifetime)
+        self.last_window = None       # host -> mean seconds
+        self.last_skew = None
+
+    def _reg(self):
+        return self._registry or telemetry.default_registry()
+
+    def observe(self, step, seconds):
+        """One step's wall time; closes the window on cadence. Returns
+        the list of hosts newly flagged at this boundary (usually [])."""
+        self._times.append(float(seconds))
+        if len(self._times) < self.window:
+            return []
+        mean = sum(self._times) / len(self._times)
+        del self._times[:]
+        return self._close_window(step, mean)
+
+    def _close_window(self, step, mean_s):
+        times = self._gather(mean_s)
+        self.windows += 1
+        self.last_window = dict(times)
+        if not times:
+            return []
+        median = statistics.median(times.values())
+        mx = max(times.values())
+        skew = (mx / median) if median > 0 else 1.0
+        self.last_skew = skew
+        reg = self._reg()
+        reg.gauge("train_step_skew",
+                  help="max/median of per-host mean step time, last "
+                       "skew window").set(skew)
+        reg.gauge("train_step_window_median_s",
+                  help="pod-median mean step seconds, last skew window"
+                  ).set(median)
+        reg.gauge("train_step_window_max_s",
+                  help="slowest host's mean step seconds, last skew "
+                       "window").set(mx)
+        newly = []
+        # a host absent from this window's gather (expired publish,
+        # dead peer) breaks its "consecutive" chain and closes its
+        # episode — otherwise two non-adjacent slow windows could
+        # satisfy the patience contract, and a returning host could
+        # never record a fresh episode onset
+        for host in [h for h in self._consec if h not in times]:
+            del self._consec[host]
+            self._episode.discard(host)
+        for host, t in sorted(times.items()):
+            slow = median > 0 and t > self.factor * median
+            if not slow:
+                self._consec[host] = 0
+                self._episode.discard(host)
+                continue
+            self._consec[host] = self._consec.get(host, 0) + 1
+            if self._consec[host] < self.patience \
+                    or host in self._episode:
+                continue
+            # flag once per slow episode: the host stays listed in
+            # statusz while slow, but the flight record marks the onset
+            self._episode.add(host)
+            # copy-on-write: `flagged` is read by the console's HTTP
+            # thread (statusz) — swap the dict atomically
+            self.flagged = dict(self.flagged,
+                                **{host: self.flagged.get(host, 0) + 1})
+            newly.append(host)
+            ratio = t / median if median > 0 else float("inf")
+            reg.counter(
+                "train_stragglers_total", flight=True,
+                help="hosts flagged over MXNET_STRAGGLER_FACTOR x the "
+                     "pod-median step time for MXNET_STRAGGLER_PATIENCE "
+                     "consecutive windows"
+            ).inc(host=host, ratio=round(ratio, 3))
+            telemetry.flight().record(
+                "event", "train.straggler", host=host,
+                mean_s=round(t, 6), median_s=round(median, 6),
+                ratio=round(ratio, 3), window=self.windows, step=step)
+        return newly
+
+    def status(self):
+        """The /statusz skew table."""
+        return {"window_steps": self.window, "factor": self.factor,
+                "patience": self.patience, "windows": self.windows,
+                "skew": self.last_skew,
+                "hosts": self.last_window,
+                "flagged": dict(self.flagged)}
+
+
 class ResilientLoop:
     """Drive a `TrainStep` through the full fault lifecycle.
 
@@ -214,12 +474,28 @@ class ResilientLoop:
         global/dp): 'rescale' proceeds under that documented contract
         (with a warning), 'raise' refuses the silently-lossy resume.
         Default from MXNET_ELASTIC_DP_POLICY.
+    straggler_window : int, optional
+        Steps per straggler-skew window (default from
+        MXNET_STRAGGLER_WINDOW; 0 = off). See `StragglerMonitor`.
+    anomaly : bool, optional
+        EWMA z-score anomaly detection on loss/grad-norm (default from
+        MXNET_ANOMALY_DETECT; off — it syncs the loss to the host every
+        step). See `telemetry/anomaly.py`.
+    metrics_port : int or False, optional
+        Start the live train console (stdlib HTTP `/metrics` +
+        `/statusz` + `/healthz`) on this port; 0 binds an ephemeral
+        port (`console_addr` holds the result). Default from
+        MXNET_TRAIN_METRICS_PORT; unset = no console. Pass ``False``
+        to suppress the console REGARDLESS of the env var — the opt-out
+        for secondary loops in one process (a fixed env port can only
+        be bound once).
     """
 
     def __init__(self, step, manager, loader=None, save_every=100,
                  policy=None, rollback_after=3, lr_shrink=1.0,
                  epochs=1, watch_preemption=True, grace_secs=None,
-                 elastic_dp=None, verbose=True):
+                 elastic_dp=None, verbose=True, straggler_window=None,
+                 anomaly=None, metrics_port=None):
         if policy is None:
             policy = os.environ.get("MXNET_BAD_STEP_POLICY", "off") or "off"
         policy = policy.lower()
@@ -289,6 +565,31 @@ class ResilientLoop:
         self._epoch = 0   # epochs batches() has fully consumed
         self._iter_invalid = False  # set by rollback: re-enter the loader
         self._base_lr_fn = None
+        self._last_save = None        # (step, wall time) of last save()
+        # -- ISSUE 14 observability layer (all opt-in) ---------------------
+        if straggler_window is None:
+            straggler_window = straggler_window_env()
+        self._straggler = StragglerMonitor(straggler_window) \
+            if straggler_window and straggler_window > 0 else None
+        from ..telemetry import anomaly as _anomaly_mod
+        if anomaly is None:
+            anomaly = _anomaly_mod.detect_enabled()
+        self._anomaly = _anomaly_mod.AnomalyDetector() if anomaly \
+            else None
+        self.console_addr = None
+        self._console = None
+        if metrics_port is None:
+            raw = os.environ.get("MXNET_TRAIN_METRICS_PORT")
+            if raw not in (None, ""):
+                try:
+                    metrics_port = int(raw)
+                except ValueError:
+                    raise ValueError("MXNET_TRAIN_METRICS_PORT must be "
+                                     "an integer port, got %r" % (raw,))
+        # identity check: False means "no console even if the env names
+        # a port" (False == 0 would otherwise read as "ephemeral")
+        if metrics_port is not None and metrics_port is not False:
+            self.serve_metrics(port=int(metrics_port))
         self.watcher = None
         if watch_preemption:
             self.watcher = PreemptionWatcher(grace_secs=grace_secs)
@@ -432,6 +733,7 @@ class ResilientLoop:
                             step=self._step.t, block=block):
             self._manager.save(self._step.t, self.state_dict(device=True),
                                block=block)
+        self._last_save = (self._step.t, time.time())
 
     # -- the lifecycle ------------------------------------------------------
     @property
@@ -457,6 +759,10 @@ class ResilientLoop:
                                 step=self._step.t + 1):
                 loss = self._step(x, y)
             t = self._step.t
+            # the slow-host chaos sleep lands INSIDE the timed step so
+            # the straggler monitor (and train_step_seconds) see it —
+            # that is what makes the injected straggler detectable
+            _chaos.maybe_slow_host(t)
             ok = True
             if self.policy != "off":
                 ok = bool(np.asarray(self._step.last_step_ok))
@@ -473,9 +779,20 @@ class ResilientLoop:
                     # token-id matrices (N, T) / time-major (T, N): the
                     # element count is the token count either way
                     self._m_tokens.set(shape[0] * shape[1] / dt)
+            gnorm_val = None
             if self.policy != "off":
-                self._m_gnorm.set(
-                    float(np.asarray(self._step.last_grad_norm)))
+                gnorm_val = float(np.asarray(self._step.last_grad_norm))
+                self._m_gnorm.set(gnorm_val)
+            # ISSUE 14 detectors, gated like every recording site: under
+            # MXNET_TELEMETRY=0 neither the per-window gather nor the
+            # loss sync runs (the seams are no-ops)
+            if telemetry.enabled():
+                if self._straggler is not None:
+                    self._straggler.observe(t, dt)
+                if self._anomaly is not None:
+                    self._anomaly.observe(
+                        t, loss=float(np.asarray(loss)),
+                        grad_norm=gnorm_val)
             # cadence save only on GOOD steps: after a bad step (or a
             # rollback) the state no longer corresponds to `t`, and a
             # checkpoint labeled with the wrong step poisons every later
@@ -608,3 +925,126 @@ class ResilientLoop:
         self._manager.wait()
         if self.watcher is not None:
             self.watcher.uninstall()
+        self.close_console()
+
+    # -- live train console (ISSUE 14) --------------------------------------
+    def statusz(self):
+        """The `/statusz` body: the one-look training health view —
+        step-time percentiles, throughput, data-wait fraction,
+        checkpoint age/bytes, the straggler skew table, anomaly counts,
+        and the train.step comms ledger. Everything derives from the
+        default registry and in-process state; no device work."""
+        from ..telemetry import introspect as _introspect
+        reg = telemetry.default_registry()
+        snap = reg.snapshot()["metrics"]
+
+        def hist(name):
+            h = snap.get(name) or {}
+            if not h.get("count"):
+                return None
+            return {"count": h["count"], "mean": h.get("mean"),
+                    "p50": h.get("p50"), "p95": h.get("p95"),
+                    "p99": h.get("p99")}
+
+        def gauge(name):
+            m = snap.get(name)
+            return m.get("value") if m else None
+
+        step_h = snap.get("train_step_seconds") or {}
+        wait_h = snap.get("train_data_wait_seconds") or {}
+        busy = float(step_h.get("sum") or 0.0)
+        waited = float(wait_h.get("sum") or 0.0)
+        wait_fraction = waited / (waited + busy) \
+            if (waited + busy) > 0 else None
+        step_p95 = (step_h.get("p95") if step_h.get("count") else None)
+        ckpt = {"last_step": None, "age_s": None,
+                "bytes_per_host": gauge("checkpoint_bytes_per_host")}
+        if self._last_save is not None:
+            ckpt["last_step"] = self._last_save[0]
+            ckpt["age_s"] = round(time.time() - self._last_save[1], 3)
+        comms = _introspect.site_comms("train.step")
+        return {
+            "host": reg.labels().get("host"),
+            "step": self.t,
+            "epoch": self._epoch,
+            "preempted": self.preempted,
+            "step_seconds": hist("train_step_seconds"),
+            "step_p95_ms": (round(step_p95 * 1e3, 3)
+                            if step_p95 is not None else None),
+            "samples_per_sec": gauge("train_samples_per_sec"),
+            "tokens_per_sec": gauge("train_tokens_per_sec"),
+            "data_wait_fraction": wait_fraction,
+            "grad_norm": gauge("train_grad_norm"),
+            "bad_steps": self.bad_steps,
+            "rollbacks": self.rollbacks,
+            "checkpoint": ckpt,
+            "straggler": (self._straggler.status()
+                          if self._straggler is not None else None),
+            "anomalies": ({"count": self._anomaly.anomalies,
+                           "last": {k: {"value": v[0], "z": v[1]}
+                                    for k, v in
+                                    self._anomaly.last.items()}}
+                          if self._anomaly is not None else None),
+            "comms": comms,
+        }
+
+    def serve_metrics(self, port=0, host=None):
+        """Start the opt-in train console: a stdlib HTTP daemon thread
+        serving `/metrics` (Prometheus under `Accept: text/plain`, JSON
+        snapshot otherwise), `/statusz`, and `/healthz` — the same
+        `_HTTPFrontend` the serving stack's doors share, read-only.
+        Binds MXNET_TRAIN_METRICS_HOST (default 127.0.0.1 — exposing
+        the console beyond the host is an explicit choice; a pod polled
+        cross-host by `train_top --hosts` needs 0.0.0.0 or the fabric
+        address). Returns the bound (host, port), also kept on
+        `console_addr`."""
+        if host is None:
+            host = os.environ.get("MXNET_TRAIN_METRICS_HOST",
+                                  "127.0.0.1") or "127.0.0.1"
+        if self._console is not None:
+            return self.console_addr
+        from ..serving.server import _HTTPFrontend
+        loop = self
+
+        class _TrainConsole(_HTTPFrontend):
+            def submit(self, *a, **k):
+                raise MXNetError("the train console is read-only "
+                                 "(GET /metrics, /statusz, /healthz)")
+
+            def snapshot(self):
+                return telemetry.default_registry().snapshot()
+
+            def prometheus_text(self):
+                return telemetry.default_registry().prometheus_text()
+
+            def health(self):
+                # reachable = the process is alive; the console runs on
+                # a daemon thread, so it dies with the training process
+                return {"ok": True, "step": loop.t,
+                        "host": telemetry.default_registry()
+                        .labels().get("host"),
+                        "preempted": loop.preempted}
+
+            def statusz(self):
+                return loop.statusz()
+
+            def close(self):
+                if self._httpd is not None:
+                    self._httpd.shutdown()
+                    self._httpd.server_close()
+                    self._httpd = None
+
+        self._console = _TrainConsole()
+        self.console_addr = self._console.serve_http(host=host,
+                                                     port=port,
+                                                     block=False)
+        if self.verbose:
+            print("[resilient] train console on http://%s:%d "
+                  "(/metrics /statusz /healthz)" % self.console_addr,
+                  flush=True)
+        return self.console_addr
+
+    def close_console(self):
+        if self._console is not None:
+            self._console.close()
+            self._console = None

@@ -24,6 +24,7 @@ from . import tracing
 from . import flight as _flight_mod
 from . import introspect
 from . import slo
+from . import anomaly
 
 from .metrics import (enabled, MetricsRegistry, default_registry,
                       DEFAULT_BUCKETS, merged_prometheus_text)
@@ -32,10 +33,11 @@ from .tracing import (span, record_span, current_trace, set_trace,
                       parse_traceparent, format_traceparent)
 from .flight import FlightRecorder, flight
 from .introspect import (watchdog, instrument, compile_events,
-                         compile_region, CompileBudgetExceeded,
-                         HbmBudgetExceeded)
+                         compile_region, site_comms,
+                         CompileBudgetExceeded, HbmBudgetExceeded)
 from .slo import (Objective, SLOTracker, parse_slo_env, parse_windows,
                   merge_slo, request_log, request_event)
+from .anomaly import EwmaDetector, AnomalyDetector
 
 
 def counter(name, help="", flight=False):

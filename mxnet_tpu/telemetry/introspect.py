@@ -29,6 +29,19 @@ inside jax's dispatch:
     `exec_<site>_{argument,output,temp,code,hbm}_bytes` and
     `exec_<site>_flops` — exported through the Prometheus exposition
     and every flight dump.
+  * **Collective-comms ledger** (ISSUE 14): the compiled HLO is walked
+    once per compile and every collective instruction (all-reduce,
+    reduce-scatter, all-gather, all-to-all, collective-permute) is
+    attributed to its site as per-kind byte/op gauges —
+    `comms_<site>_<kind>_bytes` / `comms_<site>_<kind>_ops` — plus a
+    derived `comms_<site>_fraction` (collective payload over the
+    executable's total `bytes accessed`). Bytes are the per-device
+    LOGICAL payload of each instruction, max(operand, result) — a
+    reduce-scatter counts its full input, an all-gather its full
+    output, so the ZeRO-1 train step's reduce-scatter/all-gather both
+    read ≈ param bytes (the analytic pin in
+    tests/test_train_observability.py) — not the ring-wire traffic
+    (which is topology-dependent: 2(N−1)/N× for a ring all-reduce).
   * **Budgets**: `MXNET_COMPILE_BUDGET=<n>[:warn|:raise]` turns the
     (n+1)-th compile at any one site into a warning or a raise — a
     recompile storm fails loudly instead of silently eating throughput.
@@ -47,6 +60,7 @@ from __future__ import annotations
 import contextlib
 import functools
 import os
+import re
 import threading
 import time
 import warnings
@@ -82,6 +96,9 @@ EXEC_TEMP_BYTES = "exec_%s_temp_bytes"
 EXEC_CODE_BYTES = "exec_%s_code_bytes"
 EXEC_HBM_BYTES = "exec_%s_hbm_bytes"
 EXEC_FLOPS = "exec_%s_flops"
+COMMS_BYTES = "comms_%s_%s_bytes"
+COMMS_OPS = "comms_%s_%s_ops"
+COMMS_FRACTION = "comms_%s_fraction"
 
 #: compile-seconds histogram buckets: traces take ms, XLA compiles of a
 #: fused train step take seconds to minutes
@@ -278,12 +295,13 @@ class CompileSite:
         self.signatures = {}          # sig -> first-seen event seq
         self.compiles = 0             # process-wide compiles at this site
         self.duplicates = 0           # same-sig recompiles (cold caches)
+        self.comms = None             # latest executable's comms ledger
 
 
 def _analyses(compiled):
-    """(memory dict, flops) from a compiled executable — the
-    version-portable seam: every accessor is optional and a missing or
-    failing one degrades to None, never to an exception (older jax /
+    """(memory dict, flops, bytes accessed) from a compiled executable —
+    the version-portable seam: every accessor is optional and a missing
+    or failing one degrades to None, never to an exception (older jax /
     backends without CompiledMemoryStats)."""
     memory = None
     try:
@@ -306,14 +324,111 @@ def _analyses(compiled):
     except Exception:
         memory = None
     flops = None
+    bytes_accessed = None
     try:
         cost = compiled.cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0] if cost else {}
         flops = float(cost.get("flops", 0.0)) or None
+        bytes_accessed = float(cost.get("bytes accessed", 0.0)) or None
     except Exception:
         flops = None
-    return memory, flops
+    return memory, flops, bytes_accessed
+
+
+# ---------------------------------------------------------------------------
+# collective-comms ledger: bytes per collective kind, read off the HLO
+# ---------------------------------------------------------------------------
+
+#: the collective opcodes the ledger attributes (gauge-name kinds are the
+#: underscored forms: all_reduce, reduce_scatter, ...)
+COLLECTIVE_KINDS = ("all_reduce", "reduce_scatter", "all_gather",
+                    "all_to_all", "collective_permute")
+
+_DTYPE_BYTES = {"pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+                "f8e4m3fn": 1, "f8e5m2": 1, "s16": 2, "u16": 2,
+                "f16": 2, "bf16": 2, "s32": 4, "u32": 4, "f32": 4,
+                "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16}
+
+_SHAPE_RE = re.compile(r"\b([a-z][a-z0-9]*)\[([0-9,]*)\]")
+#: one collective instruction: `%name = RESULT opcode(OPERANDS...`,
+#: where RESULT is a shape or a tuple of shapes. `-start` matches the
+#: async forms; the paired `-done` (which would double-count) does not.
+_COLLECTIVE_RE = re.compile(
+    r"=\s+(\([^)]*\)|\S+)\s+"
+    r"(all-reduce|reduce-scatter|all-gather|all-to-all|"
+    r"collective-permute)((?:-start)?)\(([^)]*)")
+
+
+def _shape_bytes(text):
+    """Summed byte size of every `dtype[dims]` shape token in `text`."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(text):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES.get(dtype, 4)
+    return total
+
+
+def comms_from_hlo(hlo_text):
+    """{kind: {"bytes": b, "ops": n}} over the collective instructions
+    of one (per-device SPMD) HLO module. Bytes are the instruction's
+    logical payload — max(summed operand shapes, summed result shapes)
+    — so a reduce-scatter counts its full input and an all-gather its
+    full output: exactly the hand-computable ZeRO-1 sizes (≈ param
+    bytes each), independent of which side the partitioner sharded.
+
+    Known limit: this is a STATIC walk — a collective inside a
+    while/scan body (e.g. ring attention's per-ring-step ppermute)
+    counts once, not once per iteration, so the ledger is a lower
+    bound for loop-heavy programs (trip counts are not recoverable
+    from HLO text in general; docs/OBSERVABILITY.md discloses this)."""
+    kinds = {}
+    for result, opcode, started, operands in \
+            _COLLECTIVE_RE.findall(hlo_text):
+        in_bytes = _shape_bytes(operands)
+        out_bytes = _shape_bytes(result)
+        if started:
+            # async form: the result tuple is (aliased input, real
+            # output[, contexts]) — max(in, raw out) would double-count
+            # the alias, while the bare operand undercounts an
+            # all-gather-start (whose operand is the 1/N shard). The
+            # real output side is result minus the aliased input.
+            payload = max(in_bytes, out_bytes - in_bytes)
+        else:
+            payload = max(in_bytes, out_bytes)
+        k = kinds.setdefault(opcode.replace("-", "_"),
+                             {"bytes": 0, "ops": 0})
+        k["bytes"] += payload
+        k["ops"] += 1
+    return kinds
+
+
+def comms_ledger(compiled, bytes_accessed=None):
+    """The per-executable collective ledger dict the watchdog records:
+    {"kinds": {...}, "total_bytes", "bytes_accessed", "fraction"}.
+    Returns None when the executable exposes no HLO text (an exported
+    artifact observed `owned=False` never reaches here)."""
+    try:
+        txt = compiled.as_text()
+    except Exception:
+        return None
+    if not txt:
+        return None
+    kinds = comms_from_hlo(txt)
+    total = sum(k["bytes"] for k in kinds.values())
+    fraction = None
+    if bytes_accessed:
+        # comms fraction of the step: collective payload over the
+        # executable's total traffic ("bytes accessed", same per-device
+        # cost model) — the at-a-glance "is this step collective-bound"
+        # gauge. Payload is max(in, out) <= in + out, so it can't
+        # exceed the traffic that contains it.
+        fraction = total / float(bytes_accessed)
+    return {"kinds": kinds, "total_bytes": total,
+            "bytes_accessed": bytes_accessed, "fraction": fraction}
 
 
 class Watchdog:
@@ -365,7 +480,7 @@ class Watchdog:
 
     # -- recording ----------------------------------------------------------
     def record(self, site, sig, reason, seconds, phase=None, memory=None,
-               flops=None, duplicate=False, start_us=None):
+               flops=None, duplicate=False, start_us=None, comms=None):
         """Record one compile event (the seam `InstrumentedJit` and
         `compile_region` report through). Returns the event dict."""
         with self._lock:
@@ -377,6 +492,8 @@ class Watchdog:
                 site.compiles += 1
                 if sig is not None:
                     site.signatures.setdefault(sig, seq)
+            if comms is not None:
+                site.comms = comms
             self.total_seconds += seconds
             ev = {"seq": seq, "site": site.name, "reason": reason,
                   "seconds": seconds, "phase": phase,
@@ -386,6 +503,8 @@ class Watchdog:
                 ev["hbm_bytes"] = memory.get("hbm_bytes")
             if flops:
                 ev["flops"] = flops
+            if comms is not None:
+                ev["comms"] = comms
             self._events.append(ev)
         if enabled():
             reg = self.registry()
@@ -425,6 +544,32 @@ class Watchdog:
                 reg.gauge(EXEC_FLOPS % site.sane,
                           help="declared flops, latest executable"
                           ).set(flops)
+            if comms is not None:
+                for kind, k in comms["kinds"].items():
+                    reg.gauge(COMMS_BYTES % (site.sane, kind),
+                              help="per-device %s payload bytes per "
+                                   "step, latest executable"
+                              % kind.replace("_", "-")).set(k["bytes"])
+                    reg.gauge(COMMS_OPS % (site.sane, kind),
+                              help="%s instructions in the latest "
+                                   "executable"
+                              % kind.replace("_", "-")).set(k["ops"])
+                # the gauges claim "latest executable": a recompile
+                # whose lowering DROPPED a kind must zero that kind's
+                # existing gauges, not leave them advertising
+                # collectives the running program no longer contains
+                for kind in COLLECTIVE_KINDS:
+                    if kind in comms["kinds"]:
+                        continue
+                    for tmpl in (COMMS_BYTES, COMMS_OPS):
+                        name = tmpl % (site.sane, kind)
+                        if name in reg._metrics:
+                            reg.gauge(name).set(0)
+                if comms["fraction"] is not None:
+                    reg.gauge(COMMS_FRACTION % site.sane,
+                              help="collective payload / total bytes "
+                                   "accessed, latest executable"
+                              ).set(comms["fraction"])
             if start_us is None:
                 start_us = time.perf_counter_ns() // 1000 \
                     - int(seconds * 1e6)
@@ -656,10 +801,14 @@ class InstrumentedJit:
         t0 = time.perf_counter()
         compiled = self._jitted.lower(*args).compile()
         seconds = time.perf_counter() - t0
-        memory, flops = _analyses(compiled)
+        memory, flops, bytes_accessed = _analyses(compiled)
+        # the ledger walk is pure telemetry (an HLO-text pass per
+        # compile); under MXNET_TELEMETRY=0 it never runs
+        comms = comms_ledger(compiled, bytes_accessed) if enabled() \
+            else None
         wd.record(site, sig, reason, seconds, phase=phase,
                   memory=memory, flops=flops, duplicate=duplicate,
-                  start_us=t0_us)
+                  start_us=t0_us, comms=comms)
         self._record_instance_compile(phase)
         try:
             # pre-flight: refuse (or warn about) an over-budget
@@ -730,3 +879,12 @@ def compile_region(site, phase=None, **attrs):
 def compile_events(site=None):
     """Recorded compile events, oldest first (`site=` filters)."""
     return watchdog().events(site)
+
+
+def site_comms(site):
+    """The latest compiled executable's collective-comms ledger at a
+    site — {"kinds": {kind: {"bytes", "ops"}}, "total_bytes",
+    "bytes_accessed", "fraction"} — or None before the first compile
+    there (or under MXNET_TELEMETRY=0, where the HLO walk never runs)."""
+    s = watchdog().sites().get(site)
+    return s.comms if s is not None else None

@@ -30,8 +30,10 @@ import time
 import uuid
 from collections import deque
 
+import zlib
+
 from .. import profiler
-from .metrics import enabled, default_registry
+from .metrics import enabled, default_registry, _host_label
 
 _ids = itertools.count(1)
 _tls = threading.local()
@@ -249,6 +251,23 @@ def clear():
     _occupancy_last = -1
 
 
+def host_pid(host, pid):
+    """Fold a host label into the numeric pid a Perfetto row keys on.
+    Traces merged across a pod's hosts (tools/postmortem.py --perfetto)
+    can carry the SAME OS pid on different hosts (containers all start
+    at pid 1), which would silently merge their rows; folding the host
+    into the high digits keeps every host's rows distinct while the low
+    digits stay the recognizable OS pid."""
+    try:
+        h = int(host)
+    except (TypeError, ValueError):
+        h = zlib.crc32(str(host).encode())
+    # 1e9 host slots: numeric pod indices never wrap, and crc32 string
+    # labels collide only at ~1/1e9 per pair (the residual window is
+    # disclosed here; pids stay well inside exact-int JSON range)
+    return (h % 1_000_000_000) * 1_000_000 + int(pid) % 1_000_000
+
+
 def export_perfetto(path=None):
     """Write the span ring as Perfetto-compatible chrome-trace JSON.
 
@@ -256,31 +275,45 @@ def export_perfetto(path=None):
     named by a thread_name metadata event), so loading the file in
     Perfetto/chrome://tracing shows one request's whole life — queue,
     prefill chunks, decode steps — as a single connected row; untraced
-    spans keep their real thread id. Returns the trace dict (and writes
-    it to `path` when given)."""
+    spans keep their real thread id. The process row folds
+    MXNET_HOST_ID into the pid (`host_pid`) and is named
+    `host <h> pid <p>`, so exports from different pod hosts can be
+    merged without their rows colliding. Returns the trace dict (and
+    writes it to `path` when given)."""
     global _exported_upto
     with _lock:
         recs = list(_spans)
         if recs:    # spans up to here have been exported: only younger
             # ones count as dropped if the ring overwrites them
             _exported_upto = max(_exported_upto, recs[-1]["id"])
+    host = _host_label()
     events = []
     rows = {}
+    pids = {}
     for r in recs:
         tid = r["tid"]
         if r["trace"] is not None:
             # stable small row ids: first-seen order per trace id
             tid = rows.setdefault(r["trace"], 1_000_000 + len(rows))
+        pid = host_pid(host, r["pid"])
+        pids[pid] = r["pid"]
         ev = {"name": r["name"], "cat": r["cat"], "ph": "X",
-              "ts": r["ts"], "dur": r["dur"], "pid": r["pid"],
+              "ts": r["ts"], "dur": r["dur"], "pid": pid,
               "tid": tid,
               "args": dict(r.get("attrs") or {}, trace=r["trace"],
-                           span_id=r["id"])}
+                           span_id=r["id"], host=host)}
         events.append(ev)
+    this_pid = host_pid(host, os.getpid())
+    pids.setdefault(this_pid, os.getpid())
     for trace, tid in rows.items():
         events.append({"name": "thread_name", "ph": "M",
-                       "pid": os.getpid(), "tid": tid,
+                       "pid": this_pid, "tid": tid,
                        "args": {"name": "trace %s" % (trace,)}})
+    for pid, os_pid in pids.items():
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0,
+                       "args": {"name": "host %s pid %s"
+                                % (host, os_pid)}})
     events.sort(key=lambda e: e.get("ts", 0))
     doc = {"traceEvents": events, "displayTimeUnit": "ms"}
     if path is not None:

@@ -27,6 +27,20 @@ changes:
                                    with no drain, no checkpoint, no
                                    cleanup (the multi-host chaos drill
                                    kills one host of a pod this way).
+  MXNET_CHAOS_SPIKE_STEP=<step>    poison step <step>'s gradients with a
+                                   LARGE FINITE value (1e6) — the
+                                   finite-but-wrong fault the anomaly
+                                   detector (telemetry/anomaly.py)
+                                   exists for: the NaN/Inf guard stays
+                                   green while the grad norm explodes.
+  MXNET_CHAOS_SLOW_HOST=<host>:<secs>[:<from_step>]
+                                   sleep <secs> at EVERY step boundary
+                                   (from <from_step>, default 1) on the
+                                   process whose MXNET_HOST_ID equals
+                                   <host> — the straggler fault.
+                                   UNLATCHED (a straggler is slow every
+                                   step); the first firing records one
+                                   flight event.
 
 SERVING faults (ISSUE 11; tools/chaos_serve.py drives them through a
 multi-replica fleet) target one replica's serving loop and are keyed
@@ -74,7 +88,17 @@ import time
 
 
 _FAULTS = ("kill_save", "corrupt_ckpt", "nan_step", "sigterm_at",
-           "sigkill_at")
+           "sigkill_at", "spike_step")
+
+#: `<host>:<secs>[:<from_step>]` — per-step sleep on one emulated host
+#: (parsed separately: the key is a HOST label, not a step)
+_HOST_FAULTS = ("slow_host",)
+
+#: the finite gradient poison `spike_step` injects: big enough that the
+#: EWMA z-score on the grad norm flags it unmissably, small enough that
+#: squaring it in the norm stays finite (so the NaN/Inf guard does NOT
+#: trip — that is the point: finite-but-wrong)
+SPIKE_POISON = 1.0e6
 
 #: serving faults: value is (replica, iteration[, extra]) — parsed from
 #: "r:i[:x]" env strings or passed as tuples to configure()
@@ -114,6 +138,26 @@ def _parse_serve(name, val):
     return tuple(out)
 
 
+def _parse_host(name, val):
+    """(host, secs[, from_step]) out of `<host>:<secs>[:<from_step>]`
+    (host stays a string — MXNET_HOST_ID labels are strings)."""
+    if isinstance(val, (tuple, list)):
+        parts = list(val)
+    else:
+        parts = str(val).split(":")
+    if len(parts) not in (2, 3):
+        raise ValueError("%s must be <host>:<secs>[:<from_step>], got %r"
+                         % (name, val))
+    try:
+        out = [str(parts[0]), float(parts[1])]
+        if len(parts) == 3:
+            out.append(int(parts[2]))
+    except (TypeError, ValueError):
+        raise ValueError("%s must be <host>:<secs>[:<from_step>], got %r"
+                         % (name, val))
+    return tuple(out)
+
+
 def _load_env():
     global _env_loaded
     if _env_loaded:
@@ -132,6 +176,11 @@ def _load_env():
         if val:
             _conf.setdefault(name, _parse_serve(
                 "MXNET_CHAOS_" + name.upper(), val))
+    for name in _HOST_FAULTS:
+        val = os.environ.get("MXNET_CHAOS_" + name.upper())
+        if val:
+            _conf.setdefault(name, _parse_host(
+                "MXNET_CHAOS_" + name.upper(), val))
 
 
 def configure(**faults):
@@ -140,14 +189,18 @@ def configure(**faults):
     A value of None disarms. Returns the active config."""
     _load_env()
     for name, step in faults.items():
-        if name not in _FAULTS and name not in _SERVE_FAULTS:
+        if name not in _FAULTS and name not in _SERVE_FAULTS \
+                and name not in _HOST_FAULTS:
             raise ValueError("unknown chaos fault %r (know %s)"
-                             % (name, ", ".join(_FAULTS + _SERVE_FAULTS)))
+                             % (name, ", ".join(_FAULTS + _SERVE_FAULTS
+                                                + _HOST_FAULTS)))
         if step is None:
             _conf.pop(name, None)
             _fired.discard(name)
         elif name in _SERVE_FAULTS:
             _conf[name] = _parse_serve(name, step)
+        elif name in _HOST_FAULTS:
+            _conf[name] = _parse_host(name, step)
         else:
             _conf[name] = int(step)
     return dict(_conf)
@@ -198,9 +251,38 @@ def maybe_corrupt_checkpoint(step, path):
 
 def grad_poison(step):
     """TrainStep threads this scalar into the jitted step as `g + poison`
-    on every gradient: 0.0 normally, NaN on the armed step. Passing it as
-    a runtime argument keeps the injection retrace-free."""
-    return float("nan") if _should("nan_step", step) else 0.0
+    on every gradient: 0.0 normally, NaN on the armed `nan_step`, a
+    large FINITE value on the armed `spike_step` (the anomaly detector's
+    quarry: the guard's finiteness check stays green while the grad norm
+    explodes). Passing it as a runtime argument keeps the injection
+    retrace-free."""
+    if _should("nan_step", step):
+        return float("nan")
+    if _should("spike_step", step):
+        return SPIKE_POISON
+    return 0.0
+
+
+def maybe_slow_host(step):
+    """ResilientLoop calls this at each step boundary: an armed
+    `slow_host` fault sleeps on the process whose MXNET_HOST_ID matches
+    — one straggling host of an emulated pod. UNLATCHED (slow is a
+    standing condition, not an event); the first firing records one
+    flight event so the postmortem timeline names the injection."""
+    _load_env()
+    cfg = _conf.get("slow_host")
+    if cfg is None or os.environ.get("MXNET_HOST_ID", "0") != cfg[0]:
+        return False
+    if int(step) < (cfg[2] if len(cfg) > 2 else 1):
+        return False
+    if "slow_host" not in _fired:
+        _fired.add("slow_host")
+        from .. import telemetry
+        telemetry.flight().record("fault", "chaos.slow_host",
+                                  host=cfg[0], secs=cfg[1],
+                                  step=int(step))
+    time.sleep(cfg[1])
+    return True
 
 
 def maybe_sigterm(step):

@@ -232,3 +232,128 @@ def test_compile_and_hbm_budget_env_knobs(monkeypatch):
     assert introspect.hbm_budget_bytes() == (1.5 * 1024.0 ** 3, "raise")
     monkeypatch.setenv("MXNET_HBM_BUDGET_GB", "2:warn")
     assert introspect.hbm_budget_bytes() == (2.0 * 1024.0 ** 3, "warn")
+
+
+def test_train_observability_env_knobs(monkeypatch):
+    """ISSUE 14 knobs: straggler window/factor/patience, anomaly
+    alpha/zscore/warmup/detect, the train-console port, and the two new
+    chaos faults — defaults, overrides, and loud failures naming the
+    knob (enforcement is pinned end-to-end in
+    test_train_observability.py)."""
+    from mxnet_tpu.parallel import resilient
+    from mxnet_tpu.telemetry import anomaly
+    from mxnet_tpu.utils import chaos
+
+    for var in ("MXNET_STRAGGLER_WINDOW", "MXNET_STRAGGLER_FACTOR",
+                "MXNET_STRAGGLER_PATIENCE", "MXNET_ANOMALY_DETECT",
+                "MXNET_ANOMALY_ALPHA", "MXNET_ANOMALY_ZSCORE",
+                "MXNET_ANOMALY_WARMUP"):
+        monkeypatch.delenv(var, raising=False)
+    assert resilient.straggler_window_env() == 0       # off by default
+    assert resilient.straggler_factor() == 2.0
+    assert resilient.straggler_patience() == 2
+    monkeypatch.setenv("MXNET_STRAGGLER_WINDOW", "16")
+    monkeypatch.setenv("MXNET_STRAGGLER_FACTOR", "1.5")
+    monkeypatch.setenv("MXNET_STRAGGLER_PATIENCE", "3")
+    assert resilient.straggler_window_env() == 16
+    assert resilient.straggler_factor() == 1.5
+    assert resilient.straggler_patience() == 3
+    monkeypatch.setenv("MXNET_STRAGGLER_WINDOW", "soon")
+    with pytest.raises(ValueError, match="MXNET_STRAGGLER_WINDOW"):
+        resilient.straggler_window_env()
+    monkeypatch.setenv("MXNET_STRAGGLER_FACTOR", "0.5")  # <= 1: absurd
+    with pytest.raises(ValueError, match="MXNET_STRAGGLER_FACTOR"):
+        resilient.straggler_factor()
+
+    assert not anomaly.detect_enabled()                # off by default
+    monkeypatch.setenv("MXNET_ANOMALY_DETECT", "1")
+    assert anomaly.detect_enabled()
+    assert anomaly.anomaly_alpha() == 0.05
+    assert anomaly.anomaly_zscore() == 6.0
+    assert anomaly.anomaly_warmup() == 20
+    monkeypatch.setenv("MXNET_ANOMALY_ALPHA", "0.2")
+    monkeypatch.setenv("MXNET_ANOMALY_ZSCORE", "4")
+    monkeypatch.setenv("MXNET_ANOMALY_WARMUP", "5")
+    assert anomaly.anomaly_alpha() == 0.2
+    assert anomaly.anomaly_zscore() == 4.0
+    assert anomaly.anomaly_warmup() == 5
+    monkeypatch.setenv("MXNET_ANOMALY_ALPHA", "2.0")   # not a weight
+    with pytest.raises(ValueError, match="MXNET_ANOMALY_ALPHA"):
+        anomaly.anomaly_alpha()
+
+    monkeypatch.setenv("MXNET_STRAGGLER_WINDOW", "0")
+    monkeypatch.setenv("MXNET_STRAGGLER_FACTOR", "2.0")
+    monkeypatch.setenv("MXNET_ANOMALY_DETECT", "0")
+    monkeypatch.setenv("MXNET_ANOMALY_ALPHA", "0.05")
+    # console port: unset = no console; a non-integer fails naming the
+    # knob at loop construction (before any training happened)
+    import tempfile
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import ResilientLoop, TrainStep
+    from mxnet_tpu.utils.recovery import CheckpointManager
+    import mxnet_tpu as mx
+    net = gluon.nn.Dense(4, in_units=8)
+    net.initialize(mx.init.Xavier())
+    step = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+                     {"learning_rate": 0.1})
+    monkeypatch.delenv("MXNET_TRAIN_METRICS_PORT", raising=False)
+    loop = ResilientLoop(step, CheckpointManager(tempfile.mkdtemp()),
+                         watch_preemption=False, verbose=False)
+    assert loop.console_addr is None and loop._console is None
+    monkeypatch.setenv("MXNET_TRAIN_METRICS_PORT", "http")
+    with pytest.raises(ValueError, match="MXNET_TRAIN_METRICS_PORT"):
+        ResilientLoop(step, CheckpointManager(tempfile.mkdtemp()),
+                      watch_preemption=False, verbose=False)
+
+    # chaos: the two new faults parse (slow_host keyed by HOST string,
+    # spike_step by step) and malformed values fail loudly
+    chaos.reset()
+    monkeypatch.setenv("MXNET_CHAOS_SLOW_HOST", "2:0.25:3")
+    monkeypatch.setenv("MXNET_CHAOS_SPIKE_STEP", "7")
+    active = chaos.active()
+    assert active["slow_host"] == ("2", 0.25, 3)
+    assert active["spike_step"] == 7
+    chaos.reset()
+    monkeypatch.setenv("MXNET_CHAOS_SLOW_HOST", "2")   # missing secs
+    with pytest.raises(ValueError, match="MXNET_CHAOS_SLOW_HOST"):
+        chaos.active()
+    chaos.reset()
+
+
+def test_anomaly_alpha_zero_fails_loudly_naming_the_knob(monkeypatch):
+    """alpha=0 would freeze the EWMA; it must be rejected AT THE KNOB
+    (named), not mid-training by the lazily-built detector."""
+    from mxnet_tpu.telemetry import anomaly
+    monkeypatch.setenv("MXNET_ANOMALY_ALPHA", "0")
+    with pytest.raises(ValueError, match="MXNET_ANOMALY_ALPHA"):
+        anomaly.anomaly_alpha()
+    monkeypatch.setenv("MXNET_ANOMALY_ALPHA", "-0.1")
+    with pytest.raises(ValueError, match="MXNET_ANOMALY_ALPHA"):
+        anomaly.anomaly_alpha()
+
+
+def test_train_metrics_host_env(monkeypatch, tmp_path):
+    """MXNET_TRAIN_METRICS_HOST selects the console's bind interface
+    (loopback by default; cross-host pod polling needs an explicit
+    0.0.0.0)."""
+    import tempfile
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import ResilientLoop, TrainStep
+    from mxnet_tpu.utils.recovery import CheckpointManager
+    import mxnet_tpu as mx
+    net = gluon.nn.Dense(4, in_units=8)
+    net.initialize(mx.init.Xavier())
+    step = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+                     {"learning_rate": 0.1})
+    monkeypatch.delenv("MXNET_TRAIN_METRICS_HOST", raising=False)
+    loop = ResilientLoop(step, CheckpointManager(tempfile.mkdtemp()),
+                         watch_preemption=False, verbose=False,
+                         metrics_port=0)
+    assert loop.console_addr[0] == "127.0.0.1"
+    loop.close_console()
+    monkeypatch.setenv("MXNET_TRAIN_METRICS_HOST", "0.0.0.0")
+    loop = ResilientLoop(step, CheckpointManager(tempfile.mkdtemp()),
+                         watch_preemption=False, verbose=False,
+                         metrics_port=0)
+    assert loop.console_addr[0] == "0.0.0.0"
+    loop.close_console()

@@ -68,7 +68,7 @@ def arith_prompt(seed, lo, n):
 
 def _has_memory_analysis():
     compiled = jax.jit(lambda a: a + 1).lower(jnp.ones((2,))).compile()
-    memory, _ = introspect._analyses(compiled)
+    memory, _flops, _bytes = introspect._analyses(compiled)
     return memory is not None
 
 

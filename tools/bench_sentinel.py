@@ -266,7 +266,14 @@ def _judge_secondary(verdict, fresh, ref):
                              # or attainment drop warns, the measured
                              # tok/s decides the exit code
                              ("goodput_tok_per_sec", 0.25, -1),
-                             ("slo_ttft_attainment", 0.10, -1)):
+                             ("slo_ttft_attainment", 0.10, -1),
+                             # ISSUE 14: training-observability health
+                             # signals — a growing data-wait fraction,
+                             # step-time tail, or collective footprint
+                             # warns; the measured value decides
+                             ("data_wait_fraction", 0.25, 1),
+                             ("step_p95_ms", 0.50, 1),
+                             ("comms_bytes_per_step", 0.15, 1)):
         fv, rv = fresh.get(field), ref.get(field)
         if not isinstance(fv, (int, float)) or not isinstance(
                 rv, (int, float)) or rv <= 0:

@@ -60,6 +60,7 @@ import signal
 import subprocess
 import sys
 import tempfile
+import time
 
 import numpy as np
 
@@ -246,18 +247,32 @@ def _final_of(host):
     return lines[-1] if lines else None
 
 
-def _check_flight_dumps(flight_dir, survivors):
+def _load_tool(name):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_flight_dumps(flight_dir, survivors, straggler_host=None):
     """Post-mortem gate of the pod drill: every SIGTERM'd survivor must
     have dumped its flight recorder, each dump must parse and hold the
-    spans from right before the injected fault, and tools/postmortem.py
-    must render the set into a usable timeline."""
-    import importlib.util
+    spans from right before the injected fault, the straggler and
+    anomaly DETECTOR events (ISSUE 14) must have landed in the
+    survivors' black boxes naming the right host, and
+    tools/postmortem.py must render the set into a usable timeline
+    (ALERT callouts + per-host skew table included)."""
     import json as _json
     files = sorted(os.path.join(flight_dir, n)
                    for n in os.listdir(flight_dir)
                    if n.startswith("flight-") and
                    n.endswith(".sigterm.json"))
     hosts_seen = set()
+    straggler_events = []
+    anomaly_events = []
     for f in files:
         with open(f) as fh:
             doc = _json.load(fh)           # parseable
@@ -271,21 +286,94 @@ def _check_flight_dumps(flight_dir, survivors):
                   if e.get("kind") == "fault"]
         assert "chaos.sigterm_at" in faults, (
             "flight dump %s is missing the injected fault event" % f)
+        straggler_events += [e for e in doc["events"]
+                             if e.get("name") == "train.straggler"]
+        anomaly_events += [e for e in doc["events"]
+                           if e.get("name") == "train.anomaly"]
     assert len(hosts_seen) == survivors, (
         "expected flight dumps from %d survivor hosts, got %s"
         % (survivors, sorted(hosts_seen)))
-    spec = importlib.util.spec_from_file_location(
-        "postmortem", os.path.join(os.path.dirname(
-            os.path.abspath(__file__)), "postmortem.py"))
-    pm = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(pm)
-    text = pm.render(pm.load_dumps([flight_dir]))
+    if straggler_host is not None:
+        flagged = {str(e.get("host")) for e in straggler_events}
+        assert flagged == {str(straggler_host)}, (
+            "straggler detection flagged %s, expected exactly host %s"
+            % (sorted(flagged) or "nobody", straggler_host))
+        assert anomaly_events, ("the injected finite grad spike left "
+                                "no train.anomaly event in any "
+                                "survivor's black box")
+        assert any(e.get("signal") == "grad_norm"
+                   for e in anomaly_events), anomaly_events
+    pm = _load_tool("postmortem")
+    dumps = pm.load_dumps([flight_dir])
+    text = pm.render(dumps)
     assert "FAULT" in text and "train.device_step" in text
+    if straggler_host is not None:
+        assert "ALERT" in text, "detector events not called out"
+        assert "STRAGGLER" in text, "skew table did not mark the host"
+        # the merged Perfetto export keeps per-host rows distinct
+        # (MXNET_HOST_ID folded into the pid — the ISSUE 14 fix)
+        doc = pm.export_perfetto(dumps)
+        span_pids = {e["pid"] for e in doc["traceEvents"]
+                     if e.get("ph") == "X"}
+        assert len(span_pids) >= survivors, (
+            "perfetto export merged hosts onto %d process row(s)"
+            % len(span_pids))
     head = text.splitlines()
     print("-- flight recorder: %d dump(s) from %d survivor host(s); "
-          "post-mortem timeline renders (%d lines)"
-          % (len(files), len(hosts_seen), len(head)))
+          "%d straggler + %d anomaly event(s); post-mortem timeline "
+          "renders (%d lines)"
+          % (len(files), len(hosts_seen), len(straggler_events),
+             len(anomaly_events), len(head)))
     for line in head[:6]:
+        print("   " + line)
+
+
+def _await_console(host, timeout=180.0):
+    """Poll one emulated host's captured stdout for the train-console
+    line; returns the base URL. The console starts at ResilientLoop
+    construction (before the first compile), so it is up for the whole
+    multi-second compile window the drill renders its frame in."""
+    deadline = time.time() + timeout
+    pat = re.compile(r"train console on (http://[0-9.:]+)")
+    while time.time() < deadline:
+        try:
+            with open(host.out.name) as f:
+                m = pat.search(f.read())
+            if m:
+                return m.group(1)
+        except OSError:
+            pass
+        time.sleep(0.25)
+    raise AssertionError("host %d never printed its train-console "
+                         "address" % host.index)
+
+
+def _check_train_top(url, host):
+    """`train_top --once` must render a live frame against the drill
+    pod while the straggling host is still mid-run — and the frame
+    must NAME the flagged straggler (the acceptance gate: flagged in
+    the flight recorder, the postmortem timeline, AND a rendered
+    frame)."""
+    tt = _load_tool("train_top")
+    deadline = time.time() + 240.0
+    frame = best = ""
+    while time.time() < deadline:
+        frame = tt.render_once([url], timeout=5.0)
+        if " live " in frame or " drain " in frame:
+            best = frame
+            if "FLAGGED" in frame:
+                break
+        if host.proc.poll() is not None:
+            break
+        time.sleep(0.25)
+    assert "train console" in best, best or frame
+    assert " live " in best or " drain " in best, (
+        "train_top never rendered a live row against the drill pod:\n"
+        + (best or frame))
+    assert "FLAGGED" in best, (
+        "train_top never rendered the flagged straggler:\n" + best)
+    print("-- train_top --once frame against the live pod:")
+    for line in best.splitlines():
         print("   " + line)
 
 
@@ -326,16 +414,46 @@ def multihost(args):
     # relaunch must refuse it. Every pod host gets a flight-recorder
     # directory: the SIGKILL'd victim can't dump (that's the point of a
     # black box on the OTHERS), the SIGTERM'd survivors must.
+    #
+    # ISSUE 14 observability gates ride the same pod leg: host 0 (a
+    # SURVIVOR) is the chaos-armed straggler (0.25s per-step sleep) and
+    # carries the train console; the straggler detector must flag
+    # exactly it (shared-dir step-time exchange, factor 1.5 because at
+    # 2 emulated hosts the median averages the slow host in), a FINITE
+    # grad spike after the last complete checkpoint must trip the
+    # anomaly detector (the relaunch rewinds past the corruption, so
+    # bit-identity still holds), and train_top must render a frame
+    # against the live degraded pod. None of these knobs reach the
+    # relaunch legs — _worker_env only carries them on this leg.
     flight_dir = os.path.join(base, "flight")
     k_drain = k_kill + 2
+    k_spike = k_kill + 1               # after the last COMPLETE save
+    observability = {
+        "MXNET_STRAGGLER_DIR": os.path.join(base, "straggler"),
+        "MXNET_STRAGGLER_WINDOW": "2",
+        "MXNET_STRAGGLER_FACTOR": "1.5",
+        "MXNET_STRAGGLER_PATIENCE": "2",
+        "MXNET_ANOMALY_DETECT": "1",
+        "MXNET_ANOMALY_WARMUP": "5",
+    }
     crew = [_Host(args, fault_dir, i, hosts,
                   chaos=dict(
                       {"MXNET_CHAOS_SIGKILL_AT": str(k_kill)}
                       if i == hosts - 1 else
                       {"MXNET_CHAOS_SIGTERM_AT": str(k_drain)},
                       MXNET_FLIGHT_RECORDER_DIR=flight_dir,
-                      MXNET_HOST_ID=str(i)))
+                      MXNET_HOST_ID=str(i),
+                      **dict(observability,
+                             **({"MXNET_CHAOS_SLOW_HOST": "0:0.25",
+                                 "MXNET_CHAOS_SPIKE_STEP": str(k_spike),
+                                 "MXNET_TRAIN_METRICS_PORT": "0"}
+                                if i == 0 else {}))))
             for i in range(hosts)]
+    # the console is up from ResilientLoop construction (before the
+    # first compile), and host 0's injected slowness stretches its run:
+    # render the live frame while the pod is degraded
+    console_url = _await_console(crew[0])
+    _check_train_top(console_url, crew[0])
     victim = crew[-1]
     rc = victim.wait()
     victim.report("fault: SIGKILL host %d @%d" % (hosts - 1, k_kill))
@@ -347,7 +465,8 @@ def multihost(args):
         assert rc == EXIT_PREEMPTED, \
             "survivor did not drain cleanly (%r):\n%s" % (rc,
                                                           h.stdout[-2000:])
-    _check_flight_dumps(flight_dir, survivors=hosts - 1)
+    _check_flight_dumps(flight_dir, survivors=hosts - 1,
+                        straggler_host=0)
 
     shutil.copytree(fault_dir, elastic_dir)   # snapshot for leg 4
 

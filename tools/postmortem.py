@@ -5,12 +5,20 @@ A pod host that dies (or drains on preemption) leaves one or more
 `flight-host<h>-pid<p>-<n>.<reason>.json` files in
 `MXNET_FLIGHT_RECORDER_DIR` (see mxnet_tpu/telemetry/flight.py). This
 tool merges any number of them — the whole pod's black boxes — into one
-wall-clock-ordered timeline tagged by host/pid, calls out injected and
-observed FAULTs, and summarizes each dump's final metric values, so "what
-was the pod doing in its last seconds" is one command:
+wall-clock-ordered timeline tagged by host/pid, calls out injected
+FAULTs and detector ALERTs (straggler / anomaly flags, ISSUE 14),
+appends a per-host step-time skew table, and summarizes each dump's
+final metric values, so "what was the pod doing in its last seconds"
+is one command:
 
     python tools/postmortem.py /path/to/flight-dir
     python tools/postmortem.py dumpA.json dumpB.json
+    python tools/postmortem.py /path/to/flight-dir --perfetto pod.json
+
+`--perfetto` additionally merges every dump's span events into one
+Perfetto-loadable trace where each host is its own process row
+(MXNET_HOST_ID folded into the pid — two containerized hosts sharing
+an OS pid can no longer collide onto one row).
 
 The multi-host chaos drill (tools/chaos_train.py --multihost) asserts
 that the killed host's survivors leave dumps this tool can render.
@@ -18,7 +26,26 @@ that the killed host's survivors leave dumps this tool can render.
 import argparse
 import json
 import os
+import statistics
 import sys
+import zlib
+
+#: detector events rendered as FAULT-style callouts: not injected
+#: faults, but exactly as load-bearing on a timeline (the answer to
+#: "did the pod KNOW something was wrong before it died")
+ALERT_EVENTS = ("train.straggler", "train.anomaly")
+
+
+def host_pid(host, pid):
+    """Mirror of telemetry.tracing.host_pid (this tool is deliberately
+    stdlib-only): fold the host label into the high digits of the pid a
+    Perfetto row keys on, so two hosts sharing an OS pid stay distinct
+    rows in the merged trace."""
+    try:
+        h = int(host)
+    except (TypeError, ValueError):
+        h = zlib.crc32(str(host).encode())
+    return (h % 1_000_000_000) * 1_000_000 + int(pid) % 1_000_000
 
 
 def load_dumps(paths):
@@ -64,6 +91,90 @@ def _fmt_extras(ev):
     return " ".join(parts)
 
 
+def _skew_table(dumps):
+    """Per-host step-time skew summary (ISSUE 14): each host's mean
+    step time out of its dump's final `train_step_seconds` histogram,
+    the pod median, the ratio, and whether the straggler detector
+    flagged the host (`train_stragglers_total` / a `train.straggler`
+    event naming it). Returns the rendered lines ([] when no dump
+    carries train metrics)."""
+    per_host = {}
+    flagged = set()
+    for d in dumps:
+        host = str(d.get("host"))
+        metrics = (d.get("metrics") or {}).get("metrics") or {}
+        h = metrics.get("train_step_seconds") or {}
+        if h.get("count"):
+            best = per_host.get(host)
+            if best is None or h["count"] > best["count"]:
+                per_host[host] = {"count": h["count"],
+                                  "mean": h.get("mean") or 0.0}
+        for ev in d.get("events", []):
+            if ev.get("name") == "train.straggler" \
+                    and ev.get("host") is not None:
+                flagged.add(str(ev["host"]))
+    if not per_host:
+        return []
+    median = statistics.median(v["mean"] for v in per_host.values())
+    lines = ["-- per-host step-time skew (pod median %.3f ms over %d "
+             "host(s))" % (median * 1e3, len(per_host))]
+    for host in sorted(per_host):
+        v = per_host[host]
+        ratio = v["mean"] / median if median > 0 else float("nan")
+        lines.append(
+            "   host%-6s steps=%-6d mean=%8.3fms  %5.2fx median%s"
+            % (host, v["count"], v["mean"] * 1e3, ratio,
+               "  STRAGGLER" if host in flagged else ""))
+    return lines
+
+
+def export_perfetto(dumps, path=None):
+    """Merge every dump's span events into one Perfetto-loadable
+    chrome-trace JSON: each HOST is its own process row (`host_pid`
+    folding — this is the multi-host row-collision fix: span events
+    from different hosts' rings used to share pid/tid and silently
+    merge), each trace id its own named thread row within it."""
+    events = []
+    rows = {}
+    pids = {}
+    for d in dumps:
+        host = str(d.get("host"))
+        pid = host_pid(host, d.get("pid", 0))
+        pids[pid] = (host, d.get("pid", 0))
+        for ev in d.get("events", []):
+            if ev.get("kind") != "span":
+                continue
+            trace = ev.get("trace")
+            if trace is not None:
+                tid = rows.setdefault((pid, trace),
+                                      1_000_000 + len(rows))
+            else:
+                tid = 1
+            dur = float(ev.get("dur_us") or 0.0)
+            args = {k: v for k, v in ev.items()
+                    if k not in ("t", "kind", "name", "dur_us")}
+            args["host"] = host
+            events.append({"name": ev.get("name", "?"), "cat": "flight",
+                           "ph": "X",
+                           "ts": float(ev.get("t", 0.0)) * 1e6 - dur,
+                           "dur": dur, "pid": pid, "tid": tid,
+                           "args": args})
+    for (pid, trace), tid in rows.items():
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": tid,
+                       "args": {"name": "trace %s" % (trace,)}})
+    for pid, (host, os_pid) in pids.items():
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": "host %s pid %s"
+                                % (host, os_pid)}})
+    events.sort(key=lambda e: e.get("ts", 0))
+    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump(doc, f)
+    return doc
+
+
 def render(dumps):
     """One merged timeline, oldest event first, host/pid-tagged; then a
     per-dump summary (reason + headline metric values)."""
@@ -83,13 +194,24 @@ def render(dumps):
                      % ("host%s/pid%s" % (d["host"], d["pid"]),
                         d["reason"], os.path.basename(d["_path"])))
     lines.append("-- timeline (t is seconds since the oldest event)")
+    alerts = []
     for t, tag, ev in rows:
         kind = ev.get("kind", "?")
         marker = {"fault": "FAULT ", "metric": "metric",
                   "span": "span  ", "event": "event "}.get(kind, kind)
+        if ev.get("name") in ALERT_EVENTS:
+            marker = "ALERT "
+            alerts.append((t, tag, ev))
         lines.append("  +%8.3fs %-14s %s %-28s %s"
                      % (t - (t0 or 0.0), tag, marker, ev.get("name", "?"),
                         _fmt_extras(ev)))
+    if alerts:
+        lines.append("-- detector alerts (%d)" % len(alerts))
+        for t, tag, ev in alerts:
+            lines.append("   +%8.3fs %-14s %-16s %s"
+                         % (t - (t0 or 0.0), tag, ev.get("name"),
+                            _fmt_extras(ev)))
+    lines.extend(_skew_table(dumps))
     for d in dumps:
         metrics = (d.get("metrics") or {}).get("metrics") or {}
         if not metrics:
@@ -113,8 +235,16 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("paths", nargs="+",
                     help="flight dump files and/or directories")
+    ap.add_argument("--perfetto", metavar="PATH", default=None,
+                    help="also write the merged span events as a "
+                         "Perfetto trace (one process row per host)")
     args = ap.parse_args(argv)
-    print(render(load_dumps(args.paths)))
+    dumps = load_dumps(args.paths)
+    print(render(dumps))
+    if args.perfetto:
+        doc = export_perfetto(dumps, args.perfetto)
+        print("-- perfetto trace: %d event(s) -> %s"
+              % (len(doc["traceEvents"]), args.perfetto))
     return 0
 
 

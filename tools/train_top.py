@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""Live train console (ISSUE 14): `top` for a training pod.
+
+Polls one or many `ResilientLoop` train consoles
+(`MXNET_TRAIN_METRICS_PORT`; endpoints `/healthz` + `/statusz`) and
+renders one terminal frame per interval: per-host step progress,
+step-time p50/p95, throughput, data-wait fraction, checkpoint age,
+bad-step/rollback/anomaly counts — plus the pod's straggler skew table
+(who is slow, by how much, who is FLAGGED) and the train.step
+collective-comms ledger. Deliberately **stdlib-only** — it must run on
+a bastion host where importing jax is not an option.
+
+    # one host
+    python tools/train_top.py --url http://127.0.0.1:9100
+
+    # a pod: comma-separated host:port list (or full URLs)
+    python tools/train_top.py --hosts 10.0.0.1:9100,10.0.0.2:9100
+
+    # one frame for scripts/CI (no screen control)
+    python tools/train_top.py --url http://127.0.0.1:9100 --once
+
+The multi-host chaos drill (tools/chaos_train.py --multihost) renders a
+`--once` frame against its live degraded pod — the console must never
+crash on a half-dead pod (that is exactly when an operator is staring
+at it).
+"""
+import argparse
+import json
+import statistics
+import sys
+import time
+import urllib.error
+import urllib.request
+
+
+def fetch_host(base_url, timeout=5.0):
+    """(health, statusz) from one train console; an unreachable or
+    unparseable endpoint becomes None — the renderer degrades per host
+    instead of dying with the pod."""
+    out = []
+    for path in ("/healthz", "/statusz"):
+        try:
+            with urllib.request.urlopen(base_url.rstrip("/") + path,
+                                        timeout=timeout) as r:
+                out.append(json.loads(r.read()))
+        except Exception:
+            out.append(None)
+    return tuple(out)
+
+
+def _num(v, fmt="%.1f", dash="-"):
+    if v is None:
+        return dash
+    try:
+        return fmt % v
+    except (TypeError, ValueError):
+        return dash
+
+
+def _bytes(n):
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(n) < 1024.0 or unit == "GB":
+            return ("%.1f%s" if unit != "B" else "%.0f%s") % (n, unit)
+        n /= 1024.0
+
+
+def render(bodies, now=None):
+    """One plain-text frame out of [(url, health, statusz), ...]."""
+    now = time.time() if now is None else now
+    stamp = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(now))
+    lines = ["mxnet_tpu train console  %d host(s)  %s"
+             % (len(bodies), stamp)]
+    lines.append(
+        "  %-14s %-6s %7s %9s %9s %10s %6s %7s %5s %5s %5s"
+        % ("host", "state", "step", "p50 ms", "p95 ms", "tok/s",
+           "wait%", "ckpt s", "bad", "rlbk", "anom"))
+    stragglers = None
+    comms = None
+    anomaly_last = None
+    for url, health, statusz in bodies:
+        z = statusz or {}
+        h = health or {}
+        label = z.get("host", h.get("host"))
+        if label is None:
+            label = url.split("//")[-1]
+        if health is None and statusz is None:
+            lines.append("  %-14s %-6s   console UNREACHABLE (%s)"
+                         % (label, "DOWN", url))
+            continue
+        state = "drain" if h.get("preempted") else \
+            ("live" if h.get("ok") else "DOWN")
+        sh = z.get("step_seconds") or {}
+        rate = z.get("tokens_per_sec")
+        if rate is None:
+            rate = z.get("samples_per_sec")
+        wait = z.get("data_wait_fraction")
+        ckpt = z.get("checkpoint") or {}
+        anom = z.get("anomalies") or {}
+        lines.append(
+            "  %-14s %-6s %7s %9s %9s %10s %6s %7s %5s %5s %5s"
+            % (str(label)[:14], state, _num(z.get("step"), "%d"),
+               _num(sh.get("p50"), "%.1f") if sh.get("p50") is None
+               else _num(sh["p50"] * 1e3, "%.1f"),
+               _num(z.get("step_p95_ms"), "%.1f"),
+               _num(rate, "%.0f"),
+               _num(wait * 100 if wait is not None else None, "%.1f"),
+               _num(ckpt.get("age_s"), "%.0f"),
+               _num(z.get("bad_steps"), "%d"),
+               _num(z.get("rollbacks"), "%d"),
+               _num(anom.get("count"), "%d")))
+        if stragglers is None and z.get("straggler"):
+            stragglers = z["straggler"]
+        if comms is None and z.get("comms"):
+            comms = z["comms"]
+        if anom.get("last"):
+            anomaly_last = (label, anom["last"])
+    if stragglers:
+        hosts = stragglers.get("hosts") or {}
+        flagged = stragglers.get("flagged") or {}
+        lines.append(
+            "stragglers: skew %s (factor %s, window %s steps, %s "
+            "windows closed)"
+            % (_num(stragglers.get("skew"), "%.2f"),
+               _num(stragglers.get("factor"), "%.1f"),
+               _num(stragglers.get("window_steps"), "%d"),
+               _num(stragglers.get("windows"), "%d")))
+        if hosts:
+            median = statistics.median(hosts.values())
+            for hname in sorted(hosts):
+                ratio = hosts[hname] / median if median else None
+                mark = "  <-- FLAGGED x%d" % flagged[hname] \
+                    if hname in flagged else ""
+                lines.append("  host %-10s mean %8s ms  %sx median%s"
+                             % (hname, _num(hosts[hname] * 1e3, "%.2f"),
+                                _num(ratio, "%.2f"), mark))
+    if anomaly_last:
+        label, last = anomaly_last
+        lines.append("anomaly z-scores (host %s): %s" % (label, "  ".join(
+            "%s %s (z %s)" % (k, _num((v or {}).get("value"), "%.4g"),
+                              _num((v or {}).get("z"), "%.2f"))
+            for k, v in sorted(last.items()))))
+    if comms:
+        kinds = comms.get("kinds") or {}
+        parts = ["%s %s/step x%s" % (k.replace("_", "-"),
+                                     _bytes(v.get("bytes")),
+                                     _num(v.get("ops"), "%d"))
+                 for k, v in sorted(kinds.items())]
+        lines.append(
+            "comms (train.step): %s   total %s  fraction-of-step %s"
+            % ("  ".join(parts) if parts else "no collectives",
+               _bytes(comms.get("total_bytes")),
+               _num(comms.get("fraction"), "%.3f")))
+    return "\n".join(lines)
+
+
+def render_once(urls, timeout=5.0):
+    """Fetch + render one frame (the chaos drill's seam)."""
+    return render([(u,) + fetch_host(u, timeout=timeout) for u in urls])
+
+
+def _urls(args):
+    if args.hosts:
+        urls = []
+        for h in args.hosts.split(","):
+            h = h.strip()
+            if not h:
+                continue
+            urls.append(h if "//" in h else "http://" + h)
+        return urls
+    return [args.url]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        epilog="examples:\n"
+               "  train_top.py --url http://127.0.0.1:9100\n"
+               "  train_top.py --hosts 10.0.0.1:9100,10.0.0.2:9100\n"
+               "  train_top.py --url http://127.0.0.1:9100 --once\n",
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--url", default="http://127.0.0.1:9100",
+                    help="one train console base URL "
+                         "(MXNET_TRAIN_METRICS_PORT)")
+    ap.add_argument("--hosts", default="",
+                    help="comma-separated host:port list — poll a whole "
+                         "pod (overrides --url)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between frames")
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit (no screen control)")
+    ap.add_argument("--plain", action="store_true",
+                    help="never emit ANSI clear codes (log-friendly)")
+    args = ap.parse_args(argv)
+    urls = _urls(args)
+    try:
+        if args.once:
+            print(render_once(urls))
+            return 0
+        while True:
+            frame = render_once(urls)
+            if not args.plain and sys.stdout.isatty():
+                sys.stdout.write("\x1b[2J\x1b[H")
+            print(frame, flush=True)
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    except BrokenPipeError:          # `train_top ... | head` is fine
+        return 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
