@@ -294,6 +294,26 @@ def check_line(r):
             raise ValueError("comms_bytes_per_step %d exceeds the "
                              "executable's total bytes accessed %d it "
                              "is a subset of: %r" % (cb, ba, r))
+    # remediation fields (ISSUE 15): MTTR is a measured wall-time span
+    # (fault-inject -> first post-recovery step) and the steps lost to
+    # a remediation restart are a re-executed-work count — both real
+    # measurements, never placeholders.
+    mttr = r.get("mttr_s")
+    if mttr is not None:
+        if not isinstance(mttr, (int, float)) or isinstance(mttr, bool) \
+                or mttr <= 0 or mttr != mttr or mttr == float("inf"):
+            raise ValueError("mttr_s must be a finite positive number "
+                             "of seconds: %r" % (r,))
+        if r.get("value") is None:
+            raise ValueError("mttr_s without a measured value: %r" % (r,))
+    slr = r.get("steps_lost_per_remediation")
+    if slr is not None:
+        if not isinstance(slr, int) or isinstance(slr, bool) or slr < 0:
+            raise ValueError("steps_lost_per_remediation must be a "
+                             "non-negative step count: %r" % (r,))
+        if mttr is None:
+            raise ValueError("steps_lost_per_remediation without the "
+                             "mttr_s measurement it rides: %r" % (r,))
     return r
 
 
@@ -1414,11 +1434,18 @@ def bench_resilience(smoke, dtype, device_kind):
                 mgr.save(loop.t, state, block=True)  # full publish
                 publish_s.append(time.perf_counter() - t0)
         mgr.wait(_barrier=False)
-        t0 = time.perf_counter()
+        # remediation MTTR (ISSUE 15): fault-inject -> first
+        # post-recovery step, measured over the exact path a
+        # supervisor-driven restart takes (restore_latest + state load
+        # + one already-compiled step); steps_lost_per_remediation is
+        # the re-executed work the restart cadence implies
+        t_fault = time.perf_counter()
         restored = mgr.restore_latest()        # the relaunch path
         step0, tree = restored
         loop.load_state_dict(tree)
-        restore_s = time.perf_counter() - t0
+        restore_s = time.perf_counter() - t_fault
+        loop.step(*batch_for(loop.t))      # first post-recovery step
+        mttr_s = time.perf_counter() - t_fault
         steps_lost = kill_at - step0
         state_bytes = sum(np.asarray(v).nbytes
                           for v in jax.tree.leaves(tree))
@@ -1523,6 +1550,8 @@ def bench_resilience(smoke, dtype, device_kind):
                 "state_bytes": int(state_bytes),
                 "save_every": save_every,
                 "steps_lost_per_preemption": steps_lost,
+                "mttr_s": round(mttr_s, 4),
+                "steps_lost_per_remediation": steps_lost,
                 "bad_step_guard": True,
                 "data_wait_fraction": data_wait_fraction,
                 "step_p95_ms": step_p95_ms,
@@ -1542,7 +1571,12 @@ def bench_resilience(smoke, dtype, device_kind):
                                  "latest train.step executable's "
                                  "collective ledger (the ZeRO-1 "
                                  "sharded leg when devices allow, else "
-                                 "the single-device leg's 0)"}
+                                 "the single-device leg's 0); mttr_s is "
+                                 "fault-inject -> first post-recovery "
+                                 "step over the supervisor-driven "
+                                 "restart path (ISSUE 15), with "
+                                 "steps_lost_per_remediation the "
+                                 "re-executed work that restart implies"}
     finally:
         shutil.rmtree(d, ignore_errors=True)
 

@@ -177,6 +177,34 @@ assert not missing, ("ISSUE 14 fields missing from the resilience "
 print("2j OK:", {f: line[f] for f in fields})
 PYEOF
 
+echo "=== 2k. training remediation: supervised chaos drill + MTTR gate (ISSUE 15) ==="
+# (a) the supervised remediation campaign end-to-end on-chip: slow host
+# cordoned + elastic N-1 finish, SIGKILL auto-relaunch bit-identical
+# within the restart budget, injected SDC digest flip names exactly the
+# poisoned host, crash-loop opens the circuit with a rendered
+# postmortem. timeout-bounded: a wedged relaunch must not stall the
+# session. (b) the resilience line (step 2f artifact) must carry the
+# ISSUE 15 MTTR fields; the sentinel judges their LEVELS warn-only at
+# step 8. Predictions: BENCH_NOTES.md round 15.
+timeout -k 30 2400 python tools/chaos_train.py --multihost --supervised \
+  --net mlp --steps 12 --save-every 4 | tee CHAOS_SUPERVISED_TPU.txt
+python - <<'PYEOF'
+import json
+line = None
+for l in open("BENCH_RESILIENCE_SHARDED.jsonl"):
+    try:
+        r = json.loads(l)
+    except ValueError:
+        continue
+    if str(r.get("metric", "")).endswith("resilience_ckpt_publish_ms"):
+        line = r
+fields = ("mttr_s", "steps_lost_per_remediation")
+missing = [f for f in fields if line is None or f not in line]
+assert not missing, ("ISSUE 15 fields missing from the resilience "
+                     "line: %s" % missing)
+print("2k OK:", {f: line[f] for f in fields})
+PYEOF
+
 echo "=== 3. flash attention seq sweep (1024/2048/4096) ==="
 BENCH_CONFIGS=transformer_flash BENCH_FLASH_SEQ=1024,2048,4096,8192 \
   python bench.py | tee BENCH_FLASH_SWEEP.jsonl

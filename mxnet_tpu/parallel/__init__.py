@@ -12,7 +12,11 @@ machinery (SURVEY §2.3/§5.8):
 from .mesh import build_mesh, data_parallel_mesh, mesh_sharding
 from .trainer import TrainStep
 from .resilient import (ResilientLoop, PreemptionWatcher, BadStepError,
-                        Preempted, EXIT_PREEMPTED, StragglerMonitor)
+                        Preempted, EXIT_PREEMPTED, StragglerMonitor,
+                        Reconfigured, EXIT_RECONFIGURE)
+from .supervisor import (TrainSupervisor, CordonRoster, SDCProbe,
+                         CheckpointAuditor, CordonedHostError,
+                         effective_hosts)
 from .ring_attention import ring_attention, ring_attention_sharded
 from . import collectives
 from .pipeline import gpipe_apply

@@ -94,6 +94,12 @@ from .. import telemetry
 #: Chosen outside the usual 0/1/2 and shell-builtin ranges.
 EXIT_PREEMPTED = 83
 
+#: distinct exit code meaning "remediation drained cleanly — re-read the
+#: cordon roster and relaunch me at the new (usually smaller) world"
+#: (ISSUE 15; parallel/supervisor.py). Distinct from EXIT_PREEMPTED so a
+#: relauncher can tell "same shape" from "shape changed".
+EXIT_RECONFIGURE = 84
+
 _POLICIES = ("off", "skip", "rollback", "raise")
 
 
@@ -110,6 +116,19 @@ class Preempted(SystemExit):
     def __init__(self, step):
         super().__init__(EXIT_PREEMPTED)
         self.step = step
+
+
+class Reconfigured(SystemExit):
+    """Raised by ResilientLoop after the remediation supervisor's
+    reconfigure checkpoint published. Subclasses
+    SystemExit(EXIT_RECONFIGURE): unhandled, the process exits with the
+    reconfigure code and the relauncher rebuilds the world from the
+    cordon roster; in-process callers may catch it."""
+
+    def __init__(self, step, reason=None):
+        super().__init__(EXIT_RECONFIGURE)
+        self.step = step
+        self.reason = reason
 
 
 class PreemptionWatcher:
@@ -594,6 +613,18 @@ class ResilientLoop:
         if watch_preemption:
             self.watcher = PreemptionWatcher(grace_secs=grace_secs)
             self.watcher.install()
+            # thread the drain deadline through checkpoint publish IO:
+            # retry backoff during a SIGTERM drain can no longer sleep
+            # past the grace window and lose the final checkpoint to
+            # the force-exit timer (remaining_grace() is None until a
+            # signal actually arrives — no cap on ordinary saves)
+            if hasattr(manager, "deadline_fn"):
+                manager.deadline_fn = self.watcher.remaining_grace
+        # -- ISSUE 15 remediation layer (opt-in) -------------------------
+        self.supervisor = None
+        from . import supervisor as _supervisor_mod
+        if _supervisor_mod.remediation_enabled():
+            _supervisor_mod.TrainSupervisor(self)
 
     # -- lr scale (rollback shrink) -----------------------------------------
     def _install_lr_scale(self):
@@ -786,21 +817,37 @@ class ResilientLoop:
             # ISSUE 14 detectors, gated like every recording site: under
             # MXNET_TELEMETRY=0 neither the per-window gather nor the
             # loss sync runs (the seams are no-ops)
+            new_stragglers, anomalies = [], []
             if telemetry.enabled():
                 if self._straggler is not None:
-                    self._straggler.observe(t, dt)
+                    new_stragglers = self._straggler.observe(t, dt)
                 if self._anomaly is not None:
-                    self._anomaly.observe(
+                    anomalies = self._anomaly.observe(
                         t, loss=float(np.asarray(loss)),
                         grad_norm=gnorm_val)
+            # ISSUE 15 remediation: the supervisor consumes this
+            # boundary's detector signals and may run an SDC parity
+            # probe; any resulting cordon arms the reconfigure drain
+            # checked below, after the preemption protocol
+            sup = self.supervisor
+            if sup is not None:
+                sup.note_batch(x, y)
+                sup.on_step(t, stragglers=new_stragglers,
+                            anomalies=anomalies)
             # cadence save only on GOOD steps: after a bad step (or a
             # rollback) the state no longer corresponds to `t`, and a
             # checkpoint labeled with the wrong step poisons every later
-            # restore
-            if ok and self.save_every and t % self.save_every == 0:
+            # restore. An armed SDC quarantine suppresses publishing
+            # entirely — suspect-window state must never become the
+            # checkpoint a relaunch restores.
+            if ok and self.save_every and t % self.save_every == 0 \
+                    and not (sup is not None and sup.suppress_saves):
                 self.save()
         _chaos.maybe_sigterm(t)
         self._check_preempt()
+        if sup is not None and sup.reconfigure_requested \
+                and not self.preempted:
+            self._check_reconfigure()
         # after the preemption drain: a SIGKILL'd host gets no drain at
         # all (the multi-host chaos drill's dead-host fault)
         _chaos.maybe_sigkill(t)
@@ -877,6 +924,46 @@ class ResilientLoop:
                   "relaunch code %d" % EXIT_PREEMPTED, flush=True)
         raise Preempted(t)
 
+    def _check_reconfigure(self):
+        """The remediation drain (ISSUE 15): the supervisor cordoned a
+        host (or otherwise demanded a new world), so checkpoint at this
+        boundary, dump the black box, and exit with EXIT_RECONFIGURE —
+        the relauncher re-reads the cordon roster and rebuilds the pod
+        at N−1 via the elastic sharded restore."""
+        sup = self.supervisor
+        t = self._step.t
+        reason = sup.reconfigure_reason
+        if sup.suppress_saves:
+            # SDC quarantine: publish NOTHING — the relaunch must
+            # restore the newest quorum-certified step, not this
+            # suspect-window boundary
+            if self.verbose:
+                print("[resilient] reconfigure requested (%s) — SDC "
+                      "quarantine active, exiting WITHOUT a drain "
+                      "checkpoint (code %d)" % (reason,
+                                                EXIT_RECONFIGURE),
+                      flush=True)
+        else:
+            if self.verbose:
+                print("[resilient] reconfigure requested (%s) — "
+                      "checkpointing step %d and exiting with code %d"
+                      % (reason, t, EXIT_RECONFIGURE), flush=True)
+            # synchronous publication + the multi-process barrier,
+            # exactly the preemption drain's protocol: the relaunched
+            # (smaller) world must find this boundary complete on every
+            # surviving host
+            self.save(block=True)
+            self._manager.wait()
+        telemetry.flight().record("event", "train.reconfigure_exit",
+                                  reason=reason, step=t)
+        telemetry.flight().dump("reconfigure")
+        if sup.auditor is not None:
+            sup.auditor.stop()
+        if self.verbose and not sup.suppress_saves:
+            print("[resilient] checkpoint published; exiting with "
+                  "reconfigure code %d" % EXIT_RECONFIGURE, flush=True)
+        raise Reconfigured(t, reason)
+
     # -- epoch driver -------------------------------------------------------
     def batches(self):
         """Resume-aware batch stream: iterates `epochs` passes over the
@@ -925,6 +1012,8 @@ class ResilientLoop:
         self._manager.wait()
         if self.watcher is not None:
             self.watcher.uninstall()
+        if self.supervisor is not None:
+            self.supervisor.close()
         self.close_console()
 
     # -- live train console (ISSUE 14) --------------------------------------
@@ -986,6 +1075,8 @@ class ResilientLoop:
                                     self._anomaly.last.items()}}
                           if self._anomaly is not None else None),
             "comms": comms,
+            "remediation": (self.supervisor.status()
+                            if self.supervisor is not None else None),
         }
 
     def serve_metrics(self, port=0, host=None):

@@ -258,6 +258,7 @@ class TrainStep:
         self._lr_schedule = None
         self._t = 0
         self._step_fn = None
+        self._probe_fn = None
         self._compiled = False
 
     def set_lr_schedule(self, fn):
@@ -369,6 +370,11 @@ class TrainStep:
             # no segmentable children: whole-forward checkpoint (weaker —
             # peak is unchanged, but recompute semantics are preserved)
             forward_loss = jax.checkpoint(forward_loss, policy=remat_policy)
+
+        # kept for the donation-free SDC parity probe (probe()): the
+        # same forward/loss trace the step differentiates, minus the
+        # optimizer update and the buffer donation
+        self._forward_loss = forward_loss
 
         guard = self._guard
 
@@ -555,6 +561,47 @@ class TrainStep:
         jax.tree.map(_engine.note, (loss, self._grad_vals,
                                     self._nograd_vals, self._opt_state))
         return loss
+
+    def probe(self, x, y, seed=0):
+        """Deterministic, donation-free parity probe (ISSUE 15): compute
+        `(loss, global_grad_norm)` for the given batch under a FIXED RNG
+        seed against the live parameters — without mutating params,
+        optimizer state, the RNG key chain, or the step counter, and
+        without donating any buffer. Two calls with the same batch and
+        seed return bit-identical floats, and two HOSTS holding
+        replicated parameters return bit-identical floats — which is
+        what lets the SDC parity probe (parallel/supervisor.py)
+        cross-check digests and attribute a divergence to one chip.
+        Compiled once (its own non-donating executable, watchdog site
+        `train.probe`); reuses the step's forward/loss trace verbatim.
+        """
+        from ..telemetry import introspect as _introspect
+        if self._step_fn is None:
+            self._build()
+        if self._probe_fn is None:
+            fwd = self._forward_loss
+
+            def probe_fn(grad_vals, nograd_vals, x, y, key):
+                (loss_val, _aux), grads = jax.value_and_grad(
+                    fwd, has_aux=True)(grad_vals, nograd_vals, x, y, key)
+                gnorm = jnp.sqrt(sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in grads))
+                return loss_val, gnorm
+
+            self._probe_fn = _introspect.instrument(
+                jax.jit(probe_fn), site="train.probe", phase="train",
+                argnames=("grad_vals", "nograd_vals", "x", "y", "key"))
+        xv = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        yv = y._data if isinstance(y, NDArray) else jnp.asarray(y)
+        if self._mesh is not None:
+            from .mesh import shard_batch
+            xv = shard_batch(self._mesh, xv, self._data_axis)
+            yv = shard_batch(self._mesh, yv, self._data_axis)
+        key = jax.random.PRNGKey(int(seed))
+        loss, gnorm = self._probe_fn(self._grad_vals, self._nograd_vals,
+                                     xv, yv, key)
+        return float(np.asarray(loss)), float(np.asarray(gnorm))
 
     def memory_analysis(self):
         """XLA memory accounting of the compiled step (requires one prior

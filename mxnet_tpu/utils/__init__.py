@@ -17,7 +17,7 @@ def makedirs(d):
 
 
 def retry(fn, attempts=3, backoff=0.1, jitter=0.1, retry_on=(OSError,),
-          on_retry=None):
+          on_retry=None, deadline_s=None):
     """Call `fn()` with exponential backoff on transient failures.
 
     attempts  total tries (>=1); the last failure re-raises.
@@ -28,23 +28,40 @@ def retry(fn, attempts=3, backoff=0.1, jitter=0.1, retry_on=(OSError,),
               else propagates immediately.
     on_retry  optional callback (exc, attempt_index) before each sleep —
               the logging/metrics hook.
+    deadline_s  cap on the TOTAL seconds this call may spend sleeping
+              between attempts (measured from entry on the monotonic
+              clock). A sleep that would cross the deadline is clamped
+              to the remainder; once the deadline is spent the current
+              failure re-raises instead of retrying. The seam that lets
+              a SIGTERM drain thread the PreemptionWatcher's
+              `remaining_grace()` through checkpoint publish IO — the
+              backoff can no longer sleep past MXNET_PREEMPT_GRACE_SECS
+              and lose the final checkpoint. None = unbounded.
 
-    Used by model-zoo downloads and the serving HTTP frontend's
-    submit-on-QueueFull path; deliberately tiny so any transient-failure
-    site can adopt it.
+    Used by model-zoo downloads, the serving HTTP frontend's
+    submit-on-QueueFull path, and `CheckpointManager._io_retry`;
+    deliberately tiny so any transient-failure site can adopt it.
     """
     import random as _random
     import time as _time
     attempts = max(1, int(attempts))
+    t0 = _time.monotonic()
     for i in range(attempts):
         try:
             return fn()
         except retry_on as e:
             if i == attempts - 1:
                 raise
+            remaining = None
+            if deadline_s is not None:
+                remaining = float(deadline_s) - (_time.monotonic() - t0)
+                if remaining <= 0:
+                    raise
             if on_retry is not None:
                 on_retry(e, i)
             delay = backoff * (2 ** i)
             delay *= 1.0 + jitter * _random.random()
+            if remaining is not None:
+                delay = min(delay, remaining)
             if delay > 0:
                 _time.sleep(delay)

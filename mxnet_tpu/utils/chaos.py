@@ -41,6 +41,14 @@ changes:
                                    UNLATCHED (a straggler is slow every
                                    step); the first firing records one
                                    flight event.
+  MXNET_CHAOS_SDC_AT=<host>:<step> flip the SDC parity probe's digest on
+                                   the process whose MXNET_HOST_ID equals
+                                   <host>, at the first probe with step
+                                   >= <step> — silent data corruption: a
+                                   finite-but-wrong result only the
+                                   cross-host digest quorum
+                                   (parallel/supervisor.py SDCProbe) can
+                                   attribute to one chip.
 
 SERVING faults (ISSUE 11; tools/chaos_serve.py drives them through a
 multi-replica fleet) target one replica's serving loop and are keyed
@@ -93,6 +101,10 @@ _FAULTS = ("kill_save", "corrupt_ckpt", "nan_step", "sigterm_at",
 #: `<host>:<secs>[:<from_step>]` — per-step sleep on one emulated host
 #: (parsed separately: the key is a HOST label, not a step)
 _HOST_FAULTS = ("slow_host",)
+
+#: `<host>:<step>` — faults targeting one host at one step (the key is
+#: a HOST label + an integer step, unlike _HOST_FAULTS' float seconds)
+_HOST_STEP_FAULTS = ("sdc_at",)
 
 #: the finite gradient poison `spike_step` injects: big enough that the
 #: EWMA z-score on the grad norm flags it unmissably, small enough that
@@ -158,6 +170,21 @@ def _parse_host(name, val):
     return tuple(out)
 
 
+def _parse_host_step(name, val):
+    """(host, step) out of `<host>:<step>` (host stays a string —
+    MXNET_HOST_ID labels are strings; step is a 1-based int)."""
+    if isinstance(val, (tuple, list)):
+        parts = list(val)
+    else:
+        parts = str(val).split(":")
+    if len(parts) != 2:
+        raise ValueError("%s must be <host>:<step>, got %r" % (name, val))
+    try:
+        return (str(parts[0]), int(parts[1]))
+    except (TypeError, ValueError):
+        raise ValueError("%s must be <host>:<step>, got %r" % (name, val))
+
+
 def _load_env():
     global _env_loaded
     if _env_loaded:
@@ -181,6 +208,11 @@ def _load_env():
         if val:
             _conf.setdefault(name, _parse_host(
                 "MXNET_CHAOS_" + name.upper(), val))
+    for name in _HOST_STEP_FAULTS:
+        val = os.environ.get("MXNET_CHAOS_" + name.upper())
+        if val:
+            _conf.setdefault(name, _parse_host_step(
+                "MXNET_CHAOS_" + name.upper(), val))
 
 
 def configure(**faults):
@@ -190,10 +222,12 @@ def configure(**faults):
     _load_env()
     for name, step in faults.items():
         if name not in _FAULTS and name not in _SERVE_FAULTS \
-                and name not in _HOST_FAULTS:
+                and name not in _HOST_FAULTS \
+                and name not in _HOST_STEP_FAULTS:
             raise ValueError("unknown chaos fault %r (know %s)"
                              % (name, ", ".join(_FAULTS + _SERVE_FAULTS
-                                                + _HOST_FAULTS)))
+                                                + _HOST_FAULTS
+                                                + _HOST_STEP_FAULTS)))
         if step is None:
             _conf.pop(name, None)
             _fired.discard(name)
@@ -201,6 +235,8 @@ def configure(**faults):
             _conf[name] = _parse_serve(name, step)
         elif name in _HOST_FAULTS:
             _conf[name] = _parse_host(name, step)
+        elif name in _HOST_STEP_FAULTS:
+            _conf[name] = _parse_host_step(name, step)
         else:
             _conf[name] = int(step)
     return dict(_conf)
@@ -237,6 +273,15 @@ def maybe_kill_during_save(step):
     """recovery.CheckpointManager._write calls this between writing the
     temp file and the atomic os.replace publish."""
     if _should("kill_save", step):
+        # best-effort black box before dying: the fault event just
+        # recorded (and the spans before it) reach the flight dir when
+        # one is configured, so a crash-LOOPING worker still leaves a
+        # postmortem trail. No-op without MXNET_FLIGHT_RECORDER_DIR.
+        from .. import telemetry
+        try:
+            telemetry.flight().dump("chaos_kill")
+        except Exception:
+            pass
         os._exit(43)  # hard exit: no atexit, no flush — a real preemption
 
 
@@ -282,6 +327,28 @@ def maybe_slow_host(step):
                                   host=cfg[0], secs=cfg[1],
                                   step=int(step))
     time.sleep(cfg[1])
+    return True
+
+
+def sdc_poison(step):
+    """SDCProbe (parallel/supervisor.py) calls this with each probe's
+    step: an armed `sdc_at` fault whose host matches this process's
+    MXNET_HOST_ID returns True at the first probe with step >= the
+    armed one (then latches) — the probe perturbs its computed values
+    before digesting, emulating a chip that silently computes a
+    finite-but-wrong answer. The digest flip is only attributable by
+    the cross-host quorum; nothing else in the process misbehaves."""
+    _load_env()
+    cfg = _conf.get("sdc_at")
+    if cfg is None or "sdc_at" in _fired:
+        return False
+    if os.environ.get("MXNET_HOST_ID", "0") != cfg[0] \
+            or int(step) < cfg[1]:
+        return False
+    _fired.add("sdc_at")
+    from .. import telemetry
+    telemetry.flight().record("fault", "chaos.sdc_at", host=cfg[0],
+                              step=int(step))
     return True
 
 

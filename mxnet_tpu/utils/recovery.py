@@ -174,6 +174,21 @@ class CheckpointManager:
         #: bounded-backoff attempts for each filesystem publish operation
         #: (a transient NFS/GCS-fuse hiccup must not kill an async save)
         self.io_retries = 3
+        #: optional () -> seconds-or-None deadline for publish-IO retry
+        #: backoff. ResilientLoop points this at its PreemptionWatcher's
+        #: `remaining_grace()`, so a SIGTERM drain's retry sleeps can
+        #: never outlast MXNET_PREEMPT_GRACE_SECS and lose the final
+        #: checkpoint to the grace-timer force-exit. None (or a callable
+        #: returning None) = unbounded backoff.
+        self.deadline_fn = None
+        #: optional observers for the remediation supervisor
+        #: (parallel/supervisor.py): `on_error(exc)` fires when a
+        #: publish ultimately failed (after retries — the stored error
+        #: still surfaces on the next save()/wait()), `on_success()`
+        #: after a clean publish. Both best-effort, never raised into
+        #: the writer thread.
+        self.on_error = None
+        self.on_success = None
         self._worker = None
         self._lock = threading.Lock()
         self._error = None
@@ -369,8 +384,9 @@ class CheckpointManager:
     # -- IO primitives (each publish operation retries transients) ----------
     def _io_retry(self, fn):
         from mxnet_tpu.utils import retry
+        deadline = self.deadline_fn() if self.deadline_fn else None
         return retry(fn, attempts=self.io_retries, backoff=0.05,
-                     jitter=0.5, retry_on=OSError,
+                     jitter=0.5, retry_on=OSError, deadline_s=deadline,
                      on_retry=lambda e, i: self._metrics["retries"].inc(
                          error=str(e), attempt=i))
 
@@ -435,9 +451,26 @@ class CheckpointManager:
                 self._metrics["saves"].inc()
                 _chaos.maybe_corrupt_checkpoint(step, final)
                 self._prune()
+            self._notify(True)
         except Exception as e:  # surfaced on the next save()/wait()
             with self._lock:
                 self._error = e
+            self._notify(False, e)
+
+    def _notify(self, ok, exc=None):
+        """Best-effort publish-outcome observers (the remediation
+        supervisor's consecutive-failure signal); a raising callback
+        must never poison the writer thread."""
+        cb = self.on_success if ok else self.on_error
+        if cb is None:
+            return
+        try:
+            if ok:
+                cb()
+            else:
+                cb(exc)
+        except Exception:
+            pass
 
     def _write_sharded(self, step, host, entries, gmeta):
         try:
@@ -468,9 +501,11 @@ class CheckpointManager:
                 self._metrics["saves"].inc()
                 _chaos.maybe_corrupt_checkpoint(step, final)
                 self._prune()
+            self._notify(True)
         except Exception as e:  # surfaced on the next save()/wait()
             with self._lock:
                 self._error = e
+            self._notify(False, e)
 
     def _prune(self):
         steps = sorted(self.all_steps())
@@ -619,6 +654,52 @@ class CheckpointManager:
                              "manifest — the manifest writer never "
                              "published" % step)
         self._verify_manifest(step, path)
+
+    def step_files(self, step):
+        """Every on-disk file belonging to `step` (single-file npz +
+        manifest, every shard npz + sidecar, the global manifest) that
+        currently exists — the demotion/audit unit."""
+        step = int(step)
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        single = "ckpt-%d.npz" % step
+        shard_prefix = "ckpt-%d.shard" % step
+        manifest = os.path.basename(self._manifest_path(step))
+        for name in sorted(names):
+            if name == single or name == manifest \
+                    or (name.startswith(shard_prefix)
+                        and (name.endswith(".npz")
+                             or name.endswith(".manifest.json"))):
+                out.append(os.path.join(self.directory, name))
+        return out
+
+    def demote(self, step, reason=""):
+        """Take `step` out of the restorable set by renaming every one
+        of its files with a `.corrupt` suffix (kept on disk as evidence,
+        invisible to `all_steps()`/`restore_latest()`). The background
+        checkpoint auditor (parallel/supervisor.py) calls this when a
+        PUBLISHED checkpoint later fails its manifest re-verification —
+        bit-rot or a torn write between save and the restore that would
+        have needed it. Returns the renamed paths."""
+        renamed = []
+        for path in self.step_files(step):
+            try:
+                os.replace(path, path + ".corrupt")
+                renamed.append(path)
+            except OSError:
+                continue
+        if renamed:
+            _fsync_dir(self.directory)
+            self._metrics["manifest_failures"].inc(step=int(step),
+                                                   error=reason
+                                                   or "demoted")
+            telemetry.flight().record(
+                "event", "train.ckpt_demoted", step=int(step),
+                reason=str(reason)[:200], files=len(renamed))
+        return renamed
 
     def intact_steps(self):
         """Steps whose checkpoints fully verify on this host (sharded:

@@ -320,6 +320,52 @@ def test_train_observability_env_knobs(monkeypatch):
     chaos.reset()
 
 
+def test_remediation_env_knobs(monkeypatch):
+    """ISSUE 15 knob surface: supervisor cadences/budgets parse with
+    documented defaults, malformed values fail naming the knob, and the
+    sdc_at chaos fault parses its <host>:<step> shape."""
+    from mxnet_tpu.parallel import supervisor
+    from mxnet_tpu.utils import chaos
+    for var in ("MXNET_TRAIN_REMEDIATION", "MXNET_SDC_PROBE_EVERY",
+                "MXNET_SDC_PROBE_TIMEOUT", "MXNET_TRAIN_RESTART_MAX",
+                "MXNET_TRAIN_RESTART_BACKOFF", "MXNET_CORDON_MIN_HOSTS"):
+        monkeypatch.delenv(var, raising=False)
+    assert not supervisor.remediation_enabled()        # off by default
+    assert supervisor.sdc_probe_every() == 0
+    assert supervisor.sdc_probe_timeout() == 60.0
+    assert supervisor.restart_max() == 3
+    assert supervisor.restart_backoff() == 0.5
+    assert supervisor.cordon_min_hosts() == 1
+    monkeypatch.setenv("MXNET_TRAIN_REMEDIATION", "1")
+    monkeypatch.setenv("MXNET_SDC_PROBE_EVERY", "64")
+    monkeypatch.setenv("MXNET_TRAIN_RESTART_MAX", "5")
+    monkeypatch.setenv("MXNET_TRAIN_RESTART_BACKOFF", "1.5")
+    monkeypatch.setenv("MXNET_CORDON_MIN_HOSTS", "2")
+    assert supervisor.remediation_enabled()
+    assert supervisor.sdc_probe_every() == 64
+    assert supervisor.restart_max() == 5
+    assert supervisor.restart_backoff() == 1.5
+    assert supervisor.cordon_min_hosts() == 2
+    monkeypatch.setenv("MXNET_SDC_PROBE_EVERY", "often")
+    with pytest.raises(ValueError, match="MXNET_SDC_PROBE_EVERY"):
+        supervisor.sdc_probe_every()
+    monkeypatch.setenv("MXNET_TRAIN_RESTART_MAX", "-1")
+    with pytest.raises(ValueError, match="MXNET_TRAIN_RESTART_MAX"):
+        supervisor.restart_max()
+    monkeypatch.setenv("MXNET_CORDON_MIN_HOSTS", "0")  # a 0-host pod
+    with pytest.raises(ValueError, match="MXNET_CORDON_MIN_HOSTS"):
+        supervisor.cordon_min_hosts()
+    # the sdc_at chaos fault: <host>:<step>, host stays a string
+    chaos.reset()
+    monkeypatch.setenv("MXNET_CHAOS_SDC_AT", "3:17")
+    assert chaos.active()["sdc_at"] == ("3", 17)
+    chaos.reset()
+    monkeypatch.setenv("MXNET_CHAOS_SDC_AT", "3")      # missing step
+    with pytest.raises(ValueError, match="MXNET_CHAOS_SDC_AT"):
+        chaos.active()
+    chaos.reset()
+
+
 def test_anomaly_alpha_zero_fails_loudly_naming_the_knob(monkeypatch):
     """alpha=0 would freeze the EWMA; it must be rejected AT THE KNOB
     (named), not mid-training by the lazily-built detector."""

@@ -273,7 +273,13 @@ def _judge_secondary(verdict, fresh, ref):
                              # warns; the measured value decides
                              ("data_wait_fraction", 0.25, 1),
                              ("step_p95_ms", 0.50, 1),
-                             ("comms_bytes_per_step", 0.15, 1)):
+                             ("comms_bytes_per_step", 0.15, 1),
+                             # ISSUE 15: remediation health signals — a
+                             # growing fault->recovery time or more
+                             # re-executed work per restart warns; the
+                             # measured publish latency decides
+                             ("mttr_s", 0.50, 1),
+                             ("steps_lost_per_remediation", 0.50, 1)):
         fv, rv = fresh.get(field), ref.get(field)
         if not isinstance(fv, (int, float)) or not isinstance(
                 rv, (int, float)) or rv <= 0:

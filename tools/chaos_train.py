@@ -48,10 +48,35 @@ directory. The drill then
      — with the global batch size held constant — finishes
      loss-curve-identical (equal up to collective reduction order).
 
+Supervised mode (``--multihost --supervised``) is the ISSUE 15
+REMEDIATION campaign: the pod runs with the training supervisor armed
+(`MXNET_TRAIN_REMEDIATION=1`, parallel/supervisor.py) under a
+relauncher implementing the restart ladder (budget, exponential
+backoff, circuit breaker — the pod-scale sibling of
+tools/train_supervise.py). Four legs:
+
+  A. a chaos-armed SLOW host is flagged by the straggler detector,
+     CORDONED onto the shared roster, the pod drains with
+     EXIT_RECONFIGURE (84), and the relauncher rebuilds it at N−1
+     hosts (cordoned host excluded) via the elastic sharded restore —
+     the finish must equal the undisturbed reference;
+  B. a SIGKILLed host is auto-relaunched within the restart budget and
+     the pod finishes bit-identical;
+  C. an injected SDC digest flip (``MXNET_CHAOS_SDC_AT``) makes the
+     cross-host parity-probe quorum name EXACTLY the poisoned host,
+     which is cordoned and excluded at N−1;
+  D. a crash-looping worker (kill-during-save kept armed across
+     relaunches) exhausts the budget: the circuit OPENS, the campaign
+     leg fails loudly with a rendered postmortem.
+
+Every detector flag, cordon, reconfigure, and injected fault must
+appear on the merged flight-recorder timeline (tools/postmortem.py).
+
 Usage:
     python tools/chaos_train.py                  # LeNet drill
     python tools/chaos_train.py --net mlp        # fast CI config
     python tools/chaos_train.py --multihost      # pod-scale drill
+    python tools/chaos_train.py --multihost --supervised  # remediation
 """
 import argparse
 import os
@@ -377,6 +402,155 @@ def _check_train_top(url, host):
         print("   " + line)
 
 
+class _PodSupervisor:
+    """Pod-scale relauncher with the ISSUE 15 restart ladder — the
+    multihost counterpart of tools/train_supervise.py. Launches one
+    `_Host` per world member, then:
+
+      rc 0    host finished — final line collected;
+      rc 83   preempted (drained): relaunch that host, FREE;
+      rc 84   reconfigure (drained): wait for the whole incarnation to
+              exit, re-read the cordon roster, relaunch the SHRUNK
+              world (elastic restore picks up the pod's newest
+              all-complete step);
+      other   crash: consume one restart life, back off exponentially,
+              relaunch that host with chaos scrubbed (unless
+              `keep_chaos` — the crash-loop leg). Budget exhausted ⇒
+              circuit OPEN: surviving hosts killed, postmortem rendered
+              from the flight dir, run() returns False.
+    """
+
+    def __init__(self, args, ckpt_dir, labels, env_for, restart_max=None,
+                 backoff=0.2, keep_chaos=False, flight_dir=None):
+        self.args = args
+        self.ckpt_dir = ckpt_dir
+        self.labels = [str(l) for l in labels]
+        self.env_for = env_for          # label -> extra env dict
+        if restart_max is None:
+            from mxnet_tpu.parallel import supervisor as _sup
+            restart_max = _sup.restart_max()
+        self.restart_max = int(restart_max)
+        self.backoff = float(backoff)
+        self.keep_chaos = keep_chaos
+        self.flight_dir = flight_dir
+        self.roster_dir = os.path.join(ckpt_dir, "cordon")
+        self.finals = {}                # label -> FINAL line
+        self.crashes = 0
+        self.relaunches = 0
+        self.incarnations = 0
+        self.circuit_open = False
+        self.worlds = []                # world per incarnation
+        self.postmortem_text = ""
+        self._launched = {}             # label -> launch count
+
+    def _roster(self):
+        return _load_tool("train_supervise").read_roster(self.roster_dir)
+
+    def _launch(self, label, idx, n):
+        env = dict(self.env_for(label) or {})
+        if self._launched.get(label, 0) > 0 and not self.keep_chaos:
+            # a relaunch scrubs the injected faults (a real relauncher
+            # scrubs MXNET_CHAOS_*; the crash-loop leg keeps them to
+            # model a fault that is really still there)
+            env = {k: v for k, v in env.items()
+                   if not k.startswith("MXNET_CHAOS_")}
+        env["MXNET_HOST_ID"] = label
+        self._launched[label] = self._launched.get(label, 0) + 1
+        return _Host(self.args, self.ckpt_dir, idx, n, chaos=env)
+
+    def run(self, deadline_s=900):
+        from mxnet_tpu.parallel.resilient import (EXIT_PREEMPTED,
+                                                  EXIT_RECONFIGURE)
+        world = [l for l in self.labels if l not in self._roster()]
+        deadline = time.time() + deadline_s
+        while True:
+            self.worlds.append(list(world))
+            self.incarnations += 1
+            n = len(world)
+            print("[pod-supervise] incarnation %d: world %s"
+                  % (self.incarnations - 1, world), flush=True)
+            crew = {lab: self._launch(lab, i, n)
+                    for i, lab in enumerate(world)}
+            pending = dict(crew)
+            reconfigure = False
+            while pending:
+                assert time.time() < deadline, \
+                    "pod incarnation timed out (world %s)" % world
+                for lab in list(pending):
+                    h = pending[lab]
+                    rc = h.proc.poll()
+                    if rc is None:
+                        continue
+                    h.wait()
+                    del pending[lab]
+                    if rc == 0:
+                        h.report("host %s finished" % lab)
+                        self.finals[lab] = _final_of(h)
+                        continue
+                    if rc == EXIT_RECONFIGURE:
+                        h.report("host %s reconfigure (84)" % lab)
+                        reconfigure = True
+                        continue
+                    if rc == EXIT_PREEMPTED:
+                        h.report("host %s preempted (83) — relaunch "
+                                 "(free)" % lab)
+                        self.relaunches += 1
+                        pending[lab] = crew[lab] = self._launch(
+                            lab, world.index(lab), n)
+                        continue
+                    # a crash: one life, exponential backoff, relaunch
+                    self.crashes += 1
+                    lives = self.restart_max - self.crashes
+                    h.report("host %s CRASH rc=%s (%d of %d lives left)"
+                             % (lab, rc, max(lives, 0),
+                                self.restart_max))
+                    if lives < 0:
+                        self.circuit_open = True
+                        print("[pod-supervise] CIRCUIT OPEN: restart "
+                              "budget (MXNET_TRAIN_RESTART_MAX=%d) "
+                              "exhausted — degrading loudly"
+                              % self.restart_max, flush=True)
+                        for o in pending.values():
+                            o.proc.kill()
+                            o.wait()
+                        self._postmortem()
+                        return False
+                    delay = min(self.backoff * (2 ** (self.crashes - 1)),
+                                30.0)
+                    print("[pod-supervise] backing off %.2fs before "
+                          "relaunching host %s" % (delay, lab),
+                          flush=True)
+                    time.sleep(delay)
+                    self.relaunches += 1
+                    pending[lab] = crew[lab] = self._launch(
+                        lab, world.index(lab), n)
+                if pending:
+                    time.sleep(0.1)
+            if not reconfigure:
+                return True
+            new_world = [l for l in self.labels
+                         if l not in self._roster()]
+            print("[pod-supervise] reconfigure: world %s -> %s "
+                  "(cordoned: %s)" % (world, new_world,
+                                      sorted(self._roster()) or "none"),
+                  flush=True)
+            assert new_world, "reconfigure cordoned the whole pod"
+            self.relaunches += 1
+            world = new_world
+
+    def _postmortem(self):
+        if not self.flight_dir or not os.path.isdir(self.flight_dir):
+            return
+        try:
+            pm = _load_tool("postmortem")
+            text = pm.render(pm.load_dumps([self.flight_dir]))
+        except Exception as e:
+            print("[pod-supervise] (postmortem render failed: %s)" % e)
+            return
+        self.postmortem_text = text
+        print(text, flush=True)
+
+
 def multihost(args):
     """The pod-scale drill (see the module docstring, Multi-host mode)."""
     import shutil
@@ -512,6 +686,183 @@ def multihost(args):
     return 0
 
 
+def _flight_events(flight_dir):
+    """(name -> [event, ...]) across every dump in `flight_dir`."""
+    import json as _json
+    out = {}
+    for name in sorted(os.listdir(flight_dir)):
+        if not (name.startswith("flight-") and name.endswith(".json")):
+            continue
+        with open(os.path.join(flight_dir, name)) as f:
+            doc = _json.load(f)
+        for ev in doc.get("events", []):
+            out.setdefault(ev.get("name"), []).append(ev)
+    return out
+
+
+def supervised(args):
+    """The ISSUE 15 remediation campaign (module docstring, Supervised
+    mode): four legs through the detect -> decide -> act loop."""
+    base = args.work_dir or tempfile.mkdtemp(prefix="chaos_sup_")
+    hosts, devices = args.hosts or 3, args.devices
+    roster_of = _load_tool("train_supervise").read_roster
+    print("== supervised remediation campaign: %s, %d steps, save every "
+          "%d, %d hosts x %d virtual devices"
+          % (args.net, args.steps, args.save_every, hosts, devices))
+
+    # undisturbed reference (emulated hosts are trajectory replicas:
+    # one clean host pins the whole pod's trajectory)
+    ref = _Host(args, os.path.join(base, "clean"), 0, 1)
+    rc = ref.wait()
+    ref.report("clean reference")
+    assert rc == 0, "clean run failed:\n" + ref.stdout[-2000:]
+    want = _final_of(ref)
+    assert want is not None
+
+    # -- leg A: slow host -> straggler flag -> cordon -> elastic N-1 --------
+    dir_a = os.path.join(base, "leg_a")
+    flight_a = os.path.join(base, "flight_a")
+    env_a = {
+        "MXNET_TRAIN_REMEDIATION": "1",
+        "MXNET_STRAGGLER_DIR": os.path.join(base, "straggler_a"),
+        "MXNET_STRAGGLER_WINDOW": "2",
+        # the injected straggler sits ~50x the pod median, so a wide
+        # factor keeps ms-scale CPU jitter between the HEALTHY hosts
+        # (whose early windows may not include the slow host's first
+        # publish yet) from ever reaching the cordon path
+        "MXNET_STRAGGLER_FACTOR": "3.0",
+        "MXNET_STRAGGLER_PATIENCE": "2",
+        "MXNET_FLIGHT_RECORDER_DIR": flight_a,
+    }
+    print("== leg A: slow host 1 (0.25s/step) must be cordoned and the "
+          "pod finish at %d hosts" % (hosts - 1))
+    pod_a = _PodSupervisor(
+        args, dir_a, [str(i) for i in range(hosts)],
+        env_for=lambda lab: dict(
+            env_a, **({"MXNET_CHAOS_SLOW_HOST": "1:0.25"}
+                      if lab == "1" else {})),
+        flight_dir=flight_a)
+    assert pod_a.run(), "leg A pod did not finish"
+    assert not pod_a.circuit_open
+    rosterA = roster_of(os.path.join(dir_a, "cordon"))
+    assert sorted(rosterA) == ["1"], (
+        "expected exactly host 1 cordoned, roster: %s" % sorted(rosterA))
+    assert rosterA["1"]["reason"] == "straggler", rosterA["1"]
+    assert pod_a.worlds[-1] == [str(i) for i in range(hosts)
+                                if i != 1], pod_a.worlds
+    for lab in pod_a.worlds[-1]:
+        got = pod_a.finals.get(lab)
+        assert got == want, ("host %s diverged after the cordoned "
+                             "restart: %r != %r" % (lab, got, want))
+    ev = _flight_events(flight_a)
+    for name in ("chaos.slow_host", "train.straggler", "train.cordon",
+                 "train.reconfigure", "train.reconfigure_exit"):
+        assert ev.get(name), "leg A flight timeline is missing %s" % name
+    assert {str(e.get("host")) for e in ev["train.cordon"]} == {"1"}
+    pm = _load_tool("postmortem")
+    text = pm.render(pm.load_dumps([flight_a]))
+    assert "train.cordon" in text and "ALERT" in text, text[:800]
+    print("== leg A OK: cordoned host 1, finished loss-curve-identical "
+          "at %d hosts (%d incarnation(s), %d relaunch(es))"
+          % (hosts - 1, pod_a.incarnations, pod_a.relaunches))
+
+    # -- leg B: SIGKILL -> auto-relaunch within the restart budget ----------
+    dir_b = os.path.join(base, "leg_b")
+    k_kill = (args.steps // 2) + 1
+    if k_kill % args.save_every == 0:
+        k_kill += 1
+    print("== leg B: SIGKILL host 1 @%d must auto-relaunch within the "
+          "budget and finish bit-identical" % k_kill)
+    pod_b = _PodSupervisor(
+        args, dir_b, ["0", "1"],
+        env_for=lambda lab: (
+            {"MXNET_CHAOS_SIGKILL_AT": str(k_kill)}
+            if lab == "1" else {}),
+        restart_max=3)
+    assert pod_b.run(), "leg B pod did not finish"
+    assert pod_b.crashes == 1, ("expected exactly one consumed life, "
+                                "got %d" % pod_b.crashes)
+    assert not pod_b.circuit_open
+    for lab in ("0", "1"):
+        assert pod_b.finals.get(lab) == want, (
+            "host %s diverged after auto-relaunch: %r != %r"
+            % (lab, pod_b.finals.get(lab), want))
+    print("== leg B OK: dead host auto-relaunched (1 of 3 lives), "
+          "bit-identical finish")
+
+    # -- leg C: injected SDC digest flip -> right host named + cordoned -----
+    dir_c = os.path.join(base, "leg_c")
+    flight_c = os.path.join(base, "flight_c")
+    k_probe = args.save_every            # on-cadence: drain step complete
+    k_sdc = 2 * k_probe
+    env_c = {
+        "MXNET_TRAIN_REMEDIATION": "1",
+        "MXNET_SDC_PROBE_EVERY": str(k_probe),
+        "MXNET_SDC_PROBE_DIR": os.path.join(base, "sdc_c"),
+        "MXNET_SDC_PROBE_TIMEOUT": "180",
+        "MXNET_FLIGHT_RECORDER_DIR": flight_c,
+    }
+    print("== leg C: SDC digest flip on host 1 @ probe step %d must "
+          "name and cordon exactly host 1" % k_sdc)
+    pod_c = _PodSupervisor(
+        args, dir_c, [str(i) for i in range(hosts)],
+        env_for=lambda lab: dict(
+            env_c, **({"MXNET_CHAOS_SDC_AT": "1:%d" % k_sdc}
+                      if lab == "1" else {})),
+        flight_dir=flight_c)
+    assert pod_c.run(), "leg C pod did not finish"
+    rosterC = roster_of(os.path.join(dir_c, "cordon"))
+    assert sorted(rosterC) == ["1"], (
+        "SDC quorum named %s, expected exactly host 1" % sorted(rosterC))
+    assert rosterC["1"]["reason"] == "sdc", rosterC["1"]
+    assert pod_c.worlds[-1] == [str(i) for i in range(hosts)
+                                if i != 1], pod_c.worlds
+    for lab in pod_c.worlds[-1]:
+        assert pod_c.finals.get(lab) is not None, \
+            "host %s left no FINAL line" % lab
+    ev = _flight_events(flight_c)
+    assert ev.get("chaos.sdc_at"), "injected SDC fault not on timeline"
+    sdc_named = {str(e.get("host")) for e in ev.get("train.sdc", [])
+                 if e.get("quorum")}
+    assert sdc_named == {"1"}, (
+        "train.sdc events named %s, expected exactly host 1"
+        % sorted(sdc_named))
+    text = pm.render(pm.load_dumps([flight_c]))
+    assert "train.sdc" in text and "ALERT" in text
+    print("== leg C OK: quorum named host 1, cordoned, finished at %d "
+          "hosts" % (hosts - 1))
+
+    # -- leg D: crash loop -> circuit opens, postmortem rendered ------------
+    dir_d = os.path.join(base, "leg_d")
+    flight_d = os.path.join(base, "flight_d")
+    k_crash = 2 * args.save_every        # kill mid-save, every relaunch
+    print("== leg D: kill-during-save @%d kept armed across relaunches "
+          "must open the circuit (budget 2)" % k_crash)
+    pod_d = _PodSupervisor(
+        args, dir_d, ["0"],
+        env_for=lambda lab: {
+            "MXNET_CHAOS_KILL_SAVE": str(k_crash),
+            "MXNET_FLIGHT_RECORDER_DIR": flight_d,
+        },
+        restart_max=2, keep_chaos=True, backoff=0.05,
+        flight_dir=flight_d)
+    ok = pod_d.run()
+    assert ok is False and pod_d.circuit_open, (
+        "crash loop did not open the circuit (ok=%r)" % ok)
+    assert pod_d.crashes == 3            # budget 2 => 3 strikes
+    assert "chaos.kill_save" in pod_d.postmortem_text, (
+        "circuit-open postmortem did not render the injected fault:\n"
+        + pod_d.postmortem_text[:800])
+    print("== leg D OK: circuit opened after %d crashes, postmortem "
+          "rendered (%d lines)"
+          % (pod_d.crashes, len(pod_d.postmortem_text.splitlines())))
+
+    print("== OK: supervised remediation campaign — straggler cordoned "
+          "+ elastic N-1 finish, SIGKILL auto-relaunch bit-identical, "
+          "SDC suspect named exactly, crash-loop circuit opened loudly")
+    return 0
+
+
 def orchestrate(args):
     from mxnet_tpu.parallel.resilient import EXIT_PREEMPTED
     base = args.work_dir or tempfile.mkdtemp(prefix="chaos_train_")
@@ -559,6 +910,11 @@ def main():
     ap.add_argument("--multihost", action="store_true",
                     help="pod-scale drill: emulated hosts, sharded "
                          "checkpoints, SIGKILL one host, elastic resume")
+    ap.add_argument("--supervised", action="store_true",
+                    help="with --multihost: the ISSUE 15 remediation "
+                         "campaign (cordon/elastic-restart, SIGKILL "
+                         "auto-relaunch, SDC quorum, crash-loop "
+                         "circuit)")
     ap.add_argument("--net", choices=("lenet", "mlp"), default="lenet")
     ap.add_argument("--steps", type=int, default=24)
     ap.add_argument("--save-every", type=int, default=4)
@@ -576,6 +932,12 @@ def main():
         assert args.ckpt_dir, "--worker needs --ckpt-dir"
         return worker(args)
     if args.multihost:
+        if args.supervised:
+            if not args.devices:
+                args.devices = 2
+            if not args.hosts:
+                args.hosts = 3
+            return supervised(args)
         if not args.devices:
             args.devices = 4
         if not args.hosts:

@@ -30,10 +30,15 @@ import statistics
 import sys
 import zlib
 
-#: detector events rendered as FAULT-style callouts: not injected
-#: faults, but exactly as load-bearing on a timeline (the answer to
-#: "did the pod KNOW something was wrong before it died")
-ALERT_EVENTS = ("train.straggler", "train.anomaly")
+#: detector + remediation events rendered as FAULT-style callouts: not
+#: injected faults, but exactly as load-bearing on a timeline (the
+#: answers to "did the pod KNOW something was wrong before it died" and
+#: "what did the supervisor DO about it" — ISSUE 15)
+ALERT_EVENTS = ("train.straggler", "train.anomaly", "train.sdc",
+                "train.sdc_quarantine", "train.cordon",
+                "train.cordon_refused", "train.reconfigure",
+                "train.reconfigure_exit", "train.host_absent",
+                "train.ckpt_demoted", "train.publish_failure")
 
 
 def host_pid(host, pid):

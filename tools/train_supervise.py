@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""Single-pod training relauncher (ISSUE 15): the restart half of the
+remediation loop.
+
+`parallel/supervisor.py` detects and decides INSIDE the training
+process (cordon roster, SDC quorum, checkpoint auditor) and exits with
+a distinct code; this wrapper is the hands OUTSIDE it — it relaunches
+the training command until the run completes, under a restart budget
+with exponential backoff and a circuit breaker, so a crash-looping job
+degrades loudly instead of thrashing:
+
+  exit 0                    done — exit 0.
+  exit 83 (EXIT_PREEMPTED)  preemption drained a checkpoint: relaunch
+                            immediately. FREE — progress is durable and
+                            spot churn must not eat the crash budget.
+  exit 84 (EXIT_RECONFIGURE) remediation drained a checkpoint: print
+                            the cordon roster and relaunch (the command
+                            re-reads the roster / elastic-restores).
+                            FREE, same reasoning.
+  anything else             a crash: consume one restart life, back off
+                            exponentially (MXNET_TRAIN_RESTART_BACKOFF
+                            base, doubling, capped at 30s), relaunch.
+                            `MXNET_TRAIN_RESTART_MAX` lives (default 3)
+                            and the circuit OPENS: the wrapper renders
+                            a postmortem (the restart ledger, plus
+                            tools/postmortem.py over --flight-dir when
+                            dumps exist) and exits with the child's
+                            code — loud, never a silent retry loop.
+
+An incarnation that stays up at least `--reset-after` seconds (default
+300) refunds the crash budget — the serving router's `respawn_reset_s`
+forgiveness, so one bad hour years ago never strands a healthy job one
+crash from its circuit.
+
+Usage:
+    python tools/train_supervise.py -- python train.py --my-args
+    python tools/train_supervise.py --roster /ckpts/cordon \\
+        --flight-dir /ckpts/flight -- python train.py
+
+Deliberately stdlib-only: it must keep running when the training
+process's own runtime is the thing that is broken.
+
+The pod-scale counterpart (N emulated hosts, cordoned hosts excluded
+from the relaunched world) lives in `tools/chaos_train.py --multihost
+--supervised`, which drills this whole ladder end-to-end.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+#: mirror of parallel/resilient.py (stdlib-only tool: no framework import)
+EXIT_PREEMPTED = 83
+EXIT_RECONFIGURE = 84
+
+_BACKOFF_CAP_S = 30.0
+
+
+def _env_int(name, default):
+    raw = os.environ.get(name)
+    if raw in (None, ""):
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise SystemExit("%s must be an integer, got %r" % (name, raw))
+
+
+def _env_float(name, default):
+    raw = os.environ.get(name)
+    if raw in (None, ""):
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise SystemExit("%s must be a number, got %r" % (name, raw))
+
+
+def read_roster(path):
+    """host -> entry of a CordonRoster directory (stdlib mirror of
+    parallel/supervisor.py — one atomic JSON per cordoned host)."""
+    out = {}
+    if not path:
+        return out
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return out
+    for name in sorted(names):
+        if not (name.startswith("host-") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(path, name)) as f:
+                entry = json.load(f)
+            out[str(entry["host"])] = entry
+        except (OSError, ValueError, KeyError, TypeError):
+            continue
+    return out
+
+
+def render_postmortem(ledger, flight_dir):
+    """The circuit-open postmortem: the wrapper's own restart ledger,
+    plus the flight-recorder timeline when black boxes exist."""
+    lines = ["== train_supervise postmortem: circuit OPEN after %d "
+             "restart(s)" % max(0, len(ledger) - 1)]
+    for i, entry in enumerate(ledger):
+        lines.append("   incarnation %d: rc=%s after %.1fs%s"
+                     % (i, entry["rc"], entry["runtime_s"],
+                        "  (%s)" % entry["verdict"]))
+    text = "\n".join(lines)
+    if flight_dir and os.path.isdir(flight_dir):
+        try:
+            import importlib.util
+            spec = importlib.util.spec_from_file_location(
+                "postmortem", os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "postmortem.py"))
+            pm = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(pm)
+            dumps = pm.load_dumps([flight_dir])
+            text += "\n" + pm.render(dumps)
+        except FileNotFoundError:
+            text += "\n   (no flight dumps under %s)" % flight_dir
+        except Exception as e:           # the ledger must still print
+            text += "\n   (postmortem render failed: %s)" % e
+    return text
+
+
+def supervise(cmd, restart_max=None, backoff=None, reset_after=300.0,
+              roster=None, flight_dir=None, run=None, sleep=time.sleep,
+              log=print):
+    """The relaunch ladder. `run`/`sleep`/`log` are test seams; `run`
+    defaults to a blocking subprocess of `cmd` and must return its exit
+    code. Returns the wrapper's exit code."""
+    import subprocess
+    restart_max = _env_int("MXNET_TRAIN_RESTART_MAX", 3) \
+        if restart_max is None else int(restart_max)
+    backoff = _env_float("MXNET_TRAIN_RESTART_BACKOFF", 0.5) \
+        if backoff is None else float(backoff)
+    if run is None:
+        run = lambda: subprocess.call(cmd)        # noqa: E731
+    lives = restart_max
+    crashes = 0                 # consecutive, drives the backoff
+    ledger = []
+    incarnation = 0
+    while True:
+        log("[supervise] incarnation %d: %s" % (incarnation,
+                                                " ".join(cmd) or "<fn>"))
+        t0 = time.monotonic()
+        rc = run()
+        runtime = time.monotonic() - t0
+        if runtime >= reset_after and crashes:
+            # ANY long incarnation refunds the crash budget — a job
+            # healthy for hours that then preempts (83/84) or crashes
+            # once must not inherit a stale strike count (the serving
+            # router's respawn_reset_s forgiveness)
+            log("[supervise] incarnation ran %.0fs — crash budget "
+                "refunded" % runtime)
+            lives, crashes = restart_max, 0
+        if rc == 0:
+            log("[supervise] run completed (rc 0, %.1fs)" % runtime)
+            return 0
+        if rc == EXIT_PREEMPTED:
+            verdict = "preempted: checkpoint drained, relaunching (free)"
+        elif rc == EXIT_RECONFIGURE:
+            cordoned = read_roster(roster)
+            verdict = ("reconfigure: cordon roster %s, relaunching "
+                       "(free)" % (sorted(cordoned) or "(unreadable)"))
+        else:
+            lives -= 1
+            crashes += 1
+            verdict = ("crash rc=%s (%d of %d lives left)"
+                       % (rc, max(lives, 0), restart_max))
+        ledger.append({"rc": rc, "runtime_s": round(runtime, 3),
+                       "verdict": verdict})
+        log("[supervise] " + verdict)
+        if rc not in (EXIT_PREEMPTED, EXIT_RECONFIGURE):
+            if lives < 0:
+                log("[supervise] CIRCUIT OPEN: restart budget "
+                    "(MXNET_TRAIN_RESTART_MAX=%d) exhausted" % restart_max)
+                log(render_postmortem(ledger, flight_dir))
+                return rc if rc else 1
+            delay = min(backoff * (2 ** (crashes - 1)), _BACKOFF_CAP_S)
+            log("[supervise] backing off %.2fs before relaunch" % delay)
+            sleep(delay)
+        incarnation += 1
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        epilog="example:\n  train_supervise.py --roster ckpts/cordon "
+               "-- python train.py\n",
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--restart-max", type=int, default=None,
+                    help="crash budget before the circuit opens "
+                         "(default MXNET_TRAIN_RESTART_MAX, 3)")
+    ap.add_argument("--backoff", type=float, default=None,
+                    help="base backoff seconds, doubling per "
+                         "consecutive crash (default "
+                         "MXNET_TRAIN_RESTART_BACKOFF, 0.5)")
+    ap.add_argument("--reset-after", type=float, default=300.0,
+                    help="healthy-incarnation seconds that refund the "
+                         "crash budget")
+    ap.add_argument("--roster", default="",
+                    help="cordon roster directory (printed on "
+                         "reconfigure exits)")
+    ap.add_argument("--flight-dir", default="",
+                    help="flight-recorder directory rendered into the "
+                         "circuit-open postmortem")
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="-- training command")
+    args = ap.parse_args(argv)
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        ap.error("no training command given (use: train_supervise.py "
+                 "[opts] -- cmd args...)")
+    return supervise(cmd, restart_max=args.restart_max,
+                     backoff=args.backoff, reset_after=args.reset_after,
+                     roster=args.roster, flight_dir=args.flight_dir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,6 +80,7 @@ def render(bodies, now=None):
     stragglers = None
     comms = None
     anomaly_last = None
+    remediation = None
     for url, health, statusz in bodies:
         z = statusz or {}
         h = health or {}
@@ -117,6 +118,8 @@ def render(bodies, now=None):
             comms = z["comms"]
         if anom.get("last"):
             anomaly_last = (label, anom["last"])
+        if remediation is None and z.get("remediation"):
+            remediation = z["remediation"]
     if stragglers:
         hosts = stragglers.get("hosts") or {}
         flagged = stragglers.get("flagged") or {}
@@ -142,6 +145,34 @@ def render(bodies, now=None):
             "%s %s (z %s)" % (k, _num((v or {}).get("value"), "%.4g"),
                               _num((v or {}).get("z"), "%.2f"))
             for k, v in sorted(last.items()))))
+    if remediation:
+        cordoned = remediation.get("cordoned") or {}
+        reconf = remediation.get("reconfigure") or {}
+        sdc = remediation.get("sdc") or {}
+        audit = remediation.get("audit") or {}
+        parts = []
+        if cordoned:
+            parts.append("CORDONED " + ", ".join(
+                "%s(%s)" % (h, (e or {}).get("reason", "?"))
+                for h, e in sorted(cordoned.items())))
+        else:
+            parts.append("no hosts cordoned")
+        if reconf.get("requested"):
+            parts.append("RECONFIGURE pending (%s)"
+                         % reconf.get("reason"))
+        if sdc.get("every"):
+            suspects = sdc.get("suspects") or {}
+            parts.append("sdc probes %s%s"
+                         % (_num(sdc.get("probes"), "%d"),
+                            ("  SUSPECT " + ", ".join(sorted(suspects)))
+                            if suspects else ""))
+        if audit:
+            demoted = audit.get("demoted") or []
+            parts.append("ckpt audits %s%s"
+                         % (_num(audit.get("audits"), "%d"),
+                            ("  DEMOTED steps %s" % demoted)
+                            if demoted else ""))
+        lines.append("remediation: " + "  ".join(parts))
     if comms:
         kinds = comms.get("kinds") or {}
         parts = ["%s %s/step x%s" % (k.replace("_", "-"),
