@@ -314,6 +314,30 @@ def check_line(r):
         if mttr is None:
             raise ValueError("steps_lost_per_remediation without the "
                              "mttr_s measurement it rides: %r" % (r,))
+    # AOT warm-start fields (ISSUE 16): the warm respawn TTFT only
+    # means something NEXT TO the cold one it halves, and
+    # breach-to-capacity is a measured wall span that must ride an
+    # actually-recorded scale-up.
+    wttft = r.get("respawn_to_first_token_warm_ms")
+    if wttft is not None:
+        if not isinstance(wttft, (int, float)) or isinstance(wttft, bool) \
+                or wttft < 0 or wttft != wttft or wttft == float("inf"):
+            raise ValueError("respawn_to_first_token_warm_ms must be a "
+                             "finite non-negative number of ms: %r"
+                             % (r,))
+        if r.get("respawn_to_first_token_ms") is None:
+            raise ValueError("warm respawn TTFT without the cold "
+                             "respawn_to_first_token_ms it is the A/B "
+                             "of: %r" % (r,))
+    b2s = r.get("burn_to_scale_up_s")
+    if b2s is not None:
+        if not isinstance(b2s, (int, float)) or isinstance(b2s, bool) \
+                or b2s < 0 or b2s != b2s or b2s == float("inf"):
+            raise ValueError("burn_to_scale_up_s must be a finite "
+                             "non-negative number of seconds: %r" % (r,))
+        if not r.get("scale_ups"):
+            raise ValueError("burn_to_scale_up_s without a recorded "
+                             "scale-up action: %r" % (r,))
     return r
 
 
@@ -1591,10 +1615,14 @@ def bench_serving_chaos(smoke, dtype, device_kind):
     paired legs, so ordinary storm queueing cancels out and the delta
     isolates the failover path), and respawn-to-first-token (router
     swap of the
-    rebuilt replica -> its first completed prefill — today dominated by
-    the fresh engine's jit compiles, exactly the gap the ROADMAP item-1
-    AOT cache targets). Judged WARN-ONLY by the sentinel: fault-drill
-    numbers are health signals, not perf measurements."""
+    rebuilt replica -> its first completed prefill), measured COLD
+    (fresh XLA compiles) and WARM (ISSUE 16: the respawned replica
+    loads its executables from a persistent AOT cache —
+    `respawn_to_first_token_warm_ms`), plus the autoscale drill's
+    breach-to-capacity span (`burn_to_scale_up_s`: scripted TTFT burn
+    breach -> a warm replica added by the Autoscaler). Judged WARN-ONLY
+    by the sentinel: fault-drill numbers are health signals, not perf
+    measurements."""
     import threading as _threading
     import jax
     import jax.numpy as jnp
@@ -1700,6 +1728,86 @@ def bench_serving_chaos(smoke, dtype, device_kind):
             respawn_ttft_ms = 1e3 * (probe.t_first_token - t_swap)
         added = [max(0.0, s - clean_ref) for s in failover_s]
         snap = srv.snapshot()["aggregate"]
+        # leg C (ISSUE 16): the SAME kill against an AOT-cached fleet —
+        # the respawned replica warm-loads its executables from disk
+        # instead of re-compiling, which is exactly the gap between
+        # respawn_to_first_token_ms and its _warm_ twin. Then the
+        # autoscale mini-drill: script a hot TTFT burn into the
+        # Autoscaler and measure breach -> warm replica ready.
+        import shutil as _shutil
+        import tempfile as _tempfile
+        from mxnet_tpu import aot as _aot
+        from mxnet_tpu.serving import Autoscaler, AutoscaleConfig
+        _chaos.reset()
+        # the cold fleet must be DOWN before re-arming serve_kill: the
+        # chaos fault keys on replica id only, and a still-beating
+        # replica 0 of the old fleet would consume the kill meant for
+        # the warm fleet's victim
+        srv.close()
+        warm_ttft_ms = None
+        burn_to_scale_up_s = None
+        scale_ups = 0
+        cache_dir = _tempfile.mkdtemp(prefix="mxtpu-aot-bench-")
+        srv2 = serving.serve((params, cfg), replicas=2, max_batch=4,
+                             block_size=8, max_queue=requests + 8,
+                             max_beat_age=5.0, respawn_backoff=0.02,
+                             aot_cache=cache_dir)
+        try:
+            # drive the compile lattice once: every executable both
+            # replicas build is PUBLISHED to the cache as a side effect
+            for rep in srv2.replicas:
+                for p in pinned:
+                    rep.submit(list(p), max_new_tokens=3 * max_new) \
+                       .result(timeout=300)
+            victim2 = srv2.replicas[0]
+            pin2 = [victim2.submit(list(p), max_new_tokens=3 * max_new)
+                    for p in pinned]
+            deadline = time.perf_counter() + 120
+            while time.perf_counter() < deadline:
+                if sum(1 for s in list(victim2.scheduler.running)
+                       if len(s.tokens) > s.prompt_len) >= len(pin2):
+                    break
+                time.sleep(0.002)
+            _chaos.configure(serve_kill=(0, 1))
+            for r in pin2:
+                r.wait(timeout=300)
+            t_swap2 = None
+            deadline = time.perf_counter() + 120
+            while time.perf_counter() < deadline:
+                srv2.health()
+                if srv2.replicas[0] is not victim2:
+                    t_swap2 = time.perf_counter()
+                    break
+                time.sleep(0.005)
+            if t_swap2 is not None:
+                probe = srv2.replicas[0].submit(list(pinned[0]),
+                                                max_new_tokens=2)
+                probe.result(timeout=300)
+                warm_ttft_ms = 1e3 * (probe.t_first_token - t_swap2)
+            _chaos.reset()
+            # autoscale mini-drill: a scripted burn breach (both short
+            # windows hot) must produce a WARM third replica; the span
+            # is breach-observed -> scale_up() returned a serving
+            # replica, dominated by the warm-start load, not XLA
+            sc = Autoscaler(srv2, AutoscaleConfig(
+                min_replicas=1, max_replicas=3, cooldown_s=0.1,
+                idle_retire_s=3600.0))
+            hot_burn = {60: {"rate": 10.0, "good": 0, "total": 8,
+                             "span_s": 60.0},
+                        300: {"rate": 10.0, "good": 0, "total": 8,
+                              "span_s": 300.0}}
+            sc.burn_rates = lambda: hot_burn
+            sc.fleet_load_tokens = lambda: 1
+            t_breach = time.perf_counter()
+            if sc.step() == "up":
+                burn_to_scale_up_s = time.perf_counter() - t_breach
+            scale_ups = sc.scale_ups
+        finally:
+            try:
+                srv2.close()
+            finally:
+                _aot.configure()      # back to env control
+                _shutil.rmtree(cache_dir, ignore_errors=True)
         return {
             "metric": ("smoke_serving_chaos_availability_pct" if smoke
                        else "serving_chaos_availability_pct"),
@@ -1710,6 +1818,14 @@ def bench_serving_chaos(smoke, dtype, device_kind):
             "respawn_to_first_token_ms": (round(respawn_ttft_ms, 1)
                                           if respawn_ttft_ms is not None
                                           else None),
+            "respawn_to_first_token_warm_ms": (
+                round(warm_ttft_ms, 1)
+                if warm_ttft_ms is not None
+                and respawn_ttft_ms is not None else None),
+            "burn_to_scale_up_s": (round(burn_to_scale_up_s, 3)
+                                   if burn_to_scale_up_s is not None
+                                   and scale_ups else None),
+            "scale_ups": scale_ups,
             "failovers": snap["failovers"],
             "respawns": snap["respawns"],
             "orphaned": snap["orphaned"],

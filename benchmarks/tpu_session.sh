@@ -205,6 +205,41 @@ assert not missing, ("ISSUE 15 fields missing from the resilience "
 print("2k OK:", {f: line[f] for f in fields})
 PYEOF
 
+echo "=== 2l. persistent AOT cache: cold/warm A/B + autoscale drill (ISSUE 16) ==="
+# (a) aot_warm populates a fresh cache for the demo serving config,
+# then a SECOND identical run must report zero compiles (pure warm
+# loads) and --verify must pass — the compile-once-serve-forever
+# contract on real hardware. (b) the serving_chaos line (re-run in 2i's
+# bench pass above) must carry the ISSUE 16 cold/warm respawn A/B and
+# the autoscale breach-to-capacity span; the sentinel judges their
+# LEVELS warn-only at step 8. Predictions: BENCH_NOTES.md round 16.
+AOT_AB_DIR=$(mktemp -d /tmp/mxtpu_aot.XXXXXX)
+timeout -k 30 900 python tools/aot_warm.py --cache "$AOT_AB_DIR" --demo \
+  --paged | tee BENCH_AOT_COLD.txt
+timeout -k 30 900 python tools/aot_warm.py --cache "$AOT_AB_DIR" --demo \
+  --paged | tee BENCH_AOT_WARM.txt
+grep -q "done: 0 compile(s)" BENCH_AOT_WARM.txt \
+  || echo "2l WARN: warm aot_warm pass still compiled (cache key drift?)"
+python tools/aot_warm.py --cache "$AOT_AB_DIR" --verify
+rm -rf "$AOT_AB_DIR"
+python - <<'PYEOF'
+import json
+line = None
+for l in open("BENCH_ALL.json"):
+    try:
+        r = json.loads(l)
+    except ValueError:
+        continue
+    if str(r.get("metric", "")).endswith("serving_chaos_availability_pct"):
+        line = r
+fields = ("respawn_to_first_token_warm_ms", "burn_to_scale_up_s",
+          "scale_ups")
+missing = [f for f in fields if line is None or f not in line]
+assert not missing, ("ISSUE 16 fields missing from the serving_chaos "
+                     "line: %s" % missing)
+print("2l OK:", {f: line[f] for f in fields})
+PYEOF
+
 echo "=== 3. flash attention seq sweep (1024/2048/4096) ==="
 BENCH_CONFIGS=transformer_flash BENCH_FLASH_SEQ=1024,2048,4096,8192 \
   python bench.py | tee BENCH_FLASH_SWEEP.jsonl

@@ -1,0 +1,20 @@
+"""mxnet_tpu.aot — persistent ahead-of-time executable cache.
+
+Seated at the compile watchdog's `lower().compile()` choke point
+(telemetry/introspect.py): every framework jit's compiled executable is
+serialized to a content-addressed disk entry, and a restarted engine,
+respawned replica, or freshly scaled-out warm replica loads it back with
+ZERO fresh XLA compilation — bit-identical logits, compile-once fleet.
+See cache.py for key anatomy and docs/OBSERVABILITY.md ("Compile-once
+fleet") for the operator story.
+"""
+from .cache import (AOTCache, CorruptEntry, atomic_publish, cache,
+                    cache_dir, configure, fingerprint, key_for,
+                    load_executable, placement_key,
+                    serialize_executable_blob)
+
+__all__ = [
+    "AOTCache", "CorruptEntry", "atomic_publish", "cache", "cache_dir",
+    "configure", "fingerprint", "key_for", "load_executable",
+    "placement_key", "serialize_executable_blob",
+]

@@ -265,6 +265,14 @@ class TrainStep:
         self._lr_schedule = fn
 
     @property
+    def warm_loads(self):
+        """Fused-step executables warm-loaded from the persistent AOT
+        cache (mxnet_tpu/aot) instead of compiled — a supervised
+        relaunch (tools/train_supervise.py --prewarm-cmd) lands here."""
+        fn = self._step_fn
+        return getattr(fn, "warm_loads", 0) if fn is not None else 0
+
+    @property
     def t(self):
         """Completed optimizer steps (the checkpoint step number)."""
         return self._t
@@ -459,7 +467,7 @@ class TrainStep:
             jax.jit(step, donate_argnums=(0, 1, 2)), site="train.step",
             phase="train",
             argnames=("grad_vals", "nograd_vals", "opt_state", "x", "y",
-                      "key", "lr", "t", "poison"))
+                      "key", "lr", "t", "poison"), variant="train_step")
         self._names = names
         self._plist = plist
         self._grad_mask = grad_mask
@@ -591,7 +599,8 @@ class TrainStep:
 
             self._probe_fn = _introspect.instrument(
                 jax.jit(probe_fn), site="train.probe", phase="train",
-                argnames=("grad_vals", "nograd_vals", "x", "y", "key"))
+                argnames=("grad_vals", "nograd_vals", "x", "y", "key"),
+                variant="train_probe")
         xv = x._data if isinstance(x, NDArray) else jnp.asarray(x)
         yv = y._data if isinstance(y, NDArray) else jnp.asarray(y)
         if self._mesh is not None:

@@ -248,11 +248,16 @@ def export_model(model, input_shapes, path, params=None,
     sig += ["out %s %s" % (_sig_dtype(a.dtype),
                            "x".join(str(d) for d in a.shape))
             for a in exported.out_avals]
-    with zipfile.ZipFile(path, "w") as z:
-        z.writestr("meta.json", json.dumps(meta))
-        z.writestr("model.stablehlo", blob)
-        z.writestr("model.mlir", exported.mlir_module_serialized)
-        z.writestr("signature.txt", "\n".join(sig) + "\n")
+    # atomic-rename publish (shared with the AOT executable cache): a
+    # reader — or a serving fleet warm-booting off this artifact — never
+    # observes a half-written zip
+    from .aot import atomic_publish
+    with atomic_publish(str(path)) as tmp:
+        with zipfile.ZipFile(tmp, "w") as z:
+            z.writestr("meta.json", json.dumps(meta))
+            z.writestr("model.stablehlo", blob)
+            z.writestr("model.mlir", exported.mlir_module_serialized)
+            z.writestr("signature.txt", "\n".join(sig) + "\n")
     return path
 
 
@@ -334,14 +339,16 @@ def export_train_step(step, example_x, example_y, path):
             for a in exported.out_avals]
     meta = {"format": 1, "train": {"n_state": n_state, "n_grad": n_g,
                                    "n_nograd": n_n, "n_opt": n_o}}
-    with zipfile.ZipFile(path, "w") as z:
-        z.writestr("meta.json", json.dumps(meta))
-        z.writestr("model.stablehlo", exported.serialize())
-        z.writestr("model.mlir", exported.mlir_module_serialized)
-        z.writestr("signature.txt", "\n".join(sig) + "\n")
-        z.writestr("train.txt", "n_state %d\n" % n_state)
-        for i, v in enumerate(state0):
-            z.writestr("state/%d.bin" % i, _np.asarray(v).tobytes())
+    from .aot import atomic_publish
+    with atomic_publish(str(path)) as tmp:
+        with zipfile.ZipFile(tmp, "w") as z:
+            z.writestr("meta.json", json.dumps(meta))
+            z.writestr("model.stablehlo", exported.serialize())
+            z.writestr("model.mlir", exported.mlir_module_serialized)
+            z.writestr("signature.txt", "\n".join(sig) + "\n")
+            z.writestr("train.txt", "n_state %d\n" % n_state)
+            for i, v in enumerate(state0):
+                z.writestr("state/%d.bin" % i, _np.asarray(v).tobytes())
     return path
 
 

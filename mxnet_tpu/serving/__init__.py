@@ -33,7 +33,8 @@ from .metrics import ServingMetrics
 from .server import LMServer, serve, spawn_resume
 from .router import (ReplicatedLMServer, serving_replicas,
                      serving_respawn_max, NoHealthyReplicas)
-from .tp import serving_tp
+from .autoscale import Autoscaler, AutoscaleConfig, autoscale_enabled
+from .tp import serving_tp, tp_cache_variant
 
 __all__ = [
     "BlockPool", "PagedKVCache", "CacheOverflow",
@@ -45,5 +46,6 @@ __all__ = [
     "make_resume", "spawn_resume",
     "ServingMetrics", "LMServer", "serve",
     "ReplicatedLMServer", "serving_replicas", "serving_respawn_max",
-    "serving_tp", "NoHealthyReplicas",
+    "serving_tp", "tp_cache_variant", "NoHealthyReplicas",
+    "Autoscaler", "AutoscaleConfig", "autoscale_enabled",
 ]

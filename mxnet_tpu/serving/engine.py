@@ -320,26 +320,31 @@ class TransformerLM:
     def bind(self, block_size):
         cfg = self.cfg
         instrument = telemetry.introspect.instrument
+        # `variant=` tags each jit's entries in the persistent AOT cache
+        # (mxnet_tpu/aot): the gather and paged decode steps share the
+        # serving.decode SITE and can trace equal signatures — the tag
+        # (plus the lowered-text hash in the key) keeps their disk
+        # entries apart, so a warm load can never swap implementations
         self._prefill_jit = instrument(jax.jit(
             lambda p, k, v, t, ln, tb: _tf_prefill(p, k, v, t, ln, tb,
                                                    cfg, block_size)),
             site="serving.prefill", phase="prefill",
-            argnames=self._PREFILL_ARGS)
+            argnames=self._PREFILL_ARGS, variant="prefill_dense")
         self._decode_jit = instrument(jax.jit(
             lambda p, k, v, t, pos, tb: _tf_decode(p, k, v, t, pos, tb,
                                                    cfg, block_size)),
             site="serving.decode", phase="decode",
-            argnames=self._DECODE_ARGS)
+            argnames=self._DECODE_ARGS, variant="decode_gather")
         self._decode_paged_jit = instrument(jax.jit(
             lambda p, k, v, t, pos, tb: _tf_decode_paged(
                 p, k, v, t, pos, tb, cfg, block_size)),
             site="serving.decode", phase="decode",
-            argnames=self._DECODE_ARGS)
+            argnames=self._DECODE_ARGS, variant="decode_paged")
         self._prefill_chunk_jit = instrument(jax.jit(
             lambda p, k, v, t, qs, ln, li, tb: _tf_prefill_chunk(
                 p, k, v, t, qs, ln, li, tb, cfg, block_size)),
             site="serving.prefill", phase="prefill",
-            argnames=self._CHUNK_ARGS)
+            argnames=self._CHUNK_ARGS, variant="prefill_chunk")
 
     def bind_tp(self, block_size, mesh):
         """Build the tensor-parallel step functions over `mesh` (axis
@@ -352,17 +357,24 @@ class TransformerLM:
         attributed to the params/pool sharding diff, not misread as new
         traffic shapes."""
         from .tp import (place_tp_params, build_tp_decode,
-                         build_tp_prefill_chunk)
+                         build_tp_prefill_chunk, tp_cache_variant)
         instrument = telemetry.introspect.instrument
         self._tp_params = place_tp_params(self.params, self.cfg, mesh)
+        # the tp variant embeds the mesh's DEVICE WINDOW: two replicas'
+        # tp steps have equal shapes and identity-free sharding
+        # descriptions but compile against different chips — their AOT
+        # cache entries must never collide (aot.placement_key covers
+        # committed args; the tag is the belt under that brace)
+        tpv = tp_cache_variant(mesh)
         self._decode_tp_jit = instrument(
             build_tp_decode(self.cfg, block_size, mesh),
             site="serving.decode", phase="decode",
-            argnames=self._DECODE_ARGS)
+            argnames=self._DECODE_ARGS, variant="decode_tp:" + tpv)
         self._prefill_chunk_tp_jit = instrument(
             build_tp_prefill_chunk(self.cfg, block_size, mesh),
             site="serving.prefill", phase="prefill",
-            argnames=self._CHUNK_ARGS)
+            argnames=self._CHUNK_ARGS,
+            variant="prefill_chunk_tp:" + tpv)
 
     def prefill(self, k, v, tokens, length, table_row):
         return self._prefill_jit(self.params, k, v, tokens, length,
@@ -518,17 +530,27 @@ class Engine:
     #: flags the engine derives compiled state from — construction-only
     _FROZEN_FLAGS = frozenset(
         ("paged", "paged_requested", "prefill_chunk", "tp",
-         "tp_requested", "mesh", "prefix_cache"))
+         "tp_requested", "mesh", "prefix_cache", "aot_cache"))
 
     def __init__(self, model, max_batch=8, max_len=None, block_size=16,
                  num_blocks=None, keep_logits=False, paged=None,
                  prefill_chunk=None, tp=None, devices=None,
-                 prefix_cache=None):
+                 prefix_cache=None, aot_cache=None):
         from ..ops.pallas_paged import paged_enabled, paged_eligible
         from ..ops.pallas_attention import default_interpret
         from .tp import (serving_tp, tp_fallback_reason, build_tp_mesh,
                          kv_pool_spec)
         from jax.sharding import NamedSharding
+        from .. import aot
+        # persistent AOT executable cache (ISSUE 16): `aot_cache=` names
+        # a directory (configuring it process-wide — the watchdog seam
+        # the jits compile through is process-global); None defers to
+        # MXNET_AOT_CACHE_DIR. Resolved BEFORE bind() so this engine's
+        # own compiles warm-load/publish. Like every placement flag it
+        # switches where executables come from, never logits.
+        if aot_cache is not None:
+            aot.configure(str(aot_cache))
+        self.aot_cache = aot.cache_dir()
         self.model = model
         self.max_batch = max_batch
         self.max_len = int(max_len or model.max_len)
@@ -618,6 +640,11 @@ class Engine:
         # a sibling's warm-up compiles, matching the pre-migration
         # engine-local ints while the watchdog stays the source of truth
         self._compile_counts = {"prefill": 0, "decode": 0}
+        # ... and the warm-load tally (ISSUE 16): executables this
+        # engine's calls LOADED from the persistent AOT cache instead of
+        # compiling — kept apart from _compile_counts so the
+        # recompile-bound tests stay meaningful with the cache on
+        self._warm_counts = {"prefill": 0, "decode": 0}
         self._constructed = True
         _LIVE.add(self)
 
@@ -677,14 +704,34 @@ class Engine:
         `prefill_compilations`)."""
         return self._compile_counts["decode"]
 
+    @property
+    def prefill_warm_loads(self):
+        """Prefill executables this engine's calls warm-loaded from the
+        persistent AOT cache (mxnet_tpu/aot) instead of compiling."""
+        return self._warm_counts["prefill"]
+
+    @property
+    def decode_warm_loads(self):
+        """Decode-path warm loads (see `prefill_warm_loads`)."""
+        return self._warm_counts["decode"]
+
+    @property
+    def warm_loads(self):
+        """Total executables this engine warm-loaded from the AOT cache
+        — the router's warm-start gauge counts replicas where this is
+        positive."""
+        return sum(self._warm_counts.values())
+
     @contextlib.contextmanager
     def _count(self, kind, sig):
         """Bracket one model step call: record its shape-bucket signature
         (test failure messages show it) and add the compiles the call
         paid — per-thread attribution, so a sibling engine sharing this
-        adapter never inflates these counters — to this engine's tally."""
+        adapter never inflates these counters — to this engine's tally.
+        Warm AOT-cache loads are tallied separately on the same seam."""
         self._sigs.add((kind, sig))
         mark = telemetry.introspect.dispatch_mark()
+        wmark = telemetry.introspect.dispatch_warm_mark()
         try:
             yield
         finally:
@@ -692,6 +739,8 @@ class Engine:
             # compile; count it even as the exception propagates
             self._compile_counts[kind] += \
                 telemetry.introspect.dispatch_compiles_since(mark)
+            self._warm_counts[kind] += \
+                telemetry.introspect.dispatch_warm_loads_since(wmark)
 
     # -- prefill -------------------------------------------------------------
 

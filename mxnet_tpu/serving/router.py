@@ -104,7 +104,8 @@ class ReplicatedLMServer(_HTTPFrontend):
 
     def __init__(self, model, replicas=2, tp=None, devices=None,
                  retry_after_s=1.0, max_beat_age=5.0, respawn_max=None,
-                 respawn_backoff=0.5, respawn_reset_s=30.0, **kwargs):
+                 respawn_backoff=0.5, respawn_reset_s=30.0,
+                 autoscale=None, **kwargs):
         from .tp import serving_tp
         if replicas < 1:
             raise MXNetError("replicas must be >= 1, got %r" % replicas)
@@ -176,6 +177,20 @@ class ReplicatedLMServer(_HTTPFrontend):
             help="replicas whose respawn circuit is open (crash loop: "
                  "died MXNET_REPLICA_RESPAWN_MAX times) — drained for "
                  "good until an operator intervenes")
+        self._c_scale_up = self.registry.counter(
+            "serving_scale_up_total",
+            help="replicas added by elastic scale-up (SLO burn breach "
+                 "or min-floor restore) — warm-started from the AOT "
+                 "executable cache when one is configured")
+        self._c_scale_down = self.registry.counter(
+            "serving_scale_down_total",
+            help="replicas retired by elastic scale-down after "
+                 "sustained idle: drained, in-flight work re-homed, "
+                 "then closed — zero lost requests")
+        self._g_warm = self.registry.gauge(
+            "serving_warm_replicas",
+            help="replicas whose engines warm-loaded at least one "
+                 "executable from the AOT cache instead of compiling")
         self.replicas = []
         self._drained = []
         # per-replica supervision state, index-aligned with `replicas`
@@ -197,6 +212,15 @@ class ReplicatedLMServer(_HTTPFrontend):
                 rep.close(drain=False, timeout=5.0)
             raise
         self._g_healthy.set(len(self.replicas))
+        # elastic autoscaling (ISSUE 16): autoscale=True arms the
+        # env-configured policy, an AutoscaleConfig pins one explicitly
+        self.autoscaler = None
+        if autoscale:
+            from .autoscale import Autoscaler, AutoscaleConfig
+            cfg = autoscale if isinstance(autoscale, AutoscaleConfig) \
+                else None
+            self.autoscaler = Autoscaler(self, config=cfg)
+            self.autoscaler.start()
 
     def _build_replica(self, i):
         """One fresh replica on its device window — the constructor's
@@ -237,49 +261,58 @@ class ReplicatedLMServer(_HTTPFrontend):
         healths = []
         now = time.perf_counter()
         for i in range(len(self.replicas)):
-            rep = self.replicas[i]
-            h = rep.health(max_beat_age=max_beat_age)
-            # dead = the loop CRASHED (raised out of _loop) or the
-            # thread vanished without an administrative close — a
-            # closed replica is down on purpose, not respawn fodder
-            h["dead"] = bool(rep._died or (not rep._thread.is_alive()
-                                           and not rep._closed))
-            h["circuit_open"] = self._circuit_open[i]
-            h["respawns"] = self._respawn_attempts[i]
-            healths.append(h)
-            if self._closed:
-                continue
-            if h["ok"]:
-                if self._ok_since[i] is None:
-                    self._ok_since[i] = now
-                elif self._respawn_attempts[i] and not \
-                        self._circuit_open[i] and \
-                        now - self._ok_since[i] >= self.respawn_reset_s:
-                    # survived a full probation: not a crash loop
-                    self._respawn_attempts[i] = 0
-            else:
-                self._ok_since[i] = None
-            if not self._drained[i] and not h["ok"]:
-                with self._lock:
-                    if self._drained[i]:
-                        continue
-                    self._drained[i] = True
-                self._c_drained.inc(replica=i)
-                telemetry.record_span(
-                    "serving.drain", time.perf_counter_ns() // 1000, 0,
-                    category="serving", to_profiler=False, replica=i,
-                    dead=h["dead"])
-                self._rehome(rep)
-            elif self._drained[i] and h["ok"]:
-                with self._lock:
-                    if not self._drained[i]:
-                        continue
-                    self._drained[i] = False
-                self._c_restored.inc(replica=i)
-            if h["dead"]:
-                self._maybe_respawn(i, now)
+            try:
+                rep = self.replicas[i]
+                h = rep.health(max_beat_age=max_beat_age)
+                # dead = the loop CRASHED (raised out of _loop) or the
+                # thread vanished without an administrative close — a
+                # closed replica is down on purpose, not respawn fodder
+                h["dead"] = bool(rep._died or (not rep._thread.is_alive()
+                                               and not rep._closed))
+                h["circuit_open"] = self._circuit_open[i]
+                h["respawns"] = self._respawn_attempts[i]
+                healths.append(h)
+                if self._closed:
+                    continue
+                if h["ok"]:
+                    if self._ok_since[i] is None:
+                        self._ok_since[i] = now
+                    elif self._respawn_attempts[i] and not \
+                            self._circuit_open[i] and \
+                            now - self._ok_since[i] >= \
+                            self.respawn_reset_s:
+                        # survived a full probation: not a crash loop
+                        self._respawn_attempts[i] = 0
+                else:
+                    self._ok_since[i] = None
+                if not self._drained[i] and not h["ok"]:
+                    with self._lock:
+                        if self._drained[i]:
+                            continue
+                        self._drained[i] = True
+                    self._c_drained.inc(replica=i)
+                    telemetry.record_span(
+                        "serving.drain", time.perf_counter_ns() // 1000,
+                        0, category="serving", to_profiler=False,
+                        replica=i, dead=h["dead"])
+                    self._rehome(rep)
+                elif self._drained[i] and h["ok"]:
+                    with self._lock:
+                        if not self._drained[i]:
+                            continue
+                        self._drained[i] = False
+                    self._c_restored.inc(replica=i)
+                if h["dead"]:
+                    self._maybe_respawn(i, now)
+            except IndexError:
+                # a concurrent scale_down retired the tail mid-pass;
+                # the shrunken fleet gets a clean verdict next sweep
+                break
         self._g_healthy.set(len(self.replicas) - sum(self._drained))
         self._g_circuit.set(sum(self._circuit_open))
+        self._g_warm.set(sum(
+            1 for rep in list(self.replicas)
+            if getattr(rep.engine, "warm_loads", 0) > 0))
         return healths
 
     def _maybe_respawn(self, i, now):
@@ -326,8 +359,12 @@ class ReplicatedLMServer(_HTTPFrontend):
                 error="%s: %s" % (type(e).__name__, e))
             return
         with self._lock:
-            if self._closed:        # raced an administrative shutdown
-                self._respawning[i] = False
+            if self._closed or i >= len(self.replicas) \
+                    or self.replicas[i] is not old:
+                # raced an administrative shutdown or a scale action
+                # that removed/replaced the slot: discard the rebuild
+                if i < len(self._respawning):
+                    self._respawning[i] = False
                 closed_race = True
             else:
                 self.replicas[i] = rep
@@ -507,14 +544,138 @@ class ReplicatedLMServer(_HTTPFrontend):
         overhead the serving bench reports in microseconds."""
         t0 = time.perf_counter()
         alive = self._routable()
-        n = len(self.replicas)
+        # snapshot the replica list: a concurrent scale action must not
+        # shift indices (or IndexError) under the sort key
+        reps = list(self.replicas)
+        n = len(reps) or 1
+        alive = [i for i in alive if i < len(reps)]
         with self._lock:
             rr = self._rr
             self._rr += 1
         order = sorted(alive, key=lambda i: (
-            self.replicas[i].load_tokens(), (i - rr) % n))
+            reps[i].load_tokens(), (i - rr) % n))
         self._h_pick.observe(time.perf_counter() - t0)
         return order
+
+    # -- elastic scaling (ISSUE 16) ------------------------------------------
+
+    def replica_count(self):
+        return len(self.replicas)
+
+    def scale_up(self):
+        """Add one replica at the tail of the fleet. The build runs
+        OFF-lock (engine construction takes real time; with an AOT
+        cache configured it warm-loads its executables instead of
+        compiling), then the append of the replica plus all its
+        index-aligned supervision state happens atomically. Returns the
+        new LMServer, or None when closed/raced/build-failed — callers
+        (the Autoscaler) treat None as \"no action taken\"."""
+        with self._lock:
+            if self._closed:
+                return None
+            i = len(self.replicas)
+        t0 = time.perf_counter_ns() // 1000
+        try:
+            rep = self._build_replica(i)
+        except Exception as e:
+            telemetry.flight().record(
+                "fault", "serving.scale_up_failed", replica=i,
+                error="%s: %s" % (type(e).__name__, e))
+            return None
+        with self._lock:
+            if self._closed or len(self.replicas) != i:
+                raced = True        # shutdown or a concurrent scale
+            else:
+                self.replicas.append(rep)
+                self._drained.append(False)
+                self._respawn_attempts.append(0)
+                self._respawn_next.append(0.0)
+                self._respawning.append(False)
+                self._circuit_open.append(False)
+                self._ok_since.append(None)
+                raced = False
+        if raced:
+            rep.close(drain=False, timeout=5.0)
+            return None
+        self._c_scale_up.inc(replica=i)
+        telemetry.record_span(
+            "serving.scale_up", t0,
+            time.perf_counter_ns() // 1000 - t0,
+            category="serving", to_profiler=False, replica=i,
+            warm=bool(getattr(rep.engine, "warm_loads", 0)))
+        self._g_healthy.set(len(self.replicas) - sum(self._drained))
+        return rep
+
+    def scale_down(self):
+        """Retire the TAIL replica (only the tail — interior removal
+        would shift every index-aligned supervision list under the
+        sweep). Drain-first: the replica is marked drained so new
+        traffic routes around it, its queued and in-flight work is
+        re-homed onto the survivors (the same failover machinery a
+        wedge uses — zero lost requests), and only then is it popped
+        and closed. Refuses (returns None) at fleet size 1, while a
+        respawn owns the slot, or when closed."""
+        with self._lock:
+            if self._closed or len(self.replicas) <= 1:
+                return None
+            i = len(self.replicas) - 1
+            if self._respawning[i]:
+                return None          # a rebuild owns the slot
+            rep = self.replicas[i]
+            self._drained[i] = True  # route new traffic around it now
+        t0 = time.perf_counter_ns() // 1000
+        try:
+            self._rehome(rep)
+        except Exception:
+            pass
+        with self._lock:
+            if len(self.replicas) != i + 1 \
+                    or self.replicas[i] is not rep:
+                return None          # raced a shutdown/respawn swap
+            self.replicas.pop()
+            self._drained.pop()
+            self._respawn_attempts.pop()
+            self._respawn_next.pop()
+            self._respawning.pop()
+            self._circuit_open.pop()
+            self._ok_since.pop()
+        # drain=True: anything that slipped in between the drain mark
+        # and the pop still completes before the threads exit
+        try:
+            rep.close(drain=True, timeout=10.0)
+        except Exception as e:
+            telemetry.flight().record(
+                "fault", "serving.scale_down_close_failed", replica=i,
+                error="%s: %s" % (type(e).__name__, e))
+        # fold the retiree's ledgers into the retired accumulators —
+        # same move as a respawn swap: its `submitted` counts live only
+        # there, and the aggregate submitted == completed + failed
+        # balance must survive the retirement (a re-homed request
+        # completes on a survivor; its submit stays on the corpse)
+        try:
+            for k, v in rep.snapshot()["requests"].items():
+                self._retired_requests[k] = \
+                    self._retired_requests.get(k, 0) + v
+        except Exception:
+            pass
+        try:
+            stz = rep.metrics.statusz()
+            for k, v in stz["tokens"].items():
+                self._retired_tokens[k] = \
+                    self._retired_tokens.get(k, 0) + v
+            for name, t in stz["tenants"].items():
+                acc = self._retired_tenants.setdefault(name, {})
+                for k, v in t["tokens"].items():
+                    acc[k] = acc.get(k, 0) + v
+        except Exception:
+            pass
+        self._c_scale_down.inc(replica=i)
+        telemetry.record_span(
+            "serving.scale_down", t0,
+            time.perf_counter_ns() // 1000 - t0,
+            category="serving", to_profiler=False, replica=i)
+        self._g_healthy.set(len(self.replicas) - sum(self._drained))
+        return rep
 
     # -- client API ----------------------------------------------------------
 
@@ -553,7 +714,9 @@ class ReplicatedLMServer(_HTTPFrontend):
                 # rate exactly when the fleet is overloaded
                 self._c_requests.inc()
                 return req
-            except QueueFull:
+            except (QueueFull, IndexError):
+                # IndexError: a scale_down retired this index between
+                # the pick and the submit — fall through to the next
                 continue
         if count_reject:
             self._final_reject()
@@ -694,6 +857,8 @@ class ReplicatedLMServer(_HTTPFrontend):
         every replica is closed, the first audit error re-raises at the
         end."""
         self._closed = True
+        if getattr(self, "autoscaler", None) is not None:
+            self.autoscaler.stop()
         first_err = None
         for rep in self.replicas:
             try:

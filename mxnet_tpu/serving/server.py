@@ -257,14 +257,16 @@ class LMServer(_HTTPFrontend):
                  token_budget=None, tp=None, devices=None,
                  replica_id=None, prefix_cache=None, tenant_budget=None,
                  tenant_budgets=None, default_priority=0,
-                 default_deadline_ms=None, brownout=None):
+                 default_deadline_ms=None, brownout=None,
+                 aot_cache=None):
         adapter = _resolve_model(model, vocab=vocab, max_len=max_len,
                                  time_major=time_major)
         self.engine = Engine(adapter, max_batch=max_batch, max_len=max_len,
                              block_size=block_size, num_blocks=num_blocks,
                              keep_logits=keep_logits, paged=paged,
                              prefill_chunk=prefill_chunk, tp=tp,
-                             devices=devices, prefix_cache=prefix_cache)
+                             devices=devices, prefix_cache=prefix_cache,
+                             aot_cache=aot_cache)
         self.scheduler = Scheduler(max_batch=max_batch, max_queue=max_queue,
                                    queue_timeout=queue_timeout,
                                    token_budget=token_budget,
@@ -506,6 +508,55 @@ class LMServer(_HTTPFrontend):
         self._closed = True
         self._work.set()
         self._thread.join(timeout=timeout)
+        # strand-proofing: work can slip past both the drain wait and
+        # `_closed` — a submit that passed the closed check enqueues
+        # after the loop exited, and a request MID-ADMISSION (popped
+        # from the queue by `admit()`, still inside its prefill, not
+        # yet visible in `running`) hides from `has_work()` and from a
+        # router `_rehome` scan, then lands in `running` just as the
+        # loop sees `_closed` and exits (a scale_down retiring the
+        # replica races routed traffic exactly this way). Sweep the
+        # corpse: rescue through the router's death hook, or fail
+        # promptly — never let a request ride silently to its timeout.
+        leftovers = self.drain_queue()
+        states = []
+        with self._failover_lock:
+            for s in (self.scheduler.running
+                      + self.scheduler.prefilling):
+                req = s.request
+                if req is None or req._event.is_set():
+                    continue
+                states.append((req, list(s.tokens), s.prompt_len))
+                s.request = None
+                s.done = True
+        if leftovers or states:
+            # the stranded seqs' blocks go back to the pool ahead of
+            # the engine's leak audit; reusable=False — an exited loop
+            # cannot certify its KV
+            for seq in (self.scheduler.running
+                        + self.scheduler.prefilling):
+                try:
+                    self.engine.release(seq, reusable=False)
+                except Exception:
+                    pass
+            self.scheduler.running = []
+            self.scheduler.prefilling = []
+            rescued = False
+            if self.on_death is not None:
+                try:
+                    self.on_death(self, leftovers, states)
+                    rescued = True
+                except Exception:
+                    pass
+            if not rescued:
+                err = MXNetError("server closed with the request "
+                                 "still in flight")
+                for req, _tokens, _plen in states:
+                    req._finish(error=err)
+                    self.metrics.request_finished(req)
+                for req in leftovers:
+                    req._finish(error=err)
+                    self.metrics.request_finished(req)
         self._release_chaos_blocks()
         if self._httpd is not None:
             self._httpd.shutdown()
@@ -912,16 +963,22 @@ def spawn_resume(orig, tokens, target):
     return resume, carried
 
 
-def serve(model, replicas=None, **kwargs):
+def serve(model, replicas=None, autoscale=None, **kwargs):
     """Build and start a serving front door over `model` (see module
     docstring for accepted forms). With `replicas=N > 1` (or
     `MXNET_SERVING_REPLICAS=N`) this is a `ReplicatedLMServer`: N engine
     replicas — each with its own scheduler, cache pool, serving thread,
     and metrics registry — behind one submit/HTTP front with
     least-loaded routing (router.py). Otherwise a single `LMServer`.
-    Keyword args pass through to each LMServer."""
+    `autoscale=True` (or MXNET_SERVING_AUTOSCALE=1) arms SLO-driven
+    elastic scaling (serving/autoscale.py) — that always builds the
+    replicated door, even at replicas=1, so the fleet can grow. Keyword
+    args pass through to each LMServer."""
+    from .autoscale import autoscale_enabled
     from .router import ReplicatedLMServer, serving_replicas
     n = serving_replicas() if replicas is None else int(replicas)
-    if n > 1:
-        return ReplicatedLMServer(model, replicas=n, **kwargs)
+    scale = autoscale_enabled() if autoscale is None else autoscale
+    if n > 1 or scale:
+        return ReplicatedLMServer(model, replicas=n, autoscale=scale,
+                                  **kwargs)
     return LMServer(model, **kwargs)

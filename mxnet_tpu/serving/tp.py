@@ -79,6 +79,19 @@ def build_tp_mesh(tp, devices=None):
     return build_mesh({TP_AXIS: tp}, devices)
 
 
+def tp_cache_variant(mesh):
+    """AOT-cache variant tag for one tp mesh: the tp degree plus the
+    concrete device ids of the replica's window ("tp2@0,1"). Two
+    replicas' tp steps trace EQUAL signatures (the sharding description
+    is deliberately identity-free) but compile against different chips —
+    this tag keeps their persistent cache entries apart."""
+    try:
+        ids = ",".join(str(d.id) for d in mesh.devices.flat)
+    except Exception:                                    # pragma: no cover
+        ids = "?"
+    return "tp%d@%s" % (mesh.shape.get(TP_AXIS, 1), ids)
+
+
 def kv_pool_spec():
     """The block pool (L, num_blocks, block_size, H, Dh) shards over the
     head axis: every chip owns H/k heads of every block, tables stay

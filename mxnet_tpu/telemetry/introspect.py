@@ -90,6 +90,11 @@ COMPILE_TOTAL = "compile_total"
 COMPILE_DUPLICATE_TOTAL = "compile_duplicate_total"
 COMPILE_OVERRUNS_TOTAL = "compile_budget_overruns_total"
 SITE_COMPILE_TOTAL = "compile_%s_total"
+COMPILE_CACHE_HITS = "compile_cache_hits"
+COMPILE_CACHE_MISSES = "compile_cache_misses"
+COMPILE_CACHE_STORES = "compile_cache_stores"
+COMPILE_CACHE_LOAD_SECONDS = "compile_cache_load_seconds"
+COMPILE_CACHE_CORRUPT_TOTAL = "compile_cache_corrupt_total"
 EXEC_ARG_BYTES = "exec_%s_argument_bytes"
 EXEC_OUT_BYTES = "exec_%s_output_bytes"
 EXEC_TEMP_BYTES = "exec_%s_temp_bytes"
@@ -104,6 +109,11 @@ COMMS_FRACTION = "comms_%s_fraction"
 #: fused train step take seconds to minutes
 _COMPILE_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
                     10.0, 30.0, 60.0, 120.0, 300.0, 600.0)
+
+#: warm-load buckets: deserializing a cached executable is disk + PJRT
+#: load work — milliseconds to a few seconds, never an XLA compile
+_CACHE_LOAD_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                       0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
 
 def _parse_budget(env_var, default_policy, convert):
@@ -295,6 +305,7 @@ class CompileSite:
         self.signatures = {}          # sig -> first-seen event seq
         self.compiles = 0             # process-wide compiles at this site
         self.duplicates = 0           # same-sig recompiles (cold caches)
+        self.cache_hits = 0           # executables warm-loaded from disk
         self.comms = None             # latest executable's comms ledger
 
 
@@ -583,6 +594,69 @@ class Watchdog:
                             duplicate=bool(duplicate))
         return ev
 
+    # -- AOT-cache recording (ISSUE 16) -------------------------------------
+    def record_cache_hit(self, site, sig, seconds, phase=None):
+        """One executable warm-loaded from the persistent AOT cache
+        (mxnet_tpu/aot): the signature registers at the site (it IS now
+        compiled in this process) but neither `compiles` nor
+        `duplicates` advances — a warm load is the ABSENCE of the
+        recompile the duplicate counter measures."""
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            site.cache_hits += 1
+            if sig is not None:
+                site.signatures.setdefault(sig, seq)
+            self.total_seconds += seconds
+            ev = {"seq": seq, "site": site.name,
+                  "reason": "warm-loaded from the AOT executable cache",
+                  "seconds": seconds, "phase": phase, "duplicate": False,
+                  "cache_hit": True, "t": time.time()}
+            self._events.append(ev)
+        if enabled():
+            reg = self.registry()
+            reg.counter(COMPILE_CACHE_HITS,
+                        help="executables warm-loaded from the "
+                             "persistent AOT cache (no XLA compile)"
+                        ).inc()
+            reg.histogram(COMPILE_CACHE_LOAD_SECONDS,
+                          buckets=_CACHE_LOAD_BUCKETS,
+                          help="wall time to load + rehydrate one "
+                               "cached executable").observe(seconds)
+            from .flight import flight
+            flight().record("event", "compile_cache_hit", site=site.name,
+                            seconds=round(seconds, 4))
+        return ev
+
+    def record_cache_miss(self, site):
+        """A keyed lookup found no (valid) entry — the compile that
+        follows will try to store one."""
+        if enabled():
+            self.registry().counter(
+                COMPILE_CACHE_MISSES,
+                help="AOT-cache lookups that fell through to a fresh "
+                     "XLA compile").inc()
+
+    def record_cache_store(self, site):
+        if enabled():
+            self.registry().counter(
+                COMPILE_CACHE_STORES,
+                help="executables serialized and published to the AOT "
+                     "cache (atomic first-wins rename)").inc()
+
+    def record_cache_corrupt(self, site):
+        """A truncated/bit-flipped/stale entry failed verification: the
+        file was quarantined and the caller recompiles — corruption
+        costs one compile, never an error or a wrong executable."""
+        if enabled():
+            self.registry().counter(
+                COMPILE_CACHE_CORRUPT_TOTAL, flight=True,
+                help="AOT-cache entries that failed sha256/format/load "
+                     "verification (quarantined, recompiled)").inc()
+            from .flight import flight
+            flight().record("event", "compile_cache_corrupt",
+                            site=site.name)
+
     def check_hbm_budget(self, site, memory):
         """Pre-flight footprint gate, called after compile and BEFORE
         the first dispatch of a new executable."""
@@ -653,6 +727,19 @@ def dispatch_compiles_since(mark):
     return getattr(_dispatch_tls, "count", 0) - mark
 
 
+def dispatch_warm_mark():
+    """Opaque marker for `dispatch_warm_loads_since` (thread-local):
+    executables this thread warm-loaded from the AOT cache instead of
+    compiling — the counterpart attribution seam to `dispatch_mark`."""
+    return getattr(_dispatch_tls, "warm", 0)
+
+
+def dispatch_warm_loads_since(mark):
+    """Warm AOT-cache loads this thread's dispatches performed since
+    `mark` (like compiles, attribution is behavior, not telemetry)."""
+    return getattr(_dispatch_tls, "warm", 0) - mark
+
+
 _watchdog = None
 _watchdog_lock = threading.Lock()
 
@@ -711,12 +798,16 @@ class InstrumentedJit:
     """
 
     def __init__(self, jitted, site, argnames=None, phase=None,
-                 owned=True, static_argnums=()):
+                 owned=True, static_argnums=(), variant=None):
         self._jitted = jitted
         self._site = watchdog().site(site)
         self._argnames = tuple(argnames) if argnames else None
         self._phase = phase
         self._owned = owned
+        # AOT-cache variant tag: two jits can share one site AND one
+        # signature (the gather and paged decode steps do) — the tag,
+        # with the lowered-text hash, keeps their disk entries apart
+        self._variant = variant
         # a lowered executable takes only the DYNAMIC arguments; static
         # ones (part of the signature, so part of the cache key) must be
         # stripped at dispatch
@@ -729,6 +820,12 @@ class InstrumentedJit:
         self._lock = threading.RLock()
         self.compiles = 0
         self.compiles_by_phase = {}
+        # warm loads are counted APART from compiles: the engine's
+        # recompile-bound tests (<=2 prefill / <=6 decode) stay
+        # meaningful with the cache on, and `warm_loads` is the
+        # restart-MTTR signal (how much XLA work the cache absorbed)
+        self.warm_loads = 0
+        self.warm_loads_by_phase = {}
 
     @property
     def site(self):
@@ -753,6 +850,14 @@ class InstrumentedJit:
             if phase:
                 self.compiles_by_phase[phase] = \
                     self.compiles_by_phase.get(phase, 0) + 1
+
+    def _record_instance_warm_load(self, phase):
+        _dispatch_tls.warm = getattr(_dispatch_tls, "warm", 0) + 1
+        with self._lock:
+            self.warm_loads += 1
+            if phase:
+                self.warm_loads_by_phase[phase] = \
+                    self.warm_loads_by_phase.get(phase, 0) + 1
 
     def _dynamic(self, args):
         if not self._static:
@@ -780,7 +885,7 @@ class InstrumentedJit:
         # an unowned entry is the jit itself: it takes every arg
         return entry(*(self._dynamic(args) if self._owned else args))
 
-    def _diff_and_gate(self, wd, sig):
+    def _diff_and_gate(self, wd, sig, gate=True):
         site = self._site
         with wd._lock:
             duplicate = sig in site.signatures
@@ -789,27 +894,89 @@ class InstrumentedJit:
                   "executable cache (engine restart / new instance)"
                   if duplicate
                   else diff_reason(self._argnames, cached, sig))
-        wd.check_budget(site)
+        if gate:
+            wd.check_budget(site)
         return duplicate, reason
 
-    def _compile(self, sig, args, phase):
-        # caller holds self._lock: one compile per signature, fleet-wide
-        wd = watchdog()
-        site = self._site
-        duplicate, reason = self._diff_and_gate(wd, sig)
-        t0_us = time.perf_counter_ns() // 1000
+    # -- persistent AOT cache hooks (ISSUE 16) ------------------------------
+    def _cache_key(self, site, sig, args):
+        """(cache, key, lowered) for this call, or (None, None, None)
+        when caching is off or this program can't be content-keyed (no
+        deterministic lowered text) — an unkeyable program is simply
+        never cached, it cannot hit a wrong entry."""
+        if not self._owned:
+            return None, None, None
+        from .. import aot
+        c = aot.cache()
+        if c is None:
+            return None, None, None
+        try:
+            lowered = self._jitted.lower(*args)
+            text = lowered.as_text()
+        except Exception:
+            return None, None, None
+        if not text:
+            return None, None, None
+        try:
+            key = aot.key_for(site.name, sig, text,
+                              variant=self._variant,
+                              placement=aot.placement_key(args))
+        except Exception:
+            return None, None, None
+        return c, key, lowered
+
+    def _cache_load(self, wd, cache, site, key, sig, phase):
+        """Warm-load one verified entry: corrupt/stale/undeserializable
+        entries are quarantined and read as a miss (NEVER an error —
+        the cache switches where the executable comes from, not what it
+        computes)."""
+        from .. import aot
         t0 = time.perf_counter()
-        compiled = self._jitted.lower(*args).compile()
-        seconds = time.perf_counter() - t0
-        memory, flops, bytes_accessed = _analyses(compiled)
-        # the ledger walk is pure telemetry (an HLO-text pass per
-        # compile); under MXNET_TELEMETRY=0 it never runs
-        comms = comms_ledger(compiled, bytes_accessed) if enabled() \
-            else None
-        wd.record(site, sig, reason, seconds, phase=phase,
-                  memory=memory, flops=flops, duplicate=duplicate,
-                  start_us=t0_us, comms=comms)
-        self._record_instance_compile(phase)
+        try:
+            rec = cache.load(site.sane, key)
+        except aot.CorruptEntry:
+            wd.record_cache_corrupt(site)
+            rec = None
+        if rec is None:
+            wd.record_cache_miss(site)
+            return None
+        payload, in_tree, out_tree, meta = rec
+        try:
+            compiled = aot.load_executable(payload, in_tree, out_tree)
+        except Exception:
+            cache.invalidate(site.sane, key)
+            wd.record_cache_corrupt(site)
+            wd.record_cache_miss(site)
+            return None
+        wd.record_cache_hit(site, sig, time.perf_counter() - t0,
+                            phase=phase)
+        self._record_instance_warm_load(phase)
+        # the stored memory analysis re-arms the HBM pre-flight: a warm
+        # load must refuse an over-budget executable exactly like the
+        # compile that produced it did
+        return self._gate_entry(wd, site, sig, compiled,
+                                meta.get("memory"))
+
+    def _cache_store(self, wd, cache, site, key, compiled, memory):
+        try:
+            from .. import aot
+            blob = aot.serialize_executable_blob(compiled)
+            if blob is None:
+                return
+            payload, trees = blob
+            if cache.store(site.sane, key, payload, trees,
+                           extra={"watchdog_site": site.name,
+                                  "variant": self._variant,
+                                  "memory": memory}):
+                wd.record_cache_store(site)
+        except Exception:
+            # persistence must never break the serving/train path: an
+            # unserializable executable just stays process-local
+            pass
+
+    def _gate_entry(self, wd, site, sig, compiled, memory):
+        """HBM pre-flight + executable-cache insert, shared by the
+        fresh-compile and warm-load paths."""
         try:
             # pre-flight: refuse (or warn about) an over-budget
             # executable BEFORE its first dispatch
@@ -828,6 +995,39 @@ class InstrumentedJit:
         self._compiled[sig] = entry
         return entry
 
+    def _compile(self, sig, args, phase):
+        # caller holds self._lock: one compile per signature, fleet-wide
+        wd = watchdog()
+        site = self._site
+        duplicate, reason = self._diff_and_gate(wd, sig, gate=False)
+        cache, key, lowered = self._cache_key(site, sig, args)
+        if cache is not None:
+            entry = self._cache_load(wd, cache, site, key, sig, phase)
+            if entry is not None:
+                return entry
+        # the compile budget gates only REAL compiles: a warm load
+        # costs no XLA work, so it must neither consume
+        # MXNET_COMPILE_BUDGET nor trip it
+        wd.check_budget(site)
+        t0_us = time.perf_counter_ns() // 1000
+        t0 = time.perf_counter()
+        if lowered is None:
+            lowered = self._jitted.lower(*args)
+        compiled = lowered.compile()
+        seconds = time.perf_counter() - t0
+        memory, flops, bytes_accessed = _analyses(compiled)
+        # the ledger walk is pure telemetry (an HLO-text pass per
+        # compile); under MXNET_TELEMETRY=0 it never runs
+        comms = comms_ledger(compiled, bytes_accessed) if enabled() \
+            else None
+        wd.record(site, sig, reason, seconds, phase=phase,
+                  memory=memory, flops=flops, duplicate=duplicate,
+                  start_us=t0_us, comms=comms)
+        self._record_instance_compile(phase)
+        if cache is not None:
+            self._cache_store(wd, cache, site, key, compiled, memory)
+        return self._gate_entry(wd, site, sig, compiled, memory)
+
     def _observe_first_call(self, sig, args, phase):
         wd = watchdog()
         duplicate, reason = self._diff_and_gate(wd, sig)
@@ -844,13 +1044,17 @@ class InstrumentedJit:
 
 
 def instrument(jitted, site, argnames=None, phase=None, owned=True,
-               static_argnums=()):
+               static_argnums=(), variant=None):
     """Register a jitted callable at a watchdog site. The one-line seam
     every framework jit entry point goes through. `static_argnums` must
     restate the jit's own (jax doesn't expose them on the jitted
-    object): the lowered executable takes only the dynamic arguments."""
+    object): the lowered executable takes only the dynamic arguments.
+    `variant` tags this instance's entries in the persistent AOT cache
+    (mxnet_tpu/aot) — required disambiguation when two different jits
+    register at one site and can trace identical signatures."""
     return InstrumentedJit(jitted, site, argnames=argnames, phase=phase,
-                           owned=owned, static_argnums=static_argnums)
+                           owned=owned, static_argnums=static_argnums,
+                           variant=variant)
 
 
 @contextlib.contextmanager

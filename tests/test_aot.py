@@ -1,0 +1,445 @@
+"""Persistent AOT executable cache tests (ISSUE 16, mxnet_tpu/aot).
+
+Load-bearing claims: (1) the content-hashed key misses on ANY input
+change — signature, variant, placement, site, program text, or
+compiler-relevant env — so a stale entry is never found, let alone
+loaded; (2) a truncated or bit-flipped entry is verified-rejected
+(quarantined, `compile_cache_corrupt_total`) and recompiled, NEVER an
+error; (3) concurrent writers publish exactly one well-formed entry
+(first wins, atomic rename — no torn file either way); (4) a restarted
+engine over a warm cache does ZERO fresh XLA compiles
+(`compile_cache_hits > 0`, `compile_total` delta == 0) with
+bit-identical logits, through the paged engine included; (5) the
+aot_warm CLI and the supervised-relaunch prewarm seam stay best-effort.
+"""
+import importlib.util
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from mxnet_tpu import aot, serving, telemetry
+from mxnet_tpu.telemetry import introspect
+from mxnet_tpu.models.transformer import (TransformerConfig,
+                                          init_transformer_params)
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_watchdog():
+    """Own watchdog + registry per test, and — load-bearing — the AOT
+    cache configuration back under env control afterwards:
+    `Engine(aot_cache=...)` configures the PROCESS-wide cache, and a
+    leaked override would silently warm every later engine test."""
+    introspect.reset()
+    telemetry.default_registry().reset()
+    telemetry.tracing.clear()
+    telemetry.flight().clear()
+    aot.configure(None)
+    yield
+    aot.configure()
+    introspect.reset()
+    telemetry.default_registry().reset()
+    telemetry.tracing.clear()
+    telemetry.flight().clear()
+
+
+@pytest.fixture(autouse=True)
+def _no_jax_persistent_cache():
+    """conftest arms jax's own persistent compilation cache for the
+    suite; an executable jax loaded from THAT cache serializes to a
+    payload `deserialize_and_load` rejects ("Symbols not found" on CPU)
+    — the AOT cache quarantines it and recompiles, which is the
+    designed graceful degradation but defeats the zero-compile
+    assertions here. Production entry points (tools/serve.py, aot_warm)
+    never enable jax's cache; run these tests like production."""
+    old = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        # flipping the config is not enough once a compile has
+        # INITIALIZED jax's cache (the module-scoped tiny_lm fixture,
+        # or any earlier test in this process): detach it explicitly
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc)
+        _cc.reset_cache()
+    except Exception:
+        pass
+    yield
+    jax.config.update("jax_compilation_cache_dir", old)
+    try:
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc)
+        _cc.reset_cache()
+    except Exception:
+        pass
+
+
+def tiny_cfg(**kw):
+    base = dict(vocab=48, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                max_len=64)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    cfg = tiny_cfg()
+    params = init_transformer_params(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+def _counter(name):
+    return telemetry.default_registry().counter(name).value
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# key anatomy: any input change is a different key
+# ---------------------------------------------------------------------------
+
+
+def test_key_for_content_sensitivity(monkeypatch):
+    sig = (("tokens", "i32[2,8]"),)
+    base = aot.key_for("serving.decode", sig, "module @m {}",
+                       variant="decode_gather", placement=("1dev",))
+    # deterministic
+    assert base == aot.key_for("serving.decode", sig, "module @m {}",
+                               variant="decode_gather",
+                               placement=("1dev",))
+    others = [
+        aot.key_for("serving.prefill", sig, "module @m {}",
+                    variant="decode_gather", placement=("1dev",)),
+        aot.key_for("serving.decode", (("tokens", "i32[4,8]"),),
+                    "module @m {}", variant="decode_gather",
+                    placement=("1dev",)),
+        aot.key_for("serving.decode", sig, "module @m { changed }",
+                    variant="decode_gather", placement=("1dev",)),
+        aot.key_for("serving.decode", sig, "module @m {}",
+                    variant="decode_paged", placement=("1dev",)),
+        aot.key_for("serving.decode", sig, "module @m {}",
+                    variant="decode_gather", placement=("4dev", "tp")),
+    ]
+    assert len(set(others) | {base}) == len(others) + 1
+    # compiler-relevant env is in the fingerprint -> in the key
+    monkeypatch.setenv("MXNET_PALLAS_INTERPRET", "1")
+    flipped = aot.key_for("serving.decode", sig, "module @m {}",
+                          variant="decode_gather", placement=("1dev",))
+    assert flipped != base
+
+
+def test_fingerprint_names_versions_and_topology():
+    fp = aot.fingerprint()
+    for field in ("jax", "jaxlib", "framework", "platform",
+                  "device_kind", "device_count", "env"):
+        assert field in fp, fp
+    assert "XLA_FLAGS" in fp["env"]
+
+
+# ---------------------------------------------------------------------------
+# entry store/load/verify
+# ---------------------------------------------------------------------------
+
+
+def test_store_load_roundtrip_first_wins(tmp_path):
+    cache = aot.AOTCache(tmp_path)
+    payload, trees = b"\x01" * 64, __import__("pickle").dumps((1, 2))
+    assert cache.store("serving_decode", "k" * 40, payload, trees,
+                       extra={"variant": "decode_gather"})
+    # first writer wins: a duplicate publish is a no-op, not an error
+    assert not cache.store("serving_decode", "k" * 40, b"other", trees)
+    got_payload, in_tree, out_tree, meta = cache.load("serving_decode",
+                                                      "k" * 40)
+    assert got_payload == payload and (in_tree, out_tree) == (1, 2)
+    assert meta["variant"] == "decode_gather"
+    assert meta["payload_sha256"]
+    assert cache.load("serving_decode", "x" * 40) is None   # miss
+    assert cache.entries() and cache.entries()[0].endswith(".mxaot")
+
+
+def test_concurrent_writers_publish_one_entry(tmp_path):
+    """N racing writers: exactly one entry file results, it verifies,
+    and nobody errors — the atomic-rename contract."""
+    cache = aot.AOTCache(tmp_path)
+    trees = __import__("pickle").dumps((None, None))
+    wins, errs = [], []
+
+    def writer(i):
+        try:
+            wins.append(cache.store("train_step", "r" * 40,
+                                    b"payload-%d" % i, trees))
+        except Exception as e:                      # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert sum(1 for w in wins if w) >= 1
+    assert len(cache.entries()) == 1
+    ok, bad = cache.verify()
+    assert len(ok) == 1 and not bad
+
+
+def test_truncated_entry_quarantined(tmp_path):
+    cache = aot.AOTCache(tmp_path)
+    trees = __import__("pickle").dumps((None, None))
+    cache.store("serving_prefill", "t" * 40, b"\x02" * 256, trees)
+    name = cache.entries()[0]
+    path = os.path.join(cache.path, name)
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) // 2)
+    with pytest.raises(aot.CorruptEntry):
+        cache.load("serving_prefill", "t" * 40)
+    # quarantined: gone from the inventory, second probe is a clean miss
+    assert not cache.entries()
+    assert cache.load("serving_prefill", "t" * 40) is None
+
+
+def test_bitflipped_entry_fails_sha256(tmp_path):
+    cache = aot.AOTCache(tmp_path)
+    trees = __import__("pickle").dumps((None, None))
+    cache.store("serving_decode", "b" * 40, b"\x03" * 512, trees)
+    path = os.path.join(cache.path, cache.entries()[0])
+    blob = bytearray(open(path, "rb").read())
+    # flip one payload bit (zip members are STORED uncompressed)
+    idx = blob.find(b"\x03\x03\x03\x03")
+    assert idx > 0
+    blob[idx] ^= 0x40
+    open(path, "wb").write(bytes(blob))
+    ok, bad = cache.verify()
+    assert bad and not ok
+    with pytest.raises(aot.CorruptEntry):
+        cache.load("serving_decode", "b" * 40)
+
+
+def test_configure_and_cache_dir(tmp_path, monkeypatch):
+    aot.configure(str(tmp_path))
+    assert aot.cache_dir() == str(tmp_path)
+    aot.configure(None)
+    assert aot.cache_dir() is None and aot.cache() is None
+    monkeypatch.setenv("MXNET_AOT_CACHE_DIR", str(tmp_path))
+    aot.configure()                       # back under env control
+    assert aot.cache_dir() == str(tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance gate: zero-compile restart, bit-identical logits
+# ---------------------------------------------------------------------------
+
+
+def _drive(eng, prompt, max_new=4):
+    """Prefill + full greedy rollout; every step's logits, bit-exact."""
+    s = eng.start(list(prompt), max_new=max_new)
+    logits = [np.asarray(s.last_logits).copy()]
+    while not s.done:
+        eng.decode_step([s])
+        logits.append(np.asarray(s.last_logits).copy())
+    tokens = list(s.tokens)
+    eng.release(s)
+    return tokens, logits
+
+
+@pytest.mark.parametrize("paged", [False, True],
+                         ids=["gather", "paged"])
+def test_zero_compile_restart_bit_identical(tiny_lm, tmp_path, paged):
+    """Cold engine compiles + publishes; a restarted engine over the
+    same cache warm-loads EVERYTHING: compile_cache_hits > 0, the
+    compile_total delta is exactly 0, and both logit streams are
+    bit-identical. Per-instance accounting separates the warm loads
+    from compiles so the recompile-bound tests stay meaningful."""
+    params, cfg = tiny_lm
+    prompt = [(3 + t) % 48 for t in range(9)]
+
+    cold = serving.Engine(serving.TransformerLM(params, cfg),
+                          max_batch=2, block_size=8, keep_logits=True,
+                          paged=paged, aot_cache=tmp_path)
+    cold_tokens, cold_logits = _drive(cold, prompt)
+    assert cold.prefill_compilations + cold.decode_compilations > 0
+    assert _counter("compile_cache_stores") > 0
+    cache = aot.AOTCache(tmp_path)
+    assert cache.entries(), "cold engine published nothing"
+    cold.close()
+
+    compiles_before = _counter("compile_total")
+    hits_before = _counter("compile_cache_hits")
+    warm = serving.Engine(serving.TransformerLM(params, cfg),
+                          max_batch=2, block_size=8, keep_logits=True,
+                          paged=paged, aot_cache=tmp_path)
+    warm_tokens, warm_logits = _drive(warm, prompt)
+
+    assert _counter("compile_total") == compiles_before, \
+        "restart paid a fresh XLA compile despite a warm cache"
+    assert _counter("compile_cache_hits") > hits_before
+    assert warm.warm_loads > 0
+    assert warm.prefill_compilations == 0
+    assert warm.decode_compilations == 0
+    assert warm_tokens == cold_tokens
+    assert len(warm_logits) == len(cold_logits)
+    for a, b in zip(cold_logits, warm_logits):
+        np.testing.assert_array_equal(a, b)
+    warm.close()
+
+
+def test_cache_on_off_logit_identity(tiny_lm, tmp_path):
+    """The cache switches where executables come from, never logits:
+    cache-off vs warm-loaded runs are bit-identical through the paged
+    engine."""
+    params, cfg = tiny_lm
+    prompt = [(7 + 2 * t) % 48 for t in range(6)]
+    off = serving.Engine(serving.TransformerLM(params, cfg),
+                         max_batch=2, block_size=8, keep_logits=True,
+                         paged=True)
+    assert off.aot_cache is None
+    off_tokens, off_logits = _drive(off, prompt)
+    off.close()
+    # populate, then restart warm
+    serving.Engine(serving.TransformerLM(params, cfg), max_batch=2,
+                   block_size=8, keep_logits=True, paged=True,
+                   aot_cache=tmp_path).close()
+    seed = serving.Engine(serving.TransformerLM(params, cfg),
+                          max_batch=2, block_size=8, keep_logits=True,
+                          paged=True, aot_cache=tmp_path)
+    _drive(seed, prompt)
+    seed.close()
+    on = serving.Engine(serving.TransformerLM(params, cfg),
+                        max_batch=2, block_size=8, keep_logits=True,
+                        paged=True, aot_cache=tmp_path)
+    on_tokens, on_logits = _drive(on, prompt)
+    assert on.warm_loads > 0
+    assert on_tokens == off_tokens
+    for a, b in zip(off_logits, on_logits):
+        np.testing.assert_array_equal(a, b)
+    on.close()
+
+
+def test_env_key_mismatch_is_a_miss(tiny_lm, tmp_path, monkeypatch):
+    """A compiler-relevant env flip (MXNET_PALLAS_INTERPRET, part of
+    the fingerprint) must MISS the warm entries and recompile — never
+    load an executable built under different compiler conditions."""
+    params, cfg = tiny_lm
+    prompt = [(1 + t) % 48 for t in range(5)]
+    cold = serving.Engine(serving.TransformerLM(params, cfg),
+                          max_batch=1, block_size=8,
+                          aot_cache=tmp_path)
+    _drive(cold, prompt, max_new=2)
+    cold.close()
+    monkeypatch.setenv("MXNET_PALLAS_INTERPRET", "1")
+    misses_before = _counter("compile_cache_misses")
+    other = serving.Engine(serving.TransformerLM(params, cfg),
+                           max_batch=1, block_size=8,
+                           aot_cache=tmp_path)
+    _drive(other, prompt, max_new=2)
+    assert other.warm_loads == 0
+    assert other.prefill_compilations + other.decode_compilations > 0
+    assert _counter("compile_cache_misses") > misses_before
+    other.close()
+
+
+def test_corrupt_cache_recompiles_never_errors(tiny_lm, tmp_path):
+    """Every entry bit-flipped on disk: the restarted engine still
+    serves (fresh compiles), counts the rejects on
+    compile_cache_corrupt_total, and republishes good entries."""
+    params, cfg = tiny_lm
+    prompt = [(5 + t) % 48 for t in range(7)]
+    cold = serving.Engine(serving.TransformerLM(params, cfg),
+                          max_batch=1, block_size=8, keep_logits=True,
+                          aot_cache=tmp_path)
+    cold_tokens, cold_logits = _drive(cold, prompt)
+    cold.close()
+    cache = aot.AOTCache(tmp_path)
+    for name in cache.entries():
+        path = os.path.join(cache.path, name)
+        blob = bytearray(open(path, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        open(path, "wb").write(bytes(blob))
+    warm = serving.Engine(serving.TransformerLM(params, cfg),
+                          max_batch=1, block_size=8, keep_logits=True,
+                          aot_cache=tmp_path)
+    tokens, logits = _drive(warm, prompt)
+    assert tokens == cold_tokens
+    for a, b in zip(cold_logits, logits):
+        np.testing.assert_array_equal(a, b)
+    assert warm.warm_loads == 0
+    assert _counter("compile_cache_corrupt_total") > 0
+    # the bad entries were quarantined and fresh ones republished
+    ok, bad = aot.AOTCache(tmp_path).verify()
+    assert ok and not bad
+    warm.close()
+
+
+# ---------------------------------------------------------------------------
+# tools: aot_warm CLI + the supervised-relaunch prewarm seam
+# ---------------------------------------------------------------------------
+
+
+def test_aot_warm_verify_and_purge(tiny_lm, tmp_path, capsys):
+    params, cfg = tiny_lm
+    eng = serving.Engine(serving.TransformerLM(params, cfg),
+                         max_batch=1, block_size=8,
+                         aot_cache=tmp_path)
+    _drive(eng, [1, 2, 3, 4], max_new=2)
+    eng.close()
+    tool = _load_tool("aot_warm")
+    assert tool.main(["--cache", str(tmp_path), "--verify"]) == 0
+    out = capsys.readouterr().out
+    assert "0 corrupt" in out
+    # corrupt one entry -> nonzero exit naming it
+    cache = aot.AOTCache(tmp_path)
+    path = os.path.join(cache.path, cache.entries()[0])
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0x01
+    open(path, "wb").write(bytes(blob))
+    assert tool.main(["--cache", str(tmp_path), "--verify"]) == 1
+    assert "CORRUPT" in capsys.readouterr().out
+    assert tool.main(["--cache", str(tmp_path), "--purge"]) == 0
+    assert not aot.AOTCache(tmp_path).entries()
+    # no cache anywhere -> a loud SystemExit, not a silent no-op
+    aot.configure(None)
+    with pytest.raises(SystemExit):
+        tool.main(["--verify"])
+
+
+def test_supervise_prewarm_seam():
+    """The prewarm hook runs before every incarnation and is strictly
+    best-effort: a failing prewarm command logs and the launch
+    proceeds cold."""
+    sup = _load_tool("train_supervise")
+    calls, logs = [], []
+    rc = sup.supervise(["cmd"], restart_max=1, backoff=0.0,
+                       run=lambda: (calls.append("run"), 0)[1],
+                       sleep=lambda s: None, log=logs.append,
+                       prewarm=lambda: calls.append("prewarm"))
+    assert rc == 0 and calls == ["prewarm", "run"]
+    # a nonzero prewarm command: logged, never fatal
+    import sys as _sys
+    logs2 = []
+    rc = sup.supervise(["cmd"], restart_max=1, backoff=0.0,
+                       run=lambda: 0, sleep=lambda s: None,
+                       log=logs2.append,
+                       prewarm=[_sys.executable, "-c",
+                                "import sys; sys.exit(3)"])
+    assert rc == 0
+    assert any("continuing cold" in m for m in logs2)
+    # an unrunnable prewarm (exception path): same story
+    logs3 = []
+    rc = sup.supervise(["cmd"], restart_max=1, backoff=0.0,
+                       run=lambda: 0, sleep=lambda s: None,
+                       log=logs3.append,
+                       prewarm=lambda: (_ for _ in ()).throw(
+                           RuntimeError("boom")))
+    assert rc == 0
+    assert any("continuing cold" in m for m in logs3)

@@ -279,7 +279,14 @@ def _judge_secondary(verdict, fresh, ref):
                              # re-executed work per restart warns; the
                              # measured publish latency decides
                              ("mttr_s", 0.50, 1),
-                             ("steps_lost_per_remediation", 0.50, 1)):
+                             ("steps_lost_per_remediation", 0.50, 1),
+                             # ISSUE 16: warm-start health signals — a
+                             # growing warm respawn TTFT or a slower
+                             # breach->capacity span means the AOT
+                             # cache stopped absorbing the XLA cost;
+                             # warn-only like the rest of the chaos leg
+                             ("respawn_to_first_token_warm_ms", 0.50, 1),
+                             ("burn_to_scale_up_s", 0.50, 1)):
         fv, rv = fresh.get(field), ref.get(field)
         if not isinstance(fv, (int, float)) or not isinstance(
                 rv, (int, float)) or rv <= 0:

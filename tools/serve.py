@@ -98,7 +98,30 @@ def main():
                          "replica is rebuilt before its crash-loop "
                          "circuit opens (default: "
                          "MXNET_REPLICA_RESPAWN_MAX or 3)")
+    ap.add_argument("--aot-cache", default=None, metavar="DIR",
+                    help="persistent AOT executable cache directory: "
+                         "compiled prefill/decode executables are "
+                         "published here and warm-loaded on restart — "
+                         "zero XLA recompiles, bit-identical logits "
+                         "(default: MXNET_AOT_CACHE_DIR or off; "
+                         "pre-populate with tools/aot_warm.py)")
+    ap.add_argument("--autoscale", action="store_true", default=None,
+                    help="SLO-driven elastic autoscaling: grow the "
+                         "fleet on TTFT burn breach, drain + retire on "
+                         "sustained idle (default: the "
+                         "MXNET_SERVING_AUTOSCALE env var; bounds from "
+                         "--min/--max-replicas)")
+    ap.add_argument("--min-replicas", type=int, default=None,
+                    help="autoscale floor (default: "
+                         "MXNET_SERVING_MIN_REPLICAS or 1)")
+    ap.add_argument("--max-replicas", type=int, default=None,
+                    help="autoscale ceiling (default: "
+                         "MXNET_SERVING_MAX_REPLICAS or 4)")
     args = ap.parse_args()
+    if args.min_replicas is not None:
+        os.environ["MXNET_SERVING_MIN_REPLICAS"] = str(args.min_replicas)
+    if args.max_replicas is not None:
+        os.environ["MXNET_SERVING_MAX_REPLICAS"] = str(args.max_replicas)
 
     from mxnet_tpu import serving
 
@@ -134,7 +157,9 @@ def main():
                   tenant_budget=args.tenant_budget,
                   default_priority=args.priority,
                   default_deadline_ms=args.deadline_ms,
-                  brownout=args.brownout)
+                  brownout=args.brownout,
+                  aot_cache=args.aot_cache,
+                  autoscale=args.autoscale)
     if args.respawn_max is not None:
         n = (args.replicas if args.replicas is not None
              else serving.serving_replicas())
@@ -176,6 +201,24 @@ def main():
              "on" if first.scheduler.brownout else "off",
              (" respawn_max=%d" % srv.respawn_max)
              if isinstance(srv, serving.ReplicatedLMServer) else ""))
+    from mxnet_tpu import aot
+    cdir = aot.cache_dir()
+    if cdir:
+        print("aot cache: %s (%d warm load(s) this start; restarts "
+              "skip XLA — pre-populate with tools/aot_warm.py)"
+              % (cdir, eng.warm_loads))
+    else:
+        print("aot cache: off (set MXNET_AOT_CACHE_DIR or --aot-cache "
+              "to make restarts compile-free)")
+    if getattr(srv, "autoscaler", None) is not None:
+        c = srv.autoscaler.cfg
+        print("autoscale: on — replicas %d..%d, scale up at burn>=%g "
+              "(two shortest windows), retire after %gs idle at "
+              "burn<=%g, cooldown %gs"
+              % (c.min_replicas, c.max_replicas, c.up_burn,
+                 c.idle_retire_s, c.down_burn, c.cooldown_s))
+    else:
+        print("autoscale: off")
     from mxnet_tpu import telemetry
     slo_objs = [o.describe() for o in telemetry.parse_slo_env()]
     if slo_objs:

@@ -129,10 +129,16 @@ def render_postmortem(ledger, flight_dir):
 
 def supervise(cmd, restart_max=None, backoff=None, reset_after=300.0,
               roster=None, flight_dir=None, run=None, sleep=time.sleep,
-              log=print):
+              log=print, prewarm=None):
     """The relaunch ladder. `run`/`sleep`/`log` are test seams; `run`
     defaults to a blocking subprocess of `cmd` and must return its exit
-    code. Returns the wrapper's exit code."""
+    code. `prewarm` runs before EVERY incarnation — a shell command
+    (string/list, e.g. `tools/aot_warm.py` against the job's
+    MXNET_AOT_CACHE_DIR so the relaunched trainer loads its train-step
+    executable instead of recompiling) or a callable; it is strictly
+    best-effort: a failing prewarm is logged and the incarnation
+    launches anyway (a cold restart beats no restart). Returns the
+    wrapper's exit code."""
     import subprocess
     restart_max = _env_int("MXNET_TRAIN_RESTART_MAX", 3) \
         if restart_max is None else int(restart_max)
@@ -145,6 +151,20 @@ def supervise(cmd, restart_max=None, backoff=None, reset_after=300.0,
     ledger = []
     incarnation = 0
     while True:
+        if prewarm is not None:
+            try:
+                if callable(prewarm):
+                    prewarm()
+                else:
+                    pw = prewarm if isinstance(prewarm, list) \
+                        else str(prewarm).split()
+                    prc = subprocess.call(pw)
+                    if prc:
+                        log("[supervise] prewarm exited rc=%d "
+                            "(continuing cold)" % prc)
+            except Exception as e:
+                log("[supervise] prewarm failed: %s (continuing cold)"
+                    % e)
         log("[supervise] incarnation %d: %s" % (incarnation,
                                                 " ".join(cmd) or "<fn>"))
         t0 = time.monotonic()
@@ -209,6 +229,11 @@ def main(argv=None):
     ap.add_argument("--flight-dir", default="",
                     help="flight-recorder directory rendered into the "
                          "circuit-open postmortem")
+    ap.add_argument("--prewarm-cmd", default=None,
+                    help="command run before every incarnation, e.g. "
+                         "'python tools/aot_warm.py --verify' — "
+                         "best-effort (a failure logs and the launch "
+                         "proceeds cold)")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="-- training command")
     args = ap.parse_args(argv)
@@ -220,7 +245,8 @@ def main(argv=None):
                  "[opts] -- cmd args...)")
     return supervise(cmd, restart_max=args.restart_max,
                      backoff=args.backoff, reset_after=args.reset_after,
-                     roster=args.roster, flight_dir=args.flight_dir)
+                     roster=args.roster, flight_dir=args.flight_dir,
+                     prewarm=args.prewarm_cmd)
 
 
 if __name__ == "__main__":
