@@ -30,9 +30,10 @@ from .scheduler import (Scheduler, Request, QueueFull, RequestTimeout,
                         DeadlineExceeded, DeadlineUnmeetable,
                         BrownoutShed, make_resume)
 from .metrics import ServingMetrics
-from .server import LMServer, serve, spawn_resume
+from .server import LMServer, serve, spawn_resume, spawn_migrate
 from .router import (ReplicatedLMServer, serving_replicas,
-                     serving_respawn_max, NoHealthyReplicas)
+                     serving_respawn_max, serving_roles,
+                     NoHealthyReplicas)
 from .autoscale import Autoscaler, AutoscaleConfig, autoscale_enabled
 from .tp import serving_tp, tp_cache_variant
 
@@ -43,9 +44,10 @@ __all__ = [
     "pow2_bucket",
     "Scheduler", "Request", "QueueFull", "RequestTimeout",
     "DeadlineExceeded", "DeadlineUnmeetable", "BrownoutShed",
-    "make_resume", "spawn_resume",
+    "make_resume", "spawn_resume", "spawn_migrate",
     "ServingMetrics", "LMServer", "serve",
     "ReplicatedLMServer", "serving_replicas", "serving_respawn_max",
+    "serving_roles",
     "serving_tp", "tp_cache_variant", "NoHealthyReplicas",
     "Autoscaler", "AutoscaleConfig", "autoscale_enabled",
 ]

@@ -110,9 +110,10 @@ class Autoscaler:
 
     # -- signals -------------------------------------------------------------
 
-    def burn_rates(self):
+    def burn_rates(self, objective="ttft"):
         """{window_seconds: {"rate", "good", "total", "span_s"}} for the
-        fleet's merged default-tenant TTFT objective — {} when no TTFT
+        fleet's merged default-tenant burn on `objective` (ttft by
+        default; the per-role policy also reads itl) — {} when no such
         SLO is armed (the scaler then acts on idleness alone). Burn is
         recomputed from SUMMED window deltas (telemetry.slo.merge_slo),
         never averaged, so an idle replica can't dilute a burning one."""
@@ -126,7 +127,7 @@ class Autoscaler:
         merged = _slo.merge_slo(payloads)
         pick = None
         for m in merged:
-            if m.get("objective") != "ttft":
+            if m.get("objective") != objective:
                 continue
             if m.get("tenant") is None:
                 pick = m
@@ -184,6 +185,26 @@ class Autoscaler:
         n = len(r.replicas)
         burns = self.burn_rates()
         hot = self._hot(burns)
+        # per-role scaling on disaggregated fleets (ISSUE 17): TTFT
+        # burn means admission/prompt pressure -> add a prefill
+        # specialist; ITL burn means steady-state decode pressure ->
+        # add a decode specialist (decode wins when both burn — the
+        # in-flight users' pain is the one migration exists to fix).
+        # Role-less fleets never reach this: role stays None and
+        # scale_up ignores it. The TypeError guard keeps scripted
+        # no-arg burn_rates stubs (tests, drills) working.
+        role = None
+        if getattr(r, "_roles", None) is not None:
+            try:
+                itl_burns = self.burn_rates("itl")
+            except TypeError:
+                itl_burns = {}
+            hot_itl = self._hot(itl_burns)
+            if hot_itl:
+                role = "decode"
+            elif hot:
+                role = "prefill"
+            hot = hot or hot_itl
         if hot:
             if self._breach_since is None:
                 self._breach_since = now
@@ -197,11 +218,11 @@ class Autoscaler:
         # immediately, cooldown notwithstanding (the fleet must never
         # undershoot)
         if n < self.cfg.min_replicas:
-            return self._up(now)
+            return self._up(now, role)
         in_cooldown = (self._last_action_t is not None
                        and now - self._last_action_t < self.cfg.cooldown_s)
         if hot and not in_cooldown and n < self.cfg.max_replicas:
-            return self._up(now)
+            return self._up(now, role)
         idle = (self._idle_since is not None
                 and now - self._idle_since >= self.cfg.idle_retire_s)
         if idle and self._cold(burns) and not in_cooldown \
@@ -209,8 +230,13 @@ class Autoscaler:
             return self._down(now)
         return None
 
-    def _up(self, now):
-        if self.router.scale_up() is None:
+    def _up(self, now, role=None):
+        # role is only ever non-None on a disaggregated router; calling
+        # positionally-only scripted stand-ins (tests, drills) without
+        # the kwarg keeps them working unchanged
+        added = (self.router.scale_up(role=role) if role is not None
+                 else self.router.scale_up())
+        if added is None:
             return None
         self._last_action_t = now
         self.scale_ups += 1

@@ -689,6 +689,18 @@ class Engine:
     def cache_utilization(self):
         return self.cache.utilization() if self.cache else None
 
+    def kv_bytes_per_token(self):
+        """Bytes of KV-cache one token occupies on this engine (both the
+        K and the V plane, every layer): the unit the migration ledger
+        prices a prefix-cache hit in — a migration hop whose target
+        already holds a block skips re-prefilling block_size tokens,
+        i.e. this many bytes per token of KV it did not have to
+        rebuild. 0 when the model family keeps no cache."""
+        if self.cache is None:
+            return 0
+        nl, nh, dh, dt = self.model.cache_spec()
+        return 2 * nl * nh * dh * np.dtype(dt).itemsize
+
     @property
     def prefill_compilations(self):
         """Prefill-path compilations THIS engine's calls paid, counted

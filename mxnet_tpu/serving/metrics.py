@@ -133,6 +133,25 @@ class ServingMetrics:
             "serving_failover_resumed_tokens_total",
             help="already-generated tokens salvaged by failover "
                  "replays (not re-decoded, only re-prefilled)")
+        # disaggregated-serving observables (ISSUE 17): the planned,
+        # every-request version of the failover hop — prefill replicas
+        # hand finished prompts to decode replicas over the same replay
+        # transport, no failover budget spent
+        self._migrations = c(
+            "serving_migration_total", flight=True,
+            help="planned prefill->decode migration hops placed "
+                 "(disaggregated serving; replay transport)")
+        self._migration_tokens = c(
+            "serving_migration_tokens_total",
+            help="tokens carried across migration hops (prompt + "
+                 "generated-so-far, re-prefilled on the decode "
+                 "replica rather than re-decoded)")
+        self._migration_bytes = c(
+            "serving_migration_bytes_saved_total",
+            help="KV-cache bytes migration hops did NOT rebuild "
+                 "because the target's prefix cache already held "
+                 "the blocks (priced at the target engine's KV "
+                 "layout, accounted per hop at target admission)")
         self._g_brownout = g(
             "serving_brownout_active",
             help="1 while brownout shedding/clamping is engaged")
@@ -263,6 +282,18 @@ class ServingMetrics:
     @property
     def failover_resumed_tokens(self):
         return int(self._failover_tokens.value)
+
+    @property
+    def migrations(self):
+        return int(self._migrations.value)
+
+    @property
+    def migration_tokens(self):
+        return int(self._migration_tokens.value)
+
+    @property
+    def migration_bytes_saved(self):
+        return int(self._migration_bytes.value)
 
     @property
     def tokens_generated(self):
@@ -453,6 +484,28 @@ class ServingMetrics:
                 resumed_tokens)
         self.log_event("failover", req, resumed_tokens=resumed_tokens,
                        hop=req.failovers + 1)
+
+    def request_migration(self, req, carried):
+        """One planned prefill->decode migration hop placed for `req`'s
+        trace (disaggregated serving). Counts the hop and the carried
+        tokens; does NOT credit the tenant `replayed` ledger — replayed
+        is failover salvage (unplanned extra work), and keeping the two
+        distinct preserves fleet-replayed == sum(tenant-replayed). The
+        hop's own finish classifies the delivery exactly once."""
+        self._migrations.inc()
+        if carried:
+            self._migration_tokens.inc(carried)
+        self.log_event("migrate", req, carried_tokens=carried)
+
+    def request_migration_savings(self, req, hit_tokens, nbytes):
+        """Bytes of KV a migration hop skipped rebuilding because this
+        (target) engine's prefix cache already held `hit_tokens` of the
+        replayed prompt — accounted per hop, on the target, priced at
+        the target's KV layout."""
+        if nbytes:
+            self._migration_bytes.inc(int(nbytes))
+        self.log_event("migrate_savings", req, hit_tokens=hit_tokens,
+                       bytes_saved=int(nbytes))
 
     def request_expired(self, req):
         """Counts the expiry only; request_finished() (always called
@@ -691,6 +744,7 @@ class ServingMetrics:
                 "deadline_shed": self.deadline_shed,
                 "brownout_shed": self.brownout_shed,
                 "failovers": self.failovers,
+                "migrations": self.migrations,
             },
             "tokens": self.tokens_ledger(),
             "goodput_tok_per_sec": round(
@@ -723,6 +777,7 @@ class ServingMetrics:
                 "deadline_shed": self.deadline_shed,
                 "brownout_shed": self.brownout_shed,
                 "failovers": self.failovers,
+                "migrations": self.migrations,
             },
             "latency_ms": {
                 "queue_mean": 1e3 * self._h_queue.sum / started,

@@ -55,6 +55,21 @@ submit/HTTP front:
   registries into one Prometheus exposition distinguished by the
   `replica` label (telemetry.merged_prometheus_text); the JSON snapshot
   carries per-replica snapshots plus summed aggregates.
+* **Disaggregated prefill/decode roles (ISSUE 17)**: with
+  `MXNET_SERVING_ROLES=prefill:N,decode:M` (or `serve(roles=)`) the
+  fleet splits into specialists — admission prefers prefill replicas,
+  and the moment a prompt finishes prefilling (first token emitted)
+  the request MIGRATES to the least-loaded decode replica over the
+  failover replay transport: the target re-prefills prompt +
+  generated-so-far, skipping every KV block its prefix cache already
+  holds (bytes saved accounted per hop), and decode continues
+  greedy-token-identical with the client's deadline, tenant, priority,
+  latency anchors, and W3C trace intact — one connected trace row,
+  SLO-classified exactly once. Degradation is graceful by
+  construction: a role-less fleet behaves byte-for-byte as before,
+  and when no healthy decode replica can absorb a hand-off the source
+  keeps decoding locally (co-scheduled fallback — flags switch
+  placement, never logits).
 
 With tensor parallelism, replica i runs on the contiguous device window
 [i*tp, (i+1)*tp) (parallel/mesh.replica_devices) — tp collectives stay
@@ -89,6 +104,63 @@ def serving_respawn_max():
     return int(env) if env else 3
 
 
+#: role names a disaggregated fleet understands — prefill replicas
+#: absorb prompt processing and hand finished prompts off; decode
+#: replicas own steady-state generation
+SERVING_ROLES = ("prefill", "decode")
+
+
+def serving_roles(spec=None):
+    """Parse a disaggregated-fleet role layout — `"prefill:N,decode:M"`
+    — from `spec`, or from MXNET_SERVING_ROLES when `spec` is None
+    (docs/ENV_VARS.md). Returns an ordered `{"prefill": N, "decode": M}`
+    dict, or None when unset/empty: the role-less fleet, byte-for-byte
+    today's co-scheduled behavior. A dict passes through validated.
+    Unknown role names, non-integer counts, and layouts naming zero
+    total replicas raise MXNetError — a typo'd role must never silently
+    build a co-scheduled fleet the operator believes is disaggregated."""
+    if spec is None:
+        spec = os.environ.get("MXNET_SERVING_ROLES")
+    if spec is None:
+        return None
+    if isinstance(spec, dict):
+        items = list(spec.items())
+    else:
+        spec = str(spec).strip()
+        if not spec:
+            return None
+        items = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, sep, count = part.partition(":")
+            if not sep:
+                raise MXNetError(
+                    "bad role spec %r: expected role:count entries "
+                    "like 'prefill:1,decode:2'" % spec)
+            items.append((name.strip(), count.strip()))
+    out = {}
+    for name, count in items:
+        if name not in SERVING_ROLES:
+            raise MXNetError(
+                "unknown serving role %r (known: %s)"
+                % (name, ", ".join(SERVING_ROLES)))
+        try:
+            n = int(count)
+        except (TypeError, ValueError):
+            raise MXNetError("bad count %r for role %r" % (count, name))
+        if n < 0:
+            raise MXNetError("role %r count must be >= 0" % name)
+        out[name] = out.get(name, 0) + n
+    if not out:
+        return None
+    if sum(out.values()) < 1:
+        raise MXNetError(
+            "role layout %r names zero replicas" % (spec,))
+    return {k: v for k, v in out.items() if v > 0}
+
+
 class NoHealthyReplicas(MXNetError):
     """Every replica behind the front door is drained/dead — a fleet
     outage, not a client error (the HTTP frontend maps this to 503,
@@ -105,8 +177,28 @@ class ReplicatedLMServer(_HTTPFrontend):
     def __init__(self, model, replicas=2, tp=None, devices=None,
                  retry_after_s=1.0, max_beat_age=5.0, respawn_max=None,
                  respawn_backoff=0.5, respawn_reset_s=30.0,
-                 autoscale=None, **kwargs):
+                 autoscale=None, roles=None, role_kwargs=None,
+                 **kwargs):
         from .tp import serving_tp
+        # disaggregated serving (ISSUE 17): `roles` splits the fleet
+        # into prefill and decode specialists; the replica count is
+        # then the SUM of the role counts and the `replicas` arg is
+        # ignored. `role_kwargs` overlays per-role LMServer kwargs
+        # (e.g. {"prefill": {"chunk_size": 64}, "decode": {"tp": 2}})
+        # on top of the shared **kwargs — flags switch placement and
+        # batching shape, never logits. roles=None is the role-less
+        # fleet, byte-for-byte today's behavior.
+        self._roles = roles if isinstance(roles, dict) or roles is None \
+            else serving_roles(roles)
+        self._role_kwargs = dict(role_kwargs or {})
+        if self._roles is not None:
+            self._roles = serving_roles(self._roles)   # validate dicts
+        if self._roles:
+            replicas = sum(self._roles.values())
+            role_seq = [nm for nm, cnt in self._roles.items()
+                        for _ in range(cnt)]
+        else:
+            role_seq = [None] * max(int(replicas), 0)
         if replicas < 1:
             raise MXNetError("replicas must be >= 1, got %r" % replicas)
         if devices is not None:
@@ -191,8 +283,19 @@ class ReplicatedLMServer(_HTTPFrontend):
             "serving_warm_replicas",
             help="replicas whose engines warm-loaded at least one "
                  "executable from the AOT cache instead of compiling")
+        # per-role fleet gauges (serving_role_<role>_replicas), created
+        # only on disaggregated fleets so a role-less exposition stays
+        # byte-for-byte unchanged
+        self._g_role = {}
+        if self._roles is not None:
+            for rn in SERVING_ROLES:
+                self._g_role[rn] = self.registry.gauge(
+                    "serving_role_%s_replicas" % rn,
+                    help="healthy (routable) replicas currently "
+                         "holding the %s role" % rn)
         self.replicas = []
         self._drained = []
+        self._role = []     # per-replica role label, index-aligned
         # per-replica supervision state, index-aligned with `replicas`
         self._respawn_attempts = [0] * replicas
         self._respawn_next = [0.0] * replicas
@@ -205,13 +308,16 @@ class ReplicatedLMServer(_HTTPFrontend):
         self._retired_tenants = {}      # {tenant: {kind: tokens}}
         try:
             for i in range(replicas):
-                self.replicas.append(self._build_replica(i))
+                self.replicas.append(
+                    self._build_replica(i, role_seq[i]))
                 self._drained.append(False)
+                self._role.append(role_seq[i])
         except BaseException:
             for rep in self.replicas:
                 rep.close(drain=False, timeout=5.0)
             raise
         self._g_healthy.set(len(self.replicas))
+        self._refresh_role_gauges()
         # elastic autoscaling (ISSUE 16): autoscale=True arms the
         # env-configured policy, an AutoscaleConfig pins one explicitly
         self.autoscaler = None
@@ -222,18 +328,37 @@ class ReplicatedLMServer(_HTTPFrontend):
             self.autoscaler = Autoscaler(self, config=cfg)
             self.autoscaler.start()
 
-    def _build_replica(self, i):
+    def _build_replica(self, i, role=None):
         """One fresh replica on its device window — the constructor's
-        path and the respawn path share it, so a rebuilt replica is
-        placed exactly like the original."""
+        path, the respawn path, and elastic scale-up share it, so a
+        rebuilt replica is placed (and role'd) exactly like the
+        original. On disaggregated fleets, per-role kwargs overlay the
+        shared ones — a prefill replica may run a larger chunk size, a
+        decode replica a different tp — and a prefill replica gets the
+        router's migration hook installed."""
         from ..parallel.mesh import replica_devices
-        devs = replica_devices(i, self._tp) if self._tp > 1 else None
-        rep = LMServer(self._model, tp=self._tp, devices=devs,
-                       replica_id=i, **self._kwargs)
+        kw = dict(self._kwargs)
+        if role is not None:
+            kw.update(self._role_kwargs.get(role, {}))
+        tp = int(kw.pop("tp", self._tp))
+        devs = replica_devices(i, tp) if tp > 1 else None
+        rep = LMServer(self._model, tp=tp, devices=devs,
+                       replica_id=i, role=role, **kw)
         # the death hook runs ON the dying serving thread: queued and
         # in-flight work is re-homed immediately, not at the next sweep
         rep.on_death = self._on_replica_death
+        if role == "prefill":
+            rep.on_prefill_done = self._migrate
         return rep
+
+    def _refresh_role_gauges(self):
+        """Re-derive the per-role healthy-replica gauges from the
+        index-aligned role/drained lists (no-op on role-less fleets)."""
+        for rn, gv in self._g_role.items():
+            gv.set(sum(
+                1 for j, r in enumerate(self._role)
+                if r == rn and j < len(self._drained)
+                and not self._drained[j]))
 
     # -- routing -------------------------------------------------------------
 
@@ -271,6 +396,8 @@ class ReplicatedLMServer(_HTTPFrontend):
                                                and not rep._closed))
                 h["circuit_open"] = self._circuit_open[i]
                 h["respawns"] = self._respawn_attempts[i]
+                if self._roles is not None and i < len(self._role):
+                    h["role"] = self._role[i]
                 healths.append(h)
                 if self._closed:
                     continue
@@ -313,6 +440,7 @@ class ReplicatedLMServer(_HTTPFrontend):
         self._g_warm.set(sum(
             1 for rep in list(self.replicas)
             if getattr(rep.engine, "warm_loads", 0) > 0))
+        self._refresh_role_gauges()
         return healths
 
     def _maybe_respawn(self, i, now):
@@ -350,7 +478,10 @@ class ReplicatedLMServer(_HTTPFrontend):
         paths, swap atomically, retire the corpse (its engine is kept
         for the leak audit)."""
         try:
-            rep = self._build_replica(i)
+            # a respawned replica keeps its slot's role: a dead prefill
+            # specialist comes back a prefill specialist, hook and all
+            role = self._role[i] if i < len(self._role) else None
+            rep = self._build_replica(i, role)
         except Exception as e:
             with self._lock:
                 self._respawning[i] = False
@@ -537,11 +668,59 @@ class ReplicatedLMServer(_HTTPFrontend):
             % (req.id, why)))
         rep.metrics.request_finished(req)
 
-    def _pick_order(self):
+    # -- migration (disaggregated serving, ISSUE 17) -------------------------
+
+    def _migrate(self, source, req, tokens):
+        """The prefill replica's hand-off hook (`on_prefill_done`),
+        called on `source`'s serving thread the moment a prompt
+        finishes prefilling (first token already appended). Place the
+        request's steady-state decode on the least-loaded healthy
+        decode replica via the replay transport (`spawn_migrate`): the
+        target re-prefills prompt + first token — skipping every KV
+        block its prefix cache already holds — and decodes on,
+        greedy-token-identical, with the stitched trace keeping the
+        hop one connected row.
+
+        Returns True when the request now lives on a decode replica
+        (or finished outright), False when no healthy decode replica
+        can absorb it — role loss or fleet-wide decode saturation —
+        in which case the source keeps decoding it locally:
+        co-scheduled fallback, never a dropped request."""
+        from .server import spawn_migrate
+        if self._closed:
+            return False
+        with self._lock:
+            targets = [
+                r for j, r in enumerate(self.replicas)
+                if j < len(self._role) and self._role[j] == "decode"
+                and j < len(self._drained) and not self._drained[j]
+                and r is not source]
+        for tgt in sorted(targets, key=lambda r: r.load_tokens()):
+            try:
+                resume, carried = spawn_migrate(req, tokens, tgt)
+            except QueueFull:
+                continue
+            if resume is None:
+                # generation was already complete at the seam: the hop
+                # finished the client directly; close the ledger where
+                # the submit was counted — exactly once
+                source.metrics.request_finished(req)
+            else:
+                tgt.metrics.request_migration(req, carried)
+            return True
+        return False
+
+    def _pick_order(self, role=None):
         """Routable replicas, least-loaded first; ties broken
         round-robin from a rotating cursor so equal replicas alternate.
-        The scan is a few dict/list reads per replica — the router
-        overhead the serving bench reports in microseconds."""
+        On disaggregated fleets, `role` PREFERS that role's replicas (a
+        stable re-sort: least-loaded order survives within each group)
+        without excluding the rest — when every prefill replica is
+        saturated or dead, admission falls through to the decode
+        replicas and the fleet degrades to co-scheduled serving instead
+        of refusing traffic. The scan is a few dict/list reads per
+        replica — the router overhead the serving bench reports in
+        microseconds."""
         t0 = time.perf_counter()
         alive = self._routable()
         # snapshot the replica list: a concurrent scale action must not
@@ -554,6 +733,9 @@ class ReplicatedLMServer(_HTTPFrontend):
             self._rr += 1
         order = sorted(alive, key=lambda i: (
             reps[i].load_tokens(), (i - rr) % n))
+        if role is not None and self._roles is not None:
+            order.sort(key=lambda i: 0 if (
+                i < len(self._role) and self._role[i] == role) else 1)
         self._h_pick.observe(time.perf_counter() - t0)
         return order
 
@@ -562,21 +744,26 @@ class ReplicatedLMServer(_HTTPFrontend):
     def replica_count(self):
         return len(self.replicas)
 
-    def scale_up(self):
+    def scale_up(self, role=None):
         """Add one replica at the tail of the fleet. The build runs
         OFF-lock (engine construction takes real time; with an AOT
         cache configured it warm-loads its executables instead of
         compiling), then the append of the replica plus all its
-        index-aligned supervision state happens atomically. Returns the
-        new LMServer, or None when closed/raced/build-failed — callers
-        (the Autoscaler) treat None as \"no action taken\"."""
+        index-aligned supervision state happens atomically. On
+        disaggregated fleets `role` says WHICH specialist to add (the
+        per-role autoscaler maps TTFT burn to prefill, ITL burn to
+        decode); role-less fleets ignore it. Returns the new LMServer,
+        or None when closed/raced/build-failed — callers (the
+        Autoscaler) treat None as \"no action taken\"."""
+        if self._roles is None:
+            role = None
         with self._lock:
             if self._closed:
                 return None
             i = len(self.replicas)
         t0 = time.perf_counter_ns() // 1000
         try:
-            rep = self._build_replica(i)
+            rep = self._build_replica(i, role)
         except Exception as e:
             telemetry.flight().record(
                 "fault", "serving.scale_up_failed", replica=i,
@@ -588,6 +775,7 @@ class ReplicatedLMServer(_HTTPFrontend):
             else:
                 self.replicas.append(rep)
                 self._drained.append(False)
+                self._role.append(role)
                 self._respawn_attempts.append(0)
                 self._respawn_next.append(0.0)
                 self._respawning.append(False)
@@ -602,8 +790,9 @@ class ReplicatedLMServer(_HTTPFrontend):
             "serving.scale_up", t0,
             time.perf_counter_ns() // 1000 - t0,
             category="serving", to_profiler=False, replica=i,
-            warm=bool(getattr(rep.engine, "warm_loads", 0)))
+            role=role, warm=bool(getattr(rep.engine, "warm_loads", 0)))
         self._g_healthy.set(len(self.replicas) - sum(self._drained))
+        self._refresh_role_gauges()
         return rep
 
     def scale_down(self):
@@ -634,6 +823,7 @@ class ReplicatedLMServer(_HTTPFrontend):
                 return None          # raced a shutdown/respawn swap
             self.replicas.pop()
             self._drained.pop()
+            self._role.pop()
             self._respawn_attempts.pop()
             self._respawn_next.pop()
             self._respawning.pop()
@@ -675,6 +865,7 @@ class ReplicatedLMServer(_HTTPFrontend):
             time.perf_counter_ns() // 1000 - t0,
             category="serving", to_profiler=False, replica=i)
         self._g_healthy.set(len(self.replicas) - sum(self._drained))
+        self._refresh_role_gauges()
         return rep
 
     # -- client API ----------------------------------------------------------
@@ -697,7 +888,10 @@ class ReplicatedLMServer(_HTTPFrontend):
         lands)."""
         if self._closed:
             raise MXNetError("server is closed")
-        order = self._pick_order()
+        # disaggregated fleets admit at the prefill specialists first;
+        # role-less fleets route exactly as before
+        order = self._pick_order(
+            "prefill" if self._roles is not None else None)
         if not order:
             raise NoHealthyReplicas(
                 "no healthy replicas (all %d drained)"
@@ -799,6 +993,8 @@ class ReplicatedLMServer(_HTTPFrontend):
                 "replicas_circuit_open": sum(self._circuit_open),
                 "failovers": sum(s["requests"].get("failovers", 0)
                                  for s in snaps),
+                "migrations": sum(s["requests"].get("migrations", 0)
+                                  for s in snaps),
                 "respawns": int(self._c_respawn.value),
                 "orphaned": int(self._c_orphaned.value),
             },
@@ -825,16 +1021,38 @@ class ReplicatedLMServer(_HTTPFrontend):
                 agg = tenants.setdefault(name, {"tokens": {}})
                 for k, v in t["tokens"].items():
                     agg["tokens"][k] = agg["tokens"].get(k, 0) + v
+        fleet = {
+            "replicas_total": len(self.replicas),
+            "replicas_drained": sum(self._drained),
+            "replicas_circuit_open": sum(self._circuit_open),
+            "tokens": tokens,
+            "tenants": tenants,
+            "slo": _slo.merge_slo([b["slo"] for b in bodies]),
+        }
+        if self._roles is not None:
+            # per-role aggregates (disaggregated fleets only, so a
+            # role-less /statusz body stays byte-for-byte unchanged):
+            # live layout + the migration ledger summed over replicas
+            role_agg = {}
+            for j, rn in enumerate(self._role):
+                if rn is None:
+                    continue
+                acc = role_agg.setdefault(
+                    rn, {"replicas": 0, "healthy": 0})
+                acc["replicas"] += 1
+                if j < len(self._drained) and not self._drained[j]:
+                    acc["healthy"] += 1
+            fleet["roles"] = role_agg
+            fleet["migrations"] = sum(
+                r.metrics.migrations for r in self.replicas)
+            fleet["migration_tokens"] = sum(
+                r.metrics.migration_tokens for r in self.replicas)
+            fleet["migration_bytes_saved"] = sum(
+                r.metrics.migration_bytes_saved
+                for r in self.replicas)
         return {
             "replicas": bodies,
-            "fleet": {
-                "replicas_total": len(self.replicas),
-                "replicas_drained": sum(self._drained),
-                "replicas_circuit_open": sum(self._circuit_open),
-                "tokens": tokens,
-                "tenants": tenants,
-                "slo": _slo.merge_slo([b["slo"] for b in bodies]),
-            },
+            "fleet": fleet,
         }
 
     def prometheus_text(self):

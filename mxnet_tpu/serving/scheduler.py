@@ -139,6 +139,13 @@ class Request:
         self.t_client_submit = self.t_submit
         self.t_client_first_token = None
         self.failovers = 0            # resume hops already spent on it
+        self.migrated = False         # this Request is a PLANNED
+                                      # prefill->decode migration hop
+                                      # (disaggregated serving), not a
+                                      # fault recovery: it is admitted
+                                      # work mid-generation (brownout-
+                                      # exempt) but spends no failover
+                                      # budget
         self.resumed_tokens = 0       # generated tokens a failover
                                       # replay carried in its prompt
                                       # (the goodput ledger credits the
@@ -183,7 +190,7 @@ class Request:
             cb(self)                 # finishes ANOTHER request
 
 
-def make_resume(orig, tokens, max_len):
+def make_resume(orig, tokens, max_len, migrate=False):
     """Build the failover replay for `orig`: a fresh Request whose
     prompt is the original prompt PLUS every token already generated —
     replayed as a prefill on the target replica (hitting the prefix
@@ -194,6 +201,15 @@ def make_resume(orig, tokens, max_len):
     `carried` counts the generated-so-far tokens the replay salvages,
     or (None, carried) when nothing remains to generate (the caller
     finishes `orig` directly with `tokens`).
+
+    `migrate=True` builds the PLANNED hop of disaggregated serving
+    (prefill replica -> decode replica) instead of a fault recovery:
+    identical replay transport and carried anchors, but the resume
+    spends no failover budget (`failovers` stays at the original's —
+    every-request migration must not eat the bounded fault-hop
+    allowance) and is marked `migrated` so admission treats it as what
+    it is: already-admitted work mid-generation (brownout-exempt,
+    never shed or clamped).
 
     The caller owns the stitch: set ``resume._on_finish`` to complete
     `orig` from the resume's result — `orig.result()` slices by the
@@ -212,7 +228,9 @@ def make_resume(orig, tokens, max_len):
                      priority=orig.priority,
                      deadline_ms=orig.deadline_ms,
                      trace=orig.trace)
-    resume.failovers = orig.failovers + 1
+    resume.failovers = orig.failovers if migrate \
+        else orig.failovers + 1
+    resume.migrated = bool(migrate or orig.migrated)
     resume.resumed_tokens = carried
     # the victim's last token-emit time rides along so the client's
     # real inter-token gap across the hop lands in the ITL histogram
@@ -364,16 +382,20 @@ class Scheduler:
                 # classes are distinguishable — with one class the
                 # max_new clamp below is the degradation lever; shedding
                 # everyone would be an outage, not a brownout)
-                # failover resumes (failovers > 0) are exempt: they ARE
-                # admitted work mid-generation, re-queued only because
-                # their replica died — shedding or clamping one would
-                # fail/truncate a response the client was already
-                # receiving and break failover token parity
-                prios = {r.priority for r in order if r.failovers == 0}
+                # failover resumes (failovers > 0) and migration hops
+                # (migrated) are exempt: they ARE admitted work
+                # mid-generation, re-queued only because their replica
+                # died or handed them to a decode replica — shedding or
+                # clamping one would fail/truncate a response the
+                # client was already receiving and break replay token
+                # parity
+                prios = {r.priority for r in order
+                         if r.failovers == 0 and not r.migrated}
                 if len(prios) > 1:
                     floor = min(prios)
                     for req in order:
-                        if req.priority == floor and req.failovers == 0:
+                        if req.priority == floor and req.failovers == 0 \
+                                and not req.migrated:
                             drop.add(req.id)
                             expired.append(req)
                             req.error = BrownoutShed(
@@ -437,12 +459,14 @@ class Scheduler:
                 spent += cost
                 by_tenant[req.tenant] = t_spent + cost
                 drop.add(req.id)
-                if self.brownout_active and req.failovers == 0:
+                if self.brownout_active and req.failovers == 0 \
+                        and not req.migrated:
                     # degrade, don't deny: newly admitted work generates
                     # fewer tokens under brownout. Admitted work is
                     # never re-clamped and logits are never touched —
-                    # which is exactly why failover resumes are exempt
-                    # (they are admitted work continuing elsewhere).
+                    # which is exactly why failover resumes and
+                    # migration hops are exempt (they are admitted work
+                    # continuing elsewhere).
                     req.max_new_tokens = min(req.max_new_tokens,
                                              self.brownout_max_new)
                 req.t_admit = now

@@ -258,7 +258,7 @@ class LMServer(_HTTPFrontend):
                  replica_id=None, prefix_cache=None, tenant_budget=None,
                  tenant_budgets=None, default_priority=0,
                  default_deadline_ms=None, brownout=None,
-                 aot_cache=None):
+                 aot_cache=None, role=None):
         adapter = _resolve_model(model, vocab=vocab, max_len=max_len,
                                  time_major=time_major)
         self.engine = Engine(adapter, max_batch=max_batch, max_len=max_len,
@@ -280,6 +280,15 @@ class LMServer(_HTTPFrontend):
         self.default_deadline_ms = default_deadline_ms
         self.metrics = ServingMetrics(replica=replica_id)
         self.replica_id = replica_id
+        # disaggregated serving (ISSUE 17): `role` is an advisory label
+        # ("prefill"/"decode"/None) the router stamps for placement and
+        # observability — it never changes this server's compute or
+        # logits. `on_prefill_done` is the router's migration hook,
+        # installed on prefill-role replicas: called on the serving
+        # thread when a prompt finishes prefilling, it moves steady-
+        # state decode to a decode replica via the replay transport.
+        self.role = str(role) if role is not None else None
+        self.on_prefill_done = None
         self._idle_wait = idle_wait
         self._work = threading.Event()
         self._closed = False
@@ -461,7 +470,12 @@ class LMServer(_HTTPFrontend):
     def statusz(self):
         """The /statusz JSON body (ISSUE 13): the goodput token ledger,
         per-tenant breakdown, and SLO attainment/burn for this server."""
-        return self.metrics.statusz(self.engine, self.scheduler)
+        body = self.metrics.statusz(self.engine, self.scheduler)
+        if self.role is not None:
+            # stamped only on disaggregated fleets — role-less bodies
+            # stay byte-for-byte as before
+            body["role"] = self.role
+        return body
 
     def health(self, max_beat_age=5.0):
         """Loop-liveness summary for /healthz: `ok` requires the serving
@@ -746,8 +760,15 @@ class LMServer(_HTTPFrontend):
             req.state = "running"
             _queue_span(req)
             met.request_admitted(req)
-            sched.running.append(seq)
             met.request_prefilled(req, time.perf_counter() - t0)
+            # disaggregated serving: same hand-off seam as the chunked
+            # path — the dense one-shot prefill just completed and the
+            # first token is appended
+            if not seq.done and self.on_prefill_done is not None \
+                    and not req._event.is_set() \
+                    and self._migrate_out(seq, req):
+                continue
+            sched.running.append(seq)
 
     def _admit_paged(self, admitted):
         """Paged admission: allocate cache blocks only; the prompt
@@ -773,6 +794,14 @@ class LMServer(_HTTPFrontend):
             req.state = "running"
             _queue_span(req)
             met.request_admitted(req)
+            if req.migrated and getattr(seq, "cache_hit_tokens", 0):
+                # the migration hop's savings ledger, priced at THIS
+                # engine's KV layout: every prompt token the prefix
+                # cache already held is KV the hop did not re-transport
+                # (re-prefill) — accounted per hop, on the target
+                met.request_migration_savings(
+                    req, seq.cache_hit_tokens,
+                    seq.cache_hit_tokens * eng.kv_bytes_per_token())
             sched.prefilling.append(seq)
 
     def _prefill_chunks(self):
@@ -822,10 +851,73 @@ class LMServer(_HTTPFrontend):
                 met.request_chunk(seq.request, seq.prefilled)
             if done:
                 sched.prefilling.remove(seq)
+                req = seq.request
+                if req is not None:
+                    met.request_prefilled(req, seq.prefill_s)
+                # disaggregated serving: a prefill-role replica hands
+                # the finished prompt to a decode replica here — after
+                # the first token (TTFT observed on THIS replica, which
+                # really produced it), before any steady-state decode.
+                # A sequence whose generation is already complete
+                # (seq.done: eos / budget hit on the first token)
+                # finishes locally; a failed placement falls through to
+                # local decode (co-scheduled fallback, no behavior
+                # change).
+                if req is not None and not seq.done \
+                        and self.on_prefill_done is not None \
+                        and not req._event.is_set() \
+                        and self._migrate_out(seq, req):
+                    met.prefill_chunk(len(sched.prefilling))
+                    continue
                 sched.running.append(seq)
-                if seq.request is not None:
-                    met.request_prefilled(seq.request, seq.prefill_s)
             met.prefill_chunk(len(sched.prefilling))
+
+    # -- migration (disaggregated serving, ISSUE 17) -------------------------
+
+    def _migrate_out(self, seq, req):
+        """Hand one just-prefilled sequence to the router's migration
+        hook (`on_prefill_done`). Returns True when the request now
+        lives elsewhere (a migration resume was placed on a decode
+        replica, or nothing remained and the hook finished it) — the
+        local sequence is then released, its fully-prefilled KV
+        registered in THIS replica's prefix cache so a same-prefix
+        prompt never re-prefills here. Returns False when the source
+        should keep decoding it locally (no healthy decode replica, or
+        every one saturated): co-scheduled fallback, byte-for-byte the
+        role-less behavior.
+
+        Exactly-once: the sequence is DETACHED under the failover lock
+        BEFORE the hook can place a replay anywhere — once a resume
+        exists, this loop can only ever release, never finish. A failed
+        placement re-attaches; the sequence was in neither scheduler
+        list during the window (the caller popped it from `prefilling`
+        and hasn't appended to `running`), so no rescue sweep can have
+        captured it meanwhile."""
+        hook = self.on_prefill_done
+        with self._failover_lock:
+            if seq.request is None or req._event.is_set():
+                return False
+            seq.request = None
+            seq.done = True
+        tokens = list(seq.tokens)
+        try:
+            placed = bool(hook(self, req, tokens))
+        except Exception:
+            placed = False
+        if not placed:
+            with self._failover_lock:
+                seq.request = req
+                seq.done = False
+            return False
+        # the prompt is fully prefilled and its first token appended:
+        # the KV is certified, so reusable=True keeps the prompt
+        # resident in the source's prefix cache for the next same-prefix
+        # arrival while the blocks go back to the pool
+        try:
+            self.engine.release(seq, reusable=True)
+        except Exception:
+            pass
+        return True
 
     # -- failover ------------------------------------------------------------
 
@@ -963,7 +1055,47 @@ def spawn_resume(orig, tokens, target):
     return resume, carried
 
 
-def serve(model, replicas=None, autoscale=None, **kwargs):
+def spawn_migrate(orig, tokens, target):
+    """Place one PLANNED prefill->decode migration hop for `orig` onto
+    `target` (a decode-role LMServer): same replay transport as
+    `spawn_resume` — the target re-prefills prompt + generated-so-far
+    (skipping every KV block its prefix cache already holds) and decode
+    continues greedy-token-identically — but the hop is disaggregated
+    serving's steady-state move, not a fault: the resume spends no
+    failover budget and admission treats it as already-admitted work
+    (never brownout-shed or clamped). Deadline, tenant, priority, the
+    client's latency anchors, and the W3C trace all ride along, so the
+    request stays ONE connected trace row and is SLO-classified exactly
+    once, by client truth, at its terminal state on the target.
+
+    Returns `(resume, carried)`; `resume` is None when the generation
+    was already complete (orig finished directly, nothing placed).
+    Raises QueueFull when the target can't absorb it. Ledger/metric
+    accounting stays with the caller."""
+    resume, carried = make_resume(orig, tokens, target.engine.max_len,
+                                  migrate=True)
+    if resume is None:
+        orig._finish(tokens=list(tokens))
+        return None, carried
+
+    def stitch(r):
+        if r.error is None:
+            orig._finish(tokens=list(r.tokens))
+        else:
+            orig._finish(error=r.error)
+
+    resume._on_finish = stitch
+    target.adopt(resume)
+    now_us = time.perf_counter_ns() // 1000
+    telemetry.record_span("serving.migration_hop", now_us, 0,
+                          trace=orig.trace, category="serving",
+                          to_profiler=False, request=orig.id,
+                          resume=resume.id, carried_tokens=carried,
+                          target=target.replica_id)
+    return resume, carried
+
+
+def serve(model, replicas=None, autoscale=None, roles=None, **kwargs):
     """Build and start a serving front door over `model` (see module
     docstring for accepted forms). With `replicas=N > 1` (or
     `MXNET_SERVING_REPLICAS=N`) this is a `ReplicatedLMServer`: N engine
@@ -972,12 +1104,22 @@ def serve(model, replicas=None, autoscale=None, **kwargs):
     least-loaded routing (router.py). Otherwise a single `LMServer`.
     `autoscale=True` (or MXNET_SERVING_AUTOSCALE=1) arms SLO-driven
     elastic scaling (serving/autoscale.py) — that always builds the
-    replicated door, even at replicas=1, so the fleet can grow. Keyword
-    args pass through to each LMServer."""
+    replicated door, even at replicas=1, so the fleet can grow.
+    `roles="prefill:N,decode:M"` (or MXNET_SERVING_ROLES) builds a
+    disaggregated fleet: prefill replicas absorb prompt processing and
+    migrate finished prompts to decode replicas over the replay
+    transport; replica count is the sum of the role counts (the
+    `replicas` arg is ignored when roles are set). Keyword args pass
+    through to each LMServer."""
     from .autoscale import autoscale_enabled
-    from .router import ReplicatedLMServer, serving_replicas
-    n = serving_replicas() if replicas is None else int(replicas)
+    from .router import (ReplicatedLMServer, serving_replicas,
+                         serving_roles)
+    role_map = serving_roles(roles)
     scale = autoscale_enabled() if autoscale is None else autoscale
+    if role_map:
+        return ReplicatedLMServer(model, roles=role_map,
+                                  autoscale=scale, **kwargs)
+    n = serving_replicas() if replicas is None else int(replicas)
     if n > 1 or scale:
         return ReplicatedLMServer(model, replicas=n, autoscale=scale,
                                   **kwargs)

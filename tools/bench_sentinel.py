@@ -71,8 +71,13 @@ _SMALLER_IS_BETTER = ("ms", "s", "us", "seconds")
 #: numbers (availability %, failover added latency, respawn-to-first-
 #: token) are resilience health signals riding a fault-injection
 #: harness — their run-to-run wobble must be reported, but only real
-#: performance measurements decide the exit code
-_WARN_ONLY_PREFIXES = ("serving_chaos_", "smoke_serving_chaos_")
+#: performance measurements decide the exit code. The disaggregation
+#: A/B (ISSUE 17) rides the same carve-out: its decode-ITL-under-storm
+#: legs are a thread-scheduler-sensitive contention drill, and the
+#: committed verdict is the in-leg baseline-vs-roles delta, not the
+#: absolute numbers
+_WARN_ONLY_PREFIXES = ("serving_chaos_", "smoke_serving_chaos_",
+                       "serving_disagg_", "smoke_serving_disagg_")
 
 
 def _device_class(line):

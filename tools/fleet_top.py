@@ -97,6 +97,7 @@ def _replica_rows(health, statusz, snap):
         rows.append({
             "replica": rid if rid is not None else i,
             "state": state,
+            "role": h.get("role") or z.get("role"),
             "queued": sched.get("queued"),
             "prefilling": sched.get("prefilling"),
             "tok_s": thru.get("tokens_per_sec"),
@@ -135,16 +136,16 @@ def render(health, statusz, snap, url="", now=None):
     rows = _replica_rows(health, statusz, snap)
     if rows:
         lines.append(
-            "  %-7s %-8s %6s %8s %10s %10s %9s %9s %8s"
-            % ("replica", "state", "queue", "prefill", "tok/s",
+            "  %-7s %-8s %-8s %6s %8s %10s %10s %9s %9s %8s"
+            % ("replica", "state", "role", "queue", "prefill", "tok/s",
                "goodput/s", "blocks", "failovers", "respawns"))
         for r in rows:
             used, total = r["blocks"]
             blocks = ("%s/%s" % (used, total)
                       if used is not None and total is not None else "-")
             lines.append(
-                "  %-7s %-8s %6s %8s %10s %10s %9s %9s %8s"
-                % (r["replica"], r["state"],
+                "  %-7s %-8s %-8s %6s %8s %10s %10s %9s %9s %8s"
+                % (r["replica"], r["state"], r.get("role") or "-",
                    _num(r["queued"], "%d"), _num(r["prefilling"], "%d"),
                    _num(r["tok_s"]), _num(r["goodput_s"]), blocks,
                    _num(r["failovers"], "%d"),
@@ -195,6 +196,18 @@ def render(health, statusz, snap, url="", now=None):
                tok.get("slow", 0), tok.get("shed", 0),
                tok.get("expired", 0), tok.get("failed", 0),
                tok.get("replayed", 0)))
+    roles = agg.get("roles") or {}
+    if roles:
+        layout = "  ".join(
+            "%s %s/%s" % (name, (roles[name] or {}).get("healthy", 0),
+                          (roles[name] or {}).get("replicas", 0))
+            for name in sorted(roles))
+        lines.append(
+            "roles: %s   migrations %s (carried %s tok, "
+            "KV bytes saved %s)"
+            % (layout, agg.get("migrations", 0),
+               agg.get("migration_tokens", 0),
+               agg.get("migration_bytes_saved", 0)))
     return "\n".join(lines)
 
 

@@ -117,6 +117,15 @@ def main():
     ap.add_argument("--max-replicas", type=int, default=None,
                     help="autoscale ceiling (default: "
                          "MXNET_SERVING_MAX_REPLICAS or 4)")
+    ap.add_argument("--roles", default=None, metavar="SPEC",
+                    help="disaggregated fleet layout 'prefill:N,"
+                         "decode:M': prefill replicas absorb prompt "
+                         "processing and migrate finished prompts to "
+                         "decode replicas over the replay transport "
+                         "(KV blocks the target already caches are "
+                         "skipped); replica count = N+M and --replicas "
+                         "is ignored (default: MXNET_SERVING_ROLES or "
+                         "off)")
     args = ap.parse_args()
     if args.min_replicas is not None:
         os.environ["MXNET_SERVING_MIN_REPLICAS"] = str(args.min_replicas)
@@ -159,7 +168,8 @@ def main():
                   default_deadline_ms=args.deadline_ms,
                   brownout=args.brownout,
                   aot_cache=args.aot_cache,
-                  autoscale=args.autoscale)
+                  autoscale=args.autoscale,
+                  roles=args.roles)
     if args.respawn_max is not None:
         n = (args.replicas if args.replicas is not None
              else serving.serving_replicas())
@@ -174,6 +184,13 @@ def main():
               % (len(srv.replicas), eng.tp,
                  " (tp fallback: %s)" % eng.tp_fallback
                  if eng.tp_fallback else ""))
+        if srv._roles is not None:
+            print("roles: %s — prompts prefill on the prefill "
+                  "replicas, then migrate to a decode replica "
+                  "(replay transport, prefix-cached KV blocks "
+                  "skipped; co-scheduled fallback on role loss)"
+                  % ", ".join("%s:%d" % (k, v)
+                              for k, v in srv._roles.items()))
         first = srv.replicas[0]
     else:
         first = srv
