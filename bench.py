@@ -356,6 +356,36 @@ def check_line(r):
                            is None):
         raise ValueError("itl_p95_flattening_x without the measured "
                          "p95 pair it is derived from: %r" % (r,))
+    # live-rollout fields (ISSUE 18): a rollout bench line is only a
+    # result if the shift lost NOTHING (a rollout that drops requests
+    # is an outage, not a measurement), the corruption-detection
+    # latency must ride an actually-recorded rejection, and the TTFT
+    # shift delta needs the measured p95 pair it is derived from.
+    lost = r.get("rollout_requests_lost")
+    if lost is not None:
+        if not isinstance(lost, int) or isinstance(lost, bool) \
+                or lost != 0:
+            raise ValueError("rollout_requests_lost must be exactly 0 "
+                             "— a rollout that loses requests is an "
+                             "outage, not a result: %r" % (r,))
+        if r.get("value") is None:
+            raise ValueError("rollout_requests_lost without a measured "
+                             "rollout duration: %r" % (r,))
+    dm = r.get("corrupt_detect_ms")
+    if dm is not None:
+        if not isinstance(dm, (int, float)) or isinstance(dm, bool) \
+                or dm < 0 or dm != dm or dm == float("inf"):
+            raise ValueError("corrupt_detect_ms must be a finite "
+                             "non-negative number of ms: %r" % (r,))
+        if not r.get("corrupt_steps_rejected"):
+            raise ValueError("corrupt_detect_ms without a recorded "
+                             "rejection — nothing was detected: %r"
+                             % (r,))
+    sd = r.get("ttft_p95_shift_delta_ms")
+    if sd is not None and (r.get("ttft_p95_shift_ms") is None
+                           or r.get("ttft_p95_steady_ms") is None):
+        raise ValueError("ttft_p95_shift_delta_ms without the measured "
+                         "p95 pair it is derived from: %r" % (r,))
     return r
 
 
@@ -2053,6 +2083,190 @@ def bench_serving_disagg(smoke, dtype, device_kind):
     return line
 
 
+def bench_serving_rollout(smoke, dtype, device_kind):
+    """Zero-downtime live weight rollout bench (ISSUE 18): one
+    2-replica fleet, three measured legs on a tiny transformer.
+    Leg 1 (detection): a freshly published candidate checkpoint is
+    bit-flipped after its manifest lands; the watcher must quarantine
+    it at the verification gate — the headline is publish→rejected
+    latency. Leg 2 (steady): a client wave with NO rollout in flight
+    pins the fleet's baseline TTFT p95. Leg 3 (shift): an identical
+    wave streams while a GOOD candidate canaries through the ladder
+    and promotes fleet-wide — measured: full rollout duration
+    (publish→promoted, the headline `value`), requests lost (MUST be
+    0 — check_line rejects the line otherwise), and the TTFT p95
+    delta vs the steady wave (the cost of shifting traffic through a
+    drain-to-completion promotion). Judged WARN-ONLY by the sentinel:
+    wall-clock under thread contention; the zero-loss gate is the
+    committed verdict."""
+    import tempfile as _tempfile
+    import threading as _threading
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import serving
+    from mxnet_tpu.telemetry import metrics as _tm
+    from mxnet_tpu.utils.recovery import CheckpointManager
+    from mxnet_tpu.models.transformer import (TransformerConfig,
+                                              init_transformer_params)
+
+    cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                            d_ff=64, max_len=64) if smoke else \
+        TransformerConfig(vocab=1024, d_model=128, n_heads=4, n_layers=2,
+                          d_ff=256, max_len=128)
+    clients = 4 if smoke else 8
+    per_client = 3 if smoke else 6
+    max_new = 8 if smoke else 16
+    window_s = 0.02 if smoke else 0.25
+    params = init_transformer_params(jax.random.PRNGKey(0), cfg)
+    if dtype == "bfloat16":
+        params = {k: v.astype(jnp.bfloat16) for k, v in params.items()}
+    rng = np.random.RandomState(23)
+    prompts = [list(rng.randint(1, cfg.vocab, 5 + i % 4))
+               for i in range(clients)]
+    new_params = {k: np.asarray(v) + np.float32(0.05)
+                  for k, v in params.items()}
+    ckpt_dir = _tempfile.mkdtemp(prefix="bench_rollout_")
+
+    # promotion REPLACES replica objects (drain-to-completion swap),
+    # so per-tenant histograms recorded on a retired incumbent vanish
+    # from `fleet.replicas` — accumulate every metrics object ever
+    # seen and merge over the full set
+    seen_metrics = []
+
+    def collect(fleet):
+        for rep in list(fleet.replicas):
+            m = getattr(rep, "metrics", None)
+            if m is not None \
+                    and not any(m is s for s in seen_metrics):
+                seen_metrics.append(m)
+
+    def merged_ttft(tenant):
+        reg = _tm.MetricsRegistry()
+        out = None
+        for m in seen_metrics:
+            h = (m._tenants_view().get(tenant) or {}).get("ttft")
+            if h is None:
+                continue
+            if out is None:
+                out = reg.histogram("bench_merge_ttft",
+                                    buckets=h.buckets)
+            for i, c in enumerate(h._counts):
+                out._counts[i] += c
+            out.sum += h.sum
+            out.count += h.count
+        if out is None or not out.count:
+            raise RuntimeError("no %r-tenant TTFT recorded" % tenant)
+        return out
+
+    srv = serving.serve((params, cfg), replicas=2,
+                        max_batch=clients + 2, block_size=8,
+                        max_queue=clients * per_client + 8)
+    try:
+        ro = srv.attach_rollout(ckpt_dir, stages=(0.25, 0.5),
+                                window_s=window_s)
+        # warm both replicas through the wave's shapes
+        for rep in srv.replicas:
+            rep.submit(list(prompts[0]),
+                       max_new_tokens=max_new).result(timeout=600)
+
+        # -- leg 1: corrupted candidate -> publish->rejected latency --
+        CheckpointManager(ckpt_dir, async_save=False).save(1, new_params)
+        path = os.path.join(ckpt_dir, "ckpt-1.npz")
+        with open(path, "r+b") as f:
+            f.seek(os.path.getsize(path) // 2)
+            b = f.read(1)
+            f.seek(os.path.getsize(path) // 2)
+            f.write(bytes([b[0] ^ 0xFF]))
+        t0 = time.perf_counter()
+        while ro.step() != "rejected":
+            if time.perf_counter() - t0 > 300:
+                raise RuntimeError("corrupt candidate never rejected")
+        detect_ms = 1e3 * (time.perf_counter() - t0)
+
+        def wave(tenant):
+            results = {}
+
+            def client(i):
+                for k in range(per_client):
+                    key = i * per_client + k
+                    try:
+                        results[key] = srv.submit(
+                            list(prompts[i]), max_new_tokens=max_new,
+                            tenant=tenant).result(timeout=600)
+                    except Exception as e:
+                        results[key] = e
+                    time.sleep(0.005)
+
+            threads = [_threading.Thread(target=client, args=(i,))
+                       for i in range(clients)]
+            for t in threads:
+                t.start()
+            return threads, results
+
+        # -- leg 2: steady wave, no rollout in flight -----------------
+        threads, steady = wave("steady")
+        for t in threads:
+            t.join(timeout=600)
+        collect(srv)
+        steady_p95 = 1e3 * merged_ttft("steady").quantile(0.95)
+
+        # -- leg 3: identical wave WHILE a good candidate promotes ----
+        threads, shift = wave("shift")
+        CheckpointManager(ckpt_dir, async_save=False).save(2, new_params)
+        t0 = time.perf_counter()
+        transitions = []
+        while time.perf_counter() - t0 < 600:
+            collect(srv)            # snapshot before a swap retires one
+            v = ro.step()
+            if v:
+                transitions.append(v)
+            if v == "promoted":
+                break
+            time.sleep(0.002)
+        duration_s = time.perf_counter() - t0
+        for t in threads:
+            t.join(timeout=600)
+        if transitions[-1:] != ["promoted"]:
+            raise RuntimeError("rollout never promoted: %r"
+                               % transitions)
+        collect(srv)
+        shift_p95 = 1e3 * merged_ttft("shift").quantile(0.95)
+        lost = sum(1 for r in list(steady.values()) + list(shift.values())
+                   if not isinstance(r, list))
+        line = {
+            "metric": ("smoke_serving_rollout_duration_s" if smoke
+                       else "serving_rollout_duration_s"),
+            "value": round(duration_s, 3), "unit": "s",
+            "rollout_requests_lost": lost,
+            "corrupt_detect_ms": round(detect_ms, 1),
+            "corrupt_steps_rejected": 1,
+            "ttft_p95_steady_ms": round(steady_p95, 3),
+            "ttft_p95_shift_ms": round(shift_p95, 3),
+            "ttft_p95_shift_delta_ms": round(shift_p95 - steady_p95, 3),
+            "promoted_version": srv.weights_version,
+            "stages": "1/4,1/2", "window_s": window_s,
+            "replicas": 2,
+            "requests": len(steady) + len(shift),
+            "transitions": ",".join(transitions),
+            "vs_baseline": None,
+            "baseline_note": "ISSUE 18: no live-rollout path exists in "
+                             "the reference tree; the in-run steady "
+                             "wave IS the TTFT baseline and the "
+                             "committed verdict is zero requests lost "
+                             "— sentinel judges serving_rollout_* "
+                             "warn-only",
+        }
+        if "cpu" in str(device_kind).lower():
+            line["interpreter_note"] = (
+                "CPU leg: engine rebuilds pay interpreted compiles and "
+                "thread contention inflates the shift delta — judge "
+                "the zero-loss gate and detection ORDERING, not the "
+                "magnitudes")
+        return line
+    finally:
+        srv.close()
+
+
 _CONFIGS = [
     ("resnet50_infer", bench_resnet50_infer),
     ("resnet50_int8_infer", bench_resnet50_int8_infer),
@@ -2065,6 +2279,7 @@ _CONFIGS = [
     ("serving_prefix", bench_serving_prefix),
     ("serving_chaos", bench_serving_chaos),
     ("serving_disagg", bench_serving_disagg),
+    ("serving_rollout", bench_serving_rollout),
     ("resilience", bench_resilience),
     ("io_pipeline", bench_io_pipeline),
     ("e2e_train_io", bench_e2e_train_io),

@@ -280,6 +280,51 @@ print("2m OK:", {f: line[f] for f in (
     "migrations", "migration_kv_bytes_saved")})
 PYEOF
 
+echo "=== 2n. zero-downtime live weight rollout (ISSUE 18) ==="
+# One 2-replica fleet, three legs: a bit-flipped candidate must be
+# quarantined at the parity gate (publish->rejected latency), a
+# steady client wave pins baseline TTFT p95, then an identical wave
+# streams WHILE a good candidate canaries through 1/4 -> 1/2 and
+# promotes fleet-wide via drain-to-completion replace. The committed
+# verdict is zero requests lost (check_line refuses the emitted line
+# otherwise); detection must be sub-second; the ladder must end
+# promoted with the candidate's version. Predictions registered in
+# BENCH_NOTES.md round 18 BEFORE this runs; sentinel judges
+# serving_rollout_* warn-only. timeout-bounded: a wedged promotion
+# must not stall the session.
+timeout -k 30 1800 env BENCH_CONFIGS=serving_rollout python bench.py \
+  | tee BENCH_SERVING_ROLLOUT.jsonl
+python - <<'PYEOF'
+import json
+line = None
+for l in open("BENCH_SERVING_ROLLOUT.jsonl"):
+    try:
+        r = json.loads(l)
+    except ValueError:
+        continue
+    if str(r.get("metric", "")).endswith("serving_rollout_duration_s"):
+        line = r
+assert line is not None, "serving_rollout emitted no result line"
+assert line.get("rollout_requests_lost") == 0, (
+    "requests lost during live rollout: %r"
+    % line.get("rollout_requests_lost"))
+dm = line.get("corrupt_detect_ms")
+assert dm is not None and 0 <= dm < 1000, (
+    "corrupt candidate not detected sub-second: %r" % dm)
+assert line.get("corrupt_steps_rejected") == 1, (
+    "corrupt candidate not quarantined: %r"
+    % line.get("corrupt_steps_rejected"))
+ts = str(line.get("transitions", ""))
+assert ts.endswith("promoted") and "canary" in ts, (
+    "rollout did not run canary->promoted: %r" % ts)
+assert line.get("promoted_version") == 2, (
+    "fleet not on the candidate version: %r"
+    % line.get("promoted_version"))
+print("2n OK:", {f: line[f] for f in (
+    "value", "rollout_requests_lost", "corrupt_detect_ms",
+    "ttft_p95_shift_delta_ms", "transitions")})
+PYEOF
+
 echo "=== 3. flash attention seq sweep (1024/2048/4096) ==="
 BENCH_CONFIGS=transformer_flash BENCH_FLASH_SEQ=1024,2048,4096,8192 \
   python bench.py | tee BENCH_FLASH_SWEEP.jsonl

@@ -35,6 +35,9 @@ from .router import (ReplicatedLMServer, serving_replicas,
                      serving_respawn_max, serving_roles,
                      NoHealthyReplicas)
 from .autoscale import Autoscaler, AutoscaleConfig, autoscale_enabled
+from .rollout import (RolloutController, RejectionRoster, rollout_dir,
+                      rollout_stages, rollout_window_s,
+                      rollout_parity_prompts)
 from .tp import serving_tp, tp_cache_variant
 
 __all__ = [
@@ -50,4 +53,6 @@ __all__ = [
     "serving_roles",
     "serving_tp", "tp_cache_variant", "NoHealthyReplicas",
     "Autoscaler", "AutoscaleConfig", "autoscale_enabled",
+    "RolloutController", "RejectionRoster", "rollout_dir",
+    "rollout_stages", "rollout_window_s", "rollout_parity_prompts",
 ]

@@ -14,9 +14,16 @@ TTFT burn and
   short window proves it's happening now, the longer one proves it's
   not a blip), bounded by `MXNET_SERVING_MAX_REPLICAS`;
 * **scales down** — drain + re-home via the PR 11 machinery, then
-  retire the tail replica — only after the fleet has been idle
+  retire one replica — only after the fleet has been idle
   (zero committed tokens) for `idle_retire_s` AND every burn window has
-  cooled below `down_burn`, bounded by `MXNET_SERVING_MIN_REPLICAS`;
+  cooled below `down_burn`, bounded by `MXNET_SERVING_MIN_REPLICAS`.
+  The victim pick is the router's, and it is VERSION-AWARE during a
+  live rollout (ISSUE 18): `ReplicatedLMServer.scale_down()` prefers
+  retiring a rollback-pending canary over a healthy incumbent and
+  refuses to drop the fleet below one replica per active weight
+  version; symmetrically, `scale_up()` spawns on the fleet's serving
+  version, so an autoscale grow mid-rollout adds an incumbent, never
+  an accidental second canary;
 * **never flaps**: `down_burn` sits well under `up_burn` (hysteresis —
   a fleet hovering between the thresholds holds its size), and any two
   scale actions are separated by `cooldown_s` regardless of direction.

@@ -55,6 +55,17 @@ submit/HTTP front:
   registries into one Prometheus exposition distinguished by the
   `replica` label (telemetry.merged_prometheus_text); the JSON snapshot
   carries per-replica snapshots plus summed aggregates.
+* **Live weight rollout (ISSUE 18)**: with a `RolloutController`
+  attached (`serve(rollout=<ckpt dir>)` / MXNET_SERVING_ROLLOUT_DIR,
+  serving/rollout.py) the router tracks a weight VERSION per replica
+  (the checkpoint step its engine was built from), routes a stage
+  fraction of placements to the canary version mid-rollout, rebuilds
+  replicas on a new version one at a time via the drain-to-completion
+  `rollout_replace` seam (zero requests lost, every request finishing
+  on the weights it started on), and retires rollback-pending canaries
+  preferentially on scale-down — never dropping below one replica per
+  active weight version while a rollout is in flight. A rollout-less
+  fleet behaves byte-for-byte as before.
 * **Disaggregated prefill/decode roles (ISSUE 17)**: with
   `MXNET_SERVING_ROLES=prefill:N,decode:M` (or `serve(roles=)`) the
   fleet splits into specialists — admission prefers prefill replicas,
@@ -225,6 +236,17 @@ class ReplicatedLMServer(_HTTPFrontend):
         self._model = model
         self._kwargs = dict(kwargs)
         self._tp = tp_req
+        # live weight rollout (ISSUE 18): the fleet's serving
+        # checkpoint step (None = the boot weights), the version→model
+        # map replicas build from, and the in-flight canary's traffic
+        # share — all managed by the attached RolloutController
+        self._models = {}
+        self.weights_version = None
+        self.rollout = None
+        self._rollout_weight = None
+        self._rollout_version = None
+        self._rollout_retiring = set()
+        self._rollout_ticket = 0
         self._closed = False
         self._lock = threading.Lock()
         self._rr = 0                # round-robin tie-break cursor
@@ -296,6 +318,7 @@ class ReplicatedLMServer(_HTTPFrontend):
         self.replicas = []
         self._drained = []
         self._role = []     # per-replica role label, index-aligned
+        self._version = []  # per-replica weight version, index-aligned
         # per-replica supervision state, index-aligned with `replicas`
         self._respawn_attempts = [0] * replicas
         self._respawn_next = [0.0] * replicas
@@ -312,6 +335,7 @@ class ReplicatedLMServer(_HTTPFrontend):
                     self._build_replica(i, role_seq[i]))
                 self._drained.append(False)
                 self._role.append(role_seq[i])
+                self._version.append(None)
         except BaseException:
             for rep in self.replicas:
                 rep.close(drain=False, timeout=5.0)
@@ -328,21 +352,26 @@ class ReplicatedLMServer(_HTTPFrontend):
             self.autoscaler = Autoscaler(self, config=cfg)
             self.autoscaler.start()
 
-    def _build_replica(self, i, role=None):
+    def _build_replica(self, i, role=None, version=None):
         """One fresh replica on its device window — the constructor's
-        path, the respawn path, and elastic scale-up share it, so a
-        rebuilt replica is placed (and role'd) exactly like the
-        original. On disaggregated fleets, per-role kwargs overlay the
-        shared ones — a prefill replica may run a larger chunk size, a
-        decode replica a different tp — and a prefill replica gets the
-        router's migration hook installed."""
+        path, the respawn path, elastic scale-up, and the rollout
+        replace seam share it, so a rebuilt replica is placed (and
+        role'd) exactly like the original. `version` selects which
+        weight version the replica serves (the checkpoint-source seam,
+        ISSUE 18): a step registered in `self._models` by the rollout
+        controller, or None for the fleet's current model. On
+        disaggregated fleets, per-role kwargs overlay the shared ones —
+        a prefill replica may run a larger chunk size, a decode replica
+        a different tp — and a prefill replica gets the router's
+        migration hook installed."""
         from ..parallel.mesh import replica_devices
         kw = dict(self._kwargs)
         if role is not None:
             kw.update(self._role_kwargs.get(role, {}))
         tp = int(kw.pop("tp", self._tp))
         devs = replica_devices(i, tp) if tp > 1 else None
-        rep = LMServer(self._model, tp=tp, devices=devs,
+        model = self._models.get(version, self._model)
+        rep = LMServer(model, tp=tp, devices=devs,
                        replica_id=i, role=role, **kw)
         # the death hook runs ON the dying serving thread: queued and
         # in-flight work is re-homed immediately, not at the next sweep
@@ -478,10 +507,13 @@ class ReplicatedLMServer(_HTTPFrontend):
         paths, swap atomically, retire the corpse (its engine is kept
         for the leak audit)."""
         try:
-            # a respawned replica keeps its slot's role: a dead prefill
-            # specialist comes back a prefill specialist, hook and all
+            # a respawned replica keeps its slot's role AND its weight
+            # version: a dead prefill specialist comes back a prefill
+            # specialist, and a dead canary comes back on the candidate
+            # weights, not the incumbent's
             role = self._role[i] if i < len(self._role) else None
-            rep = self._build_replica(i, role)
+            ver = self._version[i] if i < len(self._version) else None
+            rep = self._build_replica(i, role, version=ver)
         except Exception as e:
             with self._lock:
                 self._respawning[i] = False
@@ -506,30 +538,11 @@ class ReplicatedLMServer(_HTTPFrontend):
             rep.close(drain=False, timeout=5.0)
             return
         self._ok_since[i] = None
-        # fold the corpse's request ledger into the router's retired
-        # totals BEFORE discarding its registry: rescued requests'
-        # `submitted` counts live only there, and the aggregate
-        # submitted == completed + failed balance must survive the swap
-        try:
-            for k, v in old.snapshot()["requests"].items():
-                self._retired_requests[k] = \
-                    self._retired_requests.get(k, 0) + v
-        except Exception:
-            pass
-        # same for the goodput token ledger (ISSUE 13): tokens the
-        # corpse classified must keep counting toward the fleet
-        # identity after its registry is discarded
-        try:
-            stz = old.metrics.statusz()
-            for k, v in stz["tokens"].items():
-                self._retired_tokens[k] = \
-                    self._retired_tokens.get(k, 0) + v
-            for name, t in stz["tenants"].items():
-                acc = self._retired_tenants.setdefault(name, {})
-                for k, v in t["tokens"].items():
-                    acc[k] = acc.get(k, 0) + v
-        except Exception:
-            pass
+        # fold the corpse's ledgers BEFORE discarding its registry:
+        # rescued requests' `submitted` counts live only there, and the
+        # aggregate submitted == completed + failed balance must
+        # survive the swap
+        self._fold_retired(old)
         # keep only a few corpses for post-hoc leak audits (the chaos
         # drill reads them): an intermittently-crashing replica whose
         # probation keeps forgiving its counter would otherwise pin
@@ -552,6 +565,31 @@ class ReplicatedLMServer(_HTTPFrontend):
             category="serving", to_profiler=False, replica=i,
             attempt=self._respawn_attempts[i])
         self._g_healthy.set(len(self.replicas) - sum(self._drained))
+
+    def _fold_retired(self, rep):
+        """Fold a retiring replica's request ledger and goodput token
+        ledger (ISSUE 13) into the router's retired accumulators before
+        its registry is discarded — the respawn swap, elastic
+        scale-down, and the rollout replace seam all share this move so
+        the fleet-wide submitted == goodput + slow + shed + expired +
+        failed identity survives every retirement."""
+        try:
+            for k, v in rep.snapshot()["requests"].items():
+                self._retired_requests[k] = \
+                    self._retired_requests.get(k, 0) + v
+        except Exception:
+            pass
+        try:
+            stz = rep.metrics.statusz()
+            for k, v in stz["tokens"].items():
+                self._retired_tokens[k] = \
+                    self._retired_tokens.get(k, 0) + v
+            for name, t in stz["tenants"].items():
+                acc = self._retired_tenants.setdefault(name, {})
+                for k, v in t["tokens"].items():
+                    acc[k] = acc.get(k, 0) + v
+        except Exception:
+            pass
 
     def _routable(self, max_beat_age=None):
         """Indices of replicas traffic may go to, after a wedge/restore
@@ -736,6 +774,31 @@ class ReplicatedLMServer(_HTTPFrontend):
         if role is not None and self._roles is not None:
             order.sort(key=lambda i: 0 if (
                 i < len(self._role) and self._role[i] == role) else 1)
+        # live-rollout traffic shaping (ISSUE 18): at stage weight f,
+        # ~f of placements put the canary version FIRST (a period-1/f
+        # ticket counter, deterministic, no RNG); the rest keep it LAST
+        # — still reachable when every incumbent is saturated, so the
+        # shift never turns capacity away. f<=0 (rollback drain)
+        # excludes the canary outright; f>=1 (promote) prefers it
+        # everywhere.
+        w = self._rollout_weight
+        ver = self._rollout_version
+        if w is not None and ver is not None:
+            canary = [i for i in order if i < len(self._version)
+                      and self._version[i] == ver]
+            if canary:
+                rest = [i for i in order if i not in canary]
+                if w <= 0.0:
+                    order = rest
+                elif w >= 1.0:
+                    order = canary + rest
+                else:
+                    with self._lock:
+                        t = self._rollout_ticket
+                        self._rollout_ticket += 1
+                    period = max(1, int(round(1.0 / w)))
+                    order = (canary + rest) if t % period == 0 \
+                        else (rest + canary)
         self._h_pick.observe(time.perf_counter() - t0)
         return order
 
@@ -744,7 +807,7 @@ class ReplicatedLMServer(_HTTPFrontend):
     def replica_count(self):
         return len(self.replicas)
 
-    def scale_up(self, role=None):
+    def scale_up(self, role=None, version=None):
         """Add one replica at the tail of the fleet. The build runs
         OFF-lock (engine construction takes real time; with an AOT
         cache configured it warm-loads its executables instead of
@@ -752,18 +815,24 @@ class ReplicatedLMServer(_HTTPFrontend):
         index-aligned supervision state happens atomically. On
         disaggregated fleets `role` says WHICH specialist to add (the
         per-role autoscaler maps TTFT burn to prefill, ITL burn to
-        decode); role-less fleets ignore it. Returns the new LMServer,
+        decode); role-less fleets ignore it. `version` pins the new
+        replica's weight version — the rollout controller spawns its
+        canary this way; when omitted the replica inherits the fleet's
+        serving version, so an autoscale spawn DURING a rollout builds
+        an incumbent, never a second canary. Returns the new LMServer,
         or None when closed/raced/build-failed — callers (the
         Autoscaler) treat None as \"no action taken\"."""
         if self._roles is None:
             role = None
+        if version is None:
+            version = self.weights_version
         with self._lock:
             if self._closed:
                 return None
             i = len(self.replicas)
         t0 = time.perf_counter_ns() // 1000
         try:
-            rep = self._build_replica(i, role)
+            rep = self._build_replica(i, role, version=version)
         except Exception as e:
             telemetry.flight().record(
                 "fault", "serving.scale_up_failed", replica=i,
@@ -776,6 +845,7 @@ class ReplicatedLMServer(_HTTPFrontend):
                 self.replicas.append(rep)
                 self._drained.append(False)
                 self._role.append(role)
+                self._version.append(version)
                 self._respawn_attempts.append(0)
                 self._respawn_next.append(0.0)
                 self._respawning.append(False)
@@ -796,20 +866,45 @@ class ReplicatedLMServer(_HTTPFrontend):
         return rep
 
     def scale_down(self):
-        """Retire the TAIL replica (only the tail — interior removal
-        would shift every index-aligned supervision list under the
-        sweep). Drain-first: the replica is marked drained so new
-        traffic routes around it, its queued and in-flight work is
-        re-homed onto the survivors (the same failover machinery a
-        wedge uses — zero lost requests), and only then is it popped
-        and closed. Refuses (returns None) at fleet size 1, while a
-        respawn owns the slot, or when closed."""
+        """Retire one replica. The victim is VERSION-AWARE (ISSUE 18):
+        a rollback-pending canary is always retired before a healthy
+        incumbent, and while a rollout is in flight the fleet never
+        drops below one replica per active weight version — an idle-
+        triggered autoscale retire must not kill the canary mid-judge
+        or the last incumbent mid-promote. The pop itself stays a TAIL
+        pop (interior removal would shift every index-aligned
+        supervision list under the sweep); a non-tail victim is first
+        SWAPPED to the tail with all its aligned state, atomically
+        under the lock. Drain-first as before: marked drained, queued
+        and in-flight work re-homed onto the survivors, then popped and
+        closed — zero lost requests. Refuses (returns None) at fleet
+        size 1, while a respawn owns the slot, or when closed."""
         with self._lock:
             if self._closed or len(self.replicas) <= 1:
                 return None
-            i = len(self.replicas) - 1
+            tail = len(self.replicas) - 1
+            i = tail
+            if self._rollout_retiring:
+                for j in range(tail, -1, -1):
+                    if self._version[j] in self._rollout_retiring:
+                        i = j
+                        break
+            if self._rollout_version is not None:
+                v = self._version[i]
+                if v not in self._rollout_retiring and \
+                        sum(1 for x in self._version if x == v) <= 1:
+                    return None     # last replica of an active version
             if self._respawning[i]:
                 return None          # a rebuild owns the slot
+            if i != tail:
+                if self._respawning[tail]:
+                    return None      # can't swap under a rebuild either
+                for lst in (self.replicas, self._drained, self._role,
+                            self._version, self._respawn_attempts,
+                            self._respawn_next, self._respawning,
+                            self._circuit_open, self._ok_since):
+                    lst[i], lst[tail] = lst[tail], lst[i]
+                i = tail
             rep = self.replicas[i]
             self._drained[i] = True  # route new traffic around it now
         t0 = time.perf_counter_ns() // 1000
@@ -824,6 +919,7 @@ class ReplicatedLMServer(_HTTPFrontend):
             self.replicas.pop()
             self._drained.pop()
             self._role.pop()
+            self._version.pop()
             self._respawn_attempts.pop()
             self._respawn_next.pop()
             self._respawning.pop()
@@ -842,23 +938,7 @@ class ReplicatedLMServer(_HTTPFrontend):
         # there, and the aggregate submitted == completed + failed
         # balance must survive the retirement (a re-homed request
         # completes on a survivor; its submit stays on the corpse)
-        try:
-            for k, v in rep.snapshot()["requests"].items():
-                self._retired_requests[k] = \
-                    self._retired_requests.get(k, 0) + v
-        except Exception:
-            pass
-        try:
-            stz = rep.metrics.statusz()
-            for k, v in stz["tokens"].items():
-                self._retired_tokens[k] = \
-                    self._retired_tokens.get(k, 0) + v
-            for name, t in stz["tenants"].items():
-                acc = self._retired_tenants.setdefault(name, {})
-                for k, v in t["tokens"].items():
-                    acc[k] = acc.get(k, 0) + v
-        except Exception:
-            pass
+        self._fold_retired(rep)
         self._c_scale_down.inc(replica=i)
         telemetry.record_span(
             "serving.scale_down", t0,
@@ -867,6 +947,106 @@ class ReplicatedLMServer(_HTTPFrontend):
         self._g_healthy.set(len(self.replicas) - sum(self._drained))
         self._refresh_role_gauges()
         return rep
+
+    # -- live weight rollout (ISSUE 18) --------------------------------------
+
+    def rollout_replace(self, j, version):
+        """Rebuild replica j on weight `version` — the promote (and
+        rollback-revert) seam. A PLANNED replace, unlike a respawn: the
+        old replica is marked drained (new traffic routes around it)
+        and then closed with drain=True, so its queued and in-flight
+        requests COMPLETE on the weights they started on — zero lost
+        requests, every response token-identical to its own serving
+        version's oracle, no cross-version failover replay. Only then
+        is the slot rebuilt on `version` and swapped in. Returns True
+        on success (or when the slot already serves `version`), False
+        when raced by a shutdown/respawn or when the build failed (the
+        controller retries on its next pass — the drained closed slot
+        makes the retry idempotent)."""
+        with self._lock:
+            if self._closed or j >= len(self.replicas) \
+                    or self._respawning[j]:
+                return False
+            old = self.replicas[j]
+            if self._version[j] == version:
+                return True
+            self._drained[j] = True
+        t0 = time.perf_counter_ns() // 1000
+        try:
+            old.close(drain=True, timeout=30.0)
+        except Exception:
+            pass
+        self._fold_retired(old)
+        role = self._role[j] if j < len(self._role) else None
+        try:
+            rep = self._build_replica(j, role, version=version)
+        except Exception as e:
+            telemetry.flight().record(
+                "fault", "serving.rollout_replace_failed", replica=j,
+                version=version,
+                error="%s: %s" % (type(e).__name__, e))
+            return False
+        with self._lock:
+            if self._closed or j >= len(self.replicas) \
+                    or self.replicas[j] is not old:
+                raced = True
+            else:
+                self.replicas[j] = rep
+                self._drained[j] = False
+                self._version[j] = version
+                self._ok_since[j] = None
+                raced = False
+        if raced:
+            rep.close(drain=False, timeout=5.0)
+            return False
+        if old.engine.cache is not None:
+            # keep the corpse for the leak audit, drop its device K/V
+            old.engine.cache.k = old.engine.cache.v = None
+        self._retired_engines.append(old.engine)
+        del self._retired_engines[:-4]
+        telemetry.record_span(
+            "serving.rollout", t0,
+            time.perf_counter_ns() // 1000 - t0,
+            category="serving", to_profiler=False, phase="replace",
+            replica=j, version=version)
+        self._g_healthy.set(len(self.replicas) - sum(self._drained))
+        return True
+
+    def attach_rollout(self, directory, start=False, **cfg):
+        """Attach a RolloutController watching `directory` for newly
+        published checkpoint steps (serving/rollout.py). `serve()`
+        calls this with start=True (a daemon watcher thread); tests and
+        drills attach with start=False and drive `rollout.step()` by
+        hand. Stages/window/prompt-count kwargs pass through."""
+        from .rollout import RolloutController
+        if self.rollout is not None:
+            raise MXNetError("a rollout controller is already attached")
+        self.rollout = RolloutController(self, directory, **cfg)
+        if start:
+            self.rollout.start()
+        return self.rollout
+
+    def rollout_command(self, cmd, step=None, reason=None):
+        """Operator override dispatch (POST /v1/rollout, the
+        tools/rollout.py CLI): promote / rollback / reject / status."""
+        if self.rollout is None:
+            raise MXNetError(
+                "no rollout controller attached (serve with "
+                "rollout=<dir> or MXNET_SERVING_ROLLOUT_DIR)")
+        if cmd == "promote":
+            return self.rollout.promote()
+        if cmd == "rollback":
+            return self.rollout.rollback(reason or "operator override")
+        if cmd == "reject":
+            if step is None:
+                raise MXNetError("rollout reject needs a step")
+            return self.rollout.reject(
+                int(step), reason or "operator reject")
+        if cmd == "status":
+            return self.rollout.status()
+        raise MXNetError(
+            "unknown rollout command %r (know promote, rollback, "
+            "reject, status)" % (cmd,))
 
     # -- client API ----------------------------------------------------------
 
@@ -1050,6 +1230,11 @@ class ReplicatedLMServer(_HTTPFrontend):
             fleet["migration_bytes_saved"] = sum(
                 r.metrics.migration_bytes_saved
                 for r in self.replicas)
+        if self.rollout is not None:
+            # live-rollout block (ISSUE 18), present only when a
+            # controller is attached — a rollout-less /statusz body
+            # stays byte-for-byte unchanged
+            fleet["rollout"] = self.rollout.status()
         return {
             "replicas": bodies,
             "fleet": fleet,
@@ -1077,6 +1262,8 @@ class ReplicatedLMServer(_HTTPFrontend):
         self._closed = True
         if getattr(self, "autoscaler", None) is not None:
             self.autoscaler.stop()
+        if getattr(self, "rollout", None) is not None:
+            self.rollout.stop()
         first_err = None
         for rep in self.replicas:
             try:

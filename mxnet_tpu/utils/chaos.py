@@ -80,6 +80,25 @@ only run under load), then latches (serve_crash_loop excepted):
                                         iterations (default 20):
                                         transient exhaustion, requests
                                         queue instead of failing.
+  MXNET_CHAOS_SERVE_ROLLOUT_CORRUPT=<step>:<file_index>
+                                        bit-flip one byte mid-file in
+                                        live-rollout candidate <step>'s
+                                        payload file #<file_index>,
+                                        AFTER its manifest published —
+                                        bitrot landing between publish
+                                        and canary, which the rollout
+                                        verification / parity gate's
+                                        digest probe must quarantine
+                                        before any user traffic.
+  MXNET_CHAOS_SERVE_ROLLOUT_SLOW_CANARY=<r>:<i>[:<secs>]
+                                        sleep <secs> (default 0.05) on
+                                        replica r's EVERY loop iteration
+                                        >= i — a healthy-but-SLOW canary
+                                        the rollout judge must roll back
+                                        on per-replica SLO burn instead
+                                        of promoting. UNLATCHED like
+                                        slow_host; the first firing
+                                        records one flight event.
 
 Steps are 1-based and compare against the trainer's post-increment step
 counter (`TrainStep._t`), i.e. the value `ResilientLoop` reports. Each
@@ -115,7 +134,8 @@ SPIKE_POISON = 1.0e6
 #: serving faults: value is (replica, iteration[, extra]) — parsed from
 #: "r:i[:x]" env strings or passed as tuples to configure()
 _SERVE_FAULTS = ("serve_kill", "serve_crash_loop", "serve_wedge",
-                 "serve_poison", "serve_exhaust")
+                 "serve_poison", "serve_exhaust",
+                 "serve_rollout_corrupt", "serve_rollout_slow_canary")
 
 
 class ChaosReplicaKilled(RuntimeError):
@@ -432,6 +452,59 @@ def pool_exhaustion(replica, iteration):
     if cfg is None:
         return 0
     return int(cfg[2]) if len(cfg) > 2 else 20
+
+
+def maybe_rollout_corrupt(step, files):
+    """RolloutController's watcher calls this with each candidate
+    step's published payload files BEFORE verifying them: an armed
+    serve_rollout_corrupt=<step>:<file_index> bit-flips one byte in the
+    middle of files[file_index % len(files)] — bitrot landing AFTER the
+    manifest published, which the candidate verification (or the parity
+    gate's digest probe) must catch and quarantine before any user
+    request reaches the weights. Exact-step match, latched."""
+    _load_env()
+    cfg = _conf.get("serve_rollout_corrupt")
+    if cfg is None or "serve_rollout_corrupt" in _fired:
+        return False
+    if int(step) != cfg[0] or not files:
+        return False
+    _fired.add("serve_rollout_corrupt")
+    path = files[int(cfg[1]) % len(files)]
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([(b[0] ^ 0xFF) if b else 0xFF]))
+    from .. import telemetry
+    telemetry.flight().record("fault", "chaos.serve_rollout_corrupt",
+                              step=int(step),
+                              path=os.path.basename(path))
+    return True
+
+
+def rollout_slow_canary(replica, iteration):
+    """LMServer's loop calls this every iteration: an armed
+    serve_rollout_slow_canary=<r>:<i>[:<secs>] sleeps (default 0.05s)
+    on replica r at EVERY iteration >= i — a canary whose weights are
+    fine but whose latency is not, which the rollout judge must catch
+    through its per-replica SLO burn and roll back instead of
+    promoting. UNLATCHED like slow_host (slow is a standing condition);
+    the first firing records one flight event."""
+    _load_env()
+    cfg = _conf.get("serve_rollout_slow_canary")
+    if cfg is None:
+        return False
+    if int(replica) != cfg[0] or int(iteration) < cfg[1]:
+        return False
+    if "serve_rollout_slow_canary" not in _fired:
+        _fired.add("serve_rollout_slow_canary")
+        from .. import telemetry
+        telemetry.flight().record(
+            "fault", "chaos.serve_rollout_slow_canary",
+            replica=int(replica), step=int(iteration))
+    time.sleep(cfg[2] if len(cfg) > 2 else 0.05)
+    return True
 
 
 def maybe_sigkill(step):

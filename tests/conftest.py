@@ -70,6 +70,7 @@ _SLOW_TESTS = {
     "test_resnetish_dp_tp_matches_single_device",
     "test_custom_op_trains_inside_module",
     "test_model_zoo_get_model",
+    "test_live_rollout_end_to_end_zero_loss",
 }
 
 # fused-optimizer equality: sgd stays in the fast tier as the smoke for the

@@ -403,3 +403,38 @@ def test_train_metrics_host_env(monkeypatch, tmp_path):
                          metrics_port=0)
     assert loop.console_addr[0] == "0.0.0.0"
     loop.close_console()
+
+
+def test_serving_rollout_dir_env_attaches_controller(monkeypatch,
+                                                     tmp_path):
+    """MXNET_SERVING_ROLLOUT_DIR turns live rollouts on through
+    serve() — even a single-replica fleet becomes a routed fleet with
+    a watching controller — and the ladder/window/prompt knobs feed
+    its config. Malformed ladders fail loudly naming the knob."""
+    import jax
+    from mxnet_tpu import serving
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.models.transformer import (TransformerConfig,
+                                              init_transformer_params)
+    cfg = TransformerConfig(vocab=48, d_model=32, n_heads=4, n_layers=2,
+                            d_ff=64, max_len=64)
+    params = init_transformer_params(jax.random.PRNGKey(0), cfg)
+    monkeypatch.setenv("MXNET_SERVING_ROLLOUT_DIR", str(tmp_path))
+    monkeypatch.setenv("MXNET_ROLLOUT_STAGES", "1/8,1/2")
+    monkeypatch.setenv("MXNET_ROLLOUT_WINDOW_S", "0.5")
+    monkeypatch.setenv("MXNET_ROLLOUT_PARITY_PROMPTS", "2")
+    srv = serving.serve((params, cfg), max_batch=2, block_size=8)
+    try:
+        assert srv.rollout is not None
+        assert srv.rollout.directory == str(tmp_path)
+        assert srv.rollout.stages == (0.125, 0.5)
+        assert srv.rollout.window_s == 0.5
+        assert srv.rollout.parity_prompts == 2
+        assert srv.statusz()["fleet"]["rollout"]["state"] == "idle"
+    finally:
+        srv.close()
+    monkeypatch.setenv("MXNET_ROLLOUT_STAGES", "1/2,1/4")
+    with pytest.raises(MXNetError, match="MXNET_ROLLOUT_STAGES"):
+        serving.serve((params, cfg), max_batch=2, block_size=8)
+    monkeypatch.delenv("MXNET_ROLLOUT_STAGES")
+    monkeypatch.delenv("MXNET_SERVING_ROLLOUT_DIR")

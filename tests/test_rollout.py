@@ -1,0 +1,619 @@
+"""Zero-downtime live weight rollout tests (ISSUE 18): the checkpoint
+watcher, the parity gate, the canary traffic ladder, parity-judged
+promotion, and automatic rollback (serving/rollout.py + the router's
+version machinery).
+
+Load-bearing claims: (1) the watcher never judges an INCOMPLETE
+(mid-publish) step and never retries a rejected one; (2) a corrupted
+candidate is quarantined — demoted on disk, marked on the shared
+rejection roster, the failing probe NAMED — before it sees any user
+traffic; (3) the stage ladder advances only after each observation
+window and rolls back after `max_bad` consecutive bad windows
+(hysteresis: one bad window re-observes); (4) promotion rebuilds
+incumbents one at a time with zero requests lost, then returns the
+fleet to its pre-rollout size; (5) autoscaling during a rollout stays
+version-pinned; (6) two routers watching one directory agree on a
+rejection (first writer wins).
+"""
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import jax
+import numpy as np
+
+from mxnet_tpu import serving
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serving.rollout import (RejectionRoster, params_digest,
+                                       pinned_prompts, rollout_dir,
+                                       rollout_parity_prompts,
+                                       rollout_stages, rollout_window_s)
+from mxnet_tpu.utils import chaos
+from mxnet_tpu.utils.recovery import CheckpointManager
+from mxnet_tpu.models.transformer import (TransformerConfig,
+                                          init_transformer_params)
+
+
+def tiny_cfg(**kw):
+    base = dict(vocab=48, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                max_len=64)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    cfg = tiny_cfg()
+    params = init_transformer_params(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+@pytest.fixture(autouse=True)
+def _chaos_reset():
+    yield
+    chaos.reset()
+
+
+def arith_prompt(start, stride, n, vocab=48):
+    return [(start + stride * t) % vocab for t in range(n)]
+
+
+def publish(directory, step, params):
+    """One verified single-file checkpoint publish (manifest + npz)."""
+    CheckpointManager(str(directory), async_save=False).save(
+        step, {k: np.asarray(v) for k, v in params.items()})
+
+
+def perturbed(params, eps=0.05):
+    return {k: np.asarray(v) + eps for k, v in params.items()}
+
+
+def _serve(tiny_lm, replicas=2):
+    return serving.serve(tiny_lm, replicas=replicas, max_batch=2,
+                         block_size=8)
+
+
+def _attach(srv, directory, **kw):
+    kw.setdefault("stages", (0.5,))
+    kw.setdefault("window_s", 0.0)
+    return srv.attach_rollout(str(directory), **kw)
+
+
+# ---------------------------------------------------------------------------
+# pure pieces: pinned prompts, digests, knobs, roster
+# ---------------------------------------------------------------------------
+
+
+def test_pinned_prompts_pure_and_bounded():
+    a = pinned_prompts(48, 6, 64)
+    assert a == pinned_prompts(48, 6, 64)       # no RNG, no clock
+    assert len(a) == 6
+    for p in a:
+        assert 2 <= len(p) <= 64 - 8
+        assert all(1 <= t < 48 for t in p)
+    # a tiny max_len still yields legal prompts
+    for p in pinned_prompts(8, 4, 10):
+        assert len(p) == 2 and all(1 <= t < 8 for t in p)
+
+
+def test_params_digest_order_independent_and_sensitive(tiny_lm):
+    params, _ = tiny_lm
+    tree = {k: np.asarray(v) for k, v in params.items()}
+    names = sorted(tree)
+    shuffled = {k: tree[k] for k in reversed(names)}
+    assert params_digest(tree) == params_digest(shuffled)
+    bumped = dict(tree)
+    bumped[names[0]] = tree[names[0]] + 1e-3
+    assert params_digest(bumped) != params_digest(tree)
+
+
+def test_rollout_knob_parsing_and_validation(monkeypatch):
+    monkeypatch.delenv("MXNET_SERVING_ROLLOUT_DIR", raising=False)
+    assert rollout_dir() is None
+    monkeypatch.setenv("MXNET_SERVING_ROLLOUT_DIR", "/ckpts")
+    assert rollout_dir() == "/ckpts"
+
+    assert rollout_stages() == (1.0 / 16, 1.0 / 4, 1.0 / 2)
+    assert rollout_stages("1/8, 1/2, 1") == (0.125, 0.5, 1.0)
+    assert rollout_stages((0.25, 0.75)) == (0.25, 0.75)
+    monkeypatch.setenv("MXNET_ROLLOUT_STAGES", "1/16,1/4")
+    assert rollout_stages() == (1.0 / 16, 0.25)
+    for bad in ("banana", "0.5,0.25", "0", "2", "1/0"):
+        with pytest.raises(MXNetError, match="MXNET_ROLLOUT_STAGES"):
+            rollout_stages(bad)
+
+    monkeypatch.delenv("MXNET_ROLLOUT_WINDOW_S", raising=False)
+    assert rollout_window_s() == 5.0
+    assert rollout_window_s("2.5") == 2.5
+    assert rollout_window_s(0) == 0.0
+    with pytest.raises(MXNetError, match="MXNET_ROLLOUT_WINDOW_S"):
+        rollout_window_s("-1")
+    with pytest.raises(MXNetError, match="MXNET_ROLLOUT_WINDOW_S"):
+        rollout_window_s("soon")
+
+    assert rollout_parity_prompts("7") == 7
+    with pytest.raises(MXNetError,
+                       match="MXNET_ROLLOUT_PARITY_PROMPTS"):
+        rollout_parity_prompts("0")
+    with pytest.raises(MXNetError,
+                       match="MXNET_ROLLOUT_PARITY_PROMPTS"):
+        rollout_parity_prompts("many")
+
+
+def test_rejection_roster_first_writer_wins(tmp_path):
+    """Two routers watching one checkpoint directory must agree on a
+    rejection without a coordinator: per-step atomic JSON files, the
+    first writer's verdict sticks, torn entries are skipped."""
+    a = RejectionRoster(str(tmp_path / "rejected"))
+    b = RejectionRoster(str(tmp_path / "rejected"))
+    assert a.reject(5, "sha mismatch", by="router-a") is True
+    assert b.reject(5, "late verdict", by="router-b") is False
+    assert a.steps() == b.steps() == {5}
+    assert a.entry(5)["by"] == "router-a"
+    assert b.entry(5)["reason"] == "sha mismatch"
+    # a torn/garbage entry never poisons the set
+    (tmp_path / "rejected" / "step-9.json").write_text("{tor")
+    assert b.steps() == {5}
+    # concurrent first writes: exactly one winner
+    wins = []
+    def racer(r, tag):
+        wins.append((tag, r.reject(12, tag, by=tag)))
+    ts = [threading.Thread(target=racer, args=(r, t))
+          for r, t in ((a, "a"), (b, "b"))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert sum(1 for _, won in wins if won) == 1
+    assert a.entry(12)["by"] in ("a", "b")
+
+
+def test_rollout_requires_roleless_tuple_model():
+    from mxnet_tpu.serving.rollout import RolloutController
+
+    class Roled:
+        _roles = {"prefill": 1, "decode": 1}
+    with pytest.raises(MXNetError, match="role-less"):
+        RolloutController(Roled(), "/nowhere")
+
+    class Opaque:
+        _roles = None
+        _model = object()
+    with pytest.raises(MXNetError, match="params, cfg"):
+        RolloutController(Opaque(), "/nowhere")
+
+
+# ---------------------------------------------------------------------------
+# the watcher
+# ---------------------------------------------------------------------------
+
+
+def test_watcher_skips_incomplete_and_rejected(tiny_lm, tmp_path):
+    params, cfg = tiny_lm
+    srv = _serve(tiny_lm)
+    try:
+        ro = _attach(srv, tmp_path)
+        assert ro.step(now=0.0) is None          # empty directory
+        # a shard file with no global manifest is a writer mid-publish:
+        # skipped, never judged, never quarantined
+        (tmp_path / "ckpt-9.shard0of2.npz").write_bytes(b"partial")
+        assert ro.step(now=1.0) is None
+        assert ro.state == "idle" and ro.roster.steps() == set()
+        assert 9 in ro.mgr.all_steps()           # it IS visible...
+        # a pre-rejected step is never picked up, however new
+        publish(tmp_path, 12, perturbed(params))
+        ro.roster.reject(12, "operator fence", by="operator")
+        assert ro.step(now=2.0) is None
+        assert ro.state == "idle" and ro.candidate is None
+        assert all(v is None for v in srv._version)
+    finally:
+        srv.close()
+
+
+def test_corrupt_candidate_quarantined_before_traffic(tiny_lm,
+                                                      tmp_path):
+    """A bit-flip after publish fails the manifest re-verification: the
+    step is demoted on disk (.corrupt), rostered, the probe named —
+    and the fleet never builds an engine on it."""
+    params, cfg = tiny_lm
+    srv = _serve(tiny_lm)
+    try:
+        ro = _attach(srv, tmp_path)
+        publish(tmp_path, 3, perturbed(params))
+        path = tmp_path / "ckpt-3.npz"
+        blob = bytearray(path.read_bytes())
+        blob[len(blob) // 2] ^= 0xFF
+        path.write_bytes(bytes(blob))
+        assert ro.step(now=0.0) == "rejected"
+        assert ro.roster.steps() == {3}
+        assert ro.last_rejection["step"] == 3
+        assert ro.last_rejection["probe"] == "digest"
+        assert os.path.exists(str(path) + ".corrupt")
+        assert not path.exists()
+        assert len(srv.replicas) == 2
+        assert all(v is None for v in srv._version)
+        # demoted AND rostered: the next pass sees nothing at all
+        assert ro.step(now=1.0) is None
+        block = srv.statusz()["fleet"]["rollout"]
+        assert block["state"] == "idle"
+        assert block["rejected_steps"] == [3]
+        assert block["last_rejection"]["probe"] == "digest"
+    finally:
+        srv.close()
+
+
+def test_parity_gate_names_shape_and_divergence_probes(tiny_lm,
+                                                       tmp_path):
+    params, cfg = tiny_lm
+    srv = _serve(tiny_lm)
+    try:
+        ro = _attach(srv, tmp_path)
+        # a key-set mismatch (truncated tree) fails the shape probe
+        names = sorted(params)
+        short = {k: np.asarray(v) for k, v in params.items()
+                 if k != names[0]}
+        CheckpointManager(str(tmp_path), async_save=False).save(2, short)
+        assert ro.step(now=0.0) == "rejected"
+        assert ro.last_rejection["probe"] == "shape"
+        # digest changed but every probe output bit-identical means the
+        # weights never actually loaded: the divergence probe fires
+        publish(tmp_path, 4, perturbed(params))
+        fixed = [([1, 2, 3], np.zeros(cfg.vocab, np.float32))]
+        ro._probe_outputs = lambda p, c: list(fixed)
+        assert ro.step(now=1.0) == "rejected"
+        assert ro.last_rejection["probe"] == "divergence"
+        assert ro.roster.steps() == {2, 4}
+    finally:
+        srv.close()
+
+
+def test_chaos_rollout_corrupt_fault_is_caught(tiny_lm, tmp_path):
+    """The chaos seam (serve_rollout_corrupt) flips a byte in the
+    candidate's published npz between publish and scan — the watcher's
+    verification must catch exactly that."""
+    params, cfg = tiny_lm
+    srv = _serve(tiny_lm)
+    try:
+        ro = _attach(srv, tmp_path)
+        publish(tmp_path, 7, perturbed(params))
+        chaos.configure(serve_rollout_corrupt=(7, 0))
+        assert ro.step(now=0.0) == "rejected"
+        assert "serve_rollout_corrupt" in chaos.fired()
+        assert ro.last_rejection["probe"] == "digest"
+        assert ro.roster.steps() == {7}
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# the ladder: canary -> stages -> promote, judged rollback
+# ---------------------------------------------------------------------------
+
+
+def test_canary_spawns_extra_replica_and_ladder_respects_window(
+        tiny_lm, tmp_path):
+    params, cfg = tiny_lm
+    srv = _serve(tiny_lm)
+    try:
+        ro = _attach(srv, tmp_path, stages=(0.25, 0.5), window_s=10.0)
+        publish(tmp_path, 1, perturbed(params))
+        assert ro.step(now=0.0) == "canary"
+        # ONE extra replica, pinned to the candidate version; the
+        # incumbents keep serving the boot weights
+        assert len(srv.replicas) == 3
+        assert srv._version == [None, None, 1]
+        assert srv._rollout_weight == 0.25 and ro.stage == 0
+        # the observation window gates every advance
+        assert ro.step(now=3.0) is None
+        assert ro.stage == 0
+        assert ro.step(now=10.5) == "stage"
+        assert ro.stage == 1 and srv._rollout_weight == 0.5
+        assert ro.step(now=11.0) is None         # new window opened
+        assert ro.step(now=21.0) == "promoting"
+        assert srv._rollout_weight == 1.0
+        block = srv.statusz()["fleet"]["rollout"]
+        assert block["state"] == "promoting"
+        assert block["candidate"] == 1 and block["incumbent"] is None
+    finally:
+        srv.close()
+
+
+def test_weighted_pick_order_shifts_canary_share(tiny_lm, tmp_path):
+    """At stage weight f the canary heads ~f of placement orders and
+    absorbs overflow last otherwise; at weight 0 it is excluded."""
+    params, cfg = tiny_lm
+    srv = _serve(tiny_lm)
+    try:
+        ro = _attach(srv, tmp_path, stages=(0.25,), window_s=30.0)
+        publish(tmp_path, 1, perturbed(params))
+        assert ro.step(now=0.0) == "canary"
+        canary = srv._version.index(1)
+        heads = [srv._pick_order()[0] == canary for _ in range(16)]
+        assert sum(heads) == 4                   # 1/4 of placements
+        tails = [srv._pick_order()[-1] == canary for _ in range(16)]
+        assert sum(tails) == 12                  # last otherwise
+        srv._rollout_weight = 0.0                # rollback shuts traffic
+        assert all(canary not in srv._pick_order() for _ in range(8))
+    finally:
+        srv.close()
+
+
+def test_promotion_rebuilds_fleet_and_restores_size(tiny_lm, tmp_path):
+    params, cfg = tiny_lm
+    srv = _serve(tiny_lm)
+    try:
+        ro = _attach(srv, tmp_path)
+        publish(tmp_path, 1, perturbed(params))
+        assert ro.step(now=0.0) == "canary"
+        assert ro.step(now=1.0) == "promoting"
+        # incumbents rebuild ONE per pass (drain -> re-home -> swap)
+        assert ro.step(now=2.0) == "promote_one"
+        assert ro.step(now=3.0) == "promote_one"
+        assert ro.step(now=4.0) == "promoted"
+        assert srv.weights_version == 1
+        assert all(v == 1 for v in srv._version)
+        # the extra canary retired: pre-rollout size, not fleet growth
+        assert len(srv.replicas) == 2
+        assert ro.state == "idle" and ro.stage == -1
+        assert ro.last_promotion == {"step": 1}
+        # the promoted fleet really serves the NEW weights
+        prompt = arith_prompt(3, 5, 6)
+        got = srv.generate(list(prompt), max_new_tokens=4, timeout=300)
+        ref = serving.serve((perturbed(params), cfg), max_batch=2,
+                            block_size=8)
+        try:
+            assert got == ref.generate(list(prompt), max_new_tokens=4,
+                                       timeout=300)
+        finally:
+            ref.close()
+        # the watcher is idle again and re-scans find nothing newer
+        assert ro.step(now=5.0) is None
+    finally:
+        srv.close()
+
+
+def test_judged_breach_rolls_back_with_hysteresis(tiny_lm, tmp_path):
+    params, cfg = tiny_lm
+    srv = _serve(tiny_lm)
+    try:
+        ro = _attach(srv, tmp_path, stages=(0.25, 0.5), window_s=0.0)
+        publish(tmp_path, 5, perturbed(params))
+        assert ro.step(now=0.0) == "canary"
+        req = srv.submit(arith_prompt(2, 3, 5), max_new_tokens=4)
+        ro.judge = lambda: False                 # scripted breach
+        assert ro.step(now=1.0) is None          # bad window 1: observe
+        assert ro.state == "staging" and ro._bad == 1
+        assert ro.step(now=2.0) == "rollback"    # bad window 2: out
+        assert ro.state == "idle" and ro.candidate is None
+        assert len(srv.replicas) == 2
+        assert all(v is None for v in srv._version)
+        assert srv._rollout_weight is None
+        assert ro.roster.steps() == {5}
+        assert ro.last_rejection["probe"] == "judge"
+        # the in-flight request survived the rollback, and the ledger
+        # identity holds (nothing silently dropped)
+        assert len(req.result(timeout=300)) == 4
+        tok = srv.statusz()["fleet"]["tokens"]
+        assert tok["submitted"] == (tok["goodput"] + tok["slow"]
+                                    + tok["shed"] + tok["expired"]
+                                    + tok["failed"]), tok
+        # the rollback never poisons the watcher: a later good step
+        # still promotes
+        del ro.judge
+        publish(tmp_path, 6, perturbed(params, eps=0.07))
+        assert ro.step(now=3.0) == "canary"
+        assert ro.step(now=4.0) == "stage"       # default judge: healthy
+    finally:
+        srv.close()
+
+
+def test_operator_overrides_and_http_surface(tiny_lm, tmp_path):
+    params, cfg = tiny_lm
+    srv = _serve(tiny_lm)
+    try:
+        ro = _attach(srv, tmp_path, stages=(0.0625, 0.25, 0.5),
+                     window_s=60.0)
+        with pytest.raises(MXNetError):
+            ro.promote()                         # nothing in flight
+        with pytest.raises(MXNetError):
+            srv.rollout_command("sideways")
+        publish(tmp_path, 2, perturbed(params))
+        assert ro.step(now=0.0) == "canary"
+        # operator promote skips the remaining ladder
+        assert srv.rollout_command("promote")["ok"]
+        assert ro.step(now=0.1) == "promoting"
+        # operator rollback wins over promotion mid-flight
+        assert srv.rollout_command("rollback", reason="oncall said no")
+        assert ro.step(now=0.2) == "rollback"
+        assert ro.roster.entry(2)["reason"].startswith("oncall")
+        # the HTTP front door drives the same dispatch
+        host, port = srv.serve_http(port=0, block=False)
+        base = "http://%s:%d" % (host, port)
+        def post(body):
+            req = urllib.request.Request(
+                base + "/v1/rollout", data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return json.loads(r.read()), r.status
+        out, status = post({"cmd": "status"})
+        assert status == 200 and out["state"] == "idle"
+        out, status = post({"cmd": "reject", "step": 99,
+                            "reason": "known-bad eval"})
+        assert status == 200 and out["first_writer"]
+        assert 99 in ro.roster.steps()
+        with pytest.raises(urllib.error.HTTPError) as err:
+            post({"cmd": "sideways"})
+        assert err.value.code == 400
+    finally:
+        srv.close()
+
+
+def test_rollout_http_404_without_controller(tiny_lm):
+    """A plain single-server front door answers /v1/rollout with 404 —
+    not a crash, not a silent 200."""
+    srv = serving.serve(tiny_lm, max_batch=2, block_size=8)
+    try:
+        host, port = srv.serve_http(port=0, block=False)
+        req = urllib.request.Request(
+            "http://%s:%d/v1/rollout" % (host, port),
+            data=json.dumps({"cmd": "status"}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=10)
+        assert err.value.code == 404
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# autoscale + version pinning
+# ---------------------------------------------------------------------------
+
+
+def test_autoscale_during_rollout_stays_version_pinned(tiny_lm,
+                                                       tmp_path):
+    params, cfg = tiny_lm
+    srv = _serve(tiny_lm)
+    try:
+        ro = _attach(srv, tmp_path, stages=(0.25,), window_s=60.0)
+        publish(tmp_path, 1, perturbed(params))
+        assert ro.step(now=0.0) == "canary"
+        # a load-driven scale_up mid-rollout joins the INCUMBENT
+        # version, never the unproven candidate
+        rep = srv.scale_up()
+        assert rep is not None
+        assert srv._version == [None, None, 1, None]
+        # scale_down must not retire the only canary mid-rollout...
+        assert srv.scale_down() is not None      # tail incumbent goes
+        assert srv._version == [None, None, 1]
+        assert srv.scale_down() is None          # ...the canary is safe
+        assert srv._version == [None, None, 1]
+        # ...until the rollback marks its version retiring: then the
+        # version-aware pick retires it even though swap churn could
+        # have moved it off the tail
+        srv._rollout_retiring.add(1)
+        assert srv.scale_down() is not None
+        assert srv._version == [None, None]
+        srv._rollout_retiring.discard(1)
+    finally:
+        srv.close()
+
+
+def test_respawn_keeps_replica_version(tiny_lm, tmp_path):
+    """A respawned replica rebuilds on the version it was serving —
+    a crash during a rollout must not quietly change its weights."""
+    params, cfg = tiny_lm
+    srv = _serve(tiny_lm)
+    try:
+        ro = _attach(srv, tmp_path, stages=(0.25,), window_s=60.0)
+        publish(tmp_path, 1, perturbed(params))
+        assert ro.step(now=0.0) == "canary"
+        j = srv._version.index(1)
+        old = srv.replicas[j]
+        assert srv.rollout_replace(j, 1) is True     # same version: noop
+        assert srv.replicas[j] is old
+        # replace an incumbent onto the candidate and back: the slot
+        # swaps atomically and the version list tracks it
+        assert srv.rollout_replace(0, 1) is True
+        assert srv._version[0] == 1
+        assert srv.rollout_replace(0, None) is True
+        assert srv._version[0] is None
+    finally:
+        srv.close()
+
+
+def test_chaos_slow_canary_standing_fault(tiny_lm):
+    """serve_rollout_slow_canary drags one replica's serving loop — the
+    canary-judge drill's knob for making a canary breach its window."""
+    chaos.configure(serve_rollout_slow_canary=(0, 1, 0.01))
+    srv = serving.serve(tiny_lm, max_batch=2, block_size=8)
+    try:
+        got = srv.generate(arith_prompt(2, 3, 5), max_new_tokens=4,
+                           timeout=300)
+        assert len(got) == 4
+        assert "serve_rollout_slow_canary" in chaos.fired()
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# end to end: promotion under live traffic, zero loss (slow tier)
+# ---------------------------------------------------------------------------
+
+
+def test_live_rollout_end_to_end_zero_loss(tiny_lm, tmp_path):
+    """Clients stream through the door while a new version canaries,
+    stages, and promotes: zero requests lost, and every response is
+    greedy-token-identical to the oracle of WHICHEVER version served
+    it — a mid-rollout fleet serves two versions, but never a blend."""
+    params, cfg = tiny_lm
+    new_params = perturbed(params)
+    work = [(arith_prompt(2 + i, 3 + i % 4, 4 + i % 5), 3 + i % 3)
+            for i in range(24)]
+    oracles = []
+    for p in (params, new_params):
+        ref = serving.serve((p, cfg), max_batch=2, block_size=8)
+        try:
+            oracles.append([ref.generate(list(pr), max_new_tokens=m,
+                                         timeout=300)
+                            for pr, m in work])
+        finally:
+            ref.close()
+    srv = _serve(tiny_lm)
+    try:
+        ro = _attach(srv, tmp_path, stages=(0.25, 0.5), window_s=0.05)
+        results = {}
+
+        def client(cid, nclients=3):
+            for i in range(cid, len(work), nclients):
+                prompt, max_new = work[i]
+                try:
+                    results[i] = srv.generate(
+                        list(prompt), max_new_tokens=max_new,
+                        timeout=300)
+                except Exception as e:           # any loss fails below
+                    results[i] = e
+                time.sleep(0.01)
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(3)]
+        for t in threads:
+            t.start()
+        publish(tmp_path, 1, new_params)
+        transitions = []
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            v = ro.step()
+            if v:
+                transitions.append(v)
+            if v == "promoted":
+                break
+            time.sleep(0.02)
+        for t in threads:
+            t.join(timeout=300)
+        assert transitions[0] == "canary" and transitions[-1] == \
+            "promoted", transitions
+        assert "promoting" in transitions
+        assert srv.weights_version == 1
+        assert len(srv.replicas) == 2
+        assert all(v == 1 for v in srv._version)
+        lost = [i for i, r in results.items()
+                if not isinstance(r, list)]
+        assert not lost, [(i, results[i]) for i in lost]
+        assert len(results) == len(work)
+        blended = [i for i, r in results.items()
+                   if r != oracles[0][i] and r != oracles[1][i]]
+        assert not blended, (
+            "responses match NEITHER version's oracle: %r" % blended)
+        tok = srv.statusz()["fleet"]["tokens"]
+        assert tok["submitted"] == (tok["goodput"] + tok["slow"]
+                                    + tok["shed"] + tok["expired"]
+                                    + tok["failed"]), tok
+    finally:
+        srv.close()

@@ -75,9 +75,12 @@ _SMALLER_IS_BETTER = ("ms", "s", "us", "seconds")
 #: A/B (ISSUE 17) rides the same carve-out: its decode-ITL-under-storm
 #: legs are a thread-scheduler-sensitive contention drill, and the
 #: committed verdict is the in-leg baseline-vs-roles delta, not the
-#: absolute numbers
+#: absolute numbers. The live-rollout drill (ISSUE 18) likewise: its
+#: hard gate is zero requests lost (enforced by check_line, not the
+#: sentinel); the durations are contention-sensitive wall clock
 _WARN_ONLY_PREFIXES = ("serving_chaos_", "smoke_serving_chaos_",
-                       "serving_disagg_", "smoke_serving_disagg_")
+                       "serving_disagg_", "smoke_serving_disagg_",
+                       "serving_rollout_", "smoke_serving_rollout_")
 
 
 def _device_class(line):

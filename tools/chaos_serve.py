@@ -61,7 +61,7 @@ os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
 SERVE_FAULTS = ("chaos.serve_wedge", "chaos.serve_kill",
                 "chaos.serve_poison", "chaos.serve_exhaust",
-                "chaos.serve_crash_loop")
+                "chaos.serve_crash_loop", "chaos.serve_rollout_corrupt")
 
 
 def build_model():
@@ -330,6 +330,57 @@ def main():
     print("-- fleet_top console frame (degraded fleet, circuit open):")
     for ln in frame.splitlines()[:8]:
         print("   | " + ln)
+
+    # -- fault 6: live rollout with a corrupted candidate (ISSUE 18) --------
+    # a new checkpoint publishes, then bitrot flips a byte in its
+    # payload AFTER the manifest landed; the rollout watcher must catch
+    # it at the verification/parity gate — BEFORE any user request
+    # reaches the weights — quarantine it on the shared rejection
+    # roster, and leave the fleet serving the incumbent with zero
+    # requests lost
+    import numpy as np
+    from mxnet_tpu.utils.recovery import CheckpointManager
+    ckpt_dir = os.path.join(flight_dir, "rollout_ckpts")
+    mgr = CheckpointManager(ckpt_dir, async_save=False)
+    params, _cfg = model
+    mgr.save(1, {k: np.asarray(v) + 0.05 for k, v in params.items()})
+    chaos.configure(serve_rollout_corrupt=(1, 0))
+    ro = srv.attach_rollout(ckpt_dir, stages=(0.5,), window_s=0.0)
+    ro_results = {}
+
+    def rollout_client(j, p, m):
+        try:
+            ro_results[j] = srv.generate(list(p), max_new_tokens=m,
+                                         timeout=300)
+        except Exception as e:
+            ro_results[j] = e
+
+    ro_threads = [threading.Thread(target=rollout_client,
+                                   args=(j, p, m))
+                  for j, (p, m) in enumerate(extra)]
+    for t in ro_threads:
+        t.start()
+    verdict = ro.step()
+    for t in ro_threads:
+        t.join(timeout=300)
+    assert verdict == "rejected", (
+        "corrupted candidate was not rejected: %r" % verdict)
+    assert "serve_rollout_corrupt" in chaos.fired()
+    assert ro.roster.steps() == {1}, ro.roster.steps()
+    assert ro.state == "idle" and ro.candidate is None
+    assert all(v is None for v in srv._version), (
+        "a corrupted candidate reached a replica: %r" % srv._version)
+    assert ro.last_rejection and ro.last_rejection["probe"] == "digest"
+    lost = [j for j, r in ro_results.items() if not isinstance(r, list)]
+    assert not lost, "rollout leg lost requests %r: %r" % (
+        lost, [ro_results[j] for j in lost])
+    mism = [j for j, r in ro_results.items() if r != want[j]]
+    assert not mism, (
+        "rollout leg perturbed greedy tokens for %r" % mism)
+    print("-- fault 6: corrupted rollout candidate quarantined at the "
+          "gate (probe=digest), %d live requests untouched, fleet "
+          "stays on the incumbent" % len(ro_results))
+    telemetry.flight().dump("phase_rollout")
 
     # -- leak audit: every pool quiescent, incl. the crashed engines --------
     stop_sweep.set()

@@ -134,18 +134,26 @@ def render(health, statusz, snap, url="", now=None):
                      % ("OK" if h.get("ok") else "DOWN",
                         _num(h.get("last_beat_age_s"), "%.2f")))
     rows = _replica_rows(health, statusz, snap)
+    # per-replica weight versions from the live-rollout block (ISSUE
+    # 18): index-aligned with the fleet, "boot" = the launch weights
+    ro = ((statusz or {}).get("fleet") or {}).get("rollout") or {}
+    vers = ro.get("versions") or []
     if rows:
         lines.append(
-            "  %-7s %-8s %-8s %6s %8s %10s %10s %9s %9s %8s"
-            % ("replica", "state", "role", "queue", "prefill", "tok/s",
-               "goodput/s", "blocks", "failovers", "respawns"))
-        for r in rows:
+            "  %-7s %-8s %-8s %5s %6s %8s %10s %10s %9s %9s %8s"
+            % ("replica", "state", "role", "ver", "queue", "prefill",
+               "tok/s", "goodput/s", "blocks", "failovers", "respawns"))
+        for i, r in enumerate(rows):
             used, total = r["blocks"]
             blocks = ("%s/%s" % (used, total)
                       if used is not None and total is not None else "-")
+            if i < len(vers):
+                ver = "boot" if vers[i] is None else str(vers[i])
+            else:
+                ver = "-"
             lines.append(
-                "  %-7s %-8s %-8s %6s %8s %10s %10s %9s %9s %8s"
-                % (r["replica"], r["state"], r.get("role") or "-",
+                "  %-7s %-8s %-8s %5s %6s %8s %10s %10s %9s %9s %8s"
+                % (r["replica"], r["state"], r.get("role") or "-", ver,
                    _num(r["queued"], "%d"), _num(r["prefilling"], "%d"),
                    _num(r["tok_s"]), _num(r["goodput_s"]), blocks,
                    _num(r["failovers"], "%d"),
@@ -208,6 +216,19 @@ def render(health, statusz, snap, url="", now=None):
             % (layout, agg.get("migrations", 0),
                agg.get("migration_tokens", 0),
                agg.get("migration_bytes_saved", 0)))
+    if ro:
+        cand = ro.get("candidate")
+        stages = ro.get("stages") or []
+        lines.append(
+            "rollout: %s  incumbent %s -> candidate %s  stage %s/%d "
+            "(weight %s)  bad-windows %s  rejected %s"
+            % (ro.get("state"),
+               "boot" if ro.get("incumbent") is None
+               else ro.get("incumbent"),
+               "-" if cand is None else cand,
+               ro.get("stage"), len(stages), ro.get("weight"),
+               ro.get("bad_windows", 0),
+               len(ro.get("rejected_steps") or [])))
     return "\n".join(lines)
 
 

@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""Live-rollout operator console (ISSUE 18).
+
+Drive and inspect a serving front door's `RolloutController`
+(mxnet_tpu/serving/rollout.py) from the command line:
+
+    python tools/rollout.py --url http://host:8080 --status
+    python tools/rollout.py --url ... --promote      # skip the ladder
+    python tools/rollout.py --url ... --rollback     # retire the canary
+    python tools/rollout.py --url ... --reject 42    # never try step 42
+    python tools/rollout.py --dir /ckpts --reject 42 # offline roster edit
+
+`--status` reads the `rollout` block off `/statusz`; `--promote`,
+`--rollback`, and `--reject` POST operator overrides to `/v1/rollout`.
+`--reject` with `--dir` (no front door needed) writes the shared
+rejection-roster entry directly — the same atomic per-step JSON file
+the controller writes, first writer wins — so an operator can fence a
+bad checkpoint before any router sees it. Deliberately **stdlib-only**,
+like fleet_top.py: it must run on a bastion host where importing jax is
+not an option.
+"""
+import argparse
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+
+def _get(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _post(url, body, timeout=5.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read()), r.status
+    except urllib.error.HTTPError as e:
+        try:
+            return json.loads(e.read()), e.code
+        except Exception:
+            return {"error": str(e)}, e.code
+
+
+def _fmt_version(v):
+    return "boot" if v is None else str(v)
+
+
+def format_status(ro):
+    """Human lines out of one /statusz rollout block."""
+    if not ro:
+        return ["rollout: not attached (serve with --rollout-dir or "
+                "MXNET_SERVING_ROLLOUT_DIR)"]
+    lines = [
+        "rollout: %s  incumbent %s  candidate %s" % (
+            ro.get("state"), _fmt_version(ro.get("incumbent")),
+            _fmt_version(ro.get("candidate"))
+            if ro.get("candidate") is not None else "-"),
+        "  ladder: %s  stage %s  weight %s  bad-windows %s  "
+        "window %ss" % (
+            "/".join("%g" % f for f in ro.get("stages") or []),
+            ro.get("stage"), ro.get("weight"), ro.get("bad_windows"),
+            ro.get("window_s")),
+        "  replica versions: %s" % " ".join(
+            _fmt_version(v) for v in ro.get("versions") or []),
+    ]
+    rej = ro.get("rejected_steps") or []
+    if rej:
+        lines.append("  rejected steps: %s"
+                     % ", ".join(str(s) for s in rej))
+    last = ro.get("last_rejection")
+    if last:
+        lines.append("  last rejection: step %s  probe %s  %s"
+                     % (last.get("step"), last.get("probe"),
+                        last.get("detail")))
+    last = ro.get("last_promotion")
+    if last:
+        lines.append("  last promotion: step %s" % last.get("step"))
+    return lines
+
+
+def reject_offline(directory, step, reason):
+    """Write the rejection-roster entry for `step` directly into
+    `<directory>/rejected/` — the controller's own format (atomic
+    per-step JSON, first writer wins), no front door required."""
+    rdir = os.path.join(directory, "rejected")
+    os.makedirs(rdir, exist_ok=True)
+    path = os.path.join(rdir, "step-%d.json" % int(step))
+    if os.path.exists(path):
+        return False
+    tmp = path + ".tmp.%d" % os.getpid()
+    with open(tmp, "w") as f:
+        json.dump({"step": int(step), "reason": str(reason)[:500],
+                   "by": "operator-cli"}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    if os.path.exists(path):
+        os.unlink(tmp)
+        return False
+    os.replace(tmp, path)
+    return True
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", default=None,
+                    help="serving front door base URL")
+    ap.add_argument("--dir", default=None, metavar="CKPT_DIR",
+                    help="checkpoint directory for offline --reject "
+                         "(edits <dir>/rejected/ directly, no front "
+                         "door needed)")
+    ap.add_argument("--status", action="store_true",
+                    help="print the in-flight rollout's state, stage, "
+                         "versions, and canary verdict-so-far")
+    ap.add_argument("--promote", action="store_true",
+                    help="operator override: skip the remaining stages "
+                         "and promote the in-flight candidate")
+    ap.add_argument("--rollback", action="store_true",
+                    help="operator override: roll the in-flight "
+                         "candidate back and reject it on the roster")
+    ap.add_argument("--reject", type=int, default=None, metavar="STEP",
+                    help="mark STEP rejected on the shared roster so "
+                         "no watcher ever canaries it")
+    ap.add_argument("--reason", default=None,
+                    help="free-text reason recorded with "
+                         "--rollback/--reject")
+    args = ap.parse_args(argv)
+
+    actions = sum(bool(a) for a in
+                  (args.status, args.promote, args.rollback,
+                   args.reject is not None))
+    if actions != 1:
+        ap.error("pick exactly one of --status / --promote / "
+                 "--rollback / --reject")
+
+    if args.reject is not None and args.dir and not args.url:
+        first = reject_offline(args.dir, args.reject,
+                               args.reason or "operator reject")
+        print("step %d %s on %s/rejected/"
+              % (args.reject,
+                 "rejected" if first
+                 else "already rejected (first writer wins)",
+                 args.dir.rstrip("/")))
+        return 0
+
+    if not args.url:
+        ap.error("--status/--promote/--rollback need --url "
+                 "(--reject works offline with --dir)")
+    base = args.url.rstrip("/")
+
+    if args.status:
+        try:
+            statusz = _get(base + "/statusz")
+        except Exception as e:
+            print("front door unreachable: %s" % e, file=sys.stderr)
+            return 1
+        fleet = statusz.get("fleet") or {}
+        for line in format_status(fleet.get("rollout")):
+            print(line)
+        return 0
+
+    body = {"cmd": ("promote" if args.promote
+                    else "rollback" if args.rollback else "reject")}
+    if args.reject is not None:
+        body["step"] = args.reject
+    if args.reason:
+        body["reason"] = args.reason
+    try:
+        out, status = _post(base + "/v1/rollout", body)
+    except Exception as e:
+        print("front door unreachable: %s" % e, file=sys.stderr)
+        return 1
+    print(json.dumps(out, indent=2, sort_keys=True))
+    return 0 if status == 200 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

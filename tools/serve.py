@@ -117,6 +117,14 @@ def main():
     ap.add_argument("--max-replicas", type=int, default=None,
                     help="autoscale ceiling (default: "
                          "MXNET_SERVING_MAX_REPLICAS or 4)")
+    ap.add_argument("--rollout-dir", default=None, metavar="DIR",
+                    help="live weight rollout: watch this checkpoint "
+                         "directory for newly published steps — verify,"
+                         " parity-gate a canary replica, shift traffic "
+                         "through weighted stages, then promote or "
+                         "roll back with zero requests lost (default: "
+                         "MXNET_SERVING_ROLLOUT_DIR or off; drive "
+                         "overrides with tools/rollout.py)")
     ap.add_argument("--roles", default=None, metavar="SPEC",
                     help="disaggregated fleet layout 'prefill:N,"
                          "decode:M': prefill replicas absorb prompt "
@@ -169,7 +177,8 @@ def main():
                   brownout=args.brownout,
                   aot_cache=args.aot_cache,
                   autoscale=args.autoscale,
-                  roles=args.roles)
+                  roles=args.roles,
+                  rollout=args.rollout_dir)
     if args.respawn_max is not None:
         n = (args.replicas if args.replicas is not None
              else serving.serving_replicas())
@@ -236,6 +245,17 @@ def main():
                  c.idle_retire_s, c.down_burn, c.cooldown_s))
     else:
         print("autoscale: off")
+    ro = getattr(srv, "rollout", None)
+    if ro is not None:
+        print("rollout: watching %s — canary ladder %s, %gs windows, "
+              "%d parity prompts (overrides: tools/rollout.py "
+              "--promote/--rollback/--reject)"
+              % (ro.directory,
+                 "/".join("%g" % f for f in ro.stages),
+                 ro.window_s, ro.parity_prompts))
+    else:
+        print("rollout: off (set MXNET_SERVING_ROLLOUT_DIR or "
+              "--rollout-dir to roll new checkpoints out live)")
     from mxnet_tpu import telemetry
     slo_objs = [o.describe() for o in telemetry.parse_slo_env()]
     if slo_objs:
