@@ -386,6 +386,38 @@ def check_line(r):
                            or r.get("ttft_p95_steady_ms") is None):
         raise ValueError("ttft_p95_shift_delta_ms without the measured "
                          "p95 pair it is derived from: %r" % (r,))
+    # speculative-decoding fields (ISSUE 19): the per-pass multiplier
+    # only means something next to the k / draft config it was measured
+    # under (a full-clone draft pins acceptance at its 1.0 upper bound
+    # — that must be visible on the line), it can never exceed the k+1
+    # ceiling (above it the ledger double-counted), and acceptance is a
+    # fraction riding the same measurement. Spec goodput <= throughput
+    # is already enforced by the generic goodput rule above.
+    app = r.get("spec_accepted_per_pass")
+    if app is not None:
+        if not isinstance(app, (int, float)) or isinstance(app, bool) \
+                or app <= 0 or app != app or app == float("inf"):
+            raise ValueError("spec_accepted_per_pass must be a finite "
+                             "positive token count: %r" % (r,))
+        if r.get("spec_k") is None or r.get("spec_draft_layers") is None:
+            raise ValueError("spec_accepted_per_pass without the "
+                             "spec_k / spec_draft_layers config it was "
+                             "measured under: %r" % (r,))
+        if app > r["spec_k"] + 1 + 1e-9:
+            raise ValueError("spec_accepted_per_pass %.3f exceeds the "
+                             "k+1=%d ceiling — the acceptance ledger "
+                             "double-counted: %r"
+                             % (app, r["spec_k"] + 1, r))
+    ar = r.get("spec_acceptance_rate")
+    if ar is not None:
+        if not isinstance(ar, (int, float)) or isinstance(ar, bool) \
+                or not 0.0 < ar <= 1.0 + 1e-9:
+            raise ValueError("spec_acceptance_rate must be a fraction "
+                             "in (0, 1]: %r" % (r,))
+        if app is None:
+            raise ValueError("spec_acceptance_rate without the "
+                             "accepted-per-pass measurement it rides: "
+                             "%r" % (r,))
     return r
 
 
@@ -2267,6 +2299,187 @@ def bench_serving_rollout(smoke, dtype, device_kind):
         srv.close()
 
 
+def bench_serving_spec(smoke, dtype, device_kind):
+    """Speculative decoding A/B (ISSUE 19): the SAME client wave on two
+    single-replica paged engines — spec OFF (the baseline leg; the
+    non-speculative path is the verbatim oracle) vs a FULL-CLONE
+    self-draft (`draft_layers == n_layers`) at k=3. The clone pins
+    acceptance at its 1.0 upper bound BY CONSTRUCTION (disclosed in
+    `draft_note`): the run measures the ceiling of the verification
+    plumbing (k+1-wide scoring, burst emission, block accounting),
+    not a trained draft's quality. Headline: spec-leg decode tok/s
+    over the measured window with `vs_baseline` = spec/off; the line
+    carries accepted-per-pass (the bench refuses to emit unless it
+    exceeds 1.0), acceptance rate, windowed goodput for both legs
+    under a disclosed TTFT SLO, and both legs' ITL quantiles. Judged
+    WARN-ONLY by the sentinel: wall-clock A/B under thread
+    contention, and CPU interpret mode inverts the draft economics
+    (BENCH_NOTES round 19 prediction 2)."""
+    import threading as _threading
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import serving
+    from mxnet_tpu.serving.spec import self_draft
+    from mxnet_tpu.models.transformer import (TransformerConfig,
+                                              init_transformer_params)
+
+    cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                            d_ff=64, max_len=96) if smoke else \
+        TransformerConfig(vocab=1024, d_model=128, n_heads=4, n_layers=2,
+                          d_ff=256, max_len=160)
+    clients = 4 if smoke else 8
+    client_new = 24 if smoke else 48
+    spec_k = int(os.environ.get("BENCH_SPEC_K", "3"))
+    draft_layers = cfg.n_layers  # FULL CLONE: acceptance == 1.0 ceiling
+    slo_ms = float(os.environ.get("BENCH_SPEC_SLO_TTFT_MS",
+                                  "5000" if smoke else "500"))
+    params = init_transformer_params(jax.random.PRNGKey(0), cfg)
+    if dtype == "bfloat16":
+        params = {k: v.astype(jnp.bfloat16) for k, v in params.items()}
+    rng = np.random.RandomState(19)
+    prompts = [list(rng.randint(1, cfg.vocab, 6 + i % 5))
+               for i in range(clients)]
+
+    def run_leg(draft):
+        """One measured wave on a fresh engine; the warm-up request
+        pays every compile (prefill lattice + the spec leg's draft /
+        spec_score sites) OUTSIDE the measured window."""
+        srv = serving.LMServer((params, cfg), max_batch=clients + 2,
+                               block_size=8, paged=True,
+                               draft=draft, spec_k=spec_k)
+        try:
+            if draft is not None and not srv.engine.spec:
+                raise RuntimeError("spec leg fell back: %r"
+                                   % srv.engine.spec_fallback)
+            srv.generate(list(prompts[0]), max_new_tokens=client_new,
+                         timeout=600)
+            led0 = srv.metrics.tokens_ledger()["goodput"]
+            results = {}
+
+            def client(i):
+                try:
+                    results[i] = srv.submit(
+                        list(prompts[i]), max_new_tokens=client_new,
+                        tenant="clients").result(timeout=600)
+                except Exception as e:      # ledger'd; leg reports ok<n
+                    results[i] = e
+
+            t0 = time.perf_counter()
+            threads = [_threading.Thread(target=client, args=(i,))
+                       for i in range(clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600)
+            wall = time.perf_counter() - t0
+            gen = sum(len(r) for r in results.values()
+                      if isinstance(r, list))
+            tv = srv.metrics._tenants_view().get("clients") or {}
+            itl, ttft = tv.get("itl"), tv.get("ttft")
+            slo = [o for o in srv.metrics.slo.payload()
+                   if o.get("objective") == "ttft"
+                   and o.get("tenant") is None]
+            leg = {
+                "ok": sum(1 for r in results.values()
+                          if isinstance(r, list)),
+                "tok_per_sec": (gen / wall) if wall > 0 else None,
+                # windowed goodput: the SLO-met subset of the tokens
+                # the window just delivered, over the same wall span
+                "goodput_tok_per_sec": (round(
+                    (srv.metrics.tokens_ledger()["goodput"] - led0)
+                    / wall, 3) if wall > 0 else None),
+                "attainment": (slo[0]["attainment"] if slo else None),
+                "itl_p50_ms": (round(1e3 * itl.quantile(0.5), 3)
+                               if itl is not None and itl.count
+                               else None),
+                "itl_p95_ms": (round(1e3 * itl.quantile(0.95), 3)
+                               if itl is not None and itl.count
+                               else None),
+                "ttft_p95_ms": (round(1e3 * ttft.quantile(0.95), 3)
+                                if ttft is not None and ttft.count
+                                else None),
+            }
+            if draft is not None:
+                snap = srv.snapshot()
+                sp = snap["spec"]
+                leg.update(passes=sp["passes"],
+                           accepted_per_pass=sp["accepted_per_pass"],
+                           acceptance_rate=sp["acceptance_rate"],
+                           fallbacks=sp["fallbacks"],
+                           decode_compilations=snap["engine"][
+                               "decode_compilations"])
+            return leg
+        finally:
+            srv.close()
+
+    # the SLO threshold is read when the server's metrics are built —
+    # arm it for both legs, restore the ambient value after
+    prev_slo = os.environ.get("MXNET_SLO_TTFT_MS")
+    os.environ["MXNET_SLO_TTFT_MS"] = "%g" % slo_ms
+    try:
+        base = run_leg(None)                              # leg A: off
+        spec = run_leg(self_draft(params, cfg, draft_layers))  # leg B
+    finally:
+        if prev_slo is None:
+            os.environ.pop("MXNET_SLO_TTFT_MS", None)
+        else:
+            os.environ["MXNET_SLO_TTFT_MS"] = prev_slo
+    app = spec.get("accepted_per_pass")
+    if app is None or app <= 1.0:
+        # the one hard gate: a pass that doesn't beat one-token-per-
+        # iteration means speculation never engaged — refuse the line
+        raise RuntimeError("speculation did not pay per pass: "
+                           "accepted_per_pass=%r (passes=%r)"
+                           % (app, spec.get("passes")))
+    line = {
+        "metric": ("smoke_serving_spec_decode_tok_per_sec" if smoke
+                   else "serving_spec_decode_tok_per_sec"),
+        "value": round(spec["tok_per_sec"], 3), "unit": "tok/s",
+        "vs_baseline": (round(spec["tok_per_sec"]
+                              / base["tok_per_sec"], 3)
+                        if base["tok_per_sec"] else None),
+        "baseline_tok_per_sec": (round(base["tok_per_sec"], 3)
+                                 if base["tok_per_sec"] else None),
+        "spec_accepted_per_pass": round(app, 3),
+        "spec_acceptance_rate": (round(spec["acceptance_rate"], 4)
+                                 if spec["acceptance_rate"] is not None
+                                 else None),
+        "spec_passes": spec["passes"],
+        "spec_fallback_passes": spec["fallbacks"],
+        "spec_k": spec_k, "spec_draft_layers": draft_layers,
+        "draft_note": "FULL-CLONE self-draft (draft_layers == "
+                      "n_layers): acceptance is pinned at its 1.0 "
+                      "upper bound by construction — the per-pass "
+                      "multiplier measures the verification "
+                      "plumbing's ceiling, not a trained draft",
+        "itl_p50_ms": spec["itl_p50_ms"],
+        "itl_p95_ms": spec["itl_p95_ms"],
+        "baseline_itl_p50_ms": base["itl_p50_ms"],
+        "baseline_itl_p95_ms": base["itl_p95_ms"],
+        "ttft_p95_ms": spec["ttft_p95_ms"],
+        "decode_compilations": spec["decode_compilations"],
+        "clients": clients, "tokens_per_client": client_new,
+        "clients_completed": "%d+%d/%d" % (base["ok"], spec["ok"],
+                                           2 * clients),
+    }
+    if spec["attainment"] is not None and \
+            spec["goodput_tok_per_sec"] is not None:
+        line.update(goodput_tok_per_sec=spec["goodput_tok_per_sec"],
+                    baseline_goodput_tok_per_sec=base[
+                        "goodput_tok_per_sec"],
+                    slo_ttft_attainment=spec["attainment"],
+                    slo_ttft_ms=slo_ms)
+    if "cpu" in str(device_kind).lower():
+        line["interpreter_note"] = (
+            "CPU leg: the cache-free draft pays a full interpreted "
+            "causal forward per proposed token, so wall-clock "
+            "vs_baseline inverts (< 1) — judge the acceptance ledger "
+            "and the per-pass multiplier; the tok/s ratio means "
+            "something on real TPUs where the draft is a fraction of "
+            "target cost and k+1 tiles the lanes (k=7/15)")
+    return line
+
+
 _CONFIGS = [
     ("resnet50_infer", bench_resnet50_infer),
     ("resnet50_int8_infer", bench_resnet50_int8_infer),
@@ -2280,6 +2493,7 @@ _CONFIGS = [
     ("serving_chaos", bench_serving_chaos),
     ("serving_disagg", bench_serving_disagg),
     ("serving_rollout", bench_serving_rollout),
+    ("serving_spec", bench_serving_spec),
     ("resilience", bench_resilience),
     ("io_pipeline", bench_io_pipeline),
     ("e2e_train_io", bench_e2e_train_io),

@@ -325,6 +325,49 @@ print("2n OK:", {f: line[f] for f in (
     "ttft_p95_shift_delta_ms", "transitions")})
 PYEOF
 
+echo "=== 2o. speculative decoding A/B (ISSUE 19) ==="
+# The SAME client wave on two single-replica paged engines: spec OFF
+# (baseline; the non-speculative path is the verbatim oracle) vs a
+# FULL-CLONE self-draft at k=3 — acceptance pinned at its 1.0 upper
+# bound by construction and disclosed on the line, so the run
+# measures the verification plumbing's ceiling. On TPU, k wants
+# k+1 lane-tileable: rerun with BENCH_SPEC_K=7 for the tiled point.
+# Gates: accepted-per-pass > 1.0 (the bench refuses to emit
+# otherwise), the k+1 ceiling + acceptance-fraction rules
+# (check_line), goodput <= throughput. Predictions registered in
+# BENCH_NOTES.md round 19 BEFORE this runs; sentinel judges
+# serving_spec_* warn-only (wall-clock A/B under thread contention).
+timeout -k 30 1800 env BENCH_CONFIGS=serving_spec python bench.py \
+  | tee BENCH_SERVING_SPEC.jsonl
+python - <<'PYEOF'
+import json
+line = None
+for l in open("BENCH_SERVING_SPEC.jsonl"):
+    try:
+        r = json.loads(l)
+    except ValueError:
+        continue
+    if str(r.get("metric", "")).endswith(
+            "serving_spec_decode_tok_per_sec"):
+        line = r
+assert line is not None, "serving_spec emitted no result line"
+app = line.get("spec_accepted_per_pass")
+assert app is not None and app > 1.0, (
+    "speculation did not pay per pass: %r" % app)
+assert app <= line["spec_k"] + 1 + 1e-9, (
+    "accepted-per-pass %r above the k+1 ceiling" % app)
+ar = line.get("spec_acceptance_rate")
+assert ar is not None and 0 < ar <= 1.0, (
+    "acceptance rate not a fraction in (0, 1]: %r" % ar)
+gp = line.get("goodput_tok_per_sec")
+assert gp is None or gp <= 1.001 * line["value"], (
+    "spec goodput %r exceeds the throughput %r it is a subset of"
+    % (gp, line["value"]))
+print("2o OK:", {f: line[f] for f in (
+    "value", "vs_baseline", "spec_accepted_per_pass",
+    "spec_acceptance_rate", "spec_k")})
+PYEOF
+
 echo "=== 3. flash attention seq sweep (1024/2048/4096) ==="
 BENCH_CONFIGS=transformer_flash BENCH_FLASH_SEQ=1024,2048,4096,8192 \
   python bench.py | tee BENCH_FLASH_SWEEP.jsonl

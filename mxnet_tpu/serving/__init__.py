@@ -11,8 +11,12 @@ with backpressure, a per-iteration token budget, priority classes and
 per-tenant token budgets, a content-addressed prefix cache
 (`MXNET_PREFIX_CACHE=1`, prefix_cache.py: shared prompt prefixes hit
 resident refcounted blocks, copy-on-write on divergence, LRU eviction),
-serving metrics, and an in-process `serve()` API with a stdlib HTTP
-frontend (tools/serve.py).
+serving metrics, draft-model speculative decoding through the paged
+engine (`MXNET_SPEC_DECODE=1`, spec.py: a small draft proposes k tokens,
+the target scores all k+1 positions in one ragged paged pass, greedy
+verification keeps the output token-identical to the non-speculative
+path), and an in-process `serve()` API with a stdlib HTTP frontend
+(tools/serve.py).
 
 Quickstart::
 
@@ -39,6 +43,8 @@ from .rollout import (RolloutController, RejectionRoster, rollout_dir,
                       rollout_stages, rollout_window_s,
                       rollout_parity_prompts)
 from .tp import serving_tp, tp_cache_variant
+from .spec import (DraftLM, self_draft, spec_decode_enabled, spec_k,
+                   spec_draft_layers)
 
 __all__ = [
     "BlockPool", "PagedKVCache", "CacheOverflow",
@@ -55,4 +61,6 @@ __all__ = [
     "Autoscaler", "AutoscaleConfig", "autoscale_enabled",
     "RolloutController", "RejectionRoster", "rollout_dir",
     "rollout_stages", "rollout_window_s", "rollout_parity_prompts",
+    "DraftLM", "self_draft", "spec_decode_enabled", "spec_k",
+    "spec_draft_layers",
 ]

@@ -80,7 +80,7 @@ class Sequence:
     __slots__ = ("tokens", "prompt_len", "block_ids", "table_row",
                  "max_total", "eos_id", "done", "last_logits", "request",
                  "prefilled", "prefill_s", "cache_hit_tokens",
-                 "shared_blocks")
+                 "shared_blocks", "token_logits")
 
     def __init__(self, prompt, max_total, eos_id=None):
         self.tokens = list(prompt)
@@ -97,6 +97,9 @@ class Sequence:
         self.cache_hit_tokens = 0     # prompt tokens served by prefix hits
         self.shared_blocks = 0        # table entries pointing at shared
                                       # (refcounted) cache blocks
+        self.token_logits = None      # keep_logits engines: one f32 (V,)
+                                      # row PER EMITTED token, both decode
+                                      # paths — the spec parity oracle
 
     @property
     def generated(self):
@@ -281,6 +284,64 @@ def _tf_prefill_chunk(params, k_pool, v_pool, toks, qs, length, last_idx,
     return k_pool, v_pool, logits
 
 
+def _tf_spec_score(params, k_pool, v_pool, toks, q_starts, counts,
+                   tables, cfg, block_size):
+    """Speculative scoring pass: the batched generalization of
+    `_tf_prefill_chunk`. For each row, toks (B, C) holds [last history
+    token, draft_1..draft_k] (zero-padded past that row's true `counts`)
+    at true positions q_starts[b]..q_starts[b]+C-1; tables (B, w) are the
+    live width-bucketed block tables. ONE paged pass writes the C
+    positions' K/V and returns logits (B, C, V) f32 — row j of a
+    sequence is the target's next-token distribution given its history
+    plus the first j draft tokens, exactly what greedy/rejection
+    verification consumes.
+
+    Position truth: the paged kernel's per-row mask `key_pos <=
+    q_starts[b] + i` is the causal mask within the chunk plus the
+    full-history mask across the cache — each scored position attends
+    precisely the tokens a one-at-a-time decode would. Positions past
+    `counts` (shorter-than-k proposals, padded batch rows) write to the
+    null block and their logits are discarded by the caller; positions
+    past an eventual rejection DO land in real table slots, but they are
+    rewritten by the next pass over this sequence (spec passes re-score
+    from the new history end; a non-spec step writes its own slot)
+    before any mask lets a query read them."""
+    from ..models.transformer import _layer_norm
+    from ..ops.pallas_paged import paged_attention
+
+    B, C = toks.shape
+    D, H = cfg.d_model, cfg.n_heads
+    Dh = D // H
+    w = tables.shape[1]
+    pos = q_starts[:, None] + jnp.arange(C)[None, :]               # (B, C)
+    valid = jnp.arange(C)[None, :] < counts[:, None]               # (B, C)
+    pe = jnp.minimum(pos, cfg.max_len - 1)
+    x = params["embed"][toks] + params["pos_embed"][pe]            # (B,C,D)
+    blk = jnp.minimum(pos // block_size, w - 1)
+    slots = jnp.take_along_axis(tables, blk, axis=1) * block_size \
+        + pos % block_size
+    slots = jnp.where(valid, slots, pos % block_size)              # null blk
+    flat = slots.reshape(B * C)
+    for i in range(cfg.n_layers):
+        pre = "layer%d_" % i
+        h = _layer_norm(x, params[pre + "ln1_g"], params[pre + "ln1_b"])
+        qkv = h @ params[pre + "wqkv"]
+        q, kk, vv = jnp.split(qkv, 3, axis=-1)
+        k_pool, v_pool = write_kv(k_pool, v_pool, i, flat,
+                                  kk.reshape(B * C, H, Dh),
+                                  vv.reshape(B * C, H, Dh))
+        att = paged_attention(q.reshape(B, C, H, Dh), k_pool[i],
+                              v_pool[i], tables,
+                              q_starts.astype(jnp.int32),
+                              block_size)                          # (B,C,H,Dh)
+        x = x + att.reshape(B, C, D) @ params[pre + "wo"]
+        h = _layer_norm(x, params[pre + "ln2_g"], params[pre + "ln2_b"])
+        x = x + _ffn(params, pre, h, cfg)
+    h = _layer_norm(x, params["lnf_g"], params["lnf_b"])
+    logits = (h @ params["head"]).astype(jnp.float32)              # (B,C,V)
+    return k_pool, v_pool, logits
+
+
 class TransformerLM:
     """Paged-cache adapter for the functional transformer
     (models/transformer.py): params dict + TransformerConfig."""
@@ -302,6 +363,7 @@ class TransformerLM:
         self._decode_jit = None
         self._decode_paged_jit = None
         self._prefill_chunk_jit = None
+        self._spec_score_jit = None
 
     def cache_spec(self):
         dt = self.params["embed"].dtype
@@ -316,6 +378,8 @@ class TransformerLM:
                      "table_row")
     _CHUNK_ARGS = ("params", "k_pool", "v_pool", "tokens", "q_start",
                    "length", "last_idx", "table_row")
+    _SPEC_ARGS = ("params", "k_pool", "v_pool", "tokens", "q_starts",
+                  "counts", "tables")
 
     def bind(self, block_size):
         cfg = self.cfg
@@ -345,6 +409,13 @@ class TransformerLM:
                 p, k, v, t, qs, ln, li, tb, cfg, block_size)),
             site="serving.prefill", phase="prefill",
             argnames=self._CHUNK_ARGS, variant="prefill_chunk")
+        # speculative k+1 scoring (one site, AOT-cacheable): the batched
+        # chunk signature against the live block tables
+        self._spec_score_jit = instrument(jax.jit(
+            lambda p, k, v, t, qs, cn, tb: _tf_spec_score(
+                p, k, v, t, qs, cn, tb, cfg, block_size)),
+            site="serving.spec_score", phase="decode",
+            argnames=self._SPEC_ARGS, variant="spec_score")
 
     def bind_tp(self, block_size, mesh):
         """Build the tensor-parallel step functions over `mesh` (axis
@@ -357,7 +428,8 @@ class TransformerLM:
         attributed to the params/pool sharding diff, not misread as new
         traffic shapes."""
         from .tp import (place_tp_params, build_tp_decode,
-                         build_tp_prefill_chunk, tp_cache_variant)
+                         build_tp_prefill_chunk, build_tp_spec_score,
+                         tp_cache_variant)
         instrument = telemetry.introspect.instrument
         self._tp_params = place_tp_params(self.params, self.cfg, mesh)
         # the tp variant embeds the mesh's DEVICE WINDOW: two replicas'
@@ -375,6 +447,10 @@ class TransformerLM:
             site="serving.prefill", phase="prefill",
             argnames=self._CHUNK_ARGS,
             variant="prefill_chunk_tp:" + tpv)
+        self._spec_score_tp_jit = instrument(
+            build_tp_spec_score(self.cfg, block_size, mesh),
+            site="serving.spec_score", phase="decode",
+            argnames=self._SPEC_ARGS, variant="spec_score_tp:" + tpv)
 
     def prefill(self, k, v, tokens, length, table_row):
         return self._prefill_jit(self.params, k, v, tokens, length,
@@ -393,9 +469,17 @@ class TransformerLM:
         return self._prefill_chunk_jit(self.params, k, v, tokens, q_start,
                                        length, last_idx, table_row)
 
+    def spec_score(self, k, v, tokens, q_starts, counts, tables):
+        return self._spec_score_jit(self.params, k, v, tokens, q_starts,
+                                    counts, tables)
+
     def decode_tp(self, k, v, tokens, positions, tables):
         return self._decode_tp_jit(self._tp_params, k, v, tokens,
                                    positions, tables)
+
+    def spec_score_tp(self, k, v, tokens, q_starts, counts, tables):
+        return self._spec_score_tp_jit(self._tp_params, k, v, tokens,
+                                       q_starts, counts, tables)
 
     def prefill_chunk_tp(self, k, v, tokens, q_start, length, last_idx,
                          table_row):
@@ -530,12 +614,14 @@ class Engine:
     #: flags the engine derives compiled state from — construction-only
     _FROZEN_FLAGS = frozenset(
         ("paged", "paged_requested", "prefill_chunk", "tp",
-         "tp_requested", "mesh", "prefix_cache", "aot_cache"))
+         "tp_requested", "mesh", "prefix_cache", "aot_cache",
+         "spec", "spec_requested", "spec_k", "draft"))
 
     def __init__(self, model, max_batch=8, max_len=None, block_size=16,
                  num_blocks=None, keep_logits=False, paged=None,
                  prefill_chunk=None, tp=None, devices=None,
-                 prefix_cache=None, aot_cache=None):
+                 prefix_cache=None, aot_cache=None, draft=None,
+                 spec=None, spec_k=None):
         from ..ops.pallas_paged import paged_enabled, paged_eligible
         from ..ops.pallas_attention import default_interpret
         from .tp import (serving_tp, tp_fallback_reason, build_tp_mesh,
@@ -632,6 +718,45 @@ class Engine:
             else:
                 self.prefix_cache = PrefixCache(self.cache.pool,
                                                 block_size)
+        # speculative decoding (ISSUE 19): a draft LM proposes spec_k
+        # tokens per decode iteration and the target scores all k+1
+        # positions in ONE ragged paged pass; greedy verification
+        # accepts a prefix, so the flag switches SPEED, never logits.
+        # Env default (MXNET_SPEC_DECODE + MXNET_SPEC_DRAFT_LAYERS for
+        # an env-only self-draft), explicit `draft=`/`spec=` overrides;
+        # ineligible configs keep the verbatim per-token decode as the
+        # fallback + parity oracle with the reason on `spec_fallback`.
+        from . import spec as _spec
+        self.spec_requested = (bool(spec) if spec is not None
+                               else (_spec.spec_decode_enabled()
+                                     or draft is not None))
+        self.spec_k = (int(spec_k) if spec_k is not None
+                       else _spec.spec_k())
+        if self.spec_k < 1:
+            raise MXNetError("spec_k must be >= 1, got %d" % self.spec_k)
+        self.spec = False
+        self.spec_fallback = None
+        self.draft = None
+        self.chaos_spec_poison = False   # armed per-iteration by the
+                                         # serving loop's chaos seam
+        self.last_spec = None            # most recent pass's accounting
+        self.spec_passes = 0
+        self.spec_proposed_tokens = 0
+        self.spec_accepted_tokens = 0
+        self.spec_fallbacks = 0
+        if self.spec_requested:
+            d = _spec.build_draft(draft, model)
+            reason = _spec.spec_fallback_reason(
+                model, d, self.paged, self.spec_k, block_size,
+                default_interpret())
+            if reason is not None:
+                self.spec_fallback = reason
+            else:
+                # the draft stays replicated (its jit never touches the
+                # mesh) while the target's scoring pass shards with tp —
+                # same placement split the tentpole demands
+                self.draft = d
+                self.spec = True
         # per-engine compile counters, fed by the watchdog's per-thread
         # dispatch attribution (telemetry/introspect.py): each model call
         # below is bracketed by `_count`, which adds exactly the compiles
@@ -765,6 +890,8 @@ class Engine:
         if L < 1:
             raise MXNetError("empty prompt")
         seq = Sequence(prompt, min(self.max_len, L + max_new), eos_id)
+        if self.keep_logits:
+            seq.token_logits = []
         if self.cache is not None:
             n = self.blocks_needed(L, max_new)
             if self.prefix_cache is None:
@@ -886,6 +1013,8 @@ class Engine:
                 seq.prefilled = L
         if self.keep_logits:
             seq.last_logits = logits
+            if seq.token_logits is not None:
+                seq.token_logits.append(logits)
         self._append(seq, int(np.argmax(logits)))
         return True
 
@@ -906,9 +1035,21 @@ class Engine:
 
     # -- decode --------------------------------------------------------------
 
+    def decode_tokens_per_step(self):
+        """Tokens one decode iteration SCORES per running sequence — the
+        scheduler's per-iteration/per-tenant budget cost and the fair
+        price next to prefill chunks: a speculating sequence occupies
+        k+1 scored positions per step, a plain one exactly 1."""
+        return self.spec_k + 1 if self.spec else 1
+
     def decode_step(self, seqs):
-        """Advance every sequence in `seqs` by one token (one fused jit
-        call over the power-of-two padded batch)."""
+        """Advance every sequence in `seqs` (one fused jit call over the
+        power-of-two padded batch). Non-speculative engines emit exactly
+        one token per sequence; speculative engines emit 1..spec_k+1
+        accepted tokens per sequence per call, token-identical to the
+        plain path. A draft fault (non-finite logits — the
+        `serve_spec_poison` chaos seam or a real draft bug) degrades
+        THIS batch to the verbatim non-speculative body below."""
         seqs = [s for s in seqs if not s.done]
         if not seqs:
             return []
@@ -916,6 +1057,12 @@ class Engine:
             raise MXNetError("decode batch %d exceeds max_batch %d"
                              % (len(seqs), self.max_batch))
         bb = pow2_bucket(len(seqs), lo=1, hi=self.max_batch)
+        if self.spec:
+            out = self._spec_decode_step(seqs, bb)
+            if out is not None:
+                return out
+            # fall through: the un-touched single-token path IS the
+            # degradation target (and the parity oracle)
         t0_us = time.perf_counter_ns() // 1000
         with telemetry.span("serving.decode", category="serving",
                             batch=len(seqs)):
@@ -971,6 +1118,8 @@ class Engine:
         for i, s in enumerate(seqs):
             if self.keep_logits and logits is not None:
                 s.last_logits = logits[i]
+                if s.token_logits is not None:
+                    s.token_logits.append(logits[i])
             self._append(s, int(nxt[i]))
             if s.request is not None:
                 telemetry.record_span("serving.decode", t0_us, dur_us,
@@ -978,6 +1127,123 @@ class Engine:
                                       category="serving",
                                       to_profiler=False, to_flight=False,
                                       position=len(s.tokens) - 1)
+        return seqs
+
+    def _draft_propose(self, seqs, bb, k, poison):
+        """Draft proposal loop: k greedy autoregressive steps of the
+        cache-free draft over the (bucketed) batch of token histories.
+        Returns (draft (B, k) int32, per-sequence proposal counts), or
+        None when the draft emitted non-finite logits — the poisoned
+        batch degrades to the non-speculative path, proposing nothing.
+        Sequences within 1 token of max_total get a shorter (possibly
+        empty) proposal: the bonus token takes the last slot, and tokens
+        drafted past max_total would be priced but undeliverable."""
+        d = self.draft
+        B = len(seqs)
+        hist = [list(s.tokens) for s in seqs]
+        nbs = [max(0, min(k, s.max_total - len(s.tokens) - 1))
+               for s in seqs]
+        out = np.zeros((B, k), np.int32)
+        for j in range(max(nbs)):
+            s_pad = pow2_bucket(max(len(h) for h in hist),
+                                lo=min(8, d.max_len), hi=d.max_len)
+            toks = np.zeros((bb, s_pad), np.int32)
+            lens = np.ones((bb,), np.int32)
+            for i, h in enumerate(hist):
+                toks[i, :len(h)] = h
+                lens[i] = len(h)
+            with self._count("decode", ("draft", bb, s_pad)):
+                logits = np.asarray(d.logits_at(jnp.asarray(toks),
+                                                jnp.asarray(lens)))
+            if poison:
+                logits = np.full_like(logits, np.nan)
+            if not np.isfinite(logits[:B]).all():
+                return None
+            nxt = np.argmax(logits, axis=-1).astype(np.int32)
+            for i in range(B):
+                if j < nbs[i]:
+                    out[i, j] = nxt[i]
+                    hist[i].append(int(nxt[i]))
+        return out, nbs
+
+    def _spec_decode_step(self, seqs, bb):
+        """One speculative iteration: draft proposes, the target scores
+        all k+1 positions in ONE ragged paged pass against the live
+        block tables, greedy verification accepts a prefix (plus the
+        target's own token at the first disagreement, plus a bonus on a
+        full sweep) — emitted tokens are EXACTLY the plain greedy
+        path's. Returns the advanced seqs, or None to degrade this
+        batch to the verbatim non-speculative step (draft fault).
+
+        KV discipline: the pass writes positions len-1..len-1+k per
+        sequence. Accepted positions become ordinary history; rejected
+        positions hold garbage that is REWRITTEN by the next step over
+        this sequence before any attention mask reaches it, and the
+        prefix cache only ever indexes tokens[:-1] (accepted history).
+        """
+        from .spec import greedy_verify
+        k = self.spec_k
+        C = k + 1
+        poison, self.chaos_spec_poison = self.chaos_spec_poison, False
+        t0_us = time.perf_counter_ns() // 1000
+        with telemetry.span("serving.spec", category="serving",
+                            batch=len(seqs), k=k):
+            drafted = self._draft_propose(seqs, bb, k, poison)
+            if drafted is None:
+                self.spec_fallbacks += 1
+                self.last_spec = {"fallback": True, "batch": len(seqs)}
+                return None
+            draft, nbs = drafted
+            B = len(seqs)
+            w = pow2_bucket(
+                max(self.cache.blocks_for(len(s.tokens) + k)
+                    for s in seqs), lo=1, hi=self._nblk)
+            toks = np.zeros((bb, C), np.int32)
+            qs = np.zeros((bb,), np.int32)
+            counts = np.zeros((bb,), np.int32)
+            tabs = np.zeros((bb, w), np.int32)
+            for i, s in enumerate(seqs):
+                toks[i, 0] = s.tokens[-1]
+                toks[i, 1:1 + nbs[i]] = draft[i, :nbs[i]]
+                qs[i] = len(s.tokens) - 1
+                counts[i] = 1 + nbs[i]
+                tabs[i] = s.table_row[:w]
+            score_fn = self.model.spec_score_tp if self.tp > 1 \
+                else self.model.spec_score
+            with self._count("decode", ("spec", bb, w)):
+                self.cache.k, self.cache.v, logits = score_fn(
+                    self.cache.k, self.cache.v, jnp.asarray(toks),
+                    jnp.asarray(qs), jnp.asarray(counts),
+                    jnp.asarray(tabs))
+            logits = np.asarray(logits)                    # (bb, C, V)
+            accepted = proposed = emitted_n = 0
+            dur_us = time.perf_counter_ns() // 1000 - t0_us
+            for i, s in enumerate(seqs):
+                am = np.argmax(logits[i], axis=-1)
+                emitted, acc = greedy_verify(am, draft[i], nbs[i])
+                accepted += acc
+                proposed += nbs[i]
+                for j, tok in enumerate(emitted):
+                    if s.done:
+                        break
+                    if self.keep_logits:
+                        s.last_logits = logits[i, j]
+                        if s.token_logits is not None:
+                            s.token_logits.append(logits[i, j])
+                    self._append(s, int(tok))
+                    emitted_n += 1
+                    if s.request is not None:
+                        telemetry.record_span(
+                            "serving.decode", t0_us, dur_us,
+                            trace=s.request.trace, category="serving",
+                            to_profiler=False, to_flight=False,
+                            position=len(s.tokens) - 1)
+        self.spec_passes += 1
+        self.spec_proposed_tokens += proposed
+        self.spec_accepted_tokens += accepted
+        self.last_spec = {"fallback": False, "batch": B,
+                          "proposed": proposed, "accepted": accepted,
+                          "emitted": emitted_n}
         return seqs
 
     def _append(self, seq, token):

@@ -33,6 +33,11 @@ _DOMAIN = profiler.Domain("serving")
 #: decode/prefill batch-size buckets (powers of two up to a big pod batch)
 _BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
 _OCCUPANCY_BUCKETS = (0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
+#: accepted-tokens-per-pass buckets (ISSUE 19): 1.0 is the floor (every
+#: speculative pass emits at least the target's own token), spec_k+1 the
+#: ceiling; fractional edges resolve the sub-token differences that
+#: decide whether speculation pays for the draft
+_SPEC_BUCKETS = (1.0, 1.5, 2.0, 2.5, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0)
 
 #: per-tenant instrument-name templates (ISSUE 13; docs/OBSERVABILITY.md
 #: names these with a `<tenant>` placeholder). Token counters share the
@@ -233,12 +238,41 @@ class ServingMetrics:
                               buckets=_OCCUPANCY_BUCKETS,
                               help="decode batch fill fraction "
                                    "(active/max_batch)")
+        # speculative decoding (ISSUE 19)
+        self._spec_passes = c("serving_spec_passes_total",
+                              help="speculative scoring passes (one "
+                                   "draft+score+verify round per "
+                                   "decode iteration)")
+        self._spec_proposed = c("serving_spec_proposed_tokens_total",
+                                help="draft tokens proposed to the "
+                                     "target for verification")
+        self._spec_accepted = c("serving_spec_accepted_tokens_total",
+                                help="draft tokens the target accepted "
+                                     "(excludes the per-pass bonus/"
+                                     "correction token)")
+        self._spec_fallbacks = c("serving_spec_fallback_total",
+                                 help="speculative passes degraded to "
+                                      "the non-speculative path (draft "
+                                      "fault / poisoned logits)")
+        self._h_spec_accepted = h("serving_spec_accepted_per_pass",
+                                  buckets=_SPEC_BUCKETS,
+                                  help="tokens emitted per sequence per "
+                                       "speculative pass (accepted + "
+                                       "1; floor 1.0, ceiling k+1)")
+        self._g_spec_rate = g("serving_spec_acceptance_rate",
+                              help="lifetime accepted/proposed draft-"
+                                   "token ratio")
         self._cache_util_last = None
         self._prefill_depth_last = 0
         # prompt tokens whose prefill compute has been observed — the
         # denominator feed for observed_prefill_rate() (plain attr, not
         # an exposition metric: it exists only to rate the h_prefill sum)
         self._prefill_tokens_obs = 0
+        # decode tokens whose step time has been observed — numerator
+        # feed for observed_token_rate(): under speculation one step
+        # emits a BURST, so the rate must count tokens, not iterations
+        # (same plain-attr pattern as _prefill_tokens_obs)
+        self._step_tokens_obs = 0
         self._counter = _DOMAIN.new_counter("tokens_generated")
 
     # -- legacy attribute surface (health(), tests) --------------------------
@@ -583,18 +617,42 @@ class ServingMetrics:
         self._g_prefill_backlog.set(queue_depth)
 
     def decode_step(self, active, max_batch, step_s, cache_util=None,
-                    paged=False):
+                    paged=False, tokens=None):
+        """One decode iteration advanced `active` sequences. `tokens` is
+        the number it actually EMITTED — equal to `active` on the plain
+        path (the default keeps old callers exact), a burst of up to
+        active*(k+1) under speculation."""
+        tokens = active if tokens is None else tokens
         self._steps.inc()
         (self._steps_paged if paged else self._steps_gather).inc()
         self._h_batch.observe(active)
         self._h_occupancy.observe(active / float(max_batch))
         self._h_step.observe(step_s)
-        self._tokens.inc(active)
+        self._tokens.inc(tokens)
+        self._step_tokens_obs += tokens
         if cache_util is not None:
             with self._lock:
                 self._cache_util_last = cache_util
             self._g_util.set(cache_util)
-        self._counter.increment(active)
+        self._counter.increment(tokens)
+
+    def spec_pass(self, batch=0, proposed=0, accepted=0, emitted=0,
+                  fallback=False):
+        """One speculative decode round (engine.last_spec feed): either
+        a completed draft+score+verify pass over `batch` sequences, or
+        a degraded one (`fallback=True` — the batch re-ran on the
+        non-speculative path, token-identical)."""
+        if fallback:
+            self._spec_fallbacks.inc()
+            return
+        self._spec_passes.inc()
+        self._spec_proposed.inc(proposed)
+        self._spec_accepted.inc(accepted)
+        if batch:
+            self._h_spec_accepted.observe(emitted / float(batch))
+        if self._spec_proposed.value > 0:
+            self._g_spec_rate.set(self._spec_accepted.value
+                                  / self._spec_proposed.value)
 
     def request_finished(self, req):
         from .scheduler import (BrownoutShed, DeadlineExceeded,
@@ -640,15 +698,17 @@ class ServingMetrics:
             failovers=req.failovers or None)
 
     def observed_token_rate(self, min_steps=8):
-        """Decode tokens per COMPUTE second, from the step-time and
-        batch histograms (sum of live sequences per step over summed
-        step wall time) — the service rate the deadline admission check
-        divides the committed-token backlog by. None until `min_steps`
-        decode steps have been observed: a cold server never sheds on a
-        rate it hasn't measured."""
+        """Decode tokens per COMPUTE second: tokens actually emitted
+        (accepted tokens under speculation — a speculative step delivers
+        a burst, so counting iterations would understate the service
+        rate and falsely shed deadline requests) over summed step wall
+        time — the rate the deadline admission check divides the
+        committed-token backlog by. None until `min_steps` decode steps
+        have been observed: a cold server never sheds on a rate it
+        hasn't measured."""
         if self.decode_steps < min_steps or self._h_step.sum <= 0:
             return None
-        return self._h_batch.sum / self._h_step.sum
+        return self._step_tokens_obs / self._h_step.sum
 
     def observed_prefill_rate(self):
         """Prompt tokens per prefill-compute second — prefill drains far
@@ -832,6 +892,28 @@ class ServingMetrics:
             if getattr(engine, "prefix_cache_fallback", None):
                 snap["engine"]["prefix_cache_fallback"] = \
                     engine.prefix_cache_fallback
+            snap["engine"]["spec_decode"] = bool(
+                getattr(engine, "spec", False))
+            if getattr(engine, "spec_fallback", None):
+                snap["engine"]["spec_fallback"] = engine.spec_fallback
+            if getattr(engine, "spec", False) or \
+                    getattr(engine, "spec_passes", 0):
+                passes = engine.spec_passes
+                snap["spec"] = {
+                    "k": engine.spec_k,
+                    "passes": passes,
+                    "proposed_tokens": engine.spec_proposed_tokens,
+                    "accepted_tokens": engine.spec_accepted_tokens,
+                    "fallbacks": engine.spec_fallbacks,
+                    "acceptance_rate": (
+                        engine.spec_accepted_tokens
+                        / engine.spec_proposed_tokens
+                        if engine.spec_proposed_tokens else None),
+                    "accepted_per_pass": (
+                        (self._h_spec_accepted.sum
+                         / self._h_spec_accepted.count)
+                        if self._h_spec_accepted.count else None),
+                }
             pc = getattr(engine, "prefix_cache", None)
             if pc is not None:
                 snap["cache"]["prefix"] = {

@@ -58,6 +58,15 @@ from collections import deque
 from ..base import MXNetError
 
 
+def _decode_cost(engine):
+    """Scored tokens one decode iteration costs per running sequence:
+    `engine.decode_tokens_per_step()` (k+1 on a speculating engine, 1
+    otherwise). getattr-defensive — scheduler tests drive minimal
+    engine stubs that predate the speculative path."""
+    fn = getattr(engine, "decode_tokens_per_step", None)
+    return fn() if fn is not None else 1
+
+
 class QueueFull(MXNetError):
     """submit() backpressure: the request queue is at max_queue."""
 
@@ -306,10 +315,13 @@ class Scheduler:
             self.pending()
 
     def spent_tokens(self, engine):
-        """Tokens the NEXT loop iteration is already committed to: one
-        decode token per running sequence plus one prefill chunk per
-        sequence still prefilling."""
-        return len(self.running) + sum(
+        """Tokens the NEXT loop iteration is already committed to:
+        `decode_tokens_per_step` per running sequence (1 plain, k+1 for
+        a speculating engine — the target SCORES k+1 positions per
+        sequence per iteration, so that is the honest price next to a
+        prefill chunk) plus one prefill chunk per sequence still
+        prefilling."""
+        return _decode_cost(engine) * len(self.running) + sum(
             engine.prefill_tokens_per_step(s.prompt_len)
             for s in self.prefilling)
 
@@ -325,11 +337,13 @@ class Scheduler:
 
     def spent_by_tenant(self, engine):
         """Per-tenant committed tokens of the NEXT loop iteration (the
-        tenant-budget analogue of `spent_tokens`)."""
+        tenant-budget analogue of `spent_tokens`, with the same
+        speculative k+1 decode price)."""
+        dc = _decode_cost(engine)
         spent = {}
         for s in self.running:
             t = self._tenant_of(s)
-            spent[t] = spent.get(t, 0) + 1
+            spent[t] = spent.get(t, 0) + dc
         for s in self.prefilling:
             t = self._tenant_of(s)
             spent[t] = spent.get(t, 0) \

@@ -279,7 +279,8 @@ class LMServer(_HTTPFrontend):
                  replica_id=None, prefix_cache=None, tenant_budget=None,
                  tenant_budgets=None, default_priority=0,
                  default_deadline_ms=None, brownout=None,
-                 aot_cache=None, role=None):
+                 aot_cache=None, role=None, draft=None, spec=None,
+                 spec_k=None):
         adapter = _resolve_model(model, vocab=vocab, max_len=max_len,
                                  time_major=time_major)
         self.engine = Engine(adapter, max_batch=max_batch, max_len=max_len,
@@ -287,7 +288,8 @@ class LMServer(_HTTPFrontend):
                              keep_logits=keep_logits, paged=paged,
                              prefill_chunk=prefill_chunk, tp=tp,
                              devices=devices, prefix_cache=prefix_cache,
-                             aot_cache=aot_cache)
+                             aot_cache=aot_cache, draft=draft, spec=spec,
+                             spec_k=spec_k)
         self.scheduler = Scheduler(max_batch=max_batch, max_queue=max_queue,
                                    queue_timeout=queue_timeout,
                                    token_budget=token_budget,
@@ -708,6 +710,18 @@ class LMServer(_HTTPFrontend):
                 try:
                     if chaos.decode_poison(rid, it):
                         raise MXNetError("chaos: decode step poisoned")
+                    if eng.spec:
+                        # spec-poison seam: NaN-fill THIS iteration's
+                        # draft logits — the engine must degrade the
+                        # batch to the non-speculative path, token-
+                        # identical to the undisturbed oracle
+                        eng.chaos_spec_poison = chaos.spec_poison(rid, it)
+                    # pre-step lengths of the sequences decode_step will
+                    # return (it filters done ones in the same order):
+                    # a speculative step emits a BURST per sequence, so
+                    # tokens = post-len minus pre-len, not 1 per step
+                    pre_lens = [len(s.tokens) for s in sched.running
+                                if not s.done]
                     advanced = eng.decode_step(sched.running)
                 except Exception as e:
                     # a decode fault poisons the STEP, not the history:
@@ -726,18 +740,27 @@ class LMServer(_HTTPFrontend):
                     continue
                 self._last_step_t = time.perf_counter()
                 if advanced:  # count only sequences that really stepped
+                    emitted = sum(len(s.tokens) - n
+                                  for s, n in zip(advanced, pre_lens))
                     met.decode_step(len(advanced), eng.max_batch,
                                     time.perf_counter() - t0,
                                     cache_util=eng.cache_utilization(),
-                                    paged=eng.paged)
+                                    paged=eng.paged, tokens=emitted)
+                    if eng.last_spec is not None:
+                        met.spec_pass(**eng.last_spec)
+                        eng.last_spec = None
                     # per-request inter-token latency (ISSUE 13): the
                     # ITL SLO and the lifecycle ledger see every gap,
-                    # including the one a failover replay opened
-                    for s in advanced:
+                    # including the one a failover replay opened — a
+                    # speculative burst records one observation per
+                    # EMITTED token (the burst's interior gaps are ~0:
+                    # the client receives those tokens back-to-back)
+                    for s, n in zip(advanced, pre_lens):
                         if s.request is not None:
-                            met.token_generated(
-                                s.request, now=self._last_step_t,
-                                position=len(s.tokens) - 1)
+                            for posn in range(n, len(s.tokens)):
+                                met.token_generated(
+                                    s.request, now=self._last_step_t,
+                                    position=posn)
                 for req in (s.request for s in sched.evict(eng)
                             if s.request is not None):
                     met.request_finished(req)
@@ -838,7 +861,11 @@ class LMServer(_HTTPFrontend):
         one chunk always runs when nothing is decoding (progress)."""
         eng, sched, met = self.engine, self.scheduler, self.metrics
         budget = sched.token_budget
-        spent = len(sched.running)
+        # the decode batch's claim on this iteration, at the same price
+        # admission charges: k+1 scored tokens per speculating sequence.
+        # Pricing both sides identically is what keeps chunks and
+        # speculative decode from starving each other under one budget.
+        spent = eng.decode_tokens_per_step() * len(sched.running)
         for seq in list(sched.prefilling):
             if seq.done:
                 # detached by a router failover while this loop was

@@ -232,6 +232,53 @@ def _prefill_chunk_body(params, k_pool, v_pool, toks, qs, length,
     return k_pool, v_pool, logits
 
 
+def _spec_score_body(params, k_pool, v_pool, toks, q_starts, counts,
+                     tables, cfg, block_size):
+    """Per-chip half of `engine._tf_spec_score` (the speculative k+1
+    scoring pass): same position/null-block semantics, this chip's
+    heads only, psum on the two output projections. The residual stream
+    stays replicated after every psum, so every chip computes identical
+    (B, C, V) logits — greedy verification on the host sees the same
+    argmaxes whether the target is sharded or not (placement, never
+    logits)."""
+    from ..models.transformer import _layer_norm
+    from ..ops.pallas_paged import paged_attention
+    from .kv_cache import write_kv
+
+    B, C = toks.shape
+    D, H = cfg.d_model, cfg.n_heads
+    Dh = D // H
+    w = tables.shape[1]
+    pos = q_starts[:, None] + jnp.arange(C)[None, :]
+    valid = jnp.arange(C)[None, :] < counts[:, None]
+    pe = jnp.minimum(pos, cfg.max_len - 1)
+    x = params["embed"][toks] + params["pos_embed"][pe]        # (B,C,D)
+    blk = jnp.minimum(pos // block_size, w - 1)
+    slots = jnp.take_along_axis(tables, blk, axis=1) * block_size \
+        + pos % block_size
+    slots = jnp.where(valid, slots, pos % block_size)          # null blk
+    flat = slots.reshape(B * C)
+    for i in range(cfg.n_layers):
+        pre = "layer%d_" % i
+        h = _layer_norm(x, params[pre + "ln1_g"], params[pre + "ln1_b"])
+        q, kk, vv = _local_qkv(h.reshape(B * C, D),
+                               params[pre + "wqkv"], Dh)
+        k_pool, v_pool = write_kv(k_pool, v_pool, i, flat, kk, vv)
+        att = paged_attention(q.reshape(B, C, -1, Dh), k_pool[i],
+                              v_pool[i], tables,
+                              q_starts.astype(jnp.int32),
+                              block_size)                      # (B,C,Hl,Dh)
+        x = x + allreduce(att.reshape(B, C, -1) @ params[pre + "wo"],
+                          TP_AXIS)
+        h = _layer_norm(x, params[pre + "ln2_g"], params[pre + "ln2_b"])
+        x = x + allreduce(
+            jax.nn.relu(h @ params[pre + "w1"]) @ params[pre + "w2"],
+            TP_AXIS)
+    h = _layer_norm(x, params["lnf_g"], params["lnf_b"])
+    logits = (h @ params["head"]).astype(jnp.float32)          # (B,C,V)
+    return k_pool, v_pool, logits
+
+
 def build_tp_decode(cfg, block_size, mesh):
     """jit(shard_map(decode)) over the tp mesh. Signature matches the
     single-device `_decode_paged_jit`: (params, k, v, tokens, positions,
@@ -265,4 +312,23 @@ def build_tp_prefill_chunk(cfg, block_size, mesh):
         body, mesh,
         in_specs=(specs, pool, pool, P(None), P(), P(), P(), P(None)),
         out_specs=(pool, pool, P(None)),
+        check_vma=False))
+
+
+def build_tp_spec_score(cfg, block_size, mesh):
+    """jit(shard_map(spec_score)) over the tp mesh. Signature matches
+    the single-device `_spec_score_jit`: (params, k, v, tokens,
+    q_starts, counts, tables) -> (k, v, logits (B, C, V))."""
+    specs = tp_param_specs(cfg)
+    pool = kv_pool_spec()
+
+    def body(params, k, v, toks, qs, counts, tabs):
+        return _spec_score_body(params, k, v, toks, qs, counts, tabs,
+                                cfg, block_size)
+
+    return jax.jit(shard_map(
+        body, mesh,
+        in_specs=(specs, pool, pool, P(None, None), P(None), P(None),
+                  P(None, None)),
+        out_specs=(pool, pool, P(None, None, None)),
         check_vma=False))

@@ -75,6 +75,13 @@ only run under load), then latches (serve_crash_loop excepted):
                                         inside the engine-fault try):
                                         the batch's requests must be
                                         resumed, the loop must survive.
+  MXNET_CHAOS_SERVE_SPEC_POISON=<r>:<i> NaN-fill one iteration's DRAFT
+                                        logits on a speculating replica:
+                                        the engine must degrade that
+                                        batch to the non-speculative
+                                        path, token-identical to the
+                                        undisturbed oracle — no request
+                                        fails, no resume is spent.
   MXNET_CHAOS_SERVE_EXHAUST=<r>:<i>[:<n>] steal every free block of the
                                         replica's pool for n loop
                                         iterations (default 20):
@@ -134,7 +141,7 @@ SPIKE_POISON = 1.0e6
 #: serving faults: value is (replica, iteration[, extra]) — parsed from
 #: "r:i[:x]" env strings or passed as tuples to configure()
 _SERVE_FAULTS = ("serve_kill", "serve_crash_loop", "serve_wedge",
-                 "serve_poison", "serve_exhaust",
+                 "serve_poison", "serve_spec_poison", "serve_exhaust",
                  "serve_rollout_corrupt", "serve_rollout_slow_canary")
 
 
@@ -441,6 +448,16 @@ def decode_poison(replica, iteration):
     """Armed serve_poison: the loop raises inside its decode try block,
     exercising the batch-fault path (requests resumed, loop alive)."""
     return _should_serve("serve_poison", replica, iteration) is not None
+
+
+def spec_poison(replica, iteration):
+    """Armed serve_spec_poison: the loop arms the engine's
+    `chaos_spec_poison` flag for ONE iteration — the draft's logits
+    come out NaN and the engine must degrade that batch to the
+    non-speculative path (token-identical, `spec_fallbacks` counted),
+    never emit from garbage."""
+    return _should_serve("serve_spec_poison", replica,
+                         iteration) is not None
 
 
 def pool_exhaustion(replica, iteration):

@@ -77,10 +77,15 @@ _SMALLER_IS_BETTER = ("ms", "s", "us", "seconds")
 #: committed verdict is the in-leg baseline-vs-roles delta, not the
 #: absolute numbers. The live-rollout drill (ISSUE 18) likewise: its
 #: hard gate is zero requests lost (enforced by check_line, not the
-#: sentinel); the durations are contention-sensitive wall clock
+#: sentinel); the durations are contention-sensitive wall clock.
+#: Speculative decoding (ISSUE 19) too: its hard gates are the bench's
+#: own accepted-per-pass > 1.0 assert and check_line's k+1 ceiling;
+#: the wall-clock A/B inverts under CPU interpret (BENCH_NOTES r19
+#: prediction 2), so absolutes are warnings, never failures
 _WARN_ONLY_PREFIXES = ("serving_chaos_", "smoke_serving_chaos_",
                        "serving_disagg_", "smoke_serving_disagg_",
-                       "serving_rollout_", "smoke_serving_rollout_")
+                       "serving_rollout_", "smoke_serving_rollout_",
+                       "serving_spec_", "smoke_serving_spec_")
 
 
 def _device_class(line):

@@ -61,7 +61,8 @@ os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
 SERVE_FAULTS = ("chaos.serve_wedge", "chaos.serve_kill",
                 "chaos.serve_poison", "chaos.serve_exhaust",
-                "chaos.serve_crash_loop", "chaos.serve_rollout_corrupt")
+                "chaos.serve_crash_loop", "chaos.serve_rollout_corrupt",
+                "chaos.serve_spec_poison")
 
 
 def build_model():
@@ -381,6 +382,45 @@ def main():
           "gate (probe=digest), %d live requests untouched, fleet "
           "stays on the incumbent" % len(ro_results))
     telemetry.flight().dump("phase_rollout")
+
+    # -- fault 7: speculative-decoding draft poison (ISSUE 19) --------------
+    # a dedicated spec-enabled replica (1-layer self-draft, k=3): NaN
+    # draft logits on one decode iteration must DEGRADE that pass to
+    # the verbatim non-speculative path — the request completes
+    # greedy-token-identical to the undisturbed oracle, no request
+    # fails, no resume is spent, and the fallback is COUNTED
+    from mxnet_tpu.serving.spec import self_draft
+    spec_srv = serving.LMServer(model, max_batch=4, block_size=8,
+                                paged=True,
+                                draft=self_draft(params, _cfg, 1),
+                                spec_k=3, replica_id=7)
+    assert spec_srv.engine.spec, (
+        "spec replica fell back: %r" % spec_srv.engine.spec_fallback)
+    chaos.configure(serve_spec_poison=(7, 1))
+    try:
+        got = spec_srv.generate(list(pin_poison[0]),
+                                max_new_tokens=pin_poison[1],
+                                timeout=300)
+        assert got == want_poison, (
+            "spec poison degrade diverged: %r != %r"
+            % (got, want_poison))
+        assert "serve_spec_poison" in chaos.fired(), (
+            "spec poison never fired")
+        assert spec_srv.engine.spec_fallbacks >= 1, (
+            "poisoned pass was not counted as a spec fallback")
+        assert spec_srv.engine.spec_accepted_tokens >= 1, (
+            "spec replica never speculated after the degrade")
+        wait_for(lambda: not spec_srv.engine.cache.pool.in_use, 30,
+                 "spec replica pool quiescent")
+        spec_srv.engine.audit_quiescent()
+    finally:
+        spec_srv.close()
+    print("-- fault 7: spec replica's draft poisoned (NaN logits); pass "
+          "degraded to non-spec, token-identical, fallback counted "
+          "(fallbacks=%d, accepted=%d after recovery)"
+          % (spec_srv.engine.spec_fallbacks,
+             spec_srv.engine.spec_accepted_tokens))
+    telemetry.flight().dump("phase_spec_poison")
 
     # -- leak audit: every pool quiescent, incl. the crashed engines --------
     stop_sweep.set()

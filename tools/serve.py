@@ -125,6 +125,20 @@ def main():
                          "roll back with zero requests lost (default: "
                          "MXNET_SERVING_ROLLOUT_DIR or off; drive "
                          "overrides with tools/rollout.py)")
+    ap.add_argument("--draft", type=int, default=None, metavar="N",
+                    help="speculative decoding with a truncated SELF-"
+                         "draft: the first N layers of the served model "
+                         "propose --spec-k tokens per iteration and the "
+                         "full model scores k+1 positions in one paged "
+                         "pass — greedy verification keeps output "
+                         "token-identical to plain decode (default: "
+                         "MXNET_SPEC_DECODE/MXNET_SPEC_DRAFT_LAYERS; "
+                         "needs the paged path; ineligible configs "
+                         "fall back with the reason printed)")
+    ap.add_argument("--spec-k", type=int, default=None,
+                    help="draft tokens proposed per decode iteration "
+                         "(default: MXNET_SPEC_K or 4; admission prices "
+                         "a speculating sequence at k+1 tokens)")
     ap.add_argument("--roles", default=None, metavar="SPEC",
                     help="disaggregated fleet layout 'prefill:N,"
                          "decode:M': prefill replicas absorb prompt "
@@ -135,6 +149,12 @@ def main():
                          "is ignored (default: MXNET_SERVING_ROLES or "
                          "off)")
     args = ap.parse_args()
+    if args.draft is not None:
+        # route through the env knobs so every construction path (single
+        # server, router respawn, autoscale grow, rollout canary) builds
+        # the same self-draft from its own copy of the weights
+        os.environ["MXNET_SPEC_DECODE"] = "1"
+        os.environ["MXNET_SPEC_DRAFT_LAYERS"] = str(args.draft)
     if args.min_replicas is not None:
         os.environ["MXNET_SERVING_MIN_REPLICAS"] = str(args.min_replicas)
     if args.max_replicas is not None:
@@ -178,7 +198,8 @@ def main():
                   aot_cache=args.aot_cache,
                   autoscale=args.autoscale,
                   roles=args.roles,
-                  rollout=args.rollout_dir)
+                  rollout=args.rollout_dir,
+                  spec_k=args.spec_k)
     if args.respawn_max is not None:
         n = (args.replicas if args.replicas is not None
              else serving.serving_replicas())
@@ -217,6 +238,16 @@ def main():
         print("prefix cache: OFF — %s" % eng.prefix_cache_fallback)
     else:
         print("prefix cache: off")
+    if eng.spec:
+        print("speculative decoding: on — k=%d, %d-layer draft "
+              "(greedy verification: flag switches speed, never "
+              "logits; admission prices each sequence at k+1)"
+              % (eng.spec_k, eng.draft.cfg.n_layers))
+    elif eng.spec_fallback:
+        print("speculative decoding: OFF — %s" % eng.spec_fallback)
+    else:
+        print("speculative decoding: off (--draft N --spec-k K, or "
+              "MXNET_SPEC_DECODE=1 + MXNET_SPEC_DRAFT_LAYERS=N)")
     print("tenants: budget=%s tokens/iteration, default priority=%d "
           "(per-request 'tenant'/'priority' JSON fields accepted)"
           % (first.scheduler.tenant_budget or "unbounded",
