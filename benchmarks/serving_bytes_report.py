@@ -56,7 +56,8 @@ import time
 import numpy as np
 
 
-def build_engine(paged, max_len, batch, cfg_kw, block_size=16, tp=None):
+def build_engine(paged, max_len, batch, cfg_kw, block_size=16, tp=None,
+                 kv_quant=None):
     import jax
     from mxnet_tpu import serving
     from mxnet_tpu.models.transformer import (TransformerConfig,
@@ -65,7 +66,7 @@ def build_engine(paged, max_len, batch, cfg_kw, block_size=16, tp=None):
     params = init_transformer_params(jax.random.PRNGKey(0), cfg)
     model = serving.TransformerLM(params, cfg)
     eng = serving.Engine(model, max_batch=batch, block_size=block_size,
-                         paged=paged, tp=tp)
+                         paged=paged, tp=tp, kv_quant=kv_quant)
     return eng, model
 
 
@@ -259,6 +260,65 @@ def main():
                      r["bytes_accessed"] / b1,
                      r["declared_kernel_bytes_per_chip_per_layer"]),
                   file=sys.stderr)
+
+    # --- quantized-KV leg (ISSUE 20): f32 vs int8 pool, same step ------
+    # The decision signal is the kernel's DECLARED per-call bytes
+    # (paged_call_cost at kv_itemsize=1 + scale sidecars — exact
+    # arithmetic, no interpreter); the compiled cost-model line rides
+    # along with the usual CPU staging-inflation disclosure. The pool-
+    # layout ratio (Engine.kv_bytes_per_token) is the resident-
+    # sequences-per-chip headline bench_serving_quant measures.
+    if os.environ.get("SERVING_BYTES_QUANT", "1") == "1":
+        import jax.numpy as jnp
+        eng_q, model_q = build_engine(True, t_max, batch, cfg_kw,
+                                      block_size, kv_quant=True)
+        assert eng_q.kv_quant, eng_q.kv_quant_fallback
+        toks, pos, tabs = decode_args(eng_q, true_lens, w_paged)
+        args = (model_q.params, eng_q.cache.k, eng_q.cache.v,
+                jnp.asarray(toks), jnp.asarray(pos), jnp.asarray(tabs),
+                eng_q.cache.k_scale, eng_q.cache.v_scale)
+        t0 = time.perf_counter()
+        cost = model_q._decode_paged_q_jit.lower(*args).compile() \
+            .cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0] if cost else {}
+        fl4, by4 = paged_call_cost(batch, 1, cfg_heads, cfg_dh,
+                                   w_paged, block_size)
+        fl8, by8 = paged_call_cost(batch, 1, cfg_heads, cfg_dh,
+                                   w_paged, block_size, kv_itemsize=1,
+                                   scale_blocks=eng_q.cache.num_blocks)
+        eng_f, _ = build_engine(True, t_max, batch, cfg_kw, block_size)
+        qrow = {
+            "path": "paged", "kv_quant": "int8", "tp": 1,
+            "padded_T": t_max, "table_width": w_paged,
+            "true_lens": list(true_lens),
+            "flops": cost.get("flops"),
+            "bytes_accessed": cost.get("bytes accessed"),
+            "compile_s": round(time.perf_counter() - t0, 1),
+            "declared_kernel_bytes_per_layer_f32": by4,
+            "declared_kernel_bytes_per_layer_int8": by8,
+            "kv_bytes_per_token_f32": eng_f.kv_bytes_per_token(),
+            "kv_bytes_per_token_int8": eng_q.kv_bytes_per_token(),
+            "device": getattr(dev, "device_kind", dev.platform),
+        }
+        if interp:
+            qrow["note"] = ("Pallas interpreter staging inflates "
+                            "absolute bytes on CPU (the int8 blocks "
+                            "are staged through f32 copies) — the "
+                            "DECLARED kernel bytes and the pool-layout "
+                            "bytes/token are the decision signals; "
+                            "absolute cost-model bytes are TPU-only")
+        print(json.dumps(qrow), flush=True)
+        print("\nquant leg (int8 KV pool, per decode call/layer):\n"
+              "declared kernel bytes  f32 %d  int8 %d  ratio %.2fx\n"
+              "pool bytes/token       f32 %d  int8 %d  ratio %.2fx "
+              "(resident-sequences multiplier at fixed pool HBM)"
+              % (by4, by8, by8 / by4,
+                 qrow["kv_bytes_per_token_f32"],
+                 qrow["kv_bytes_per_token_int8"],
+                 qrow["kv_bytes_per_token_int8"]
+                 / qrow["kv_bytes_per_token_f32"]),
+              file=sys.stderr)
 
 
 if __name__ == "__main__":

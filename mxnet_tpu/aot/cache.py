@@ -61,7 +61,8 @@ SUFFIX = ".mxaot"
 #: so a mismatch is a MISS, not an error)
 _FLAG_ENV = ("XLA_FLAGS", "LIBTPU_INIT_ARGS", "JAX_ENABLE_X64",
              "MXNET_PAGED_ATTENTION", "MXNET_PALLAS_INTERPRET",
-             "MXNET_SERVING_TP")
+             "MXNET_SERVING_TP", "MXNET_QUANTIZED_KV",
+             "MXNET_QUANTIZED_WEIGHTS")
 
 
 class CorruptEntry(MXNetError):

@@ -66,7 +66,7 @@ def paged_enabled():
 
 
 def paged_call_cost(B, Tq, H, Dh, w, block_size, kv_itemsize=4,
-                    q_itemsize=4):
+                    q_itemsize=4, scale_blocks=0):
     """Declared (flops, bytes) of ONE paged_attention call — the
     CostEstimate `_make_paged` hands XLA, factored out so instruments
     (benchmarks/serving_bytes_report.py) can cite the same numbers.
@@ -74,36 +74,57 @@ def paged_call_cost(B, Tq, H, Dh, w, block_size, kv_itemsize=4,
     (serving/tp.py) each chip runs the kernel over its H/k local heads
     of the pool shard, so the declared per-chip bytes scale ~1/k by this
     very formula — tables/q_start (replicated int32) are the only terms
-    that don't."""
+    that don't. A quantized pool passes `kv_itemsize=1` plus
+    `scale_blocks=num_blocks` (the f32 scale sidecars are scalar-
+    prefetched whole, once per call): the dominant K/V block term shrinks
+    4x by construction, which the committed cost-model A/B proves."""
     nk = B * H * w * block_size           # pool tokens touched
     flops = 4 * nk * Tq * Dh              # 2 MACs/pair for QK and PV
     bytes_ = (2 * nk * Dh * kv_itemsize           # K + V blocks walked
               + 2 * B * Tq * H * Dh * q_itemsize  # q in, out back
+              + 2 * scale_blocks * H * 4          # k/v scale sidecars
               + B * w * 4 + B * 4)                # tables + q_start
     return flops, bytes_
 
 
-def paged_eligible(head_dim, block_size, n_queries, interpret):
+def paged_eligible(head_dim, block_size, n_queries, interpret,
+                   quant=False):
     """Gate for the compiled (Mosaic) kernel; interpreter mode takes any
     shape. On real hardware stay off the (8, 128) VMEM tiling grid's bad
     cases: the lane dim (head_dim) must be a multiple of 128 and the
     sublane dims (block_size, and the query block for prefill chunks)
     multiples of 8 — callers fall back to the XLA gather path otherwise.
+    An int8 pool (`quant`) tiles (32, 128), so its block_size must be a
+    multiple of 32 — ineligible quant configs fall back to the f32 pool
+    (the precision contract's oracle), not to a different kernel.
     """
     if interpret:
         return True
-    return (head_dim % 128 == 0 and block_size % 8 == 0
-            and (n_queries == 1 or n_queries % 8 == 0))
+    if head_dim % 128 != 0 or (n_queries != 1 and n_queries % 8 != 0):
+        return False
+    return block_size % (32 if quant else 8) == 0
 
 
-def _kernel(tab_ref, qs_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
-            acc_scr, *, scale, block_size, nw, tq):
+def _kernel(tab_ref, qs_ref, *rest, scale, block_size, nw, tq,
+            quant=False):
     """One (batch row b, head h, table slot j) grid step: fold pool block
     `tab[b, j]` into row b's online softmax. Scratch carries the
-    accumulator across the innermost (j) dimension."""
+    accumulator across the innermost (j) dimension. With `quant` the
+    pool refs hold int8 and two extra scalar-prefetched (num_blocks, H)
+    f32 refs carry the per-block-per-head scales: the block is
+    dequantized HERE, in VMEM, after the 1-byte-per-element DMA — the
+    HBM read stays int8-sized."""
     from jax.experimental import pallas as pl
 
+    if quant:
+        (ksc_ref, vsc_ref, q_ref, k_ref, v_ref, o_ref,
+         m_scr, l_scr, acc_scr) = rest
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = rest
+        ksc_ref = vsc_ref = None
+
     b = pl.program_id(0)
+    h = pl.program_id(1)
     j = pl.program_id(2)
 
     @pl.when(j == 0)
@@ -121,6 +142,9 @@ def _kernel(tab_ref, qs_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
     def _accumulate():
         q = q_ref[0, :, 0].astype(jnp.float32)            # [tq, Dh]
         k = k_ref[0, :, 0].astype(jnp.float32)            # [bs, Dh]
+        if quant:
+            # live implies j <= last, so tab[b, j] is this very block
+            k = k * ksc_ref[tab_ref[b, j], h]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         # ragged mask: key at table position j*bs+t is live for query i
@@ -141,6 +165,8 @@ def _kernel(tab_ref, qs_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
         alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe),
                           0.0)
         v = v_ref[0, :, 0].astype(jnp.float32)            # [bs, Dh]
+        if quant:
+            v = v * vsc_ref[tab_ref[b, j], h]
         acc_scr[...] = alpha * acc_scr[...] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -154,61 +180,70 @@ def _kernel(tab_ref, qs_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
 
 
 @functools.lru_cache(maxsize=None)
-def _make_paged(scale, block_size, interpret):
-    """Build the traced kernel entry for one (scale, block_size) static
-    configuration — cached so every layer of every decode/prefill
+def _make_paged(scale, block_size, interpret, quant=False):
+    """Build the traced kernel entry for one (scale, block_size, quant)
+    static configuration — cached so every layer of every decode/prefill
     signature shares one traced op (the _make_flash pattern)."""
 
-    def call(q, k_pool, v_pool, tables, q_start):
+    def call(q, k_pool, v_pool, tables, q_start, k_scale=None,
+             v_scale=None):
         from jax.experimental import pallas as pl
         from jax.experimental.pallas import tpu as pltpu
 
         B, Tq, H, Dh = q.shape
         w = tables.shape[1]
         itemsize = jnp.dtype(k_pool.dtype).itemsize
+        # index maps see every scalar-prefetch operand as a trailing ref
+        n_pref = 4 if quant else 2
 
-        def kv_idx(b, h, j, tab_ref, qs_ref):
+        def kv_idx(b, h, j, tab_ref, qs_ref, *_scales):
             # dead slots re-read the row's last live block: Pallas skips
             # the DMA when consecutive grid steps map to the same block
             last = jnp.maximum(qs_ref[b] + Tq - 1, 0) // block_size
             return (tab_ref[b, jnp.minimum(j, last)], 0, h, 0)
 
+        def q_idx(b, h, j, *_pref):
+            return (b, 0, h, 0)
+
         grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=n_pref,
             grid=(B, H, w),
             in_specs=[
-                pl.BlockSpec((1, Tq, 1, Dh),
-                             lambda b, h, j, t, s: (b, 0, h, 0)),
+                pl.BlockSpec((1, Tq, 1, Dh), q_idx),
                 pl.BlockSpec((1, block_size, 1, Dh), kv_idx),
                 pl.BlockSpec((1, block_size, 1, Dh), kv_idx),
             ],
-            out_specs=pl.BlockSpec((1, Tq, 1, Dh),
-                                   lambda b, h, j, t, s: (b, 0, h, 0)),
+            out_specs=pl.BlockSpec((1, Tq, 1, Dh), q_idx),
             scratch_shapes=[pltpu.VMEM((Tq, 1), jnp.float32),
                             pltpu.VMEM((Tq, 1), jnp.float32),
                             pltpu.VMEM((Tq, Dh), jnp.float32)],
         )
         kern = functools.partial(_kernel, scale=scale,
-                                 block_size=block_size, nw=w, tq=Tq)
+                                 block_size=block_size, nw=w, tq=Tq,
+                                 quant=quant)
         # 2 MACs/flop-pair per element for each of the QK and PV
         # matmuls; bytes = K+V blocks walked + q/out + the tables
         # (paged_call_cost — shared with the bytes-report instrument)
         flops, bytes_ = paged_call_cost(
             B, Tq, H, Dh, w, block_size, kv_itemsize=itemsize,
-            q_itemsize=jnp.dtype(q.dtype).itemsize)
+            q_itemsize=jnp.dtype(q.dtype).itemsize,
+            scale_blocks=k_pool.shape[0] if quant else 0)
+        operands = ((tables, q_start, k_scale, v_scale) if quant
+                    else (tables, q_start))
         return pl.pallas_call(
             kern,
             grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
             interpret=interpret,
             **_cost(flops, bytes_),
-        )(tables, q_start, q, k_pool, v_pool)
+        )(*operands, q, k_pool, v_pool)
 
     return call
 
 
 def paged_attention(q, k_pool, v_pool, tables, q_start, block_size,
-                    scale=None, interpret=None):
+                    scale=None, interpret=None, k_scale=None,
+                    v_scale=None):
     """Ragged paged attention against a contiguous-per-layer block pool.
 
     q:       (B, Tq, H, Dh) query block — Tq=1 for decode, Tq=chunk for
@@ -220,6 +255,12 @@ def paged_attention(q, k_pool, v_pool, tables, q_start, block_size,
              blocks).
     q_start: (B,) int32 true position of each row's FIRST query token
              (for decode: the sequence's current last position).
+    k_scale, v_scale: (num_blocks, H) f32 per-block-per-head scales for
+             an INT8 pool (serving/kv_cache.py `kv_dtype="int8"`). When
+             given, blocks DMA as int8 and are dequantized in VMEM
+             inside the grid step — the per-step HBM read is
+             1 byte/element instead of 4, declared as such in the
+             CostEstimate.
 
     Returns (B, Tq, H, Dh) attention outputs; per-sequence keys past
     position q_start+i are masked, so padded table entries and pool
@@ -231,5 +272,11 @@ def paged_attention(q, k_pool, v_pool, tables, q_start, block_size,
     B, Tq, H, Dh = q.shape
     if scale is None:
         scale = 1.0 / math.sqrt(Dh)
-    return _make_paged(float(scale), int(block_size), bool(interpret))(
-        q, k_pool, v_pool, tables, q_start)
+    quant = k_scale is not None
+    call = _make_paged(float(scale), int(block_size), bool(interpret),
+                       quant)
+    if quant:
+        return call(q, k_pool, v_pool, tables, q_start,
+                    k_scale.astype(jnp.float32),
+                    v_scale.astype(jnp.float32))
+    return call(q, k_pool, v_pool, tables, q_start)

@@ -49,8 +49,17 @@ def dequantize(data, min_range, max_range, out_type="float32"):
     amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
     if data.dtype == jnp.int8:
         scale = amax / _INT8_RANGE
-    else:  # int32 accumulators: range maps the full int32 span
-        scale = amax / float(2 ** 31 - 1)
+    else:
+        # int32 accumulators of s8 x s8 products: the sidecar carries
+        # amax_a * amax_b (see _int32_range_of_product), and the true
+        # per-unit scale is the PRODUCT of the two input scales,
+        # (amax_a/127) * (amax_b/127) — NOT amax / (2^31 - 1). The MXU
+        # accumulator never spans the full int32 range; mapping the
+        # sidecar onto 2^31-1 silently shrank every dequantized value
+        # by ~1.3e5x, which requantize() then "calibrated" away, hiding
+        # the bug from roundtrips but poisoning any path that composes
+        # quantized matmuls on the raw dequantized values.
+        scale = amax / (_INT8_RANGE * _INT8_RANGE)
     return data.astype(jnp.float32) * scale
 
 
@@ -70,14 +79,60 @@ def requantize(data, min_range, max_range, min_calib_range=None,
 
 
 def _int32_range_of_product(min_a, max_a, min_b, max_b, inner):
-    """Output (min,max) convention for int32 accumulators: the range that
-    maps the int32 span onto real values (reference
+    """Output (min,max) sidecar for int32 accumulators of s8 x s8
+    products: carries amax_a * amax_b, so `dequantize`'s int32 branch
+    (scale = amax / 127^2) recovers exactly scale_a * scale_b — the true
+    per-unit value of one accumulator count (reference
     quantization_utils.h GetQuantizedToFloatScale composition)."""
     scale_a = _q_scale(min_a, max_a)
     scale_b = _q_scale(min_b, max_b)
     real_per_unit = 1.0 / (scale_a * scale_b)
-    amax = real_per_unit * float(2 ** 31 - 1)
+    amax = real_per_unit * (_INT8_RANGE * _INT8_RANGE)
     return -amax, amax
+
+
+def quantize_channelwise(w, axis=-1):
+    """Per-channel symmetric int8: one f32 scale per slice of `axis`
+    (every other axis reduced). Returns (q int8, scales f32) with
+    scales shaped like `axis`'s extent — the quantized-weights serving
+    path quantizes each output channel independently so a single
+    outlier column cannot blunt the whole matrix."""
+    red = tuple(i for i in range(w.ndim) if i != axis % w.ndim)
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=red)
+    s = jnp.maximum(amax, 1e-12) / _INT8_RANGE
+    shape = [1] * w.ndim
+    shape[axis % w.ndim] = -1
+    q = jnp.clip(jnp.rint(w.astype(jnp.float32) / s.reshape(shape)),
+                 -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def dynamic_quant_matmul(x, w_q, w_s):
+    """x (.., I) f32/bf16 @ per-output-channel int8 weight (I, O): the
+    activation is quantized per-ROW on the fly (symmetric, its own
+    scale), the contraction runs s8 x s8 -> s32 on the MXU
+    (preferred_element_type), and the accumulator dequantizes by the
+    PRODUCT of the two scales — the same convention `dequantize`'s
+    int32 branch pins. Returns f32; callers cast back to the residual
+    dtype."""
+    xf = x.astype(jnp.float32)
+    ax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    sx = jnp.maximum(ax, 1e-12) / _INT8_RANGE
+    xq = jnp.clip(jnp.rint(xf / sx), -127, 127).astype(jnp.int8)
+    acc = lax.dot_general(xq, w_q, (((x.ndim - 1,), (0,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) * sx * w_s
+
+
+def maybe_quant_matmul(x, w):
+    """Matmul against a possibly-quantized weight: plain arrays go
+    straight through `x @ w` (tracing byte-identical to the
+    pre-quantization program); a `{"q": int8, "s": f32}` dict (the
+    serving weight-quant param layout) routes through
+    `dynamic_quant_matmul` and casts back to the residual dtype."""
+    if isinstance(w, dict):
+        return dynamic_quant_matmul(x, w["q"], w["s"]).astype(x.dtype)
+    return x @ w
 
 
 @register("_contrib_quantized_fully_connected", num_outputs=3,

@@ -172,6 +172,32 @@ def batch_predict(forward, sig_shape, inputs):
     return outs
 
 
+def quantize_lm_params(params, n_layers, mode="int8",
+                       names=("wqkv", "wo", "w1", "w2")):
+    """Quantize a transformer-LM parameter dict ONCE at load (ISSUE 20):
+    per-output-channel symmetric int8 for each layer's 2-D matmul
+    weights, each becoming a `{"q": int8, "s": f32-per-channel}` dict
+    that `maybe_quant_matmul` consumes at serving time. Embeddings,
+    positional table, layer norms, and the LM head stay f32 (small, and
+    the final projection dominates the logit-error budget); 3-D MoE
+    expert stacks stay f32 too. Returns a NEW dict — the caller keeps
+    the f32 originals for the oracle / tp shard placement."""
+    if str(mode) != "int8":
+        raise MXNetError("weight quantization mode %r is not supported "
+                         "(int8 or None)" % (mode,))
+    from .ops.quantization import quantize_channelwise
+    out = dict(params)
+    for i in range(int(n_layers)):
+        pre = "layer%d_" % i
+        for name in names:
+            w = out.get(pre + name)
+            if w is None or getattr(w, "ndim", 0) != 2:
+                continue
+            q, s = quantize_channelwise(w, axis=1)
+            out[pre + name] = {"q": q, "s": s}
+    return out
+
+
 def _pure_fn_from(model, params=None):
     """(fn(*raw_inputs) -> tuple of raw outputs, input_names)."""
     from .symbol import Symbol

@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import contextlib
 import math
+import os
 import time
 import weakref
 
@@ -57,9 +58,27 @@ import jax.numpy as jnp
 
 from ..base import MXNetError
 from .. import telemetry
+from ..ops.quantization import maybe_quant_matmul as _mm
 from .kv_cache import (PagedKVCache, flat_slots, prompt_slots, write_kv,
-                       gather_kv, copy_block)
+                       gather_kv, copy_block, write_kv_quant,
+                       copy_block_quant, zero_block_scales)
 from .prefix_cache import PrefixCache, prefix_cache_enabled
+
+
+def quantized_kv_enabled():
+    """MXNET_QUANTIZED_KV=1 requests the int8 KV block pool — read when
+    an Engine is constructed (docs/ENV_VARS.md). Ineligible configs
+    fall back to the verbatim f32 pool with the reason recorded on
+    `Engine.kv_quant_fallback`."""
+    return os.environ.get("MXNET_QUANTIZED_KV", "") == "1"
+
+
+def quantized_weights_env():
+    """MXNET_QUANTIZED_WEIGHTS=int8 requests weight quantization at
+    load — read when an Engine is constructed (docs/ENV_VARS.md).
+    Unset/empty = f32 weights."""
+    v = os.environ.get("MXNET_QUANTIZED_WEIGHTS", "").strip()
+    return v or None
 
 
 def pow2_bucket(n, lo=1, hi=None):
@@ -118,7 +137,8 @@ def _ffn(params, pre, x, cfg):
     if cfg.n_experts:
         return _moe_ffn(x, params[pre + "wg"], params[pre + "w1"],
                         params[pre + "w2"])
-    return jax.nn.relu(x @ params[pre + "w1"]) @ params[pre + "w2"]
+    return _mm(jax.nn.relu(_mm(x, params[pre + "w1"])),
+               params[pre + "w2"])
 
 
 def _tf_prefill(params, k_pool, v_pool, tokens, length, table_row, cfg,
@@ -140,7 +160,7 @@ def _tf_prefill(params, k_pool, v_pool, tokens, length, table_row, cfg,
     for i in range(cfg.n_layers):
         pre = "layer%d_" % i
         h = _layer_norm(x, params[pre + "ln1_g"], params[pre + "ln1_b"])
-        qkv = h @ params[pre + "wqkv"]
+        qkv = _mm(h, params[pre + "wqkv"])
         q, kk, vv = jnp.split(qkv, 3, axis=-1)
         kh = kk.reshape(S, H, Dh)
         vh = vv.reshape(S, H, Dh)
@@ -149,7 +169,8 @@ def _tf_prefill(params, k_pool, v_pool, tokens, length, table_row, cfg,
             q.reshape(S, H, Dh).transpose(1, 0, 2)[None],
             kh.transpose(1, 0, 2)[None],
             vh.transpose(1, 0, 2)[None], causal=True)              # (1,H,S,Dh)
-        x = x + att[0].transpose(1, 0, 2).reshape(S, D) @ params[pre + "wo"]
+        x = x + _mm(att[0].transpose(1, 0, 2).reshape(S, D),
+                    params[pre + "wo"])
         h = _layer_norm(x, params[pre + "ln2_g"], params[pre + "ln2_b"])
         x = x + _ffn(params, pre, h[None], cfg)[0]
     h_last = _layer_norm(x[length - 1], params["lnf_g"], params["lnf_b"])
@@ -178,7 +199,7 @@ def _tf_decode(params, k_pool, v_pool, tokens, positions, tables, cfg,
     for i in range(cfg.n_layers):
         pre = "layer%d_" % i
         h = _layer_norm(x, params[pre + "ln1_g"], params[pre + "ln1_b"])
-        qkv = h @ params[pre + "wqkv"]
+        qkv = _mm(h, params[pre + "wqkv"])
         q, kk, vv = jnp.split(qkv, 3, axis=-1)
         qh = q.reshape(B, H, Dh)
         k_pool, v_pool = write_kv(k_pool, v_pool, i,
@@ -192,7 +213,7 @@ def _tf_decode(params, k_pool, v_pool, tokens, positions, tables, cfg,
         s = jnp.where(live[:, None, :], s, -jnp.inf)
         p = jax.nn.softmax(s, axis=-1)
         att = jnp.einsum("bht,bthd->bhd", p, vs.astype(p.dtype))
-        x = x + att.astype(x.dtype).reshape(B, D) @ params[pre + "wo"]
+        x = x + _mm(att.astype(x.dtype).reshape(B, D), params[pre + "wo"])
         h = _layer_norm(x, params[pre + "ln2_g"], params[pre + "ln2_b"])
         x = x + _ffn(params, pre, h[:, None], cfg)[:, 0]
     h = _layer_norm(x, params["lnf_g"], params["lnf_b"])
@@ -201,16 +222,23 @@ def _tf_decode(params, k_pool, v_pool, tokens, positions, tables, cfg,
 
 
 def _tf_decode_paged(params, k_pool, v_pool, tokens, positions, tables,
-                     cfg, block_size):
+                     cfg, block_size, k_scale=None, v_scale=None):
     """One decode step via the ragged paged-attention kernel: same
     contract as `_tf_decode`, but the per-layer cache read is a single
     Pallas kernel walking the block table in place (ops/pallas_paged.py)
     — no dense (B, T, H, Dh) gather is materialized. `tables` is
     width-bucketed by the caller to the longest live sequence, so the
-    compiled program's bytes track true lengths, not max_len."""
+    compiled program's bytes track true lengths, not max_len.
+
+    With `k_scale`/`v_scale` (ISSUE 20: the int8 pool's per-block-per-
+    head f32 sidecars) the appends quantize via `write_kv_quant` and the
+    kernel dequantizes in VMEM; the branch is trace-time, so the
+    flag-off program is byte-identical to the f32 path, and the return
+    grows to (k, v, k_scale, v_scale, logits, next)."""
     from ..models.transformer import _layer_norm
     from ..ops.pallas_paged import paged_attention
 
+    quant = k_scale is not None
     B = tokens.shape[0]
     D, H = cfg.d_model, cfg.n_heads
     Dh = D // H
@@ -219,24 +247,37 @@ def _tf_decode_paged(params, k_pool, v_pool, tokens, positions, tables,
     for i in range(cfg.n_layers):
         pre = "layer%d_" % i
         h = _layer_norm(x, params[pre + "ln1_g"], params[pre + "ln1_b"])
-        qkv = h @ params[pre + "wqkv"]
+        qkv = _mm(h, params[pre + "wqkv"])
         q, kk, vv = jnp.split(qkv, 3, axis=-1)
-        k_pool, v_pool = write_kv(k_pool, v_pool, i,
-                                  slots, kk.reshape(B, H, Dh),
-                                  vv.reshape(B, H, Dh))
-        att = paged_attention(q.reshape(B, 1, H, Dh), k_pool[i],
-                              v_pool[i], tables, positions,
-                              block_size)[:, 0]                    # (B,H,Dh)
-        x = x + att.reshape(B, D) @ params[pre + "wo"]
+        if quant:
+            k_pool, v_pool, k_scale, v_scale = write_kv_quant(
+                k_pool, v_pool, k_scale, v_scale, i, slots,
+                kk.reshape(B, H, Dh), vv.reshape(B, H, Dh))
+            att = paged_attention(q.reshape(B, 1, H, Dh), k_pool[i],
+                                  v_pool[i], tables, positions,
+                                  block_size, k_scale=k_scale[i],
+                                  v_scale=v_scale[i])[:, 0]
+        else:
+            k_pool, v_pool = write_kv(k_pool, v_pool, i,
+                                      slots, kk.reshape(B, H, Dh),
+                                      vv.reshape(B, H, Dh))
+            att = paged_attention(q.reshape(B, 1, H, Dh), k_pool[i],
+                                  v_pool[i], tables, positions,
+                                  block_size)[:, 0]                # (B,H,Dh)
+        x = x + _mm(att.reshape(B, D), params[pre + "wo"])
         h = _layer_norm(x, params[pre + "ln2_g"], params[pre + "ln2_b"])
         x = x + _ffn(params, pre, h[:, None], cfg)[:, 0]
     h = _layer_norm(x, params["lnf_g"], params["lnf_b"])
     logits = (h @ params["head"]).astype(jnp.float32)              # (B, V)
-    return k_pool, v_pool, logits, jnp.argmax(logits, -1).astype(jnp.int32)
+    nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+    if quant:
+        return k_pool, v_pool, k_scale, v_scale, logits, nxt
+    return k_pool, v_pool, logits, nxt
 
 
 def _tf_prefill_chunk(params, k_pool, v_pool, toks, qs, length, last_idx,
-                      table_row, cfg, block_size):
+                      table_row, cfg, block_size, k_scale=None,
+                      v_scale=None):
     """One fixed-shape prefill chunk for ONE sequence: toks (C,) are the
     prompt tokens at positions qs..qs+C-1 (zero-padded past the true
     prompt `length`), table_row (w,) is the sequence's width-bucketed
@@ -255,6 +296,7 @@ def _tf_prefill_chunk(params, k_pool, v_pool, toks, qs, length, last_idx,
     from ..models.transformer import _layer_norm
     from ..ops.pallas_paged import paged_attention
 
+    quant = k_scale is not None
     C = toks.shape[0]
     D, H = cfg.d_model, cfg.n_heads
     Dh = D // H
@@ -265,27 +307,43 @@ def _tf_prefill_chunk(params, k_pool, v_pool, toks, qs, length, last_idx,
     slots = jnp.where(pos < length, slots, pos % block_size)       # null blk
     tables = table_row[None]                                       # (1, w)
     qs_row = jnp.reshape(qs, (1,)).astype(jnp.int32)
+    # a contiguous C-token chunk touches at most ceil-plus-straddle
+    # blocks plus the null block — a tight candidate set keeps the
+    # requantizing writer's gather/scatter small
+    ncand = (C - 1) // block_size + 2
     for i in range(cfg.n_layers):
         pre = "layer%d_" % i
         h = _layer_norm(x, params[pre + "ln1_g"], params[pre + "ln1_b"])
-        qkv = h @ params[pre + "wqkv"]
+        qkv = _mm(h, params[pre + "wqkv"])
         q, kk, vv = jnp.split(qkv, 3, axis=-1)
-        k_pool, v_pool = write_kv(k_pool, v_pool, i,
-                                  slots, kk.reshape(C, H, Dh),
-                                  vv.reshape(C, H, Dh))
-        att = paged_attention(q.reshape(C, H, Dh)[None], k_pool[i],
-                              v_pool[i], tables, qs_row,
-                              block_size)[0]                       # (C,H,Dh)
-        x = x + att.reshape(C, D) @ params[pre + "wo"]
+        if quant:
+            k_pool, v_pool, k_scale, v_scale = write_kv_quant(
+                k_pool, v_pool, k_scale, v_scale, i, slots,
+                kk.reshape(C, H, Dh), vv.reshape(C, H, Dh),
+                ncand=ncand)
+            att = paged_attention(q.reshape(C, H, Dh)[None], k_pool[i],
+                                  v_pool[i], tables, qs_row,
+                                  block_size, k_scale=k_scale[i],
+                                  v_scale=v_scale[i])[0]
+        else:
+            k_pool, v_pool = write_kv(k_pool, v_pool, i,
+                                      slots, kk.reshape(C, H, Dh),
+                                      vv.reshape(C, H, Dh))
+            att = paged_attention(q.reshape(C, H, Dh)[None], k_pool[i],
+                                  v_pool[i], tables, qs_row,
+                                  block_size)[0]                   # (C,H,Dh)
+        x = x + _mm(att.reshape(C, D), params[pre + "wo"])
         h = _layer_norm(x, params[pre + "ln2_g"], params[pre + "ln2_b"])
         x = x + _ffn(params, pre, h[None], cfg)[0]
     h_last = _layer_norm(x[last_idx], params["lnf_g"], params["lnf_b"])
     logits = (h_last @ params["head"]).astype(jnp.float32)         # (V,)
+    if quant:
+        return k_pool, v_pool, k_scale, v_scale, logits
     return k_pool, v_pool, logits
 
 
 def _tf_spec_score(params, k_pool, v_pool, toks, q_starts, counts,
-                   tables, cfg, block_size):
+                   tables, cfg, block_size, k_scale=None, v_scale=None):
     """Speculative scoring pass: the batched generalization of
     `_tf_prefill_chunk`. For each row, toks (B, C) holds [last history
     token, draft_1..draft_k] (zero-padded past that row's true `counts`)
@@ -309,6 +367,7 @@ def _tf_spec_score(params, k_pool, v_pool, toks, q_starts, counts,
     from ..models.transformer import _layer_norm
     from ..ops.pallas_paged import paged_attention
 
+    quant = k_scale is not None
     B, C = toks.shape
     D, H = cfg.d_model, cfg.n_heads
     Dh = D // H
@@ -322,23 +381,39 @@ def _tf_spec_score(params, k_pool, v_pool, toks, q_starts, counts,
         + pos % block_size
     slots = jnp.where(valid, slots, pos % block_size)              # null blk
     flat = slots.reshape(B * C)
+    # each row's C contiguous positions straddle at most
+    # (C-1)//block_size + 2 blocks (incl. the null block)
+    ncand = min(B * ((C - 1) // block_size + 2), B * C)
     for i in range(cfg.n_layers):
         pre = "layer%d_" % i
         h = _layer_norm(x, params[pre + "ln1_g"], params[pre + "ln1_b"])
-        qkv = h @ params[pre + "wqkv"]
+        qkv = _mm(h, params[pre + "wqkv"])
         q, kk, vv = jnp.split(qkv, 3, axis=-1)
-        k_pool, v_pool = write_kv(k_pool, v_pool, i, flat,
-                                  kk.reshape(B * C, H, Dh),
-                                  vv.reshape(B * C, H, Dh))
-        att = paged_attention(q.reshape(B, C, H, Dh), k_pool[i],
-                              v_pool[i], tables,
-                              q_starts.astype(jnp.int32),
-                              block_size)                          # (B,C,H,Dh)
-        x = x + att.reshape(B, C, D) @ params[pre + "wo"]
+        if quant:
+            k_pool, v_pool, k_scale, v_scale = write_kv_quant(
+                k_pool, v_pool, k_scale, v_scale, i, flat,
+                kk.reshape(B * C, H, Dh), vv.reshape(B * C, H, Dh),
+                ncand=ncand)
+            att = paged_attention(q.reshape(B, C, H, Dh), k_pool[i],
+                                  v_pool[i], tables,
+                                  q_starts.astype(jnp.int32),
+                                  block_size, k_scale=k_scale[i],
+                                  v_scale=v_scale[i])
+        else:
+            k_pool, v_pool = write_kv(k_pool, v_pool, i, flat,
+                                      kk.reshape(B * C, H, Dh),
+                                      vv.reshape(B * C, H, Dh))
+            att = paged_attention(q.reshape(B, C, H, Dh), k_pool[i],
+                                  v_pool[i], tables,
+                                  q_starts.astype(jnp.int32),
+                                  block_size)                      # (B,C,H,Dh)
+        x = x + _mm(att.reshape(B, C, D), params[pre + "wo"])
         h = _layer_norm(x, params[pre + "ln2_g"], params[pre + "ln2_b"])
         x = x + _ffn(params, pre, h, cfg)
     h = _layer_norm(x, params["lnf_g"], params["lnf_b"])
     logits = (h @ params["head"]).astype(jnp.float32)              # (B,C,V)
+    if quant:
+        return k_pool, v_pool, k_scale, v_scale, logits
     return k_pool, v_pool, logits
 
 
@@ -359,16 +434,42 @@ class TransformerLM:
         self.cfg = cfg
         self.vocab = cfg.vocab
         self.max_len = cfg.max_len
+        self.weight_quant = None
+        self.params_f32 = None    # original weights once quantized —
+                                  # the tp placement + self-draft source
         self._prefill_jit = None
         self._decode_jit = None
         self._decode_paged_jit = None
         self._prefill_chunk_jit = None
         self._spec_score_jit = None
+        self._decode_paged_q_jit = None
+        self._prefill_chunk_q_jit = None
+        self._spec_score_q_jit = None
 
     def cache_spec(self):
         dt = self.params["embed"].dtype
         return (self.cfg.n_layers, self.cfg.n_heads,
                 self.cfg.d_model // self.cfg.n_heads, dt)
+
+    def quantize_weights(self, mode="int8"):
+        """Quantize the matmul weights ONCE at load (ISSUE 20):
+        per-channel symmetric int8 for wqkv/wo/w1/w2 (each becomes a
+        `{"q": int8, "s": f32-per-output-channel}` dict the step
+        bodies' `_mm` dispatch consumes); embeddings, positional table,
+        layer norms, and the LM head stay f32 — they are small, and the
+        logits' final projection dominates the error budget. MoE expert
+        stacks (3-D w1/w2) stay f32 too. Idempotent; must run BEFORE
+        `bind` so the jits trace the quantized pytree."""
+        if str(mode) != "int8":
+            raise MXNetError("weight_quant %r is not supported (int8 "
+                             "or None)" % (mode,))
+        if self.weight_quant:
+            return
+        from ..predict import quantize_lm_params
+        self.params_f32 = self.params
+        self.params = quantize_lm_params(self.params, self.cfg.n_layers,
+                                         mode=mode)
+        self.weight_quant = "int8"
 
     #: compile-watchdog argument names, shared by every decode/prefill
     #: signature diff ("tables: shape (1, 1) -> (1, 2) (axis 1)")
@@ -380,8 +481,11 @@ class TransformerLM:
                    "length", "last_idx", "table_row")
     _SPEC_ARGS = ("params", "k_pool", "v_pool", "tokens", "q_starts",
                   "counts", "tables")
+    _DECODE_Q_ARGS = _DECODE_ARGS + ("k_scale", "v_scale")
+    _CHUNK_Q_ARGS = _CHUNK_ARGS + ("k_scale", "v_scale")
+    _SPEC_Q_ARGS = _SPEC_ARGS + ("k_scale", "v_scale")
 
-    def bind(self, block_size):
+    def bind(self, block_size, kv_quant=False):
         cfg = self.cfg
         instrument = telemetry.introspect.instrument
         # `variant=` tags each jit's entries in the persistent AOT cache
@@ -416,8 +520,31 @@ class TransformerLM:
                 p, k, v, t, qs, cn, tb, cfg, block_size)),
             site="serving.spec_score", phase="decode",
             argnames=self._SPEC_ARGS, variant="spec_score")
+        if kv_quant:
+            # int8-pool variants (ISSUE 20): distinct AOT variant tags —
+            # the quant step traces extra scale operands, and a warm
+            # load must never hand the f32 path a quantized executable
+            self._decode_paged_q_jit = instrument(jax.jit(
+                lambda p, k, v, t, pos, tb, ks, vs: _tf_decode_paged(
+                    p, k, v, t, pos, tb, cfg, block_size,
+                    k_scale=ks, v_scale=vs)),
+                site="serving.decode", phase="decode",
+                argnames=self._DECODE_Q_ARGS, variant="decode_paged_q8")
+            self._prefill_chunk_q_jit = instrument(jax.jit(
+                lambda p, k, v, t, qs, ln, li, tb, ks, vs:
+                    _tf_prefill_chunk(p, k, v, t, qs, ln, li, tb, cfg,
+                                      block_size, k_scale=ks,
+                                      v_scale=vs)),
+                site="serving.prefill", phase="prefill",
+                argnames=self._CHUNK_Q_ARGS, variant="prefill_chunk_q8")
+            self._spec_score_q_jit = instrument(jax.jit(
+                lambda p, k, v, t, qs, cn, tb, ks, vs: _tf_spec_score(
+                    p, k, v, t, qs, cn, tb, cfg, block_size,
+                    k_scale=ks, v_scale=vs)),
+                site="serving.spec_score", phase="decode",
+                argnames=self._SPEC_Q_ARGS, variant="spec_score_q8")
 
-    def bind_tp(self, block_size, mesh):
+    def bind_tp(self, block_size, mesh, kv_quant=False):
         """Build the tensor-parallel step functions over `mesh` (axis
         'tp'): head-major-resharded params plus shard_map-wrapped
         decode/prefill-chunk (serving/tp.py). `self.params` stays the
@@ -429,9 +556,19 @@ class TransformerLM:
         traffic shapes."""
         from .tp import (place_tp_params, build_tp_decode,
                          build_tp_prefill_chunk, build_tp_spec_score,
-                         tp_cache_variant)
+                         tp_cache_variant, quantize_tp_params)
         instrument = telemetry.introspect.instrument
-        self._tp_params = place_tp_params(self.params, self.cfg, mesh)
+        # weight quant composes with tp by quantizing AFTER shard
+        # placement: the f32 originals are resharded, then each chip
+        # quantizes its own shard so scales are chip-local (a
+        # row-parallel shard's per-output-channel scales differ per
+        # chip — each dequantizes its partial before the psum)
+        src = self.params_f32 if self.weight_quant else self.params
+        self._tp_params = place_tp_params(src, self.cfg, mesh)
+        wq = bool(self.weight_quant)
+        if wq:
+            self._tp_params = quantize_tp_params(self._tp_params,
+                                                 self.cfg, mesh)
         # the tp variant embeds the mesh's DEVICE WINDOW: two replicas'
         # tp steps have equal shapes and identity-free sharding
         # descriptions but compile against different chips — their AOT
@@ -439,18 +576,39 @@ class TransformerLM:
         # committed args; the tag is the belt under that brace)
         tpv = tp_cache_variant(mesh)
         self._decode_tp_jit = instrument(
-            build_tp_decode(self.cfg, block_size, mesh),
+            build_tp_decode(self.cfg, block_size, mesh, weight_quant=wq),
             site="serving.decode", phase="decode",
             argnames=self._DECODE_ARGS, variant="decode_tp:" + tpv)
         self._prefill_chunk_tp_jit = instrument(
-            build_tp_prefill_chunk(self.cfg, block_size, mesh),
+            build_tp_prefill_chunk(self.cfg, block_size, mesh,
+                                   weight_quant=wq),
             site="serving.prefill", phase="prefill",
             argnames=self._CHUNK_ARGS,
             variant="prefill_chunk_tp:" + tpv)
         self._spec_score_tp_jit = instrument(
-            build_tp_spec_score(self.cfg, block_size, mesh),
+            build_tp_spec_score(self.cfg, block_size, mesh,
+                                weight_quant=wq),
             site="serving.spec_score", phase="decode",
             argnames=self._SPEC_ARGS, variant="spec_score_tp:" + tpv)
+        if kv_quant:
+            self._decode_tp_q_jit = instrument(
+                build_tp_decode(self.cfg, block_size, mesh,
+                                kv_quant=True, weight_quant=wq),
+                site="serving.decode", phase="decode",
+                argnames=self._DECODE_Q_ARGS,
+                variant="decode_tp_q8:" + tpv)
+            self._prefill_chunk_tp_q_jit = instrument(
+                build_tp_prefill_chunk(self.cfg, block_size, mesh,
+                                       kv_quant=True, weight_quant=wq),
+                site="serving.prefill", phase="prefill",
+                argnames=self._CHUNK_Q_ARGS,
+                variant="prefill_chunk_tp_q8:" + tpv)
+            self._spec_score_tp_q_jit = instrument(
+                build_tp_spec_score(self.cfg, block_size, mesh,
+                                    kv_quant=True, weight_quant=wq),
+                site="serving.spec_score", phase="decode",
+                argnames=self._SPEC_Q_ARGS,
+                variant="spec_score_tp_q8:" + tpv)
 
     def prefill(self, k, v, tokens, length, table_row):
         return self._prefill_jit(self.params, k, v, tokens, length,
@@ -486,6 +644,46 @@ class TransformerLM:
         return self._prefill_chunk_tp_jit(self._tp_params, k, v, tokens,
                                           q_start, length, last_idx,
                                           table_row)
+
+    # int8-pool steps (ISSUE 20): same signatures plus the scale
+    # sidecars, returning the updated scales with the pools
+
+    def decode_paged_q(self, k, v, k_scale, v_scale, tokens, positions,
+                       tables):
+        return self._decode_paged_q_jit(self.params, k, v, tokens,
+                                        positions, tables, k_scale,
+                                        v_scale)
+
+    def prefill_chunk_q(self, k, v, k_scale, v_scale, tokens, q_start,
+                        length, last_idx, table_row):
+        return self._prefill_chunk_q_jit(self.params, k, v, tokens,
+                                         q_start, length, last_idx,
+                                         table_row, k_scale, v_scale)
+
+    def spec_score_q(self, k, v, k_scale, v_scale, tokens, q_starts,
+                     counts, tables):
+        return self._spec_score_q_jit(self.params, k, v, tokens,
+                                      q_starts, counts, tables,
+                                      k_scale, v_scale)
+
+    def decode_tp_q(self, k, v, k_scale, v_scale, tokens, positions,
+                    tables):
+        return self._decode_tp_q_jit(self._tp_params, k, v, tokens,
+                                     positions, tables, k_scale,
+                                     v_scale)
+
+    def prefill_chunk_tp_q(self, k, v, k_scale, v_scale, tokens,
+                           q_start, length, last_idx, table_row):
+        return self._prefill_chunk_tp_q_jit(self._tp_params, k, v,
+                                            tokens, q_start, length,
+                                            last_idx, table_row,
+                                            k_scale, v_scale)
+
+    def spec_score_tp_q(self, k, v, k_scale, v_scale, tokens, q_starts,
+                        counts, tables):
+        return self._spec_score_tp_q_jit(self._tp_params, k, v, tokens,
+                                         q_starts, counts, tables,
+                                         k_scale, v_scale)
 
 
 # ---------------------------------------------------------------------------
@@ -615,17 +813,19 @@ class Engine:
     _FROZEN_FLAGS = frozenset(
         ("paged", "paged_requested", "prefill_chunk", "tp",
          "tp_requested", "mesh", "prefix_cache", "aot_cache",
-         "spec", "spec_requested", "spec_k", "draft"))
+         "spec", "spec_requested", "spec_k", "draft",
+         "kv_quant", "kv_quant_requested", "weight_quant"))
 
     def __init__(self, model, max_batch=8, max_len=None, block_size=16,
                  num_blocks=None, keep_logits=False, paged=None,
                  prefill_chunk=None, tp=None, devices=None,
                  prefix_cache=None, aot_cache=None, draft=None,
-                 spec=None, spec_k=None):
+                 spec=None, spec_k=None, kv_quant=None,
+                 weight_quant=None):
         from ..ops.pallas_paged import paged_enabled, paged_eligible
         from ..ops.pallas_attention import default_interpret
         from .tp import (serving_tp, tp_fallback_reason, build_tp_mesh,
-                         kv_pool_spec)
+                         kv_pool_spec, kv_scale_spec)
         from jax.sharding import NamedSharding
         from .. import aot
         # persistent AOT executable cache (ISSUE 16): `aot_cache=` names
@@ -666,14 +866,42 @@ class Engine:
             paged_enabled() if paged is None else bool(paged))
         self.paged = False
         self.prefill_chunk = 0
+        # quantized serving (ISSUE 20): env defaults
+        # (MXNET_QUANTIZED_KV / MXNET_QUANTIZED_WEIGHTS), explicit
+        # `kv_quant=` / `weight_quant=` override. The standing contract
+        # generalizes from "flag switches placement, never logits" to
+        # "flag switches PRECISION, with a pinned tolerance + logit-
+        # error budget vs the f32 oracle" — the gather path and the f32
+        # pool stay verbatim as the oracle; ineligible configs record
+        # their reason on `kv_quant_fallback` / `weight_quant_fallback`
+        # and fall back.
+        kvq_req = (quantized_kv_enabled() if kv_quant is None
+                   else bool(kv_quant))
+        wq_req = (quantized_weights_env() if weight_quant is None
+                  else (weight_quant or None))
+        self.kv_quant_requested = kvq_req
+        self.kv_quant = False
+        self.kv_quant_fallback = None
+        self.weight_quant = None
+        self.weight_quant_fallback = None
+        self.quant_logit_error = None   # parity seam: bench/tests record
+                                        # the measured max |logit - f32|
+        if wq_req is not None:
+            if not hasattr(model, "quantize_weights"):
+                self.weight_quant_fallback = (
+                    "model family has no weight hooks (BlockLM/"
+                    "ExportedLM serve their own parameters f32)")
+            else:
+                model.quantize_weights(str(wq_req))
+                self.weight_quant = str(wq_req)
+        if kvq_req and not model.uses_cache:
+            self.kv_quant_fallback = ("model family has no cache hooks "
+                                      "(int8 KV needs the paged pool)")
         if model.uses_cache:
             nl, nh, dh, dt = model.cache_spec()
             self._nblk = max(1, math.ceil(self.max_len / block_size))
             if num_blocks is None:
                 num_blocks = max_batch * self._nblk + 1
-            self.cache = PagedKVCache(nl, nh, dh, block_size=block_size,
-                                      num_blocks=num_blocks, dtype=dt)
-            model.bind(block_size)
             if self.paged_requested:
                 self.prefill_chunk = min(self.max_len,
                                          int(prefill_chunk
@@ -681,6 +909,30 @@ class Engine:
                 self.paged = paged_eligible(dh, block_size,
                                             self.prefill_chunk,
                                             default_interpret())
+            if kvq_req:
+                if not self.paged:
+                    self.kv_quant_fallback = (
+                        "int8 KV needs the paged path "
+                        "(MXNET_PAGED_ATTENTION=1 / Engine(paged=True) "
+                        "and a tileable config); the gather oracle "
+                        "reads the f32 pool")
+                elif not paged_eligible(dh, block_size,
+                                        self.prefill_chunk,
+                                        default_interpret(), quant=True):
+                    self.kv_quant_fallback = (
+                        "block_size %d is not a multiple of the int8 "
+                        "sublane tile (32) on this backend; the f32 "
+                        "pool stays" % block_size)
+                else:
+                    self.kv_quant = True
+            self.cache = PagedKVCache(
+                nl, nh, dh, block_size=block_size,
+                num_blocks=num_blocks, dtype=dt,
+                kv_dtype="int8" if self.kv_quant else None)
+            if self.kv_quant:
+                model.bind(block_size, kv_quant=True)
+            else:
+                model.bind(block_size)
             if tp_req > 1:
                 reason = tp_fallback_reason(model.cfg, self.paged,
                                             tp_req, devices)
@@ -690,8 +942,14 @@ class Engine:
                     self.mesh = build_tp_mesh(tp_req, devices)
                     self.tp = tp_req
                     self.cache.place(
-                        NamedSharding(self.mesh, kv_pool_spec()))
-                    model.bind_tp(block_size, self.mesh)
+                        NamedSharding(self.mesh, kv_pool_spec()),
+                        NamedSharding(self.mesh, kv_scale_spec())
+                        if self.kv_quant else None)
+                    if self.kv_quant:
+                        model.bind_tp(block_size, self.mesh,
+                                      kv_quant=True)
+                    else:
+                        model.bind_tp(block_size, self.mesh)
         elif tp_req > 1:
             self.tp_fallback = ("model family has no cache hooks "
                                 "(BlockLM/ExportedLM run single-device)")
@@ -703,6 +961,8 @@ class Engine:
         self.prefix_cache = None
         self.prefix_cache_fallback = None
         self._cow_jit = None
+        self._zero_jit = None     # scale-reset jit for freshly allocated
+                                  # blocks on the int8 pool
         want_prefix = (prefix_cache_enabled() if prefix_cache is None
                        else bool(prefix_cache))
         if want_prefix:
@@ -824,6 +1084,14 @@ class Engine:
         if self.cache is None:
             return 0
         nl, nh, dh, dt = self.model.cache_spec()
+        if self.cache.quantized:
+            # int8 payload plus the f32 per-block-per-head scale
+            # sidecars amortized over the block's tokens — the ledger
+            # must price the QUANTIZED layout or disagg bytes-saved
+            # overstates a migration hop's savings ~4x
+            scale_bytes = math.ceil(2 * nl * nh * 4
+                                    / float(self.cache.block_size))
+            return 2 * nl * nh * dh * 1 + scale_bytes
         return 2 * nl * nh * dh * np.dtype(dt).itemsize
 
     @property
@@ -896,6 +1164,8 @@ class Engine:
             n = self.blocks_needed(L, max_new)
             if self.prefix_cache is None:
                 ids = self.cache.pool.try_alloc(n)
+                if ids is not None and self.kv_quant:
+                    self._zero_scales(ids)
             else:
                 ids = self._begin_cached(seq, prompt, n)
             if ids is None:
@@ -903,6 +1173,24 @@ class Engine:
             seq.block_ids = ids
             seq.table_row = self.cache.table_row(ids, self._nblk)
         return seq
+
+    def _zero_scales(self, ids):
+        """Reset the int8 pool's scale sidecars for freshly allocated
+        (possibly reclaimed) blocks: `write_kv_quant`'s per-block scale
+        is a monotonic max, so a previous occupant's scale would pin the
+        new tokens' quantization step far too coarse. Padded to pow2
+        id-array buckets so the jit lattice stays bounded; the pad
+        entries hit block 0 (the null block, whose scale is always 0)."""
+        if not ids:
+            return
+        n = pow2_bucket(len(ids), lo=1, hi=self.cache.num_blocks)
+        arr = np.zeros((n,), np.int32)
+        arr[:len(ids)] = ids
+        if self._zero_jit is None:
+            self._zero_jit = jax.jit(zero_block_scales,
+                                     donate_argnums=(0, 1))
+        self.cache.k_scale, self.cache.v_scale = self._zero_jit(
+            self.cache.k_scale, self.cache.v_scale, jnp.asarray(arr))
 
     def _begin_cached(self, seq, prompt, n):
         """Prefix-cache admission: point the leading table entries at
@@ -923,6 +1211,10 @@ class Engine:
             if held:
                 pool.free(held)
             return None
+        if self.kv_quant:
+            # fresh (possibly reclaimed) blocks first — a COW copy below
+            # then installs the shared block's scales over fresh[0]
+            self._zero_scales(fresh)
         hit = len(full) * self.cache.block_size
         if tail is not None:
             src, m = tail
@@ -930,11 +1222,22 @@ class Engine:
                 # donate the pools so XLA updates the one block in
                 # place instead of materializing a full-pool copy per
                 # COW (backends without donation just warn and copy)
-                self._cow_jit = jax.jit(copy_block,
-                                        donate_argnums=(0, 1))
-            self.cache.k, self.cache.v = self._cow_jit(
-                self.cache.k, self.cache.v, jnp.int32(src),
-                jnp.int32(fresh[0]))
+                if self.kv_quant:
+                    self._cow_jit = jax.jit(copy_block_quant,
+                                            donate_argnums=(0, 1, 2, 3))
+                else:
+                    self._cow_jit = jax.jit(copy_block,
+                                            donate_argnums=(0, 1))
+            if self.kv_quant:
+                (self.cache.k, self.cache.v, self.cache.k_scale,
+                 self.cache.v_scale) = self._cow_jit(
+                    self.cache.k, self.cache.v, self.cache.k_scale,
+                    self.cache.v_scale, jnp.int32(src),
+                    jnp.int32(fresh[0]))
+            else:
+                self.cache.k, self.cache.v = self._cow_jit(
+                    self.cache.k, self.cache.v, jnp.int32(src),
+                    jnp.int32(fresh[0]))
             pool.free([src])          # drop the transient tail ref: the
                                       # private copy replaces it in the
                                       # table
@@ -971,14 +1274,29 @@ class Engine:
                 toks[:min(C, L - qs)] = prompt[qs:qs + C]
                 w = pow2_bucket(self.cache.blocks_for(qs + C),
                                 lo=1, hi=self._nblk)
-                chunk_fn = self.model.prefill_chunk_tp if self.tp > 1 \
-                    else self.model.prefill_chunk
+                if self.kv_quant:
+                    chunk_fn = self.model.prefill_chunk_tp_q \
+                        if self.tp > 1 else self.model.prefill_chunk_q
+                else:
+                    chunk_fn = self.model.prefill_chunk_tp \
+                        if self.tp > 1 else self.model.prefill_chunk
                 with self._count("prefill", (C, w)):
-                    self.cache.k, self.cache.v, logits = chunk_fn(
-                        self.cache.k, self.cache.v, jnp.asarray(toks),
-                        jnp.int32(qs), jnp.int32(L),
-                        jnp.int32(min(L - 1 - qs, C - 1)),
-                        jnp.asarray(seq.table_row[:w]))
+                    if self.kv_quant:
+                        (self.cache.k, self.cache.v, self.cache.k_scale,
+                         self.cache.v_scale, logits) = chunk_fn(
+                            self.cache.k, self.cache.v,
+                            self.cache.k_scale, self.cache.v_scale,
+                            jnp.asarray(toks), jnp.int32(qs),
+                            jnp.int32(L),
+                            jnp.int32(min(L - 1 - qs, C - 1)),
+                            jnp.asarray(seq.table_row[:w]))
+                    else:
+                        self.cache.k, self.cache.v, logits = chunk_fn(
+                            self.cache.k, self.cache.v,
+                            jnp.asarray(toks), jnp.int32(qs),
+                            jnp.int32(L),
+                            jnp.int32(min(L - 1 - qs, C - 1)),
+                            jnp.asarray(seq.table_row[:w]))
                 seq.prefilled = min(L, qs + C)
                 if seq.prefilled < L:
                     return False
@@ -1087,15 +1405,28 @@ class Engine:
                 if self.paged:
                     # same (batch, width) signature lattice whether the
                     # step runs on one chip or sharded over the tp mesh
-                    step_fn = self.model.decode_tp if self.tp > 1 \
-                        else self.model.decode_paged
+                    if self.kv_quant:
+                        step_fn = self.model.decode_tp_q if self.tp > 1 \
+                            else self.model.decode_paged_q
+                    else:
+                        step_fn = self.model.decode_tp if self.tp > 1 \
+                            else self.model.decode_paged
                     sig = (bb, w)
                 else:
                     sig = bb
                 with self._count("decode", sig):
-                    self.cache.k, self.cache.v, logits, nxt = step_fn(
-                        self.cache.k, self.cache.v, jnp.asarray(toks),
-                        jnp.asarray(pos), jnp.asarray(tabs))
+                    if self.kv_quant:
+                        (self.cache.k, self.cache.v, self.cache.k_scale,
+                         self.cache.v_scale, logits, nxt) = step_fn(
+                            self.cache.k, self.cache.v,
+                            self.cache.k_scale, self.cache.v_scale,
+                            jnp.asarray(toks), jnp.asarray(pos),
+                            jnp.asarray(tabs))
+                    else:
+                        self.cache.k, self.cache.v, logits, nxt = \
+                            step_fn(self.cache.k, self.cache.v,
+                                    jnp.asarray(toks), jnp.asarray(pos),
+                                    jnp.asarray(tabs))
                 nxt = np.asarray(nxt)
                 logits = np.asarray(logits) if self.keep_logits else None
             else:
@@ -1208,13 +1539,25 @@ class Engine:
                 qs[i] = len(s.tokens) - 1
                 counts[i] = 1 + nbs[i]
                 tabs[i] = s.table_row[:w]
-            score_fn = self.model.spec_score_tp if self.tp > 1 \
-                else self.model.spec_score
+            if self.kv_quant:
+                score_fn = self.model.spec_score_tp_q if self.tp > 1 \
+                    else self.model.spec_score_q
+            else:
+                score_fn = self.model.spec_score_tp if self.tp > 1 \
+                    else self.model.spec_score
             with self._count("decode", ("spec", bb, w)):
-                self.cache.k, self.cache.v, logits = score_fn(
-                    self.cache.k, self.cache.v, jnp.asarray(toks),
-                    jnp.asarray(qs), jnp.asarray(counts),
-                    jnp.asarray(tabs))
+                if self.kv_quant:
+                    (self.cache.k, self.cache.v, self.cache.k_scale,
+                     self.cache.v_scale, logits) = score_fn(
+                        self.cache.k, self.cache.v, self.cache.k_scale,
+                        self.cache.v_scale, jnp.asarray(toks),
+                        jnp.asarray(qs), jnp.asarray(counts),
+                        jnp.asarray(tabs))
+                else:
+                    self.cache.k, self.cache.v, logits = score_fn(
+                        self.cache.k, self.cache.v, jnp.asarray(toks),
+                        jnp.asarray(qs), jnp.asarray(counts),
+                        jnp.asarray(tabs))
             logits = np.asarray(logits)                    # (bb, C, V)
             accepted = proposed = emitted_n = 0
             dur_us = time.perf_counter_ns() // 1000 - t0_us

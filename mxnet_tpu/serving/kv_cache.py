@@ -176,28 +176,58 @@ class PagedKVCache:
     docstring). They are plain jax arrays threaded through the jitted
     engine functions (functional update: each step returns the new
     pools).
+
+    With ``kv_dtype="int8"`` the pools store symmetric-per-block int8
+    and grow f32 scale SIDECARS ``k_scale``/``v_scale`` of shape
+    (n_layers, num_blocks, n_heads) living beside the pool in the same
+    contiguous block layout: one scale per (layer, block, head), so the
+    paged kernel scalar-prefetches exactly one f32 per DMA'd block per
+    head and dequantizes in VMEM. `blocks_for`, tables, and the host
+    free-list are precision-agnostic — a block id means the same thing
+    in both layouts.
     """
 
     def __init__(self, n_layers, n_heads, head_dim, block_size=16,
-                 num_blocks=64, dtype=jnp.float32):
+                 num_blocks=64, dtype=jnp.float32, kv_dtype=None):
         self.n_layers = n_layers
         self.n_heads = n_heads
         self.head_dim = head_dim
         self.block_size = block_size
         self.num_blocks = num_blocks
         self.pool = BlockPool(num_blocks)
+        if kv_dtype is not None and str(kv_dtype) != "int8":
+            raise MXNetError("kv_dtype %r is not supported (int8 or "
+                             "None)" % (kv_dtype,))
+        self.kv_dtype = "int8" if kv_dtype is not None else None
         shape = (n_layers, num_blocks, block_size, n_heads, head_dim)
-        self.k = jnp.zeros(shape, dtype)
-        self.v = jnp.zeros(shape, dtype)
+        pool_dtype = jnp.int8 if self.kv_dtype else dtype
+        self.k = jnp.zeros(shape, pool_dtype)
+        self.v = jnp.zeros(shape, pool_dtype)
+        if self.kv_dtype:
+            sshape = (n_layers, num_blocks, n_heads)
+            self.k_scale = jnp.zeros(sshape, jnp.float32)
+            self.v_scale = jnp.zeros(sshape, jnp.float32)
+        else:
+            self.k_scale = self.v_scale = None
 
-    def place(self, sharding):
+    @property
+    def quantized(self):
+        return self.kv_dtype is not None
+
+    def place(self, sharding, scale_sharding=None):
         """Lay the device pools out under `sharding` (a NamedSharding).
         The tensor-parallel engine shards the HEAD axis — each chip owns
         n_heads/k heads of every block — so block ids, tables, and the
-        host free-list are placement-agnostic and unchanged."""
+        host free-list are placement-agnostic and unchanged. A quantized
+        pool's scale sidecars shard on the same head axis via
+        `scale_sharding` (their (L, NB, H) layout drops the trailing
+        token/dim axes), so each chip's scales are chip-local."""
         import jax
         self.k = jax.device_put(self.k, sharding)
         self.v = jax.device_put(self.v, sharding)
+        if self.quantized and scale_sharding is not None:
+            self.k_scale = jax.device_put(self.k_scale, scale_sharding)
+            self.v_scale = jax.device_put(self.v_scale, scale_sharding)
 
     def blocks_for(self, n_tokens):
         """Blocks needed to hold n_tokens KV entries — by construction
@@ -261,6 +291,87 @@ def copy_block(k_pool, v_pool, src, dst):
     k_pool = k_pool.at[:, dst].set(k_pool[:, src])
     v_pool = v_pool.at[:, dst].set(v_pool[:, src])
     return k_pool, v_pool
+
+
+def write_kv_quant(k_pool, v_pool, k_scale, v_scale, layer, slots,
+                   k_new, v_new, ncand=None):
+    """Quantizing scatter for an int8 pool: write N new K/V rows into one
+    layer's flat slots, requantizing each touched block symmetric-per-
+    block-per-head. slots (N,) int32; k_new/v_new (N, n_heads, head_dim)
+    float; scales (n_layers, num_blocks, n_heads) f32.
+
+    Per touched block: scale goes MONOTONIC — s_new = max(s_old,
+    amax(new rows)/127) — so rows written earlier under a smaller scale
+    are rescaled in place (dequant with s_old, requant with s_new; when
+    the scale is unchanged requantization is the exact identity, so a
+    block is only re-rounded when a larger row actually arrives). The
+    write unit is the whole block, not the token: an append rewrites
+    block_size slots where the f32 path rewrites one. That amplification
+    is on the (small) write side; the ~2x saving is on the read side the
+    kernel DMAs every step.
+
+    `ncand` is the static upper bound on DISTINCT blocks the N slots can
+    touch (default N): the N contiguous positions of a prefill chunk
+    span at most (N-1)//block_size + 2 blocks incl. the null block, so
+    callers that know the span pass it to shrink the gather. Writes
+    aimed at the null block (padded rows) land there like the f32 path —
+    its contents and scale are garbage that length masking never reads.
+    """
+    bs = k_pool.shape[2]
+    n = slots.shape[0]
+    if ncand is None:
+        ncand = n
+    ncand = min(ncand, n)
+    tb, off = slots // bs, slots % bs                       # (N,)
+    cand = jnp.unique(tb, size=ncand, fill_value=0)         # (ncand,)
+    # token i updates candidate row ci: every tb[i] is present in cand
+    # by the ncand bound, and duplicate fill rows compute identical
+    # updates from identical inputs, so the scatter below is consistent
+    ci = jnp.argmax(cand[None, :] == tb[:, None], axis=1)   # (N,)
+
+    def upd(pool, scale, new):
+        new = new.astype(jnp.float32)
+        a = jnp.max(jnp.abs(new), axis=-1)                  # (N, H)
+        plane = scale[layer].at[tb].max(a / 127.0)          # (NB, H)
+        s_old = scale[layer][cand]                          # (ncand, H)
+        s_new = plane[cand]
+        s_safe = jnp.where(s_new > 0, s_new, 1.0)
+        blk = pool[layer][cand].astype(jnp.float32) \
+            * s_old[:, None, :, None]                       # (ncand,bs,H,Dh)
+        blk = blk.at[ci, off].set(new)
+        q = jnp.clip(jnp.rint(blk / s_safe[:, None, :, None]),
+                     -127, 127).astype(jnp.int8)
+        return (pool.at[layer, cand].set(q),
+                scale.at[layer].set(plane))
+
+    k_pool, k_scale = upd(k_pool, k_scale, k_new)
+    v_pool, v_scale = upd(v_pool, v_scale, v_new)
+    return k_pool, v_pool, k_scale, v_scale
+
+
+def copy_block_quant(k_pool, v_pool, k_scale, v_scale, src, dst):
+    """`copy_block` for an int8 pool: the COW copy moves the scale
+    sidecars WITH the data — a private copy under the source's scale is
+    bit-identical to the shared original, so prefix-cache divergence
+    stays logit-invariant under quantization."""
+    k_pool = k_pool.at[:, dst].set(k_pool[:, src])
+    v_pool = v_pool.at[:, dst].set(v_pool[:, src])
+    k_scale = k_scale.at[:, dst].set(k_scale[:, src])
+    v_scale = v_scale.at[:, dst].set(v_scale[:, src])
+    return k_pool, v_pool, k_scale, v_scale
+
+
+def zero_block_scales(k_scale, v_scale, ids):
+    """Reset the scale sidecars of freshly ALLOCATED blocks (ids (m,)
+    int32, null-padded — zeroing the null block's garbage scale is
+    harmless). A reused block id otherwise inherits its previous
+    occupant's scale, and the monotonic max in `write_kv_quant` would
+    quantize the new tokens at the stale (possibly much larger) scale —
+    a silent precision leak. Prefix-cache SHARED blocks keep their
+    scales: their data is reused, so their scale still describes it."""
+    k_scale = k_scale.at[:, ids].set(0.0)
+    v_scale = v_scale.at[:, ids].set(0.0)
+    return k_scale, v_scale
 
 
 def gather_kv(k_pool, v_pool, layer, block_table, block_size):

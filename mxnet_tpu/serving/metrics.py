@@ -264,6 +264,11 @@ class ServingMetrics:
                                    "token ratio")
         self._cache_util_last = None
         self._prefill_depth_last = 0
+        # quantized-serving gauges (ISSUE 20), created lazily on the
+        # first quant-enabled engine observed — plain attr here so a
+        # quant-less exposition stays byte-for-byte unchanged (same
+        # idiom as the router's per-role fleet gauges)
+        self._quant_gauges = None
         # prompt tokens whose prefill compute has been observed — the
         # denominator feed for observed_prefill_rate() (plain attr, not
         # an exposition metric: it exists only to rate the h_prefill sum)
@@ -756,6 +761,41 @@ class ServingMetrics:
             self._g_prefix_resident.set(pc.resident_tokens)
             self._g_prefix_blocks.set(len(pc))
             self._g_prefix_hit_rate.set(pc.hit_rate)
+        # quantized-serving observables (ISSUE 20): declared only when a
+        # quant-enabled engine is observed, so the flags-off exposition
+        # stays byte-for-byte identical to the unquantized stack
+        if engine is not None and (getattr(engine, "kv_quant", False)
+                                   or getattr(engine, "weight_quant",
+                                              None)):
+            if self._quant_gauges is None:
+                g = self.registry.gauge
+                self._quant_gauges = {
+                    "kv": g("serving_kv_quant_enabled",
+                            help="1 while the paged pool stores int8 "
+                                 "KV blocks (dequantized in-VMEM by "
+                                 "the paged kernels)"),
+                    "w": g("serving_weight_quant_enabled",
+                           help="1 while the matmul weights serve "
+                                "int8 per-channel (embeds/norms/head "
+                                "stay f32)"),
+                    "bpt": g("serving_kv_quant_bytes_per_token",
+                             help="KV bytes one token occupies under "
+                                  "the engine's layout (int8 payload "
+                                  "+ amortized f32 scale sidecars "
+                                  "when quantized)"),
+                    "err": g("serving_quant_max_logit_error",
+                             help="max |quant - f32 oracle| logit "
+                                  "error last measured against this "
+                                  "engine (parity seam fed by the "
+                                  "bench/tests; 0 until measured)"),
+                }
+            q = self._quant_gauges
+            q["kv"].set(1 if engine.kv_quant else 0)
+            q["w"].set(1 if engine.weight_quant else 0)
+            q["bpt"].set(engine.kv_bytes_per_token())
+            err = getattr(engine, "quant_logit_error", None)
+            if err is not None:
+                q["err"].set(float(err))
 
     def prometheus_text(self, engine=None, scheduler=None):
         """Prometheus text exposition (format 0.0.4) of the server's

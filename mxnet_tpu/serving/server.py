@@ -280,7 +280,7 @@ class LMServer(_HTTPFrontend):
                  tenant_budgets=None, default_priority=0,
                  default_deadline_ms=None, brownout=None,
                  aot_cache=None, role=None, draft=None, spec=None,
-                 spec_k=None):
+                 spec_k=None, kv_quant=None, weight_quant=None):
         adapter = _resolve_model(model, vocab=vocab, max_len=max_len,
                                  time_major=time_major)
         self.engine = Engine(adapter, max_batch=max_batch, max_len=max_len,
@@ -289,7 +289,8 @@ class LMServer(_HTTPFrontend):
                              prefill_chunk=prefill_chunk, tp=tp,
                              devices=devices, prefix_cache=prefix_cache,
                              aot_cache=aot_cache, draft=draft, spec=spec,
-                             spec_k=spec_k)
+                             spec_k=spec_k, kv_quant=kv_quant,
+                             weight_quant=weight_quant)
         self.scheduler = Scheduler(max_batch=max_batch, max_queue=max_queue,
                                    queue_timeout=queue_timeout,
                                    token_budget=token_budget,

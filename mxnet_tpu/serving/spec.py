@@ -140,9 +140,16 @@ def build_draft(draft, model):
     target's own params when the target exposes them."""
     if draft is None:
         n = spec_draft_layers()
-        if n and getattr(model, "params", None) is not None \
+        # a weight-quantized target keeps its f32 originals on
+        # `params_f32` — the draft runs the plain dense forward
+        # (transformer_apply), so it drafts from those; draft precision
+        # only moves the acceptance rate, never the emitted tokens
+        src = getattr(model, "params_f32", None)
+        if src is None:
+            src = getattr(model, "params", None)
+        if n and src is not None \
                 and getattr(model, "cfg", None) is not None:
-            return DraftLM(*self_draft(model.params, model.cfg, n))
+            return DraftLM(*self_draft(src, model.cfg, n))
         return None
     if isinstance(draft, DraftLM):
         return draft

@@ -241,3 +241,29 @@ def test_kl_threshold_does_not_collapse_on_spiky_relu_dist():
     # all samples here) with a near-minimal threshold
     assert frac_clipped < 0.10, (t, frac_clipped)
     assert t > np.percentile(x[x > 0], 75), t
+
+
+def test_dequantize_int32_uses_product_of_scales():
+    """ISSUE 20 regression: the int32 branch of `dequantize` must map
+    one accumulator count to scale_a * scale_b (amax / 127^2), NOT
+    amax / (2^31 - 1). The old convention shrank every dequantized
+    value ~1.3e5x; roundtrips hid it (requantize "calibrated" it away)
+    but any composition on the raw values was poisoned."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.quantization import (quantize, dequantize,
+                                            _int32_range_of_product)
+    rng = np.random.RandomState(0)
+    a = rng.uniform(-3, 3, (64,)).astype(np.float32)
+    b = rng.uniform(-2, 2, (64,)).astype(np.float32)
+    qa, amin, amax = quantize(jnp.asarray(a), -3.0, 3.0)
+    qb, bmin, bmax = quantize(jnp.asarray(b), -2.0, 2.0)
+    acc = jnp.sum(qa.astype(jnp.int32) * qb.astype(jnp.int32))
+    omin, omax = _int32_range_of_product(amin, amax, bmin, bmax, len(a))
+    got = float(dequantize(acc[None], omin, omax)[0])
+    want = float(np.dot(a, b))
+    # int8 rounding noise, measured against the non-cancelled mass of
+    # the product (the dot itself nearly cancels on random data)
+    assert abs(got - want) < 1e-2 * float(np.sum(np.abs(a * b))), \
+        (got, want)
+    # the OLD 2^31-1 convention was ~1.3e5x off — pin the magnitude too
+    assert 0.5 < abs(got) / abs(want) < 2.0, (got, want)

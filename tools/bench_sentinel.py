@@ -81,11 +81,16 @@ _SMALLER_IS_BETTER = ("ms", "s", "us", "seconds")
 #: Speculative decoding (ISSUE 19) too: its hard gates are the bench's
 #: own accepted-per-pass > 1.0 assert and check_line's k+1 ceiling;
 #: the wall-clock A/B inverts under CPU interpret (BENCH_NOTES r19
-#: prediction 2), so absolutes are warnings, never failures
+#: prediction 2), so absolutes are warnings, never failures.
+#: Quantized serving (ISSUE 20) likewise: its hard gates are the
+#: bench's own token-match + logit-budget refusals and check_line's
+#: budget/layout rules; CPU interpret stages int8 blocks through f32
+#: copies, so quant wall-clock off-TPU is a warning, never a failure
 _WARN_ONLY_PREFIXES = ("serving_chaos_", "smoke_serving_chaos_",
                        "serving_disagg_", "smoke_serving_disagg_",
                        "serving_rollout_", "smoke_serving_rollout_",
-                       "serving_spec_", "smoke_serving_spec_")
+                       "serving_spec_", "smoke_serving_spec_",
+                       "serving_quant_", "smoke_serving_quant_")
 
 
 def _device_class(line):

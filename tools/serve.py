@@ -139,6 +139,21 @@ def main():
                     help="draft tokens proposed per decode iteration "
                          "(default: MXNET_SPEC_K or 4; admission prices "
                          "a speculating sequence at k+1 tokens)")
+    ap.add_argument("--kv-quant", action="store_true", default=None,
+                    help="store the paged KV pool as int8 with per-"
+                         "block-per-head f32 scales, dequantized in "
+                         "VMEM inside the paged kernel (~2x less HBM "
+                         "per decode read, ~4x more resident sequences "
+                         "per chip; precision pinned against the f32 "
+                         "oracle — default: MXNET_QUANTIZED_KV; needs "
+                         "the paged path, ineligible configs fall back "
+                         "with the reason printed)")
+    ap.add_argument("--weight-quant", default=None, metavar="MODE",
+                    help="quantize the matmul weights at load: 'int8' "
+                         "= per-output-channel symmetric int8 with "
+                         "dynamic per-row activation quant on the MXU "
+                         "(embeds/norms/head stay f32; default: "
+                         "MXNET_QUANTIZED_WEIGHTS or off)")
     ap.add_argument("--roles", default=None, metavar="SPEC",
                     help="disaggregated fleet layout 'prefill:N,"
                          "decode:M': prefill replicas absorb prompt "
@@ -199,7 +214,9 @@ def main():
                   autoscale=args.autoscale,
                   roles=args.roles,
                   rollout=args.rollout_dir,
-                  spec_k=args.spec_k)
+                  spec_k=args.spec_k,
+                  kv_quant=args.kv_quant,
+                  weight_quant=args.weight_quant)
     if args.respawn_max is not None:
         n = (args.replicas if args.replicas is not None
              else serving.serving_replicas())
@@ -248,6 +265,21 @@ def main():
     else:
         print("speculative decoding: off (--draft N --spec-k K, or "
               "MXNET_SPEC_DECODE=1 + MXNET_SPEC_DRAFT_LAYERS=N)")
+    if eng.kv_quant or eng.weight_quant:
+        print("quantized serving: kv=%s weights=%s — %d KV bytes/token "
+              "(precision pinned vs the f32 oracle; flags frozen at "
+              "construction)"
+              % ("int8" if eng.kv_quant else "f32",
+                 eng.weight_quant or "f32", eng.kv_bytes_per_token()))
+    elif eng.kv_quant_fallback or eng.weight_quant_fallback:
+        if eng.kv_quant_fallback:
+            print("kv quant: OFF — %s" % eng.kv_quant_fallback)
+        if eng.weight_quant_fallback:
+            print("weight quant: OFF — %s" % eng.weight_quant_fallback)
+    else:
+        print("quantized serving: off (--kv-quant / --weight-quant "
+              "int8, or MXNET_QUANTIZED_KV=1 / "
+              "MXNET_QUANTIZED_WEIGHTS=int8)")
     print("tenants: budget=%s tokens/iteration, default priority=%d "
           "(per-request 'tenant'/'priority' JSON fields accepted)"
           % (first.scheduler.tenant_budget or "unbounded",
